@@ -1,0 +1,99 @@
+"""Benchmark driver: CPD-ALS sec/iteration (≙ BASELINE.json primary metric).
+
+Runs rank-50 CPD-ALS on a NELL-2-shaped synthetic sparse tensor
+(3-mode, power-law slice skew; NELL-2 itself — FROSTT, 77M nnz — is not
+downloadable in this environment).  Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "sec/iter", "vs_baseline": N}
+
+``vs_baseline`` is reference_sec_per_iter / ours (higher is better) when
+a measured reference number exists in BASELINE_MEASURED.json; else 1.0.
+
+Env knobs: SPLATT_BENCH_NNZ (default 20_000_000), SPLATT_BENCH_RANK (50),
+SPLATT_BENCH_ITERS (3 timed iterations).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from splatt_tpu.utils.env import apply_env_platform
+
+apply_env_platform()
+
+
+def synthetic_nell2_like(nnz: int, seed: int = 0):
+    """Power-law 3-mode tensor with NELL-2-ish dims (12k × 9k × 29k)."""
+    from splatt_tpu.coo import SparseTensor
+
+    dims = (12092, 9184, 28818)
+    rng = np.random.default_rng(seed)
+    inds = np.empty((3, nnz), dtype=np.int64)
+    for m, d in enumerate(dims):
+        # zipf-ish skew, cycled through the mode so every slice is nonempty
+        raw = rng.zipf(1.3, size=nnz).astype(np.int64)
+        inds[m] = (raw * 2654435761 + rng.integers(0, d, size=nnz)) % d
+    vals = rng.random(nnz)
+    return SparseTensor(inds, vals, dims)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from splatt_tpu.blocked import BlockedSparse
+    from splatt_tpu.config import Options, Verbosity
+    from splatt_tpu.cpd import _make_sweep, init_factors
+    from splatt_tpu.ops.linalg import gram
+
+    nnz = int(os.environ.get("SPLATT_BENCH_NNZ", 20_000_000))
+    rank = int(os.environ.get("SPLATT_BENCH_RANK", 50))
+    iters = int(os.environ.get("SPLATT_BENCH_ITERS", 3))
+
+    tt = synthetic_nell2_like(nnz)
+    opts = Options(random_seed=7, verbosity=Verbosity.NONE,
+                   val_dtype=np.float32)
+    bs = BlockedSparse.from_coo(tt, opts)
+
+    factors = init_factors(tt.dims, rank, opts.seed(), dtype=jnp.float32)
+    grams = [gram(U) for U in factors]
+    sweep = _make_sweep(bs, tt.nmodes, 0.0)
+
+    # warmup / compile
+    f2, g2, *_ = sweep(factors, grams, True)
+    jax.block_until_ready(f2)
+    f2, g2, *rest = sweep(f2, g2, False)
+    jax.block_until_ready(f2)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        f2, g2, *rest = sweep(f2, g2, False)
+    jax.block_until_ready(f2)
+    sec_per_iter = (time.perf_counter() - t0) / iters
+
+    vs = 1.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__),
+                               "BASELINE_MEASURED.json")) as f:
+            measured = json.load(f)
+        ref = measured.get("cpd_sec_per_iter", {}).get(str(nnz))
+        if ref:
+            vs = ref / sec_per_iter
+    except (OSError, json.JSONDecodeError):
+        pass
+
+    print(json.dumps({
+        "metric": f"CPD-ALS sec/iteration, synthetic NELL-2-shaped "
+                  f"(3-mode, {nnz} nnz, rank {rank})",
+        "value": round(sec_per_iter, 4),
+        "unit": "sec/iter",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

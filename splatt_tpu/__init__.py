@@ -1,0 +1,64 @@
+"""splatt-tpu: a TPU-native sparse tensor factorization framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of SPLATT
+("The Surprisingly ParalleL spArse Tensor Toolkit", reference C library):
+Canonical Polyadic Decomposition (CPD) of large sparse tensors via
+Alternating Least Squares, built around the MTTKRP kernel.
+
+Where the reference uses CSF trees + OpenMP locks + MPI messages, this
+framework uses a blocked/padded sparse format, MXU-friendly one-hot
+segment reductions (Pallas), and `jax.sharding` meshes with XLA
+collectives.
+
+Public API surface (mirrors the reference's ``include/splatt.h``):
+
+- :class:`SparseTensor`        (≙ ``sptensor_t``, COO)
+- :class:`BlockedSparse`       (≙ ``splatt_csf``, the compiled device format)
+- :class:`KruskalTensor`       (≙ ``splatt_kruskal``)
+- :func:`load` / :func:`save`  (≙ ``splatt_load`` / tensor writers)
+- :func:`mttkrp`               (≙ ``splatt_mttkrp``)
+- :func:`cpd_als`              (≙ ``splatt_cpd_als``)
+- :func:`default_opts`         (≙ ``splatt_default_opts``)
+"""
+
+from splatt_tpu.config import (
+    MAX_NMODES,
+    BlockAlloc,
+    CommPattern,
+    Decomposition,
+    ModeOrder,
+    Options,
+    Verbosity,
+    default_opts,
+)
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.io import load, save
+from splatt_tpu.blocked import BlockedSparse, ModeLayout
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.mttkrp import mttkrp, mttkrp_stream, mttkrp_blocked
+from splatt_tpu.cpd import cpd_als
+from splatt_tpu.version import __version__, version_major, version_minor
+
+__all__ = [
+    "MAX_NMODES",
+    "BlockAlloc",
+    "CommPattern",
+    "Decomposition",
+    "ModeOrder",
+    "Options",
+    "Verbosity",
+    "default_opts",
+    "SparseTensor",
+    "BlockedSparse",
+    "ModeLayout",
+    "KruskalTensor",
+    "load",
+    "save",
+    "mttkrp",
+    "mttkrp_stream",
+    "mttkrp_blocked",
+    "cpd_als",
+    "__version__",
+    "version_major",
+    "version_minor",
+]

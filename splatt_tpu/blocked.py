@@ -1,0 +1,198 @@
+"""Blocked sparse format — the TPU-native answer to CSF (≙ src/csf.c).
+
+Design (SURVEY §7): CSF's pointer-tree (variable-length fibers,
+data-dependent traversal) is hostile to XLA.  The TPU equivalent of
+"CSF + chains-on-chains partitioning + cache tiling" is a blocked/padded
+layout:
+
+- nonzeros are **sorted by the output mode** (≙ tt_sort + csf mode
+  permutation), then segmented into **fixed-size nnz blocks** — equal work
+  per block *by construction*, which is exactly what the reference's
+  chains-on-chains partitioner (src/thread_partition.c:156-195) achieves
+  dynamically for threads;
+- each block records the first output row it touches (``row_start``) and
+  the layout records the maximum row-span any block covers (``seg_width``)
+  — together these let MTTKRP reduce each block with a small one-hot
+  matmul on the MXU instead of a scatter (the locked/privatized/tiled
+  trichotomy of src/mttkrp.c:104-236 collapses into this);
+- indices are padded to a whole number of blocks with a sentinel row
+  (= dim) and zero values, keeping every shape static for XLA.
+
+The reference's ONEMODE/TWOMODE/ALLMODE allocation policy
+(include/splatt/types_config.h:168-173, src/csf.c:770-814) survives as
+"how many sorted layouts do we precompute": a layout sorted for mode k is
+the fast path for output mode k and a generic (scatter) path otherwise —
+mirroring CSF's root vs. internal/leaf mode traversals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splatt_tpu.config import BlockAlloc, Options, default_opts
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.utils.env import ceil_to as _ceil_to
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ModeLayout:
+    """One sorted+blocked copy of the nonzeros (≙ one splatt_csf).
+
+    Data (device arrays):
+      inds: (nmodes, nnz_pad) int32 coordinates, sorted by ``mode``;
+        pad entries hold ``dim`` for ``mode`` and 0 elsewhere.
+      vals: (nnz_pad,) values, zero-padded.
+      row_start: (nblocks,) int32 — first output row each block touches
+        (``dim`` for all-padding blocks).
+
+    Static metadata:
+      mode: the output mode this layout is sorted for.
+      dim: dims[mode].
+      block: nnz per block (B).
+      seg_width: S — max output-row span of any block, rounded up to a
+        multiple of 8 (f32 sublane); the one-hot reduce is (S×B)@(B×R).
+      nnz: true nonzero count (before padding).
+    """
+
+    inds: jax.Array
+    vals: jax.Array
+    row_start: jax.Array
+    mode: int = dataclasses.field(metadata=dict(static=True))
+    dim: int = dataclasses.field(metadata=dict(static=True))
+    block: int = dataclasses.field(metadata=dict(static=True))
+    seg_width: int = dataclasses.field(metadata=dict(static=True))
+    nnz: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def nnz_pad(self) -> int:
+        return int(self.inds.shape[1])
+
+    @property
+    def nblocks(self) -> int:
+        return int(self.row_start.shape[0])
+
+    @property
+    def nmodes(self) -> int:
+        return int(self.inds.shape[0])
+
+    def storage_bytes(self) -> int:
+        """≙ csf_storage (src/csf.c:729-767)."""
+        return (self.inds.size * self.inds.dtype.itemsize
+                + self.vals.size * self.vals.dtype.itemsize
+                + self.row_start.size * self.row_start.dtype.itemsize)
+
+
+def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
+                 val_dtype=np.float32) -> ModeLayout:
+    """Sort, block and pad the tensor for output mode `mode`.
+
+    ≙ csf_alloc's sort + fiber build (src/csf.c:613-726) with the
+    secondary modes ordered small-first for deterministic layouts
+    (≙ csf_find_mode_order SMALLFIRST policy).
+    """
+    nmodes, nnz = tt.nmodes, tt.nnz
+    others = sorted((m for m in range(nmodes) if m != mode),
+                    key=lambda m: (tt.dims[m], m))
+    order = [mode] + others
+    perm = tt.sort_order(order)
+    dim = tt.dims[mode]
+
+    # Don't let the block dwarf a small tensor: clamp to the padded nnz
+    # count (kept a multiple of 128 for lane alignment).
+    block = max(128, min(block, _ceil_to(max(nnz, 1), 128)))
+    nnz_pad = max(block, _ceil_to(nnz, block))
+    nblocks = nnz_pad // block
+    inds = np.zeros((nmodes, nnz_pad), dtype=np.int32)
+    inds[:, :nnz] = tt.inds[:, perm]
+    inds[mode, nnz:] = dim  # sentinel row for padding
+    vals = np.zeros(nnz_pad, dtype=val_dtype)
+    vals[:nnz] = tt.vals[perm]
+
+    rows = inds[mode].reshape(nblocks, block)
+    row_start = rows[:, 0].astype(np.int32)
+    span = int((rows[:, -1] - rows[:, 0]).max()) + 1 if nnz else 1
+    # Padding sentinels in the last real block can inflate its span; the
+    # one-hot simply never matches those lanes (vals are zero anyway), so
+    # clamp to the widest span a block of real rows can have.
+    seg_width = _ceil_to(min(span, dim if dim > 0 else 1), 8)
+
+    return ModeLayout(
+        inds=jnp.asarray(inds),
+        vals=jnp.asarray(vals),
+        row_start=jnp.asarray(row_start),
+        mode=mode,
+        dim=dim,
+        block=block,
+        seg_width=seg_width,
+        nnz=nnz,
+    )
+
+
+@dataclasses.dataclass
+class BlockedSparse:
+    """A set of per-mode layouts + the mode→layout assignment.
+
+    ≙ splatt_csf[] + the workspace mode map (splatt_mttkrp_alloc_ws,
+    src/mttkrp.c:1814-1912).
+    """
+
+    layouts: List[ModeLayout]
+    mode_map: Dict[int, int]          # output mode -> index into layouts
+    dims: Tuple[int, ...]
+    nnz: int
+    opts: Options
+
+    @property
+    def nmodes(self) -> int:
+        return len(self.dims)
+
+    def layout_for(self, mode: int) -> ModeLayout:
+        return self.layouts[self.mode_map[mode]]
+
+    def storage_bytes(self) -> int:
+        return sum(l.storage_bytes() for l in self.layouts)
+
+    @staticmethod
+    def from_coo(tt: SparseTensor, opts: Optional[Options] = None) -> "BlockedSparse":
+        """Compile a COO tensor into blocked layouts per the alloc policy.
+
+        ≙ splatt_csf_alloc (src/csf.c:770-814):
+        - ONEMODE: one layout, sorted for the smallest mode;
+        - TWOMODE (default): smallest mode + largest mode (≙ smallest-first
+          CSF + leaf-rooted CSF, src/csf.c:787-803);
+        - ALLMODE: one per mode.
+        Every mode maps to its own layout when one exists, else to the
+        first layout (generic path).
+        """
+        opts = opts or default_opts()
+        nmodes = tt.nmodes
+        by_size = sorted(range(nmodes), key=lambda m: (tt.dims[m], m))
+        if opts.block_alloc is BlockAlloc.ONEMODE:
+            build_modes = [by_size[0]]
+        elif opts.block_alloc is BlockAlloc.TWOMODE:
+            build_modes = [by_size[0]]
+            if nmodes > 1 and by_size[-1] != by_size[0]:
+                build_modes.append(by_size[-1])
+        else:
+            build_modes = list(range(nmodes))
+
+        layouts = [build_layout(tt, m, block=opts.nnz_block,
+                                val_dtype=opts.val_dtype)
+                   for m in build_modes]
+        mode_map = {}
+        for m in range(nmodes):
+            mode_map[m] = build_modes.index(m) if m in build_modes else 0
+        return BlockedSparse(layouts=layouts, mode_map=mode_map,
+                             dims=tt.dims, nnz=tt.nnz, opts=opts)
+
+    def frobsq(self) -> float:
+        """Squared Frobenius norm from device values (≙ csf_frobsq)."""
+        v = self.layouts[0].vals
+        return float(jnp.sum(v.astype(jnp.float64 if v.dtype == jnp.float64
+                                      else jnp.float32) ** 2))

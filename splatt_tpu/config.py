@@ -1,0 +1,136 @@
+"""Run-time options and compile-time-style configuration.
+
+The reference keeps a flat ``double[SPLATT_OPTION_NOPTIONS]`` options array
+(include/splatt/types_config.h:103-123) populated by ``splatt_default_opts``
+(src/opts.c:10-47).  Here the same knobs live in a typed dataclass; enums
+mirror the reference's option enums.
+
+TPU-first mapping notes:
+- ``BlockAlloc`` ≙ ``SPLATT_CSF_{ONEMODE,TWOMODE,ALLMODE}``
+  (include/splatt/types_config.h:168-173): how many sorted nnz layouts are
+  precomputed — one shared layout, two (smallest + largest mode), or one per
+  mode.
+- ``priv_threshold`` ≙ ``SPLATT_OPTION_PRIVTHRESH`` (src/opts.c:26): modes
+  whose dim is ≤ ``priv_threshold * nnz`` use the full-width one-hot
+  reduction (no scatter at all — the analog of per-thread privatized
+  accumulators reduced at the end).
+- ``Decomposition``/``CommPattern`` ≙ the MPI decomposition/comm enums
+  (include/splatt/types_config.h:179-201).  Only the all-to-all semantics
+  are carried forward: on TPU the two row-exchange phases are
+  ``all_gather`` / ``psum_scatter`` over a mesh axis; the point-to-point
+  variant has no ICI analog.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import numpy as np
+
+# ≙ SPLATT_MAX_NMODES (include/splatt/constants.h:14-16)
+MAX_NMODES = 8
+
+
+class BlockAlloc(enum.Enum):
+    """How many per-mode sorted layouts to precompute (≙ csf allocation)."""
+
+    ONEMODE = "onemode"    # one layout (sorted for the smallest mode)
+    TWOMODE = "twomode"    # smallest mode + largest mode layouts
+    ALLMODE = "allmode"    # one layout per mode
+
+
+class ModeOrder(enum.Enum):
+    """Mode permutation policy for a layout (≙ src/csf.h:12-19)."""
+
+    SMALLFIRST = "smallfirst"
+    BIGFIRST = "bigfirst"
+    INORDER_MINUSONE = "inorder_minusone"
+    SORTED_MINUSONE = "sorted_minusone"
+    CUSTOM = "custom"
+
+
+class Decomposition(enum.Enum):
+    """Distributed decomposition (≙ types_config.h:179-190)."""
+
+    COARSE = "coarse"   # 1-D per mode
+    MEDIUM = "medium"   # n-D cartesian grid (default)
+    FINE = "fine"       # nonzero-level partition
+
+
+class CommPattern(enum.Enum):
+    """Row-exchange pattern (≙ types_config.h:197-201).
+
+    ALL2ALL is the semantic spec carried to TPU (all_gather/psum_scatter);
+    POINT2POINT is accepted for API parity but maps to the same collectives.
+    """
+
+    ALL2ALL = "all2all"
+    POINT2POINT = "point2point"
+
+
+class Verbosity(enum.IntEnum):
+    """≙ SPLATT_VERBOSITY_{NONE,LOW,HIGH,MAX} (types_config.h:143-149)."""
+
+    NONE = 0
+    LOW = 1
+    HIGH = 2
+    MAX = 3
+
+
+@dataclasses.dataclass
+class Options:
+    """Run-time options (≙ splatt_default_opts, src/opts.c:10-47).
+
+    Defaults mirror the reference: tol 1e-5, 50 iterations, TWOMODE
+    allocation, privatization threshold 0.02, MEDIUM decomposition,
+    ALL2ALL communication, time-based seed.
+    """
+
+    # CPD
+    tolerance: float = 1e-5
+    max_iterations: int = 50
+    regularization: float = 0.0
+    # RNG: None ≙ seed-from-time (src/opts.c RANDSEED default)
+    random_seed: Optional[int] = None
+    verbosity: Verbosity = Verbosity.LOW
+
+    # Blocked format (≙ CSF_ALLOC / TILE / TILELEVEL)
+    block_alloc: BlockAlloc = BlockAlloc.TWOMODE
+    nnz_block: int = 4096          # nnz per block (≙ dense-tile granularity)
+    # ≙ SPLATT_OPTION_PRIVTHRESH: a mode is "privatized" (full-width
+    # one-hot reduction, no scatter) when its dim ≤ priv_threshold * nnz
+    # — i.e. short relative to the nonzero count — and ≤ priv_cap.
+    priv_threshold: float = 0.02
+    priv_cap: int = 4096           # absolute max width for the one-hot
+                                   # full-replica (privatized) reduction
+    onehot_cap: int = 1024         # max block row-span for the sorted
+                                   # one-hot path before falling back to
+                                   # a sorted scatter
+
+    # Distributed
+    decomposition: Decomposition = Decomposition.MEDIUM
+    comm_pattern: CommPattern = CommPattern.ALL2ALL
+
+    # Numerics: device compute dtype. Host COO stays float64.
+    val_dtype: np.dtype = dataclasses.field(default_factory=lambda: np.dtype(np.float32))
+
+    def seed(self) -> int:
+        """Resolve (and pin) the RNG seed.
+
+        A time-based seed is sampled once and stored so every consumer —
+        stats header, factor init, reruns — sees the same value (the
+        reference stores the time seed into the opts array once,
+        src/opts.c).
+        """
+        if self.random_seed is None:
+            import time
+
+            self.random_seed = int(time.time()) & 0x7FFFFFFF
+        return int(self.random_seed)
+
+
+def default_opts() -> Options:
+    """≙ splatt_default_opts() (src/opts.c:10-47)."""
+    return Options()

@@ -1,0 +1,220 @@
+"""Host-side COO sparse tensor (≙ sptensor_t, src/sptensor.h:27-40).
+
+The COO tensor is the mutable, host-resident representation used for IO,
+preprocessing and analysis; device compute happens on the compiled
+:class:`splatt_tpu.blocked.BlockedSparse` format.  Arrays are numpy:
+``inds`` is an ``(nmodes, nnz)`` int64 array, ``vals`` a float64 vector.
+
+Capability parity with the reference:
+- dedup with value accumulation     (≙ tt_remove_dups,  src/sptensor.h:156-167)
+- empty-slice removal + indmap      (≙ tt_remove_empty, src/sptensor.h:170-180)
+- mode unfold to CSR                (≙ tt_unfold,       src/sptensor.h:183-196)
+- squared Frobenius norm            (≙ tt_normsq,       src/sptensor.h:199-209)
+- per-mode histograms / slice counts
+- lexicographic sort by any mode order (≙ tt_sort, src/sort.c:912-961 — on
+  TPU hosts this is a numpy lexsort; the reference's hybrid counting sort
+  exists because it hand-rolls parallelism that numpy/XLA already provide)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from splatt_tpu.config import MAX_NMODES
+
+
+@dataclasses.dataclass
+class SparseTensor:
+    """m-mode coordinate sparse tensor.
+
+    Attributes:
+      inds: (nmodes, nnz) int64 coordinates, 0-indexed.
+      vals: (nnz,) float64 values.
+      dims: tuple of mode sizes.
+      indmaps: optional per-mode local->global index maps produced by
+        :meth:`remove_empty_slices` (≙ sptensor_t.indmap).
+    """
+
+    inds: np.ndarray
+    vals: np.ndarray
+    dims: Tuple[int, ...]
+    indmaps: Optional[List[Optional[np.ndarray]]] = None
+
+    def __post_init__(self) -> None:
+        self.inds = np.ascontiguousarray(self.inds, dtype=np.int64)
+        self.vals = np.ascontiguousarray(self.vals)
+        if self.inds.ndim != 2:
+            raise ValueError("inds must be (nmodes, nnz)")
+        if self.nmodes > MAX_NMODES:
+            raise ValueError(f"nmodes {self.nmodes} exceeds MAX_NMODES={MAX_NMODES}")
+        if self.inds.shape[1] != self.vals.shape[0]:
+            raise ValueError("inds/vals nnz mismatch")
+        self.dims = tuple(int(d) for d in self.dims)
+
+    # -- basic queries ----------------------------------------------------
+
+    @property
+    def nmodes(self) -> int:
+        return self.inds.shape[0]
+
+    @property
+    def nnz(self) -> int:
+        return self.inds.shape[1]
+
+    def density(self) -> float:
+        dense = 1.0
+        for d in self.dims:
+            dense *= float(d)
+        return self.nnz / dense if dense > 0 else 0.0
+
+    def normsq(self) -> float:
+        """Squared Frobenius norm (≙ tt_normsq)."""
+        return float(np.dot(self.vals, self.vals))
+
+    def mode_histogram(self, mode: int) -> np.ndarray:
+        """nnz count per slice of `mode` (≙ tt_get_hist)."""
+        return np.bincount(self.inds[mode], minlength=self.dims[mode])
+
+    def nslices_nonempty(self, mode: int) -> int:
+        return int(np.count_nonzero(self.mode_histogram(mode)))
+
+    # -- constructors -----------------------------------------------------
+
+    @staticmethod
+    def from_arrays(inds: Sequence[np.ndarray], vals: np.ndarray,
+                    dims: Optional[Sequence[int]] = None) -> "SparseTensor":
+        ind = np.stack([np.asarray(i, dtype=np.int64) for i in inds])
+        if dims is None:
+            dims = [int(ind[m].max()) + 1 if ind.shape[1] else 0
+                    for m in range(ind.shape[0])]
+        return SparseTensor(ind, np.asarray(vals), tuple(dims))
+
+    @staticmethod
+    def random(dims: Sequence[int], nnz: int, seed: int = 0,
+               distinct: bool = True) -> "SparseTensor":
+        """Uniform random tensor for tests/benchmarks (deterministic)."""
+        rng = np.random.default_rng(seed)
+        ind = np.stack([rng.integers(0, d, size=nnz) for d in dims])
+        vals = rng.random(nnz)
+        tt = SparseTensor(ind, vals, tuple(int(d) for d in dims))
+        if distinct:
+            tt = tt.deduplicate()
+        return tt
+
+    # -- transforms -------------------------------------------------------
+
+    def sort_order(self, mode_order: Sequence[int]) -> np.ndarray:
+        """Permutation sorting nnz lexicographically by `mode_order`.
+
+        ≙ tt_sort (src/sort.c:912-961); `mode_order[0]` is the primary key.
+        """
+        # np.lexsort sorts by the LAST key first.
+        keys = tuple(self.inds[m] for m in reversed(list(mode_order)))
+        return np.lexsort(keys)
+
+    def sorted_by(self, mode_order: Sequence[int]) -> "SparseTensor":
+        perm = self.sort_order(mode_order)
+        return SparseTensor(self.inds[:, perm], self.vals[perm], self.dims,
+                            indmaps=self.indmaps)
+
+    def deduplicate(self) -> "SparseTensor":
+        """Sum values at repeated coordinates (≙ tt_remove_dups)."""
+        if self.nnz == 0:
+            return self
+        perm = self.sort_order(range(self.nmodes))
+        ind = self.inds[:, perm]
+        vals = self.vals[perm]
+        new = np.empty(self.nnz, dtype=bool)
+        new[0] = True
+        np.any(ind[:, 1:] != ind[:, :-1], axis=0, out=new[1:])
+        starts = np.flatnonzero(new)
+        summed = np.add.reduceat(vals, starts)
+        return SparseTensor(ind[:, starts], summed, self.dims,
+                            indmaps=self.indmaps)
+
+    def count_duplicates(self) -> int:
+        if self.nnz == 0:
+            return 0
+        perm = self.sort_order(range(self.nmodes))
+        ind = self.inds[:, perm]
+        same = np.all(ind[:, 1:] == ind[:, :-1], axis=0)
+        return int(np.count_nonzero(same))
+
+    def remove_empty_slices(self) -> "SparseTensor":
+        """Relabel each mode to remove empty slices (≙ tt_remove_empty).
+
+        Records per-mode ``indmap`` (local -> global index) for modes that
+        shrank; identity modes keep ``None`` like the reference.
+        """
+        new_inds = np.empty_like(self.inds)
+        indmaps: List[Optional[np.ndarray]] = []
+        dims: List[int] = []
+        for m in range(self.nmodes):
+            uniq, inv = np.unique(self.inds[m], return_inverse=True)
+            if uniq.shape[0] == self.dims[m]:
+                new_inds[m] = self.inds[m]
+                indmaps.append(None)
+                dims.append(self.dims[m])
+            else:
+                new_inds[m] = inv
+                indmaps.append(uniq.copy())
+                dims.append(int(uniq.shape[0]))
+        if all(im is None for im in indmaps):
+            return self
+        return SparseTensor(new_inds, self.vals.copy(), tuple(dims),
+                            indmaps=indmaps)
+
+    def unfold(self, mode: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Tuple[int, int]]:
+        """Mode-`mode` matricization as CSR (≙ tt_unfold, src/sptensor.h:183-196).
+
+        Returns (indptr, indices, data, shape) with rows = dims[mode] and
+        columns = product of the other dims in increasing-mode order.
+        """
+        rows = self.inds[mode]
+        other = [m for m in range(self.nmodes) if m != mode]
+        col = np.zeros(self.nnz, dtype=np.int64)
+        stride = 1
+        # row-major over the remaining modes, last mode fastest
+        for m in reversed(other):
+            col += self.inds[m] * stride
+            stride *= self.dims[m]
+        ncols = stride
+        order = np.lexsort((col, rows))
+        r, c, v = rows[order], col[order], self.vals[order]
+        indptr = np.zeros(self.dims[mode] + 1, dtype=np.int64)
+        np.add.at(indptr, r + 1, 1)
+        np.cumsum(indptr, out=indptr)
+        return indptr, c, v, (self.dims[mode], int(ncols))
+
+    def permute(self, perms: Sequence[Optional[np.ndarray]]) -> "SparseTensor":
+        """Apply per-mode relabeling permutations (≙ perm_apply, src/reorder.c:350).
+
+        ``perms[m]`` maps old index -> new index for mode m (None = identity).
+        """
+        new_inds = self.inds.copy()
+        for m, p in enumerate(perms):
+            if p is not None:
+                new_inds[m] = np.asarray(p, dtype=np.int64)[self.inds[m]]
+        return SparseTensor(new_inds, self.vals.copy(), self.dims,
+                            indmaps=self.indmaps)
+
+    def copy(self) -> "SparseTensor":
+        return SparseTensor(self.inds.copy(), self.vals.copy(), self.dims,
+                            indmaps=None if self.indmaps is None else
+                            [None if m is None else m.copy() for m in self.indmaps])
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, SparseTensor):
+            return NotImplemented
+        return (self.dims == other.dims
+                and np.array_equal(self.inds, other.inds)
+                and np.array_equal(self.vals, other.vals))
+
+    def to_dense(self) -> np.ndarray:
+        """Dense ndarray — tests/small tensors only."""
+        out = np.zeros(self.dims, dtype=self.vals.dtype)
+        np.add.at(out, tuple(self.inds), self.vals)
+        return out

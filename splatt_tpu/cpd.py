@@ -1,0 +1,147 @@
+"""CPD-ALS driver (≙ src/cpd.c: splatt_cpd_als / cpd_als_iterate).
+
+One ALS sweep (all modes) is a single jitted function; the convergence
+loop runs on host (data-dependent stopping is host logic, exactly the
+split XLA wants).  Per-sweep semantics mirror the reference
+(src/cpd.c:271-387):
+
+  for each mode m:  M ← MTTKRP(X, U, m); U_m ← solve(⊛_{k≠m} Gram_k + ρI, M);
+                    (U_m, λ) ← normalize (2-norm on iteration 0, max-norm
+                    after — src/cpd.c:343-347); Gram_m ← U_mᵀU_m
+  fit = 1 − √(⟨X,X⟩ + ⟨Z,Z⟩ − 2⟨X,Z⟩)/√⟨X,X⟩, with ⟨Z,Z⟩ = λᵀ(⊛ Grams)λ
+  (p_kruskal_norm, src/cpd.c:116-152) and ⟨X,Z⟩ from the last mode's
+  MTTKRP result (p_tt_kruskal_inner, src/cpd.c:171-218).
+  converge when |fit − fit_prev| < tolerance (src/cpd.c:368-370).
+
+Post-processing renormalizes every factor into λ (cpd_post_process,
+src/cpd.c:391-411).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splatt_tpu.blocked import BlockedSparse
+from splatt_tpu.config import Options, Verbosity, default_opts
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.linalg import (form_normal_lhs, gram, normalize_columns,
+                                   solve_normals)
+from splatt_tpu.ops.mttkrp import mttkrp, mttkrp_blocked, mttkrp_stream
+from splatt_tpu.utils.timers import timers
+
+
+def init_factors(dims: Tuple[int, ...], rank: int, seed: int,
+                 dtype=jnp.float32) -> List[jax.Array]:
+    """Seed-stable random factor init (≙ mat_rand; per-mode fold_in keeps
+    initialization independent of device layout, ≙ mpi_mat_rand's
+    rank-count invariance, src/splatt_mpi.h:368-386)."""
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for m, d in enumerate(dims):
+        out.append(jax.random.uniform(jax.random.fold_in(key, m), (d, rank),
+                                      dtype=dtype))
+    return out
+
+
+def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
+                reg: float) -> Callable:
+    """Build the jitted one-sweep function for this tensor."""
+    if isinstance(X, SparseTensor):
+        inds = jnp.asarray(X.inds)
+        vals = jnp.asarray(X.vals)
+        dims = X.dims
+
+        def do_mttkrp(factors, m):
+            return mttkrp_stream(inds, vals, factors, m, dims[m])
+    else:
+        def do_mttkrp(factors, m):
+            return mttkrp(X, factors, m)
+
+    @partial(jax.jit, static_argnames=("first",))
+    def sweep(factors, grams, first: bool):
+        lam = None
+        M = None
+        for m in range(nmodes):
+            M = do_mttkrp(factors, m)
+            lhs = form_normal_lhs(grams, m, reg)
+            U = solve_normals(lhs, M)
+            U, lam = normalize_columns(U, "2" if first else "max")
+            factors[m] = U
+            grams[m] = gram(U)
+        # ⟨Z,Z⟩ = λᵀ(⊛ Grams)λ
+        had = jnp.outer(lam, lam)
+        for g in grams:
+            had = had * g
+        znormsq = jnp.sum(had)
+        # ⟨X,Z⟩ from the last mode's MTTKRP result
+        inner = jnp.sum(M * factors[nmodes - 1] * lam[None, :])
+        return factors, grams, lam, znormsq, inner
+
+    return sweep
+
+
+def _fit(xnormsq: float, znormsq: jax.Array, inner: jax.Array) -> jax.Array:
+    residual = jnp.sqrt(jnp.maximum(xnormsq + znormsq - 2.0 * inner, 0.0))
+    return 1.0 - residual / np.sqrt(xnormsq)
+
+
+def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
+            opts: Optional[Options] = None,
+            init: Optional[List[jax.Array]] = None) -> KruskalTensor:
+    """Compute a rank-`rank` CPD of X (≙ splatt_cpd_als, src/cpd.c:22-63)."""
+    opts = opts or default_opts()
+    if isinstance(X, SparseTensor):
+        dims, nmodes = X.dims, X.nmodes
+        xnormsq = X.normsq()
+        dtype = jnp.dtype(opts.val_dtype) if X.vals.dtype != np.float64 \
+            else jnp.dtype(X.vals.dtype)
+        # host COO in float64 stays float64 only if x64 is enabled
+        if not jax.config.jax_enable_x64:
+            dtype = jnp.dtype(opts.val_dtype)
+    else:
+        dims, nmodes = X.dims, X.nmodes
+        xnormsq = X.frobsq()
+        dtype = X.layouts[0].vals.dtype
+
+    if init is not None:
+        factors = [jnp.asarray(f, dtype=dtype) for f in init]
+    else:
+        factors = init_factors(dims, rank, opts.seed(), dtype=dtype)
+    grams = [gram(U) for U in factors]
+
+    sweep = _make_sweep(X, nmodes, opts.regularization)
+
+    fit_prev = 0.0
+    fit = jnp.asarray(0.0, dtype=dtype)
+    lam = jnp.ones((rank,), dtype=dtype)
+    timers.start("cpd")
+    for it in range(opts.max_iterations):
+        t0 = time.perf_counter()
+        factors, grams, lam, znormsq, inner = sweep(factors, grams, it == 0)
+        fit = _fit(xnormsq, znormsq, inner)
+        fitval = float(fit)
+        elapsed = time.perf_counter() - t0
+        if opts.verbosity >= Verbosity.LOW:
+            print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"
+                  f"  delta = {fitval - fit_prev:+0.4e}")
+        if it > 0 and abs(fitval - fit_prev) < opts.tolerance:
+            fit_prev = fitval
+            break
+        fit_prev = fitval
+    timers.stop("cpd")
+
+    # post-process: fold remaining column norms into λ (cpd_post_process)
+    out_factors = []
+    for U in factors:
+        U, norms = normalize_columns(U, "2")
+        lam = lam * norms
+        out_factors.append(U)
+    return KruskalTensor(factors=out_factors, lam=lam,
+                         fit=jnp.asarray(fit_prev, dtype=dtype))

@@ -1,0 +1,165 @@
+"""Graph / hypergraph models of a sparse tensor (≙ src/graph.c).
+
+Host-side analysis structures (numpy CSR), used by the reorder and
+convert verbs:
+
+- :func:`tensor_to_graph`      ≙ graph_convert (src/graph.c:637-678):
+  the m-partite weighted graph — one vertex per (mode, index), an edge
+  between every pair of coordinates co-occurring in a nonzero, weighted
+  by co-occurrence count.
+- :func:`hypergraph_nnz`       ≙ hgraph_nnz_alloc (src/graph.c:452):
+  nonzeros as vertices, index-slices as hyperedges.
+- :func:`hypergraph_fibers`    ≙ hgraph_fib_alloc (src/graph.c:506):
+  mode-rooted fibers as vertices.
+
+External partitioner hooks (METIS/PaToH/Ashado, src/graph.h:180-228)
+have no equivalent binary in this environment; partition files can be
+supplied to the reorderer instead (≙ the FINE decomposition's partfile).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from splatt_tpu.coo import SparseTensor
+
+
+@dataclasses.dataclass
+class Graph:
+    """CSR adjacency with per-vertex/edge weights."""
+
+    indptr: np.ndarray
+    adj: np.ndarray
+    vwts: Optional[np.ndarray]
+    ewts: Optional[np.ndarray]
+    nvtxs: int
+
+    @property
+    def nedges(self) -> int:
+        return int(self.adj.shape[0])
+
+
+@dataclasses.dataclass
+class Hypergraph:
+    """Vertices + CSR hyperedge membership (eptr/eind)."""
+
+    nvtxs: int
+    eptr: np.ndarray
+    eind: np.ndarray
+    vwts: Optional[np.ndarray]
+
+    @property
+    def nhedges(self) -> int:
+        return int(self.eptr.shape[0] - 1)
+
+
+def _mode_offsets(dims: Tuple[int, ...]) -> np.ndarray:
+    return np.concatenate([[0], np.cumsum(dims)]).astype(np.int64)
+
+
+def tensor_to_graph(tt: SparseTensor) -> Graph:
+    """m-partite graph: vertex v = offset[m] + index, edges between all
+    co-occurring coordinate pairs, weight = #co-occurrences."""
+    offs = _mode_offsets(tt.dims)
+    nvtxs = int(offs[-1])
+    srcs, dsts = [], []
+    for a in range(tt.nmodes):
+        for b in range(tt.nmodes):
+            if a != b:
+                srcs.append(tt.inds[a] + offs[a])
+                dsts.append(tt.inds[b] + offs[b])
+    src = np.concatenate(srcs)
+    dst = np.concatenate(dsts)
+    # combine parallel edges, accumulating weights
+    key = src * nvtxs + dst
+    uniq, counts = np.unique(key, return_counts=True)
+    src_u = (uniq // nvtxs).astype(np.int64)
+    dst_u = (uniq % nvtxs).astype(np.int64)
+    order = np.lexsort((dst_u, src_u))
+    src_u, dst_u, counts = src_u[order], dst_u[order], counts[order]
+    indptr = np.zeros(nvtxs + 1, dtype=np.int64)
+    np.add.at(indptr, src_u + 1, 1)
+    np.cumsum(indptr, out=indptr)
+    vwts = np.concatenate([tt.mode_histogram(m) for m in range(tt.nmodes)])
+    return Graph(indptr=indptr, adj=dst_u, vwts=vwts,
+                 ewts=counts.astype(np.int64), nvtxs=nvtxs)
+
+
+def hypergraph_nnz(tt: SparseTensor) -> Hypergraph:
+    """Nonzeros as vertices; hyperedge per (mode, index) containing the
+    nonzeros in that slice."""
+    offs = _mode_offsets(tt.dims)
+    nhedges = int(offs[-1])
+    counts = np.zeros(nhedges, dtype=np.int64)
+    for m in range(tt.nmodes):
+        counts[offs[m]:offs[m + 1]] = tt.mode_histogram(m)
+    eptr = np.concatenate([[0], np.cumsum(counts)])
+    eind = np.empty(int(eptr[-1]), dtype=np.int64)
+    for m in range(tt.nmodes):
+        order = np.argsort(tt.inds[m], kind="stable")
+        seg = eptr[offs[m]] + np.arange(tt.nnz)
+        eind[seg] = order
+    return Hypergraph(nvtxs=tt.nnz, eptr=eptr.astype(np.int64), eind=eind,
+                      vwts=None)
+
+
+def hypergraph_fibers(tt: SparseTensor, mode: int) -> Hypergraph:
+    """Mode-`mode`-rooted fibers as vertices (a fiber = all nnz sharing
+    every coordinate except `mode`); hyperedges per (mode, index) list
+    the fibers touching that slice."""
+    others = [m for m in range(tt.nmodes) if m != mode]
+    # fiber id = rank of the distinct coordinate tuple over `others`
+    keys = np.stack([tt.inds[m] for m in others])
+    order = np.lexsort(keys[::-1])
+    sorted_keys = keys[:, order]
+    new_fiber = np.ones(tt.nnz, dtype=bool)
+    if tt.nnz > 1:
+        new_fiber[1:] = np.any(sorted_keys[:, 1:] != sorted_keys[:, :-1], axis=0)
+    fiber_of_sorted = np.cumsum(new_fiber) - 1
+    fiber_id = np.empty(tt.nnz, dtype=np.int64)
+    fiber_id[order] = fiber_of_sorted
+    nfibers = int(fiber_of_sorted[-1]) + 1 if tt.nnz else 0
+
+    offs = _mode_offsets(tt.dims)
+    # hyperedges: for every (m, idx) slice, the set of fibers present
+    pairs = []
+    for m in range(tt.nmodes):
+        key = (tt.inds[m] + offs[m]) * max(nfibers, 1) + fiber_id
+        pairs.append(np.unique(key))
+    allpairs = np.concatenate(pairs) if pairs else np.empty(0, np.int64)
+    hedge = allpairs // max(nfibers, 1)
+    vtx = allpairs % max(nfibers, 1)
+    eptr = np.zeros(int(offs[-1]) + 1, dtype=np.int64)
+    np.add.at(eptr, hedge + 1, 1)
+    np.cumsum(eptr, out=eptr)
+    return Hypergraph(nvtxs=nfibers, eptr=eptr, eind=vtx, vwts=None)
+
+
+def write_graph(g: Graph, path: str) -> None:
+    """METIS-like text format (≙ graph writers in src/io.c)."""
+    has_ew = g.ewts is not None
+    has_vw = g.vwts is not None
+    fmt = f"{int(has_vw)}{int(has_ew)}"
+    with open(path, "w") as f:
+        f.write(f"{g.nvtxs} {g.nedges // 2} {fmt}\n")
+        for v in range(g.nvtxs):
+            parts = []
+            if has_vw:
+                parts.append(str(int(g.vwts[v])))
+            for k in range(g.indptr[v], g.indptr[v + 1]):
+                parts.append(str(int(g.adj[k]) + 1))
+                if has_ew:
+                    parts.append(str(int(g.ewts[k])))
+            f.write(" ".join(parts) + "\n")
+
+
+def write_hypergraph(h: Hypergraph, path: str) -> None:
+    """PaToH/hMETIS-like text format."""
+    with open(path, "w") as f:
+        f.write(f"{h.nhedges} {h.nvtxs}\n")
+        for e in range(h.nhedges):
+            mem = h.eind[h.eptr[e]:h.eptr[e + 1]]
+            f.write(" ".join(str(int(v) + 1) for v in mem) + "\n")

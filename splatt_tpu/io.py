@@ -1,0 +1,171 @@
+"""Tensor file IO (≙ src/io.c).
+
+Formats:
+- Text coordinate ``.tns``/``.coo``: whitespace-separated indices + value,
+  ``#`` comments, 0/1-index autodetect (≙ tt_get_dims/p_tt_read_file,
+  src/io.c:273-348,62-108).
+- Binary ``.bin``: magic + header recording index/value widths, with
+  automatic 32-bit index narrowing when lossless (≙ bin_header,
+  src/io.h:82-87, writer src/io.c:118-150).
+
+Also writers for dense matrices and vectors (factor outputs, ≙
+mat_write/vec_write) and permutation files.
+
+The text parser uses a vectorized numpy parse; a C++ fast path
+(splatt_tpu.native) is used when the shared library has been built.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from splatt_tpu.coo import SparseTensor
+
+# Binary format: magic, version, nmodes, idx_width_bytes, val_width_bytes,
+# dims[nmodes] (u64), nnz (u64), then inds per mode, then vals.
+_BIN_MAGIC = b"SPTT"
+_BIN_VERSION = 1
+
+
+def _parse_text(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a coordinate text file into (inds (m,nnz) int64, vals f64)."""
+    try:
+        from splatt_tpu import native
+
+        parsed = native.parse_tns(path)
+        if parsed is not None:
+            return parsed
+    except ImportError:
+        pass
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    body = [ln for ln in lines if ln.strip() and not ln.lstrip().startswith(b"#")]
+    if not body:
+        raise ValueError(f"{path}: empty tensor file")
+    ncols = len(body[0].split())
+    toks = np.array(b" ".join(body).split(), dtype=np.float64)
+    if toks.size % ncols != 0:
+        raise ValueError(f"{path}: ragged rows in tensor file")
+    table = toks.reshape(-1, ncols)
+    inds = table[:, :-1].astype(np.int64).T
+    vals = np.ascontiguousarray(table[:, -1])
+    return np.ascontiguousarray(inds), vals
+
+
+def load_coord(path: str) -> SparseTensor:
+    """Load a coordinate tensor, autodetecting text vs binary and indexing base.
+
+    ≙ tt_read (src/io.c:230-270): 1-indexed files are shifted to 0-indexed;
+    a file containing any 0 index is treated as 0-indexed
+    (≙ tt_get_dims autodetect, src/io.c:273-348).
+    """
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic == _BIN_MAGIC:
+        return _load_binary(path)
+    inds, vals = _parse_text(path)
+    if inds.size and inds.min() > 0:
+        inds = inds - 1
+    dims = tuple(int(inds[m].max()) + 1 if inds.shape[1] else 0
+                 for m in range(inds.shape[0]))
+    return SparseTensor(inds, vals, dims)
+
+
+# `load` is the public name (≙ splatt_load / splatt_csf_load entrypoints).
+load = load_coord
+
+
+def save(tt: SparseTensor, path: str, binary: Optional[bool] = None,
+         one_indexed: bool = True) -> None:
+    """Write a tensor as text (default) or binary (``.bin`` or binary=True)."""
+    if binary is None:
+        binary = path.endswith(".bin")
+    if binary:
+        _save_binary(tt, path)
+    else:
+        _save_text(tt, path, one_indexed=one_indexed)
+
+
+def _save_text(tt: SparseTensor, path: str, one_indexed: bool = True) -> None:
+    shift = 1 if one_indexed else 0
+    cols = [tt.inds[m] + shift for m in range(tt.nmodes)]
+    with open(path, "w") as f:
+        for row in zip(*cols, tt.vals):
+            f.write(" ".join(str(int(x)) for x in row[:-1]))
+            f.write(f" {row[-1]:.17g}\n")
+
+
+def _save_binary(tt: SparseTensor, path: str) -> None:
+    # Narrow indices to 32-bit when lossless (≙ src/io.c:118-150).
+    idx_width = 4 if (tt.nnz == 0 or tt.inds.max() < 2**31) else 8
+    val_width = tt.vals.dtype.itemsize
+    with open(path, "wb") as f:
+        f.write(_BIN_MAGIC)
+        f.write(struct.pack("<IIII", _BIN_VERSION, tt.nmodes, idx_width, val_width))
+        f.write(np.asarray(tt.dims, dtype=np.uint64).tobytes())
+        f.write(struct.pack("<Q", tt.nnz))
+        idt = np.int32 if idx_width == 4 else np.int64
+        for m in range(tt.nmodes):
+            f.write(np.ascontiguousarray(tt.inds[m], dtype=idt).tobytes())
+        f.write(np.ascontiguousarray(tt.vals).tobytes())
+
+
+def _load_binary(path: str) -> SparseTensor:
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        if magic != _BIN_MAGIC:
+            raise ValueError(f"{path}: bad magic")
+        version, nmodes, idx_width, val_width = struct.unpack("<IIII", f.read(16))
+        if version != _BIN_VERSION:
+            raise ValueError(f"{path}: unsupported binary version {version}")
+        dims = np.frombuffer(f.read(8 * nmodes), dtype=np.uint64).astype(np.int64)
+        (nnz,) = struct.unpack("<Q", f.read(8))
+        idt = np.int32 if idx_width == 4 else np.int64
+        inds = np.empty((nmodes, nnz), dtype=np.int64)
+        for m in range(nmodes):
+            inds[m] = np.frombuffer(f.read(idx_width * nnz), dtype=idt)
+        vdt = np.float32 if val_width == 4 else np.float64
+        vals = np.frombuffer(f.read(val_width * nnz), dtype=vdt).copy()
+    return SparseTensor(inds, vals, tuple(int(d) for d in dims))
+
+
+# -- dense matrix / vector / permutation writers (≙ mat_write/vec_write) ---
+
+def write_matrix(mat: np.ndarray, path: str) -> None:
+    mat = np.asarray(mat)
+    with open(path, "w") as f:
+        for row in mat:
+            f.write(" ".join(f"{v:.17g}" for v in row))
+            f.write("\n")
+
+
+def read_matrix(path: str) -> np.ndarray:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                rows.append([float(t) for t in line.split()])
+    return np.asarray(rows)
+
+
+def write_vector(vec: np.ndarray, path: str) -> None:
+    with open(path, "w") as f:
+        for v in np.asarray(vec).ravel():
+            f.write(f"{v:.17g}\n")
+
+
+def write_permutation(perm: np.ndarray, path: str) -> None:
+    with open(path, "w") as f:
+        for p in np.asarray(perm).ravel():
+            f.write(f"{int(p)}\n")
+
+
+def read_permutation(path: str) -> np.ndarray:
+    with open(path) as f:
+        return np.asarray([int(x) for x in f.read().split()], dtype=np.int64)

@@ -1,0 +1,59 @@
+"""Kruskal tensor — the CPD output (≙ splatt_kruskal, include/splatt/structs.h:25-44)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KruskalTensor:
+    """Rank-R factorization: ``X ≈ Σ_r λ_r · U1[:,r] ∘ ... ∘ Um[:,r]``.
+
+    Attributes:
+      factors: list of (dim_m, rank) factor matrices.
+      lam: (rank,) column norms λ.
+      fit: scalar quality-of-fit in [0, 1] (1 = exact).
+    """
+
+    factors: List[jax.Array]
+    lam: jax.Array
+    fit: jax.Array
+
+    @property
+    def rank(self) -> int:
+        return int(self.factors[0].shape[1])
+
+    @property
+    def nmodes(self) -> int:
+        return len(self.factors)
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return tuple(int(f.shape[0]) for f in self.factors)
+
+    def to_dense(self) -> np.ndarray:
+        """Reconstruct the dense tensor — tests/small problems only."""
+        rank = self.rank
+        out = None
+        for r in range(rank):
+            term = np.asarray(self.lam)[r]
+            vec = None
+            for f in self.factors:
+                col = np.asarray(f)[:, r]
+                vec = col if vec is None else np.multiply.outer(vec, col)
+            out = term * vec if out is None else out + term * vec
+        return out
+
+    def normsq(self) -> jax.Array:
+        """⟨Z,Z⟩ = λᵀ (⊛_m UᵐᵀUᵐ) λ (≙ p_kruskal_norm, src/cpd.c:116-152)."""
+        rank = self.factors[0].shape[1]
+        had = jnp.outer(self.lam, self.lam)
+        for f in self.factors:
+            had = had * (f.T @ f)
+        return jnp.sum(had)

@@ -1,0 +1,17 @@
+from splatt_tpu.ops.mttkrp import mttkrp, mttkrp_stream, mttkrp_blocked
+from splatt_tpu.ops.linalg import (
+    gram,
+    form_normal_lhs,
+    solve_normals,
+    normalize_columns,
+)
+
+__all__ = [
+    "mttkrp",
+    "mttkrp_stream",
+    "mttkrp_blocked",
+    "gram",
+    "form_normal_lhs",
+    "solve_normals",
+    "normalize_columns",
+]

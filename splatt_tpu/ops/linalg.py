@@ -1,0 +1,90 @@
+"""Dense linear algebra for CPD-ALS (≙ src/matrix.c, src/splatt_lapack.h).
+
+All rank×rank / dim×rank dense math lowers to XLA (MXU):
+- :func:`gram`             ≙ mat_aTa          (src/matrix.c:414-455, BLAS syrk)
+- :func:`form_normal_lhs`  ≙ p_form_gram      (src/matrix.c:29-83)
+- :func:`solve_normals`    ≙ mat_solve_normals (src/matrix.c:529-606,
+                             LAPACK potrf/potrs with gelss SVD fallback)
+- :func:`normalize_columns` ≙ p_mat_2norm/p_mat_maxnorm (src/matrix.c:87-205)
+
+The SPD-fallback is branchless: we always compute both the Cholesky solve
+and a pseudoinverse (lstsq-style, via eigendecomposition) solve and select
+per-call with ``jnp.where`` on NaN detection — data-dependent control flow
+is hostile to XLA; two rank³ solves at rank ≤ a few hundred are noise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def gram(U: jax.Array) -> jax.Array:
+    """UᵀU (rank×rank Gram matrix; ≙ mat_aTa).
+
+    The reference only fills the upper triangle then mirrors; XLA emits a
+    full syrk-like matmul on the MXU either way.
+    """
+    return U.T @ U
+
+
+def form_normal_lhs(grams: Sequence[jax.Array], mode: int,
+                    regularization: float = 0.0) -> jax.Array:
+    """Hadamard product of all Grams except `mode`, + λI (≙ p_form_gram)."""
+    rank = grams[0].shape[0]
+    out = jnp.ones((rank, rank), dtype=grams[0].dtype)
+    for m, g in enumerate(grams):
+        if m != mode:
+            out = out * g
+    if regularization != 0.0:
+        out = out + regularization * jnp.eye(rank, dtype=out.dtype)
+    return out
+
+
+def solve_normals(lhs: jax.Array, rhs: jax.Array) -> jax.Array:
+    """Solve ``X · lhs = rhs`` for X (rows = factor rows; ≙ mat_solve_normals).
+
+    lhs is the rank×rank normal-equations matrix (symmetric PSD), rhs the
+    (dim, rank) MTTKRP result.  Primary path: Cholesky.  If lhs is not
+    SPD (rank-deficient factors), fall back to a least-squares solve via
+    symmetric eigendecomposition pseudoinverse (≙ the LAPACK gelss
+    fallback, src/matrix.c:554-603) — selected branchlessly.
+    """
+    chol = jax.scipy.linalg.cho_factor(lhs, lower=True)
+    x_chol = jax.scipy.linalg.cho_solve(chol, rhs.T).T
+
+    # Pseudoinverse fallback via eigh (lhs symmetric): pinv = V diag(1/w) Vᵀ.
+    # eigh doubles as the SPD detector — LAPACK potrf's failure (info > 0)
+    # is not observable through jax, and a failed factorization can return
+    # finite garbage, so NaN-scanning x_chol is not sufficient.
+    # Cutoff at √eps·‖w‖: normal equations square the condition number, so
+    # eigenvalues below √eps·max|w| carry no information; eps-level cutoffs
+    # keep eigh noise and blow the solve up.
+    w, v = jnp.linalg.eigh(lhs)
+    tol = jnp.sqrt(jnp.finfo(lhs.dtype).eps) * jnp.max(jnp.abs(w))
+    w_inv = jnp.where(jnp.abs(w) > tol, 1.0 / w, 0.0)
+    x_pinv = rhs @ (v * w_inv) @ v.T
+
+    spd = (jnp.min(w) > tol) & jnp.all(jnp.isfinite(x_chol))
+    return jnp.where(spd, x_chol, x_pinv)
+
+
+@partial(jax.jit, static_argnames=("which",))
+def normalize_columns(U: jax.Array, which: str = "2") -> tuple[jax.Array, jax.Array]:
+    """Normalize columns, returning (normalized U, λ).
+
+    which="2": 2-norm (used on ALS iteration 0); which="max": max-norm with
+    a floor of 1 so λ never shrinks columns (≙ p_mat_2norm / p_mat_maxnorm,
+    src/matrix.c:87-205 — the max-norm path clamps norms below 1 to 1).
+    """
+    if which == "2":
+        lam = jnp.sqrt(jnp.sum(U * U, axis=0))
+    elif which == "max":
+        lam = jnp.maximum(jnp.max(jnp.abs(U), axis=0), 1.0)
+    else:
+        raise ValueError(f"unknown norm {which!r}")
+    safe = jnp.where(lam > 0, lam, 1.0)
+    return U / safe, lam

@@ -1,0 +1,194 @@
+"""MTTKRP — the hot kernel (≙ src/mttkrp.c, 1931 LoC in the reference).
+
+``mttkrp(X, factors, mode)`` computes, for every output row i of `mode`::
+
+    M[i, :] = Σ_{nnz n : ind_mode[n] = i}  val[n] · ∏_{k≠mode} U_k[ind_k[n], :]
+
+Four execution paths replace the reference's root/internal/leaf ×
+locked/nolock × tiled traversal matrix (src/mttkrp.c:104-1341):
+
+- ``stream``        — COO gather + segment_sum.  Trivially correct; the
+  differential-test gold oracle (≙ mttkrp_stream, src/mttkrp.c:1697-1757).
+- ``sorted_onehot`` — blocked layout sorted by the output mode: per-block
+  partial products reduced by a small one-hot matmul on the MXU, then a
+  block-level scatter combine.  ≙ the root-mode CSF traversal — scatter
+  contention is gone by construction, like CSF's accumulate-up-the-tree.
+- ``privatized``    — short output modes: full-width one-hot per block and
+  a pure tree-sum over blocks, no scatter at all.  ≙ per-thread output
+  replicas + parallel reduction (p_reduce_privatized, src/mttkrp.c:56-87).
+- ``scatter``       — generic path for modes the layout is not sorted for
+  (≙ internal/leaf traversals with the mutex pool): XLA scatter-add via
+  segment_sum, flagged sorted when the layout mode matches.
+
+Path choice (≙ mttkrp_csf dispatch src/mttkrp.c:1287-1341 +
+p_is_privatized :221-236) is static at trace time.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splatt_tpu.blocked import BlockedSparse, ModeLayout
+from splatt_tpu.config import Options, default_opts
+from splatt_tpu.coo import SparseTensor
+
+PATHS = ("stream", "sorted_onehot", "privatized", "scatter", "sorted_scatter")
+
+
+def _gather_prod(inds: jax.Array, vals: jax.Array,
+                 factors: Sequence[jax.Array], mode: int) -> jax.Array:
+    """(nnz, R) partial products: val · ⊛_{k≠mode} U_k[ind_k].
+
+    Gathers lower to XLA dynamic-gather; the Hadamard chain fuses.
+    Out-of-range (sentinel) indices clamp — their values are zero.
+    """
+    dtype = factors[0].dtype
+    prod = vals.astype(dtype)[:, None]
+    for k, U in enumerate(factors):
+        if k != mode:
+            prod = prod * jnp.take(U, inds[k], axis=0, mode="clip",
+                                   indices_are_sorted=False)
+    return prod
+
+
+# -- stream (oracle) -------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("mode", "dim"))
+def mttkrp_stream(inds: jax.Array, vals: jax.Array,
+                  factors: List[jax.Array], mode: int, dim: int) -> jax.Array:
+    """COO streaming MTTKRP — the gold oracle (≙ src/mttkrp.c:1697-1757)."""
+    prod = _gather_prod(inds, vals, factors, mode)
+    return jax.ops.segment_sum(prod, inds[mode], num_segments=dim)
+
+
+# -- blocked paths ---------------------------------------------------------
+
+def _block_chunks(nblocks: int, elems_per_block: int,
+                  target_elems: int = 1 << 23) -> int:
+    """Blocks per scan step, sized to bound one-hot materialization."""
+    c = max(1, target_elems // max(elems_per_block, 1))
+    return min(c, nblocks)
+
+
+def _scan_onehot(local: jax.Array, prod: jax.Array, width: int,
+                 accumulate: bool) -> jax.Array:
+    """Per-block one-hot reduce: out[b] = onehot(local[b]) @ prod[b].
+
+    local: (nb, B) int32 in [0, width) (out-of-range lanes contribute 0).
+    prod:  (nb, B, R).
+    Returns (nb, width, R) partials, or (width, R) if `accumulate`.
+    Runs as a scan over chunks of blocks so the transient one-hot
+    (chunk, width, B) stays bounded; inside a chunk the one-hot contraction
+    is a batched matmul on the MXU.
+    """
+    nb, B = local.shape
+    R = prod.shape[-1]
+    dtype = prod.dtype
+    C = _block_chunks(nb, width * B)
+    nsteps = -(-nb // C)
+    nb_pad = nsteps * C
+    if nb_pad != nb:
+        local = jnp.pad(local, ((0, nb_pad - nb), (0, 0)), constant_values=-1)
+        prod = jnp.pad(prod, ((0, nb_pad - nb), (0, 0), (0, 0)))
+    local = local.reshape(nsteps, C, B)
+    prod = prod.reshape(nsteps, C, B, R)
+
+    iota = jnp.arange(width, dtype=jnp.int32)
+
+    def step(carry, xs):
+        loc, prd = xs
+        onehot = (loc[:, None, :] == iota[None, :, None]).astype(dtype)
+        part = jnp.einsum("cwb,cbr->cwr", onehot, prd,
+                          preferred_element_type=dtype)
+        if accumulate:
+            return carry + jnp.sum(part, axis=0), None
+        return carry, part
+
+    if accumulate:
+        init = jnp.zeros((width, R), dtype=dtype)
+        acc, _ = jax.lax.scan(step, init, (local, prod))
+        return acc
+    _, parts = jax.lax.scan(step, None, (local, prod))
+    return parts.reshape(nb_pad, width, R)[:nb]
+
+
+@partial(jax.jit, static_argnames=("mode", "path"))
+def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
+                   path: str = "sorted_onehot") -> jax.Array:
+    """Blocked MTTKRP over one :class:`ModeLayout` (static path dispatch)."""
+    dim = int(factors[mode].shape[0])
+    R = factors[mode].shape[1]
+    prod = _gather_prod(layout.inds, layout.vals, factors, mode)
+    seg = layout.inds[mode]
+
+    if path in ("scatter", "sorted_scatter"):
+        nseg = dim + 1 if mode == layout.mode else dim
+        out = jax.ops.segment_sum(prod, seg, num_segments=nseg,
+                                  indices_are_sorted=(path == "sorted_scatter"))
+        return out[:dim]
+
+    nb, B = layout.nblocks, layout.block
+    prod = prod.reshape(nb, B, R)
+
+    if path == "privatized":
+        width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
+        local = seg.reshape(nb, B)
+        return _scan_onehot(local, prod, width, accumulate=True)[:dim]
+
+    if path == "sorted_onehot":
+        if mode != layout.mode:
+            raise ValueError("sorted_onehot requires the layout's own mode")
+        S = layout.seg_width
+        local = seg.reshape(nb, B) - layout.row_start[:, None]
+        parts = _scan_onehot(local, prod, S, accumulate=False)  # (nb, S, R)
+        idx = (layout.row_start[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
+        out = jnp.zeros((dim + S + 1, R), dtype=parts.dtype)
+        out = out.at[idx].add(parts.reshape(-1, R))
+        return out[:dim]
+
+    raise ValueError(f"unknown path {path!r}")
+
+
+def choose_path(layout: ModeLayout, mode: int, opts: Options) -> str:
+    """Static path selection (≙ mttkrp_csf dispatch + p_is_privatized)."""
+    if mode == layout.mode:
+        if layout.seg_width <= opts.onehot_cap:
+            return "sorted_onehot"
+        return "sorted_scatter"
+    return "scatter"
+
+
+def _choose_path_bs(bs: BlockedSparse, mode: int) -> str:
+    layout = bs.layout_for(mode)
+    dim = bs.dims[mode]
+    if mode != layout.mode:
+        if dim + 16 <= bs.opts.priv_cap and dim <= bs.opts.priv_threshold * max(bs.nnz, 1):
+            return "privatized"
+        return "scatter"
+    return choose_path(layout, mode, bs.opts)
+
+
+def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
+           mode: int, path: Optional[str] = None) -> jax.Array:
+    """Public MTTKRP (≙ splatt_mttkrp, include/splatt/api_kernels.h:98-119).
+
+    Accepts a host COO tensor (oracle path) or a compiled BlockedSparse.
+    `path` forces a specific execution path (tests sweep all of them).
+    """
+    if isinstance(X, SparseTensor):
+        if path is not None and path != "stream":
+            raise ValueError(
+                f"path={path!r} requires a BlockedSparse input; a COO "
+                f"SparseTensor only supports the stream path")
+        inds = jnp.asarray(X.inds)
+        vals = jnp.asarray(X.vals)
+        return mttkrp_stream(inds, vals, factors, mode, X.dims[mode])
+    layout = X.layout_for(mode)
+    if path is None:
+        path = _choose_path_bs(X, mode)
+    return mttkrp_blocked(layout, factors, mode, path=path)

@@ -1,0 +1,4 @@
+from splatt_tpu.parallel.mesh import auto_grid, make_mesh
+from splatt_tpu.parallel.sharded import sharded_cpd_als, sharded_mttkrp
+
+__all__ = ["auto_grid", "make_mesh", "sharded_cpd_als", "sharded_mttkrp"]

@@ -1,0 +1,64 @@
+"""Device mesh construction and grid auto-selection (≙ src/mpi/mpi_setup.c).
+
+The reference builds an n-D cartesian MPI grid, auto-sizing it by
+prime-factorizing the rank count onto the longest tensor modes
+(p_get_best_mpi_dim, src/mpi/mpi_io.c:537-574).  On TPU the cartesian
+grid is a `jax.sharding.Mesh`; layer communicators (per-mode
+MPI_Comm_split, src/mpi/mpi_setup.c:201-243) are simply the mesh axis
+names handed to collectives.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def _prime_factors(n: int) -> List[int]:
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return sorted(out, reverse=True)
+
+
+def auto_grid(n_devices: int, dims: Sequence[int]) -> Tuple[int, ...]:
+    """Choose an n-D device grid for tensor `dims` (≙ p_get_best_mpi_dim).
+
+    Greedy: hand each prime factor (largest first) to the mode with the
+    most remaining length per grid slot.
+    """
+    grid = [1] * len(dims)
+    for p in _prime_factors(n_devices):
+        target = int(np.argmax([d / g for d, g in zip(dims, grid)]))
+        grid[target] *= p
+    return tuple(grid)
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("nnz",),
+              grid: Optional[Sequence[int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Default is the 1-D ``('nnz',)`` mesh used by the medium-grain CPD:
+    nonzeros and factor rows are both sharded over it (the reference's
+    per-mode "layer" communicators collapse onto one axis when every
+    mode is row-sharded the same way).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if grid is None:
+        grid = (n,) if len(axis_names) == 1 else auto_grid(n, [1] * len(axis_names))
+    mesh_devs = np.array(devs).reshape(tuple(grid))
+    return Mesh(mesh_devs, tuple(axis_names))

@@ -1,0 +1,150 @@
+"""Tensor reordering (≙ src/reorder.c).
+
+Relabels mode indices to improve locality of the blocked layouts.
+Strategies (≙ splatt_perm_type, src/reorder.h:15-22):
+
+- ``random``: uniform random relabeling of every mode (≙ perm_rand).
+- ``graph``: BFS (Cuthill-McKee-like) traversal of the m-partite graph
+  — co-occurring indices get nearby labels.  The reference delegates to
+  METIS/PaToH partitions (perm_graph, src/reorder.c:412); without an
+  external partitioner we use the locality-driven BFS ordering, and
+  accept explicit partition files via :func:`partition_to_perm`
+  (≙ the partition-driven relabeling path).
+- ``fibsched``: fiber-locality ordering derived from the fiber
+  hypergraph of the smallest mode.
+
+:class:`Permutation` keeps both directions per mode (≙ permutation_t,
+src/reorder.h:29-33): ``perms[m][old] = new`` and ``iperms[m][new] = old``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.graph import tensor_to_graph, hypergraph_fibers, _mode_offsets
+
+PERM_TYPES = ("random", "graph", "fibsched")
+
+
+@dataclasses.dataclass
+class Permutation:
+    perms: List[Optional[np.ndarray]]   # old -> new per mode
+    iperms: List[Optional[np.ndarray]]  # new -> old per mode
+
+    @staticmethod
+    def identity(nmodes: int) -> "Permutation":
+        return Permutation([None] * nmodes, [None] * nmodes)
+
+    @staticmethod
+    def from_perms(perms: Sequence[Optional[np.ndarray]]) -> "Permutation":
+        iperms: List[Optional[np.ndarray]] = []
+        for p in perms:
+            iperms.append(None if p is None else np.argsort(p))
+        return Permutation(list(perms), iperms)
+
+    def apply(self, tt: SparseTensor) -> SparseTensor:
+        """Relabel tensor indices (≙ perm_apply, src/reorder.c:350)."""
+        return tt.permute(self.perms)
+
+    def undo(self, tt: SparseTensor) -> SparseTensor:
+        return tt.permute(self.iperms)
+
+    def apply_to_factor(self, U: np.ndarray, mode: int) -> np.ndarray:
+        """Rows of a factor computed on the relabeled tensor, restored
+        to original labels."""
+        p = self.perms[mode]
+        if p is None:
+            return U
+        out = np.empty_like(U)
+        out[p] = U
+        return out
+
+
+def reorder(tt: SparseTensor, how: str = "graph",
+            seed: int = 0) -> Permutation:
+    """Compute (not apply) a relabeling permutation (≙ tt_perm dispatch,
+    src/reorder.c:271-315)."""
+    if how == "random":
+        rng = np.random.default_rng(seed)
+        return Permutation.from_perms(
+            [rng.permutation(d) for d in tt.dims])
+    if how == "graph":
+        return _graph_bfs_perm(tt)
+    if how == "fibsched":
+        return _fiber_perm(tt)
+    raise ValueError(f"unknown reorder type {how!r} (one of {PERM_TYPES})")
+
+
+def _graph_bfs_perm(tt: SparseTensor) -> Permutation:
+    """BFS over the m-partite graph from the heaviest vertex; each mode's
+    indices are labeled in first-visit order."""
+    g = tensor_to_graph(tt)
+    offs = _mode_offsets(tt.dims)
+    visited = np.zeros(g.nvtxs, dtype=bool)
+    order: List[int] = []
+    # degree-descending start candidates for disconnected components
+    degree = np.diff(g.indptr)
+    candidates = np.argsort(-degree)
+    ci = 0
+    from collections import deque
+
+    queue: deque = deque()
+    while len(order) < g.nvtxs:
+        while ci < g.nvtxs and visited[candidates[ci]]:
+            ci += 1
+        if not queue:
+            if ci >= g.nvtxs:
+                break
+            queue.append(int(candidates[ci]))
+            visited[candidates[ci]] = True
+        while queue:
+            v = queue.popleft()
+            order.append(v)
+            nbrs = g.adj[g.indptr[v]:g.indptr[v + 1]]
+            for n in nbrs:
+                if not visited[n]:
+                    visited[n] = True
+                    queue.append(int(n))
+    perms: List[np.ndarray] = [np.empty(d, dtype=np.int64) for d in tt.dims]
+    next_label = [0] * tt.nmodes
+    for v in order:
+        m = int(np.searchsorted(offs, v, side="right")) - 1
+        idx = v - offs[m]
+        perms[m][idx] = next_label[m]
+        next_label[m] += 1
+    return Permutation.from_perms(perms)
+
+
+def _fiber_perm(tt: SparseTensor) -> Permutation:
+    """Label the smallest mode's indices by fiber-visit order."""
+    root = int(np.argmin(tt.dims))
+    h = hypergraph_fibers(tt, root)
+    offs = _mode_offsets(tt.dims)
+    perms: List[Optional[np.ndarray]] = [None] * tt.nmodes
+    # order root-mode slices by their first fiber id (locality proxy)
+    firsts = np.full(tt.dims[root], np.iinfo(np.int64).max, dtype=np.int64)
+    base = offs[root]
+    for idx in range(tt.dims[root]):
+        lo, hi = h.eptr[base + idx], h.eptr[base + idx + 1]
+        if hi > lo:
+            firsts[idx] = h.eind[lo:hi].min()
+    order = np.argsort(firsts, kind="stable")
+    p = np.empty(tt.dims[root], dtype=np.int64)
+    p[order] = np.arange(tt.dims[root])
+    perms[root] = p
+    return Permutation.from_perms(perms)
+
+
+def partition_to_perm(parts: np.ndarray, dim: int) -> np.ndarray:
+    """Turn a per-index partition assignment into a relabeling that makes
+    each part's indices contiguous (≙ perm from partition file,
+    src/reorder.c:364-412; also the FINE decomposition input)."""
+    parts = np.asarray(parts[:dim])
+    order = np.argsort(parts, kind="stable")
+    perm = np.empty(dim, dtype=np.int64)
+    perm[order] = np.arange(dim)
+    return perm

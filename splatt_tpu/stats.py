@@ -1,0 +1,61 @@
+"""Tensor and factorization statistics (≙ src/stats.c).
+
+- :func:`tensor_stats`  ≙ stats_tt basic dims/nnz/density/storage
+  (src/stats.c:26-42)
+- :func:`cpd_stats_text` ≙ cpd_stats factoring header (rank, iters, tol,
+  allocation, storage — src/stats.c:226-296)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from splatt_tpu.blocked import BlockedSparse
+from splatt_tpu.config import Options
+from splatt_tpu.coo import SparseTensor
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.2f}{unit}"
+        n /= 1024
+    return f"{n:.2f}TB"
+
+
+def coo_storage_bytes(tt: SparseTensor) -> int:
+    return tt.inds.size * tt.inds.dtype.itemsize + tt.vals.nbytes
+
+
+def tensor_stats(tt: SparseTensor, name: str = "tensor") -> str:
+    dims = "x".join(str(d) for d in tt.dims)
+    lines = [
+        f"Tensor information ---------------------------------",
+        f"FILE={name}",
+        f"DIMS={dims} NNZ={tt.nnz}",
+        f"DENSITY={tt.density():e}",
+        f"COORD-STORAGE={_human_bytes(coo_storage_bytes(tt))}",
+    ]
+    return "\n".join(lines)
+
+
+def cpd_stats_text(bs_or_tt, rank: int, opts: Options) -> str:
+    lines = [
+        "Factoring ------------------------------------------",
+        f"NFACTORS={rank} MAXITS={opts.max_iterations} TOL={opts.tolerance:0.1e} "
+        f"REG={opts.regularization:0.1e} SEED={opts.seed()} THREADS=XLA",
+    ]
+    if isinstance(bs_or_tt, BlockedSparse):
+        bs = bs_or_tt
+        nlay = len(bs.layouts)
+        lines.append(
+            f"BLOCKED-ALLOC={bs.opts.block_alloc.value} NNZ-BLOCK={bs.opts.nnz_block} "
+            f"LAYOUTS={nlay}")
+        lines.append(f"BLOCKED-STORAGE={_human_bytes(bs.storage_bytes())}")
+        for i, lay in enumerate(bs.layouts):
+            lines.append(
+                f"  layout[{i}]: mode={lay.mode} nblocks={lay.nblocks} "
+                f"seg_width={lay.seg_width} pad={lay.nnz_pad - lay.nnz}")
+    return "\n".join(lines)

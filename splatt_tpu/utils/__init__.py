@@ -1,0 +1,3 @@
+from splatt_tpu.utils.timers import timers, Timer, TimerRegistry
+
+__all__ = ["timers", "Timer", "TimerRegistry"]

@@ -1,0 +1,28 @@
+"""Environment/platform helpers shared by entry points."""
+
+from __future__ import annotations
+
+import os
+
+
+def ceil_to(x: int, mult: int) -> int:
+    """Round x up to a multiple of mult."""
+    return ((x + mult - 1) // mult) * mult
+
+
+def apply_env_platform() -> None:
+    """Mirror JAX_PLATFORMS into jax.config.
+
+    Some images install a site plugin (e.g. a TPU relay) that selects
+    platforms programmatically at interpreter startup, which overrides
+    the JAX_PLATFORMS env var.  Calling this before any backend
+    initializes makes the env var authoritative again.
+    """
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:
+            pass

@@ -1,0 +1,107 @@
+"""Named wall-clock timer registry (≙ src/timer.{h,c}).
+
+The reference keeps a global array of named timers with verbosity levels
+gating which are reported (timers[TIMER_NTIMERS], src/timer.h:36-85;
+report_times, src/timer.c:67-90).  Same idea here: a process-global
+registry, `timers.start/stop(name)` brackets, and a leveled report.
+
+JAX note: device work is asynchronous — wrap regions whose cost you want
+attributed with ``block=True`` (calls ``block_until_ready`` on a token) or
+time whole steps; fine-grained on-device attribution belongs to the JAX
+profiler, not wall clocks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+# Report levels (≙ timer_lvl in src/timer.h): 0 none, 1 summary, 2 detail.
+_DEFAULT_LEVELS = {
+    "total": 1,
+    "io": 1,
+    "blocked_build": 1,   # ≙ TIMER_CSF
+    "sort": 2,            # ≙ TIMER_SORT
+    "cpd": 1,             # ≙ TIMER_CPD
+    "mttkrp": 2,          # ≙ TIMER_MTTKRP
+    "solve": 2,           # ≙ TIMER_INV
+    "fit": 2,             # ≙ TIMER_FIT
+    "reorder": 2,         # ≙ TIMER_PART
+    "bench": 1,
+}
+
+
+class Timer:
+    __slots__ = ("name", "seconds", "_t0", "running", "level")
+
+    def __init__(self, name: str, level: int = 2) -> None:
+        self.name = name
+        self.seconds = 0.0
+        self._t0 = 0.0
+        self.running = False
+        self.level = level
+
+    def start(self) -> None:
+        if not self.running:
+            self.running = True
+            self._t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        if self.running:
+            self.seconds += time.perf_counter() - self._t0
+            self.running = False
+
+    def reset(self) -> None:
+        self.seconds = 0.0
+        self.running = False
+
+
+class TimerRegistry:
+    def __init__(self) -> None:
+        self._timers: Dict[str, Timer] = {}
+        for name, lvl in _DEFAULT_LEVELS.items():
+            self._timers[name] = Timer(name, lvl)
+
+    def get(self, name: str) -> Timer:
+        if name not in self._timers:
+            self._timers[name] = Timer(name)
+        return self._timers[name]
+
+    def start(self, name: str) -> None:
+        self.get(name).start()
+
+    def stop(self, name: str) -> None:
+        self.get(name).stop()
+
+    def reset(self) -> None:
+        for t in self._timers.values():
+            t.reset()
+
+    def __getitem__(self, name: str) -> float:
+        return self.get(name).seconds
+
+    class _Bracket:
+        def __init__(self, timer: Timer) -> None:
+            self.timer = timer
+
+        def __enter__(self):
+            self.timer.start()
+            return self.timer
+
+        def __exit__(self, *exc):
+            self.timer.stop()
+            return False
+
+    def time(self, name: str) -> "TimerRegistry._Bracket":
+        return self._Bracket(self.get(name))
+
+    def report(self, level: int = 1) -> str:
+        """≙ report_times (src/timer.c:67-90)."""
+        lines = ["", "Timing information ---------------------------------"]
+        for t in self._timers.values():
+            if t.seconds > 0 and t.level <= level:
+                lines.append(f"  {t.name + ':':<16s} {t.seconds:0.3f}s")
+        return "\n".join(lines)
+
+
+timers = TimerRegistry()

@@ -1,0 +1,45 @@
+"""Test configuration.
+
+Must run before jax is imported anywhere: force the CPU platform with 8
+virtual devices so multi-chip sharding tests run on a single host
+(≙ the reference testing MPI paths with `mpirun -np 4 / -np 7` on one
+machine, scripts/mpi_test.sh), and enable x64 so differential tests can
+use the reference's double-precision tolerances (tests/mttkrp_test.c:25-30).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The env var alone is not enough where a site plugin (e.g. the axon TPU
+# relay) selects platforms via jax.config at interpreter startup — the
+# config programmatically set wins over JAX_PLATFORMS.  Setting it here,
+# before any backend initializes, forces pure-CPU tests and keeps the
+# single real TPU chip free (and avoids serializing test processes on
+# its lease).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+from tests import gen
+
+
+@pytest.fixture(scope="session")
+def tensors_dir(tmp_path_factory):
+    """Generate the fixture tensor files once per session."""
+    d = tmp_path_factory.mktemp("tensors")
+    gen.write_fixtures(d)
+    return d
+
+
+@pytest.fixture(params=["small", "med", "small4", "med4", "med5"])
+def any_tensor(request):
+    """All fixture tensors as in-memory COO (≙ tests/tensors/*.tns sweep)."""
+    return gen.fixture_tensor(request.param)

@@ -1,0 +1,58 @@
+"""Deterministic fixture tensor generation.
+
+The reference ships five tiny real COO tensors (tests/tensors/: small.tns,
+med.tns 3-mode; small4.tns, med4.tns 4-mode; med5.tns 5-mode, plus a
+0-indexed variant — tests/splatt_test.h:11-28).  We generate equivalents
+deterministically instead of copying data files: same shapes/roles, fixed
+seeds, including skewed (power-law-ish) index distributions so the sorted/
+blocked paths see realistic slice imbalance.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.io import save
+
+_SPECS = {
+    # name: (dims, nnz, seed, skew)
+    "small": ((4, 4, 3), 10, 1, False),
+    "med": ((40, 36, 44), 3000, 2, True),
+    "small4": ((4, 3, 3, 5), 12, 3, False),
+    "med4": ((30, 24, 36, 20), 3000, 4, True),
+    "med5": ((20, 18, 24, 14, 10), 3000, 5, True),
+}
+
+
+def _skewed_indices(rng: np.random.Generator, dim: int, nnz: int) -> np.ndarray:
+    """Zipf-ish slice sizes: realistic power-law imbalance."""
+    raw = rng.zipf(1.5, size=nnz) % dim
+    return raw.astype(np.int64)
+
+
+def fixture_tensor(name: str) -> SparseTensor:
+    dims, nnz, seed, skew = _SPECS[name]
+    rng = np.random.default_rng(seed)
+    if skew:
+        ind = np.stack([_skewed_indices(rng, d, nnz) for d in dims])
+    else:
+        ind = np.stack([rng.integers(0, d, size=nnz) for d in dims])
+    vals = np.round(rng.random(nnz) * 4.0, 1) + 0.1
+    tt = SparseTensor(ind, vals, dims).deduplicate()
+    # ensure no empty slices so dims are exact (mirrors the real fixtures)
+    tt = tt.remove_empty_slices()
+    tt.indmaps = None
+    return tt
+
+
+def write_fixtures(directory) -> None:
+    os.makedirs(directory, exist_ok=True)
+    for name in _SPECS:
+        tt = fixture_tensor(name)
+        save(tt, str(directory / f"{name}.tns"), one_indexed=True)
+    # 0-indexed variant (≙ small4_zeroidx.tns)
+    tt = fixture_tensor("small4")
+    save(tt, str(directory / "small4_zeroidx.tns"), one_indexed=False)

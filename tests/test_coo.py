@@ -1,0 +1,95 @@
+"""COO tensor unit tests (≙ tests/sptensor_test.c)."""
+
+import numpy as np
+import pytest
+
+from splatt_tpu.coo import SparseTensor
+from tests import gen
+
+
+def test_basic_properties(any_tensor):
+    tt = any_tensor
+    assert tt.nnz > 0
+    assert tt.nmodes == len(tt.dims)
+    for m in range(tt.nmodes):
+        assert tt.inds[m].min() >= 0
+        assert tt.inds[m].max() < tt.dims[m]
+    assert tt.normsq() == pytest.approx(np.sum(tt.vals ** 2))
+
+
+def test_deduplicate_sums_values():
+    ind = np.array([[0, 0, 1, 0], [1, 1, 2, 1], [2, 2, 0, 2]])
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    tt = SparseTensor(ind, vals, (2, 3, 3)).deduplicate()
+    assert tt.nnz == 2
+    dense = tt.to_dense()
+    assert dense[0, 1, 2] == pytest.approx(7.0)
+    assert dense[1, 2, 0] == pytest.approx(3.0)
+
+
+def test_count_duplicates():
+    ind = np.array([[0, 0, 1], [1, 1, 2], [2, 2, 0]])
+    tt = SparseTensor(ind, np.ones(3), (2, 3, 3))
+    assert tt.count_duplicates() == 1
+
+
+def test_remove_empty_slices_indmap():
+    # mode 0 uses only indices {1, 3} of dim 5
+    ind = np.array([[1, 3, 3], [0, 1, 2], [0, 0, 1]])
+    tt = SparseTensor(ind, np.arange(3, dtype=float), (5, 3, 2))
+    out = tt.remove_empty_slices()
+    assert out.dims == (2, 3, 2)
+    assert out.indmaps[0].tolist() == [1, 3]
+    assert out.indmaps[1] is None
+    np.testing.assert_array_equal(out.inds[0], [0, 1, 1])
+    # dense content preserved through the relabeling
+    np.testing.assert_allclose(out.to_dense(),
+                               tt.to_dense()[[1, 3], :, :])
+
+
+def test_sort_lexicographic(any_tensor):
+    tt = any_tensor.sorted_by(range(any_tensor.nmodes))
+    keys = tt.inds
+    for n in range(1, tt.nnz):
+        a = tuple(keys[m, n - 1] for m in range(tt.nmodes))
+        b = tuple(keys[m, n] for m in range(tt.nmodes))
+        assert a <= b
+
+
+def test_sort_preserves_content(any_tensor):
+    tt = any_tensor
+    perm_order = list(reversed(range(tt.nmodes)))
+    out = tt.sorted_by(perm_order)
+    np.testing.assert_allclose(out.to_dense(), tt.to_dense())
+
+
+def test_unfold_matches_dense():
+    tt = gen.fixture_tensor("small")
+    dense = tt.to_dense()
+    for mode in range(tt.nmodes):
+        indptr, cols, vals, shape = tt.unfold(mode)
+        mat = np.zeros(shape)
+        for r in range(shape[0]):
+            for k in range(indptr[r], indptr[r + 1]):
+                mat[r, cols[k]] += vals[k]
+        # build expected unfolding: mode first, remaining modes in order
+        order = [mode] + [m for m in range(tt.nmodes) if m != mode]
+        expected = np.transpose(dense, order).reshape(shape)
+        np.testing.assert_allclose(mat, expected)
+
+
+def test_permute_roundtrip(any_tensor):
+    tt = any_tensor
+    rng = np.random.default_rng(0)
+    perms = [rng.permutation(d) for d in tt.dims]
+    inv = [np.argsort(p) for p in perms]
+    out = tt.permute(perms).permute(inv)
+    np.testing.assert_array_equal(out.inds, tt.inds)
+
+
+def test_mode_histogram(any_tensor):
+    tt = any_tensor
+    for m in range(tt.nmodes):
+        hist = tt.mode_histogram(m)
+        assert hist.sum() == tt.nnz
+        assert hist.shape[0] == tt.dims[m]

@@ -1,0 +1,78 @@
+"""IO round-trip tests (≙ tests/io_test.c)."""
+
+import numpy as np
+import pytest
+
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.io import (load, read_matrix, read_permutation, save,
+                           write_matrix, write_permutation, write_vector)
+from tests import gen
+
+
+def test_text_roundtrip(tmp_path, any_tensor):
+    tt = any_tensor
+    path = str(tmp_path / "t.tns")
+    save(tt, path)
+    out = load(path)
+    assert out.dims == tt.dims
+    np.testing.assert_array_equal(out.inds, tt.inds)
+    np.testing.assert_allclose(out.vals, tt.vals)
+
+
+def test_zero_vs_one_indexed(tmp_path):
+    """≙ small4_zeroidx.tns autodetect (src/io.c:273-348)."""
+    tt = gen.fixture_tensor("small4")
+    p1 = str(tmp_path / "one.tns")
+    p0 = str(tmp_path / "zero.tns")
+    save(tt, p1, one_indexed=True)
+    save(tt, p0, one_indexed=False)
+    a, b = load(p1), load(p0)
+    np.testing.assert_array_equal(a.inds, b.inds)
+    assert a.dims == b.dims
+
+
+def test_binary_roundtrip(tmp_path, any_tensor):
+    tt = any_tensor
+    path = str(tmp_path / "t.bin")
+    save(tt, path)
+    out = load(path)
+    assert out.dims == tt.dims
+    np.testing.assert_array_equal(out.inds, tt.inds)
+    np.testing.assert_allclose(out.vals, tt.vals)
+
+
+def test_binary_wide_indices(tmp_path):
+    """Indices above 2^31 force 8-byte storage."""
+    ind = np.array([[0, 2**31 + 5], [1, 0], [0, 1]], dtype=np.int64)
+    tt = SparseTensor(ind, np.array([1.0, 2.0]), (2**31 + 6, 2, 2))
+    path = str(tmp_path / "wide.bin")
+    save(tt, path)
+    out = load(path)
+    np.testing.assert_array_equal(out.inds, tt.inds)
+
+
+def test_comments_and_blank_lines(tmp_path):
+    path = tmp_path / "c.tns"
+    path.write_text("# header comment\n\n1 2 1 1.5\n# mid comment\n2 1 2 2.5\n")
+    tt = load(str(path))
+    assert tt.nnz == 2
+    assert tt.dims == (2, 2, 2)
+    np.testing.assert_allclose(tt.vals, [1.5, 2.5])
+
+
+def test_fixture_files_load(tensors_dir):
+    for name in ("small", "med", "small4", "med4", "med5"):
+        tt = load(str(tensors_dir / f"{name}.tns"))
+        ref = gen.fixture_tensor(name)
+        assert tt.dims == ref.dims
+        assert tt.nnz == ref.nnz
+
+
+def test_matrix_vector_perm_roundtrip(tmp_path):
+    mat = np.arange(12, dtype=float).reshape(4, 3) / 7.0
+    write_matrix(mat, str(tmp_path / "m.mat"))
+    np.testing.assert_allclose(read_matrix(str(tmp_path / "m.mat")), mat)
+    write_vector(mat[:, 0], str(tmp_path / "v.vec"))
+    perm = np.array([3, 1, 0, 2])
+    write_permutation(perm, str(tmp_path / "p.perm"))
+    np.testing.assert_array_equal(read_permutation(str(tmp_path / "p.perm")), perm)

@@ -1,0 +1,72 @@
+"""Dense linalg tests (≙ tests/matrix_test.c)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from splatt_tpu.ops.linalg import (form_normal_lhs, gram, normalize_columns,
+                                   solve_normals)
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).random(shape))
+
+
+def test_gram():
+    U = _rand((20, 6))
+    np.testing.assert_allclose(np.asarray(gram(U)),
+                               np.asarray(U).T @ np.asarray(U), atol=1e-12)
+
+
+def test_form_normal_lhs():
+    grams = [gram(_rand((10, 4), seed=s)) for s in range(3)]
+    lhs = form_normal_lhs(grams, mode=1, regularization=0.5)
+    want = np.asarray(grams[0]) * np.asarray(grams[2]) + 0.5 * np.eye(4)
+    np.testing.assert_allclose(np.asarray(lhs), want, atol=1e-12)
+
+
+def test_solve_normals_spd():
+    rng = np.random.default_rng(3)
+    A = rng.random((5, 5))
+    lhs = jnp.asarray(A @ A.T + 5 * np.eye(5))  # SPD
+    rhs = _rand((12, 5), seed=4)
+    X = solve_normals(lhs, rhs)
+    # X · lhs = rhs
+    np.testing.assert_allclose(np.asarray(X @ lhs), np.asarray(rhs), atol=1e-8)
+
+
+def test_solve_normals_singular_fallback():
+    """Rank-deficient lhs exercises the pseudoinverse path
+    (≙ the gelss fallback, src/matrix.c:554-603)."""
+    v = np.array([1.0, 2.0, 3.0])
+    lhs = jnp.asarray(np.outer(v, v))  # rank 1, not SPD
+    rhs = _rand((4, 3), seed=5)
+    X = solve_normals(lhs, rhs)
+    assert np.all(np.isfinite(np.asarray(X)))
+    # least-squares optimality: residual orthogonal to range(lhs)
+    resid = np.asarray(X @ lhs) - np.asarray(rhs)
+    np.testing.assert_allclose(resid @ np.asarray(lhs).T, 0.0, atol=1e-8)
+
+
+def test_normalize_2norm():
+    U = _rand((30, 5), seed=6)
+    out, lam = normalize_columns(U, "2")
+    np.testing.assert_allclose(np.asarray(jnp.linalg.norm(out, axis=0)),
+                               1.0, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out * lam), np.asarray(U), atol=1e-12)
+
+
+def test_normalize_maxnorm_floor():
+    """Max-norm clamps λ below 1 to 1 (≙ p_mat_maxnorm)."""
+    U = jnp.asarray(np.array([[0.5, 3.0], [0.25, -6.0]]))
+    out, lam = normalize_columns(U, "max")
+    np.testing.assert_allclose(np.asarray(lam), [1.0, 6.0])
+    np.testing.assert_allclose(np.asarray(out),
+                               [[0.5, 0.5], [0.25, -1.0]])
+
+
+def test_normalize_zero_column_safe():
+    U = jnp.asarray(np.array([[0.0, 1.0], [0.0, 1.0]]))
+    out, lam = normalize_columns(U, "2")
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert float(lam[0]) == 0.0

@@ -1,0 +1,100 @@
+"""Multi-device tests on the 8-virtual-CPU-device mesh.
+
+≙ the reference's `mpirun -np 4 / -np 7` single-machine tests
+(scripts/mpi_test.sh) — including the deliberately-awkward device count
+(row/nnz counts not divisible by the mesh) via padding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from splatt_tpu.config import Options, Verbosity
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.cpd import cpd_als, init_factors
+from splatt_tpu.parallel.mesh import auto_grid, make_mesh
+from splatt_tpu.parallel.sharded import (shard_factors, shard_nnz,
+                                         sharded_cpd_als, sharded_mttkrp)
+from tests import gen
+from tests.test_mttkrp import np_mttkrp
+
+
+def _opts(**kw):
+    kw.setdefault("random_seed", 42)
+    kw.setdefault("verbosity", Verbosity.NONE)
+    kw.setdefault("val_dtype", np.float64)
+    return Options(**kw)
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_auto_grid():
+    """≙ p_get_best_mpi_dim (src/mpi/mpi_io.c:537-574)."""
+    assert sorted(auto_grid(8, (100, 100, 100))) == [1, 2, 4] \
+        or sorted(auto_grid(8, (100, 100, 100))) == [2, 2, 2]
+    g = auto_grid(12, (1000, 10, 10))
+    assert np.prod(g) == 12
+    assert g[0] >= 4  # longest mode gets the most devices
+    assert auto_grid(7, (5, 5)) in ((7, 1), (1, 7))
+    assert np.prod(auto_grid(1, (3, 3, 3))) == 1
+
+
+@pytest.mark.parametrize("ndev", [4, 8])
+def test_sharded_mttkrp_matches_oracle(ndev):
+    tt = gen.fixture_tensor("med")
+    mesh = make_mesh(n_devices=ndev)
+    rank = 8
+    rng = np.random.default_rng(3)
+    factors_host = [jnp.asarray(rng.random((d, rank))) for d in tt.dims]
+    inds, vals = shard_nnz(tt, mesh, val_dtype=np.float64)
+    factors = shard_factors(factors_host, tt.dims, mesh)
+    for mode in range(tt.nmodes):
+        got = np.asarray(sharded_mttkrp(inds, vals, factors, mode, mesh))
+        want = np_mttkrp(tt, factors_host, mode)
+        np.testing.assert_allclose(got[:tt.dims[mode]], want, atol=1e-10)
+        # padded rows receive nothing
+        np.testing.assert_allclose(got[tt.dims[mode]:], 0.0, atol=0)
+
+
+def test_sharded_cpd_matches_single_device():
+    """Same seed → same fit on 1 device and 8 devices (rank-count
+    invariance, ≙ mpi_mat_rand seed stability)."""
+    tt = gen.fixture_tensor("med")
+    opts = _opts(max_iterations=8)
+    init = init_factors(tt.dims, 6, opts.seed(), dtype=jnp.float64)
+    single = cpd_als(tt, rank=6, opts=opts, init=init)
+    mesh = make_mesh(n_devices=8)
+    multi = sharded_cpd_als(tt, rank=6, mesh=mesh, opts=opts, init=init)
+    assert float(multi.fit) == pytest.approx(float(single.fit), abs=1e-8)
+    for a, b in zip(single.factors, multi.factors):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_sharded_cpd_device_count_invariance():
+    """Fit identical across device counts (4 vs 8)."""
+    tt = gen.fixture_tensor("med4")
+    opts = _opts(max_iterations=6)
+    init = init_factors(tt.dims, 4, opts.seed(), dtype=jnp.float64)
+    fits = []
+    for ndev in (4, 8):
+        mesh = make_mesh(n_devices=ndev)
+        out = sharded_cpd_als(tt, rank=4, mesh=mesh, opts=opts, init=init)
+        fits.append(float(out.fit))
+    assert fits[0] == pytest.approx(fits[1], abs=1e-9)
+
+
+def test_sharded_awkward_sizes():
+    """Dims and nnz not divisible by the device count (≙ -np 7 tests)."""
+    rng = np.random.default_rng(9)
+    dims = (13, 11, 7)
+    tt = SparseTensor(
+        np.stack([rng.integers(0, d, size=101) for d in dims]),
+        rng.random(101), dims).deduplicate()
+    mesh = make_mesh(n_devices=8)
+    out = sharded_cpd_als(tt, rank=3, mesh=mesh, opts=_opts(max_iterations=4))
+    assert np.isfinite(float(out.fit))
+    for U, d in zip(out.factors, dims):
+        assert U.shape == (d, 3)
