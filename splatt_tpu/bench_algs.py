@@ -1,0 +1,100 @@
+"""MTTKRP algorithm comparison harness (≙ src/bench.c + cmd_bench.c).
+
+The reference's `splatt bench` times MTTKRP algorithms {splatt, csf,
+giga, ttbox, coord} per mode with thread scaling (src/bench.c:50-436).
+The TPU equivalents are the execution paths of
+:mod:`splatt_tpu.ops.mttkrp`: {stream, sorted_onehot(+pallas),
+privatized, scatter}; thread scaling has no analog (XLA owns the chip),
+so the sweep axis is the path × engine matrix instead.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splatt_tpu.blocked import BlockedSparse
+from splatt_tpu.config import BlockAlloc, Options
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.cpd import init_factors
+from splatt_tpu.ops.mttkrp import (choose_impl, mttkrp_blocked,
+                                   mttkrp_stream)
+
+ALGS = ("stream", "blocked", "blocked_pallas", "scatter")
+
+
+def _time_call(fn, warmup: int = 1, reps: int = 3) -> float:
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_mttkrp(tt: SparseTensor, rank: int = 16,
+                 algs: Sequence[str] = ALGS,
+                 opts: Optional[Options] = None,
+                 reps: int = 3) -> Dict[str, List[float]]:
+    """Per-mode wall clock for each algorithm; returns alg -> [sec/mode].
+
+    ≙ the per-mode timing loop of src/bench.c:84-117.
+    """
+    opts = opts or Options(block_alloc=BlockAlloc.ALLMODE)
+    dtype = jnp.dtype(opts.val_dtype)
+    factors = init_factors(tt.dims, rank, opts.seed() or 1, dtype=dtype)
+    inds = jnp.asarray(tt.inds)
+    vals = jnp.asarray(tt.vals, dtype=dtype)
+    results: Dict[str, List[float]] = {}
+
+    needs_blocked = any(a != "stream" for a in algs)
+    bs = BlockedSparse.from_coo(tt, opts) if needs_blocked else None
+
+    for alg in algs:
+        times: List[float] = []
+        for mode in range(tt.nmodes):
+            if alg == "stream":
+                fn = lambda: mttkrp_stream(inds, vals, factors, mode,
+                                           tt.dims[mode])
+            else:
+                layout = bs.layout_for(mode)
+                if alg == "scatter":
+                    path = ("sorted_scatter" if layout.mode == mode
+                            else "scatter")
+                    impl = "xla"
+                elif alg == "blocked":
+                    path = ("sorted_onehot" if layout.mode == mode
+                            else "privatized")
+                    impl = "xla"
+                elif alg == "blocked_pallas":
+                    path = ("sorted_onehot" if layout.mode == mode
+                            else "privatized")
+                    impl = choose_impl(
+                        Options(use_pallas=True, val_dtype=opts.val_dtype))
+                else:
+                    raise ValueError(f"unknown algorithm {alg!r}")
+                if path == "privatized":
+                    width = tt.dims[mode] + 16
+                    if width > opts.priv_cap:
+                        times.append(float("nan"))
+                        continue
+                fn = lambda: mttkrp_blocked(layout, factors, mode,
+                                            path=path, impl=impl)
+            times.append(_time_call(fn, reps=reps))
+        results[alg] = times
+    return results
+
+
+def format_bench(results: Dict[str, List[float]]) -> str:
+    lines = []
+    for alg, times in results.items():
+        cols = "  ".join(f"mode{m}: {'  nan  ' if np.isnan(t) else f'{t:0.5f}'}"
+                         for m, t in enumerate(times))
+        total = np.nansum(times)
+        lines.append(f"  {alg:<16s} {cols}  total: {total:0.5f}s")
+    return "\n".join(lines)

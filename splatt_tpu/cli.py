@@ -1,0 +1,248 @@
+"""Command-line interface (≙ src/cmds/: the `splatt` binary).
+
+Verbs mirror splatt_cmds.h:77-92: cpd, bench, check, convert, reorder,
+stats.  Invoke as ``python -m splatt_tpu.cli <verb> ...`` or via the
+``splatt-tpu`` console entry.
+
+Example (≙ `splatt cpd mytensor.tns -r 16 -v`):
+
+    python -m splatt_tpu.cli cpd mytensor.tns -r 16 -v
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+from splatt_tpu.utils.env import apply_env_platform
+
+apply_env_platform()
+
+
+def _common_opts(p: argparse.ArgumentParser) -> None:
+    p.add_argument("tensor", help="coordinate tensor file (.tns/.bin)")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="increase verbosity (repeatable)")
+
+
+def _build_opts(args) -> "Options":
+    from splatt_tpu.config import BlockAlloc, Options, Verbosity
+
+    opts = Options()
+    opts.verbosity = Verbosity(min(1 + getattr(args, "verbose", 0), 3))
+    if getattr(args, "tol", None) is not None:
+        opts.tolerance = args.tol
+    if getattr(args, "iters", None) is not None:
+        opts.max_iterations = args.iters
+    if getattr(args, "reg", None) is not None:
+        opts.regularization = args.reg
+    if getattr(args, "seed", None) is not None:
+        opts.random_seed = args.seed
+    if getattr(args, "alloc", None):
+        opts.block_alloc = BlockAlloc(args.alloc)
+    if getattr(args, "block", None):
+        opts.nnz_block = args.block
+    if getattr(args, "f64", False):
+        opts.val_dtype = np.dtype(np.float64)
+    return opts
+
+
+def cmd_cpd(args) -> int:
+    """≙ splatt_cpd_cmd (src/cmds/cmd_cpd.c:159-243)."""
+    import jax.numpy as jnp
+
+    from splatt_tpu.blocked import BlockedSparse
+    from splatt_tpu.config import Verbosity
+    from splatt_tpu.cpd import cpd_als
+    from splatt_tpu.io import load, write_matrix, write_vector
+    from splatt_tpu.stats import cpd_stats_text, tensor_stats
+    from splatt_tpu.utils.timers import timers
+
+    opts = _build_opts(args)
+    timers.start("total")
+    with timers.time("io"):
+        tt = load(args.tensor)
+    print(tensor_stats(tt, args.tensor))
+    with timers.time("blocked_build"):
+        bs = BlockedSparse.from_coo(tt, opts)
+    print(cpd_stats_text(bs, args.rank, opts))
+    out = cpd_als(bs, rank=args.rank, opts=opts)
+    print(f"Final fit: {float(out.fit):0.5f}")
+    if not args.nowrite:
+        for m, U in enumerate(out.factors):
+            write_matrix(np.asarray(U), f"mode{m + 1}.mat")
+        write_vector(np.asarray(out.lam), "lambda.mat")
+    timers.stop("total")
+    if opts.verbosity >= Verbosity.LOW:
+        print(timers.report(level=2 if opts.verbosity >= Verbosity.HIGH
+                            else 1))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    """≙ splatt_bench_cmd (src/cmds/cmd_bench.c:198-286)."""
+    from splatt_tpu.bench_algs import ALGS, bench_mttkrp, format_bench
+    from splatt_tpu.io import load
+    from splatt_tpu.reorder import reorder
+    from splatt_tpu.stats import tensor_stats
+
+    opts = _build_opts(args)
+    tt = load(args.tensor)
+    print(tensor_stats(tt, args.tensor))
+    if args.permute:
+        perm = reorder(tt, args.permute, seed=opts.seed())
+        tt = perm.apply(tt)
+        print(f"  (reordered: {args.permute})")
+    algs = args.alg or list(ALGS)
+    results = bench_mttkrp(tt, rank=args.rank, algs=algs, opts=opts,
+                           reps=args.reps)
+    print(f"Benchmarking MTTKRP, rank {args.rank}, {args.reps} reps")
+    print(format_bench(results))
+    return 0
+
+
+def cmd_check(args) -> int:
+    """≙ splatt_check_cmd (src/cmds/cmd_check.c:63-116): find (and
+    optionally fix) duplicate nonzeros and empty slices."""
+    from splatt_tpu.io import load, save
+
+    tt = load(args.tensor)
+    ndup = tt.count_duplicates()
+    nempty = sum(tt.dims[m] - tt.nslices_nonempty(m)
+                 for m in range(tt.nmodes))
+    print(f"duplicates: {ndup}  empty slices: {nempty}")
+    if args.fix:
+        fixed = tt.deduplicate().remove_empty_slices()
+        save(fixed, args.fix)
+        print(f"wrote fixed tensor: {args.fix} "
+              f"(nnz {tt.nnz} -> {fixed.nnz}, dims {tt.dims} -> {fixed.dims})")
+    return 0 if (ndup == 0 and nempty == 0) else 1
+
+
+def cmd_convert(args) -> int:
+    """≙ splatt_convert_cmd (src/cmds/cmd_convert.c)."""
+    from splatt_tpu.convert import convert
+    from splatt_tpu.io import load
+
+    tt = load(args.tensor)
+    convert(tt, args.type, args.output, mode=args.mode)
+    print(f"wrote {args.type}: {args.output}")
+    return 0
+
+
+def cmd_reorder(args) -> int:
+    """≙ splatt_reorder_cmd (src/cmds/cmd_reorder.c)."""
+    from splatt_tpu.io import load, save, write_permutation
+    from splatt_tpu.reorder import reorder
+
+    tt = load(args.tensor)
+    perm = reorder(tt, args.type, seed=args.seed or 0)
+    out = perm.apply(tt)
+    save(out, args.output)
+    for m, p in enumerate(perm.perms):
+        if p is not None and args.write_perms:
+            write_permutation(p, f"{args.output}.perm{m}")
+    print(f"wrote reordered tensor: {args.output}")
+    return 0
+
+
+def cmd_stats(args) -> int:
+    """≙ splatt_stats_cmd (src/cmds/cmd_stats.c)."""
+    from splatt_tpu.io import load
+    from splatt_tpu.stats import tensor_stats
+
+    tt = load(args.tensor)
+    print(tensor_stats(tt, args.tensor))
+    for m in range(tt.nmodes):
+        hist = tt.mode_histogram(m)
+        nz = hist[hist > 0]
+        print(f"  mode {m}: dim={tt.dims[m]} nonempty={nz.size} "
+              f"nnz/slice min={nz.min() if nz.size else 0} "
+              f"avg={tt.nnz / max(nz.size, 1):.1f} "
+              f"max={nz.max() if nz.size else 0}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="splatt-tpu",
+        description="Sparse tensor factorization on TPU "
+                    "(CPD-ALS over blocked sparse formats)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("cpd", help="compute the CPD of a sparse tensor")
+    _common_opts(p)
+    p.add_argument("-r", "--rank", type=int, default=10)
+    p.add_argument("-t", "--tol", type=float)
+    p.add_argument("-i", "--iters", type=int)
+    p.add_argument("--reg", type=float)
+    p.add_argument("--seed", type=int)
+    p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
+    p.add_argument("--block", type=int, help="nnz per block")
+    p.add_argument("--f64", action="store_true", help="double precision")
+    p.add_argument("--nowrite", action="store_true",
+                   help="skip writing factor files")
+    p.set_defaults(fn=cmd_cpd)
+
+    p = sub.add_parser("bench", help="benchmark MTTKRP algorithms")
+    _common_opts(p)
+    p.add_argument("-r", "--rank", type=int, default=16)
+    p.add_argument("-a", "--alg", action="append",
+                   help="algorithm (repeatable): stream/blocked/"
+                        "blocked_pallas/scatter")
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--seed", type=int)
+    p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
+    p.add_argument("--block", type=int)
+    p.add_argument("--f64", action="store_true")
+    p.add_argument("--permute", choices=["random", "graph", "fibsched"],
+                   help="reorder the tensor first")
+    p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser("check", help="check for duplicates/empty slices")
+    _common_opts(p)
+    p.add_argument("--fix", metavar="OUT",
+                   help="write a fixed tensor to OUT")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("convert", help="convert to other formats")
+    _common_opts(p)
+    p.add_argument("type", choices=["graph", "fibmat", "fibhgraph",
+                                    "nnzhgraph", "bin", "coord"])
+    p.add_argument("output")
+    p.add_argument("-m", "--mode", type=int, default=0)
+    p.set_defaults(fn=cmd_convert)
+
+    p = sub.add_parser("reorder", help="relabel tensor indices")
+    _common_opts(p)
+    p.add_argument("type", choices=["random", "graph", "fibsched"])
+    p.add_argument("output")
+    p.add_argument("--seed", type=int)
+    p.add_argument("--write-perms", action="store_true")
+    p.set_defaults(fn=cmd_reorder)
+
+    p = sub.add_parser("stats", help="print tensor statistics")
+    _common_opts(p)
+    p.set_defaults(fn=cmd_stats)
+
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "rank", 1) < 1:
+        print(f"splatt-tpu: error: rank must be >= 1 (got {args.rank})",
+              file=sys.stderr)
+        return 2
+    try:
+        return args.fn(args)
+    except (OSError, ValueError) as e:
+        print(f"splatt-tpu: error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

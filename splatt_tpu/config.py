@@ -108,6 +108,10 @@ class Options:
     onehot_cap: int = 1024         # max block row-span for the sorted
                                    # one-hot path before falling back to
                                    # a sorted scatter
+    # One-hot reduction engine: None = auto (Pallas kernel on TPU,
+    # scanned-XLA einsum elsewhere); True forces Pallas (interpret mode
+    # off-TPU); False forces the XLA engine.
+    use_pallas: Optional[bool] = None
 
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM

@@ -109,10 +109,18 @@ class SparseTensor:
     def sort_order(self, mode_order: Sequence[int]) -> np.ndarray:
         """Permutation sorting nnz lexicographically by `mode_order`.
 
-        ≙ tt_sort (src/sort.c:912-961); `mode_order[0]` is the primary key.
+        ≙ tt_sort (src/sort.c:912-961); `mode_order[0]` is the primary
+        key.  Uses the native bucket+sort when the extension is built
+        (both are stable, so results are identical), else np.lexsort.
         """
+        order = list(mode_order)
+        from splatt_tpu import native
+
+        perm = native.sort_perm(self.inds, self.dims, order)
+        if perm is not None:
+            return perm
         # np.lexsort sorts by the LAST key first.
-        keys = tuple(self.inds[m] for m in reversed(list(mode_order)))
+        keys = tuple(self.inds[m] for m in reversed(order))
         return np.lexsort(keys)
 
     def sorted_by(self, mode_order: Sequence[int]) -> "SparseTensor":

@@ -1,0 +1,127 @@
+"""ctypes bindings to the native host runtime (native/splatt_native.cpp).
+
+The reference implements its host-side hot paths (text parsing
+src/io.c:62-108, sorting src/sort.c) in C; this module provides the
+same for splatt-tpu: a buffered single-pass `.tns` parser and a
+bucket+std::sort permutation used by the blocked-layout compiler.
+
+The shared library is built on first use (g++ is assumed present, as on
+the target image); every entry point degrades gracefully — callers fall
+back to the numpy implementations when the library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_SO_PATH = Path(__file__).resolve().parent / "_native.so"
+_SRC_PATH = Path(__file__).resolve().parent.parent / "native" / "splatt_native.cpp"
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", str(_SO_PATH), str(_SRC_PATH)],
+            check=True, capture_output=True, timeout=300)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    if not _SO_PATH.exists() or (
+            _SRC_PATH.exists()
+            and _SRC_PATH.stat().st_mtime > _SO_PATH.stat().st_mtime):
+        if not _SRC_PATH.exists() or not _build():
+            _load_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(str(_SO_PATH))
+    except OSError:
+        _load_failed = True
+        return None
+    lib.tns_open.restype = ctypes.c_void_p
+    lib.tns_open.argtypes = [ctypes.c_char_p]
+    lib.tns_rows.restype = ctypes.c_int64
+    lib.tns_rows.argtypes = [ctypes.c_void_p]
+    lib.tns_cols.restype = ctypes.c_int
+    lib.tns_cols.argtypes = [ctypes.c_void_p]
+    lib.tns_fill.restype = ctypes.c_int
+    lib.tns_fill.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_void_p]
+    lib.tns_close.argtypes = [ctypes.c_void_p]
+    lib.sort_perm.restype = ctypes.c_int
+    lib.sort_perm.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                              ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_tns(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse a coordinate text file; None → caller should fall back."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.tns_open(os.fsencode(path))
+    if not h:
+        return None
+    try:
+        nrows = lib.tns_rows(h)
+        ncols = lib.tns_cols(h)
+        nmodes = ncols - 1
+        inds = np.empty((nmodes, nrows), dtype=np.int64)
+        vals = np.empty(nrows, dtype=np.float64)
+        rc = lib.tns_fill(h, inds.ctypes.data_as(ctypes.c_void_p),
+                          vals.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise ValueError(f"{path}: malformed tensor file "
+                             f"(native parser rc={rc})")
+        return inds, vals
+    finally:
+        lib.tns_close(h)
+
+
+def sort_perm(inds: np.ndarray, dims: Sequence[int],
+              mode_order: Sequence[int]) -> Optional[np.ndarray]:
+    """Lexicographic nnz permutation by mode_order; None → fall back."""
+    lib = _load()
+    if lib is None:
+        return None
+    inds = np.ascontiguousarray(inds, dtype=np.int64)
+    nmodes, nnz = inds.shape
+    order = list(mode_order)
+    # the C comparator walks mode_order[1..nmodes); a partial order has
+    # different semantics (remaining modes unordered) — numpy handles it
+    if len(order) != nmodes or sorted(order) != list(range(nmodes)):
+        return None
+    dims_arr = np.asarray(dims, dtype=np.int64)
+    order_arr = np.asarray(order, dtype=np.int32)
+    perm = np.empty(nnz, dtype=np.int64)
+    rc = lib.sort_perm(inds.ctypes.data_as(ctypes.c_void_p),
+                       ctypes.c_int64(nnz), ctypes.c_int(nmodes),
+                       dims_arr.ctypes.data_as(ctypes.c_void_p),
+                       order_arr.ctypes.data_as(ctypes.c_void_p),
+                       perm.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        return None
+    return perm

@@ -117,14 +117,27 @@ def _scan_onehot(local: jax.Array, prod: jax.Array, width: int,
     return parts.reshape(nb_pad, width, R)[:nb]
 
 
-@partial(jax.jit, static_argnames=("mode", "path"))
+@partial(jax.jit, static_argnames=("mode", "path", "impl"))
 def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
-                   path: str = "sorted_onehot") -> jax.Array:
-    """Blocked MTTKRP over one :class:`ModeLayout` (static path dispatch)."""
+                   path: str = "sorted_onehot",
+                   impl: str = "xla") -> jax.Array:
+    """Blocked MTTKRP over one :class:`ModeLayout`.
+
+    `path` picks the algorithm (static dispatch); `impl` picks the
+    one-hot reduction engine: "xla" (scanned einsum), "pallas"
+    (VMEM-resident Mosaic kernel, TPU only) or "pallas_interpret"
+    (kernel semantics on CPU, for tests).
+    """
+    from splatt_tpu.ops.pallas_kernels import (onehot_reduce_full,
+                                               onehot_reduce_sorted,
+                                               vmem_chunk)
+
     dim = int(factors[mode].shape[0])
     R = factors[mode].shape[1]
     prod = _gather_prod(layout.inds, layout.vals, factors, mode)
     seg = layout.inds[mode]
+    pallas = impl in ("pallas", "pallas_interpret")
+    interpret = impl == "pallas_interpret"
 
     if path in ("scatter", "sorted_scatter"):
         nseg = dim + 1 if mode == layout.mode else dim
@@ -135,9 +148,16 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     nb, B = layout.nblocks, layout.block
     prod = prod.reshape(nb, B, R)
 
+    itemsize = jnp.dtype(prod.dtype).itemsize
+
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
         local = seg.reshape(nb, B)
+        chunk = vmem_chunk(width, B, int(R), itemsize)
+        if pallas and chunk >= 1:
+            return onehot_reduce_full(local, prod, width,
+                                      interpret=interpret,
+                                      chunk=chunk)[:dim]
         return _scan_onehot(local, prod, width, accumulate=True)[:dim]
 
     if path == "sorted_onehot":
@@ -145,7 +165,12 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             raise ValueError("sorted_onehot requires the layout's own mode")
         S = layout.seg_width
         local = seg.reshape(nb, B) - layout.row_start[:, None]
-        parts = _scan_onehot(local, prod, S, accumulate=False)  # (nb, S, R)
+        chunk = vmem_chunk(S, B, int(R), itemsize)
+        if pallas and chunk >= 1:
+            parts = onehot_reduce_sorted(local, prod, S, interpret=interpret,
+                                         chunk=chunk)
+        else:
+            parts = _scan_onehot(local, prod, S, accumulate=False)  # (nb,S,R)
         idx = (layout.row_start[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
         out = jnp.zeros((dim + S + 1, R), dtype=parts.dtype)
         out = out.at[idx].add(parts.reshape(-1, R))
@@ -173,12 +198,25 @@ def _choose_path_bs(bs: BlockedSparse, mode: int) -> str:
     return choose_path(layout, mode, bs.opts)
 
 
+def choose_impl(opts: Options) -> str:
+    """Pick the one-hot reduction engine: Pallas on TPU (or when forced),
+    scanned-XLA elsewhere; forcing Pallas off-TPU uses interpret mode."""
+    backend = jax.default_backend()
+    if opts.use_pallas is None:
+        return "pallas" if backend == "tpu" else "xla"
+    if not opts.use_pallas:
+        return "xla"
+    return "pallas" if backend == "tpu" else "pallas_interpret"
+
+
 def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
-           mode: int, path: Optional[str] = None) -> jax.Array:
+           mode: int, path: Optional[str] = None,
+           impl: Optional[str] = None) -> jax.Array:
     """Public MTTKRP (≙ splatt_mttkrp, include/splatt/api_kernels.h:98-119).
 
     Accepts a host COO tensor (oracle path) or a compiled BlockedSparse.
-    `path` forces a specific execution path (tests sweep all of them).
+    `path` forces a specific execution path and `impl` a reduction
+    engine (tests sweep both).
     """
     if isinstance(X, SparseTensor):
         if path is not None and path != "stream":
@@ -191,4 +229,6 @@ def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
     layout = X.layout_for(mode)
     if path is None:
         path = _choose_path_bs(X, mode)
-    return mttkrp_blocked(layout, factors, mode, path=path)
+    if impl is None:
+        impl = choose_impl(X.opts)
+    return mttkrp_blocked(layout, factors, mode, path=path, impl=impl)

@@ -1,0 +1,146 @@
+"""Pallas TPU kernels for the MTTKRP hot path.
+
+The performance-critical reduction in blocked MTTKRP is
+
+    out[b, s, :] = Σ_j  [local[b, j] == s] · prod[b, j, :]
+
+i.e. a per-block one-hot contraction (S×B)@(B×R) — the TPU replacement
+for the reference's scattered accumulation with its mutex pool /
+privatization / tile scheduling (src/mttkrp.c:104-236).  XLA executes
+the same einsum but materializes the one-hot operand (nb·S·B elements)
+in HBM; the Pallas kernel builds it on the fly in VMEM with a
+broadcasted iota-compare and feeds the MXU directly, so HBM traffic is
+just prod in + partials out.
+
+Two variants:
+- :func:`onehot_reduce_sorted`  — per-block partials (sorted layouts,
+  combined by a small scatter outside);
+- :func:`onehot_reduce_full`    — full-width accumulation across the
+  whole grid (privatized short modes, no scatter at all).
+
+Both take `interpret=` so the differential tests run on CPU
+(≙ tests running the real kernels at 7 threads, tests/mttkrp_test.c).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from splatt_tpu.utils.env import ceil_to
+
+# Max blocks per grid step; the actual chunk is sized against VMEM by
+# vmem_chunk() below.
+_CHUNK = 8
+
+
+def vmem_chunk(width: int, block: int, rank: int,
+               itemsize: int = 4, budget_bytes: int = 8 << 20) -> int:
+    """Blocks per grid step such that the kernel's working set —
+    one-hot (C,width,block) + prod (C,block,rank) + out (C,width,rank) —
+    fits the VMEM budget (half of the ~16MB scratchpad, leaving room
+    for double buffering).  Returns 0 when even one block does not fit:
+    callers must fall back to the XLA engine, which streams the one-hot
+    through HBM instead.
+    """
+    per_block = (width * block + block * rank + width * rank) * itemsize
+    if per_block <= 0:
+        return _CHUNK
+    return min(_CHUNK, budget_bytes // per_block)
+
+
+def _sorted_kernel(local_ref, prod_ref, out_ref, *, seg_width: int):
+    local = local_ref[...]                      # (C, B) int32
+    prod = prod_ref[...]                        # (C, B, R)
+    C, B = local.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (C, seg_width, B), 1)
+    onehot = (local[:, None, :] == iota).astype(prod.dtype)
+    out_ref[...] = jax.lax.dot_general(
+        onehot, prod,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=prod.dtype)
+
+
+def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
+    local = local_ref[...]                      # (C, B) int32
+    prod = prod_ref[...]                        # (C, B, R)
+    C, B = local.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (C, width, B), 1)
+    onehot = (local[:, None, :] == iota).astype(prod.dtype)
+    part = jax.lax.dot_general(
+        onehot, prod,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=prod.dtype)      # (C, width, R)
+    acc = jnp.sum(part, axis=0)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = acc
+
+    @pl.when(pl.program_id(0) != 0)
+    def _accum():
+        out_ref[...] += acc
+
+
+def _pad_blocks(local: jax.Array, prod: jax.Array, chunk: int):
+    nb = local.shape[0]
+    nb_pad = ceil_to(max(nb, 1), chunk)
+    if nb_pad != nb:
+        local = jnp.pad(local, ((0, nb_pad - nb), (0, 0)),
+                        constant_values=-1)
+        prod = jnp.pad(prod, ((0, nb_pad - nb), (0, 0), (0, 0)))
+    return local, prod, nb_pad
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("seg_width", "interpret", "chunk"))
+def onehot_reduce_sorted(local: jax.Array, prod: jax.Array, seg_width: int,
+                         interpret: bool = False,
+                         chunk: int = _CHUNK) -> jax.Array:
+    """(nb, B) local ids + (nb, B, R) partials → (nb, S, R) block partials."""
+    nb = local.shape[0]
+    B = local.shape[1]
+    R = prod.shape[-1]
+    local, prod, nb_pad = _pad_blocks(local, prod, chunk)
+    grid = (nb_pad // chunk,)
+    out = pl.pallas_call(
+        functools.partial(_sorted_kernel, seg_width=seg_width),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, B, R), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((chunk, seg_width, R), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb_pad, seg_width, R), prod.dtype),
+        interpret=interpret,
+    )(local, prod)
+    return out[:nb]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("width", "interpret", "chunk"))
+def onehot_reduce_full(local: jax.Array, prod: jax.Array, width: int,
+                       interpret: bool = False,
+                       chunk: int = _CHUNK) -> jax.Array:
+    """(nb, B) ids + (nb, B, R) partials → (width, R) total (privatized)."""
+    B = local.shape[1]
+    R = prod.shape[-1]
+    local, prod, nb_pad = _pad_blocks(local, prod, chunk)
+    grid = (nb_pad // chunk,)
+    out = pl.pallas_call(
+        functools.partial(_full_kernel, width=width),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, B, R), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((width, R), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((width, R), prod.dtype),
+        interpret=interpret,
+    )(local, prod)
+    return out

@@ -55,13 +55,12 @@ class Permutation:
 
     def apply_to_factor(self, U: np.ndarray, mode: int) -> np.ndarray:
         """Rows of a factor computed on the relabeled tensor, restored
-        to original labels."""
+        to original labels: row `old` of the result is row
+        ``perms[mode][old]`` of U (U is indexed by new labels)."""
         p = self.perms[mode]
         if p is None:
             return U
-        out = np.empty_like(U)
-        out[p] = U
-        return out
+        return U[p]
 
 
 def reorder(tt: SparseTensor, how: str = "graph",

@@ -1,0 +1,60 @@
+"""API conventions (≙ tests/api_test.c:38-92: default opts, version)."""
+
+import numpy as np
+
+import splatt_tpu
+from splatt_tpu.config import (BlockAlloc, CommPattern, Decomposition,
+                               Verbosity, default_opts)
+from splatt_tpu.utils.timers import TimerRegistry
+
+
+def test_default_opts_match_reference_defaults():
+    """≙ splatt_default_opts (src/opts.c:10-47)."""
+    o = default_opts()
+    assert o.tolerance == 1e-5
+    assert o.max_iterations == 50
+    assert o.regularization == 0.0
+    assert o.block_alloc is BlockAlloc.TWOMODE
+    assert o.priv_threshold == 0.02
+    assert o.decomposition is Decomposition.MEDIUM
+    assert o.comm_pattern is CommPattern.ALL2ALL
+    assert o.random_seed is None  # seed-from-time until resolved
+
+
+def test_seed_pinned_once():
+    o = default_opts()
+    s1 = o.seed()
+    s2 = o.seed()
+    assert s1 == s2
+    assert o.random_seed == s1
+
+
+def test_version():
+    assert splatt_tpu.version_major == 0
+    assert splatt_tpu.__version__.count(".") == 2
+
+
+def test_public_surface():
+    for name in splatt_tpu.__all__:
+        assert hasattr(splatt_tpu, name), name
+
+
+def test_timer_registry():
+    reg = TimerRegistry()
+    with reg.time("mttkrp"):
+        pass
+    reg.start("cpd")
+    reg.stop("cpd")
+    assert reg["mttkrp"] >= 0.0
+    report = reg.report(level=2)
+    assert "mttkrp" in report or reg["mttkrp"] == 0.0
+    reg.reset()
+    assert reg["cpd"] == 0.0
+
+
+def test_max_nmodes_guard():
+    import pytest
+
+    with pytest.raises(ValueError):
+        splatt_tpu.SparseTensor(np.zeros((9, 1), dtype=np.int64),
+                                np.ones(1), tuple([2] * 9))

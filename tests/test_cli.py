@@ -1,0 +1,94 @@
+"""CLI verb tests (≙ the cmd_* paths; driven in-process)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from splatt_tpu.cli import main
+from splatt_tpu.io import load, read_matrix
+from tests import gen
+
+
+@pytest.fixture
+def tns(tensors_dir):
+    return str(tensors_dir / "med.tns")
+
+
+def test_cpd_writes_factors(tns, tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["cpd", tns, "-r", "4", "-i", "5", "--seed", "3", "--f64"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Final fit:" in out
+    assert "DIMS=" in out
+    tt = gen.fixture_tensor("med")
+    for m in range(3):
+        U = read_matrix(f"mode{m + 1}.mat")
+        assert U.shape == (tt.dims[m], 4)
+    lam = np.loadtxt("lambda.mat")
+    assert lam.shape == (4,)
+
+
+def test_cpd_nowrite_and_verbose(tns, tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(["cpd", tns, "-r", "3", "-i", "3", "--seed", "1",
+               "--nowrite", "-v"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "its =" in out          # per-iteration report
+    assert "Timing information" in out
+    assert not os.path.exists("mode1.mat")
+
+
+def test_check_clean_and_dirty(tmp_path, capsys, tensors_dir):
+    rc = main(["check", str(tensors_dir / "small.tns")])
+    assert rc == 0
+    assert "duplicates: 0" in capsys.readouterr().out
+    # dirty tensor: duplicates + empty slice
+    dirty = tmp_path / "dirty.tns"
+    dirty.write_text("1 1 1 1.0\n1 1 1 2.0\n3 2 2 1.0\n")
+    fixed = str(tmp_path / "fixed.tns")
+    rc = main(["check", str(dirty), "--fix", fixed])
+    assert rc == 1
+    out = load(fixed)
+    assert out.nnz == 2
+    assert out.dims == (2, 2, 2)   # empty slice 2 of mode 0 removed
+
+
+def test_stats(tns, capsys):
+    rc = main(["stats", tns])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DENSITY=" in out
+    assert "mode 0:" in out
+
+
+def test_convert_roundtrip(tns, tmp_path, capsys):
+    out_bin = str(tmp_path / "t.bin")
+    assert main(["convert", tns, "bin", out_bin]) == 0
+    a, b = load(tns), load(out_bin)
+    np.testing.assert_array_equal(a.inds, b.inds)
+    for target in ("graph", "fibhgraph", "nnzhgraph", "fibmat"):
+        out = str(tmp_path / f"t.{target}")
+        assert main(["convert", tns, target, out]) == 0
+        assert os.path.getsize(out) > 0
+
+
+def test_reorder_preserves_content(tns, tmp_path, capsys):
+    out_path = str(tmp_path / "r.tns")
+    assert main(["reorder", tns, "random", out_path, "--seed", "5"]) == 0
+    a, b = load(tns), load(out_path)
+    assert a.nnz == b.nnz
+    np.testing.assert_allclose(np.sort(a.vals), np.sort(b.vals))
+    for m in range(a.nmodes):
+        np.testing.assert_array_equal(
+            np.sort(np.unique(a.inds[m])), np.sort(np.unique(b.inds[m])))
+
+
+def test_bench_runs(tns, capsys):
+    rc = main(["bench", tns, "-r", "4", "--reps", "1", "--block", "256",
+               "-a", "stream", "-a", "blocked"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "stream" in out and "blocked" in out and "total:" in out

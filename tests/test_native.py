@@ -1,0 +1,96 @@
+"""Native extension tests: parser and sort must agree with the numpy
+reference implementations exactly (they are drop-in fast paths)."""
+
+import numpy as np
+import pytest
+
+from splatt_tpu import native
+from splatt_tpu.io import load, save
+from tests import gen
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native extension not built")
+
+
+def test_parse_matches_python(tmp_path, any_tensor):
+    tt = any_tensor
+    path = str(tmp_path / "t.tns")
+    save(tt, path)
+    inds, vals = native.parse_tns(path)
+    np.testing.assert_array_equal(inds - 1, tt.inds)  # file is 1-indexed
+    np.testing.assert_allclose(vals, tt.vals)
+
+
+def test_parse_comments_blank_lines(tmp_path):
+    p = tmp_path / "c.tns"
+    p.write_text("# hdr\n\n  # indented comment\n1 2 1 1.5\n2 1 2 -2.5e-1\n")
+    inds, vals = native.parse_tns(str(p))
+    np.testing.assert_array_equal(inds, [[1, 2], [2, 1], [1, 2]])
+    np.testing.assert_allclose(vals, [1.5, -0.25])
+
+
+def test_parse_no_trailing_newline(tmp_path):
+    p = tmp_path / "t.tns"
+    p.write_bytes(b"1 1 1 2.0\n2 2 2 3.0")
+    inds, vals = native.parse_tns(str(p))
+    assert inds.shape == (3, 2)
+    np.testing.assert_allclose(vals, [2.0, 3.0])
+
+
+def test_parse_ragged_raises(tmp_path):
+    p = tmp_path / "r.tns"
+    p.write_text("1 2 3\n1 1 1 5.0\n")
+    with pytest.raises(ValueError):
+        native.parse_tns(str(p))
+
+
+def test_parse_nonnumeric_raises(tmp_path):
+    p = tmp_path / "x.tns"
+    p.write_text("1 a 1 5.0\n")
+    with pytest.raises(ValueError):
+        native.parse_tns(str(p))
+
+
+def test_load_uses_native_and_matches(tmp_path, any_tensor):
+    """End-to-end: load() (native fast path) == in-memory fixture."""
+    tt = any_tensor
+    path = str(tmp_path / "t.tns")
+    save(tt, path)
+    out = load(path)
+    np.testing.assert_array_equal(out.inds, tt.inds)
+    np.testing.assert_allclose(out.vals, tt.vals)
+
+
+@pytest.mark.parametrize("lead", [0, 1])
+def test_sort_perm_matches_lexsort(any_tensor, lead):
+    tt = any_tensor
+    order = [lead] + [m for m in range(tt.nmodes) if m != lead]
+    got = native.sort_perm(tt.inds, tt.dims, order)
+    keys = tuple(tt.inds[m] for m in reversed(order))
+    want = np.lexsort(keys)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sort_perm_with_duplicates():
+    inds = np.array([[1, 1, 0, 1], [2, 2, 0, 2], [0, 0, 1, 0]])
+    dims = (2, 3, 2)
+    got = native.sort_perm(inds, dims, [0, 1, 2])
+    want = np.lexsort((inds[2], inds[1], inds[0]))
+    np.testing.assert_array_equal(got, want)  # stability incl. exact dups
+
+
+def test_partial_mode_order_falls_back(any_tensor):
+    """A partial mode order has different semantics than the C sort
+    (remaining modes unordered) — native must decline, numpy handles it."""
+    tt = any_tensor
+    assert native.sort_perm(tt.inds, tt.dims, [1]) is None
+    perm = tt.sort_order([1])  # goes through the numpy fallback
+    rows = tt.inds[1][perm]
+    assert np.all(np.diff(rows) >= 0)
+
+
+def test_out_of_range_indices_decline():
+    """Indices outside dims must not crash the native sort (the numpy
+    fallback tolerates them)."""
+    inds = np.array([[0, 5], [1, 0], [0, 1]])  # 5 >= dims[0]=2
+    assert native.sort_perm(inds, (2, 2, 2), [0, 1, 2]) is None
