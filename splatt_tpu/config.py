@@ -138,3 +138,37 @@ class Options:
 def default_opts() -> Options:
     """≙ splatt_default_opts() (src/opts.c:10-47)."""
     return Options()
+
+
+_warned_f64 = False
+
+
+def resolve_dtype(opts: Options, data_dtype=None):
+    """Resolve the device compute dtype once, centrally.
+
+    Rules: start from ``opts.val_dtype``; float64 host data upgrades to
+    float64 when x64 is enabled; float64 without x64 degrades to
+    float32 with ONE clear warning instead of a truncation warning at
+    every array construction site.
+    """
+    import warnings
+
+    import jax
+
+    d = np.dtype(opts.val_dtype)
+    if (data_dtype is not None and np.dtype(data_dtype) == np.float64
+            and jax.config.jax_enable_x64):
+        d = np.dtype(np.float64)
+    if d == np.float64 and not jax.config.jax_enable_x64:
+        global _warned_f64
+        if not _warned_f64:
+            warnings.warn(
+                "float64 requested but jax x64 is disabled; computing in "
+                "float32 (set JAX_ENABLE_X64=1 or "
+                "jax.config.update('jax_enable_x64', True) for double)",
+                stacklevel=2)
+            _warned_f64 = True
+        d = np.dtype(np.float32)
+    import jax.numpy as jnp
+
+    return jnp.dtype(d)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from splatt_tpu.blocked import BlockedSparse
-from splatt_tpu.config import Options, Verbosity, default_opts
+from splatt_tpu.config import Options, Verbosity, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.linalg import (form_normal_lhs, gram, normalize_columns,
@@ -100,11 +100,7 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     if isinstance(X, SparseTensor):
         dims, nmodes = X.dims, X.nmodes
         xnormsq = X.normsq()
-        dtype = jnp.dtype(opts.val_dtype) if X.vals.dtype != np.float64 \
-            else jnp.dtype(X.vals.dtype)
-        # host COO in float64 stays float64 only if x64 is enabled
-        if not jax.config.jax_enable_x64:
-            dtype = jnp.dtype(opts.val_dtype)
+        dtype = resolve_dtype(opts, X.vals.dtype)
     else:
         dims, nmodes = X.dims, X.nmodes
         xnormsq = X.frobsq()

@@ -1,4 +1,50 @@
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from splatt_tpu.config import Decomposition, Options, default_opts
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.parallel.mesh import auto_grid, make_mesh
 from splatt_tpu.parallel.sharded import sharded_cpd_als, sharded_mttkrp
+from splatt_tpu.parallel.grid import GridDecomp, grid_cpd_als
+from splatt_tpu.parallel.coarse import coarse_cpd_als
 
-__all__ = ["auto_grid", "make_mesh", "sharded_cpd_als", "sharded_mttkrp"]
+
+def distributed_cpd_als(tt: SparseTensor, rank: int,
+                        opts: Optional[Options] = None,
+                        init=None,
+                        grid: Optional[Tuple[int, ...]] = None,
+                        partition: Optional[np.ndarray] = None,
+                        mesh=None) -> KruskalTensor:
+    """Distributed CPD-ALS, dispatching on ``opts.decomposition``
+    (≙ SPLATT_OPTION_DECOMP, types_config.h:179-190):
+
+    - MEDIUM (default): n-D grid, inputs local, outputs layer-psum'd
+      (:func:`grid_cpd_als`)
+    - COARSE: per-mode owner-computes copies, all_gather inputs, no
+      output reduce (:func:`coarse_cpd_als`)
+    - FINE: arbitrary nonzero placement (equal chunks, or a
+      user-supplied per-nonzero `partition`), all_gather inputs +
+      psum_scatter outputs (:func:`sharded_cpd_als`)
+    """
+    opts = opts or default_opts()
+    if opts.decomposition is Decomposition.MEDIUM and partition is None:
+        return grid_cpd_als(tt, rank, grid=grid, mesh=mesh, opts=opts,
+                            init=init)
+    if opts.decomposition is Decomposition.COARSE:
+        return coarse_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init)
+    return sharded_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
+                           partition=partition)
+
+
+__all__ = [
+    "auto_grid",
+    "make_mesh",
+    "sharded_cpd_als",
+    "sharded_mttkrp",
+    "GridDecomp",
+    "grid_cpd_als",
+    "coarse_cpd_als",
+    "distributed_cpd_als",
+]

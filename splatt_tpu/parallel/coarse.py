@@ -1,0 +1,144 @@
+"""Coarse-grained decomposition: owner-computes over per-mode copies.
+
+≙ the reference's COARSE decomposition (types_config.h:179-190,
+src/cmds/mpi_cmd_cpd.c:223-258): each rank owns a contiguous block of
+*every* mode's slices and keeps one filtered tensor copy per mode
+(hence the ALLMODE CSF requirement).  Updating mode m needs **no
+output reduction at all** — a rank holds every nonzero that touches its
+rows of mode m — at the price of replicating the nonzeros nmodes times
+and gathering the input factors.
+
+TPU mapping over a 1-D mesh axis ``d``:
+  - per mode m, nonzeros are sorted by mode m and bucketed by the
+    equal row fences of axis d (pad cells to the max bucket);
+  - factor m is row-sharded over d;
+  - update m: ``all_gather`` the other factors (≙ mpi_update_rows),
+    local gather-prod + segment-sum into the owned block, local solve,
+    λ/Gram ``psum`` — and no reduce_rows anywhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from splatt_tpu.config import (Options, Verbosity, default_opts,
+                               resolve_dtype)
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.cpd import init_factors
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
+from splatt_tpu.parallel.common import bucket_scatter, run_distributed_als
+from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
+from splatt_tpu.utils.env import ceil_to
+
+
+def _bucket_by_mode(tt: SparseTensor, mode: int, ndev: int, val_dtype):
+    """Bucket nonzeros by the equal row fences of `mode`.
+
+    Returns (inds (nmodes, ndev, C) int32 with mode-m indices local to
+    the fence, vals (ndev, C), block_rows).
+    """
+    dim_pad = ceil_to(max(tt.dims[mode], ndev), ndev)
+    block = dim_pad // ndev
+    owner = tt.inds[mode] // block
+    binds, bvals, _ = bucket_scatter(tt.inds, tt.vals, owner, ndev,
+                                     val_dtype)
+    binds[mode] %= block  # localize to the fence (pad slots stay 0)
+    return binds, bvals, block
+
+
+def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
+                   opts: Optional[Options] = None,
+                   init: Optional[List[jax.Array]] = None,
+                   axis: str = "d") -> KruskalTensor:
+    """Distributed CPD-ALS, coarse-grained owner-computes."""
+    opts = opts or default_opts()
+    mesh, axis = single_axis_of(mesh, axis)
+    mesh = mesh or make_mesh(axis_names=(axis,))
+    ndev = mesh.shape[axis]
+    nmodes = tt.nmodes
+    xnormsq = tt.normsq()
+    dtype = resolve_dtype(opts, tt.vals.dtype)
+
+    # one sorted+bucketed copy per mode (≙ per-mode tensors + ALLMODE)
+    per_mode = [_bucket_by_mode(tt, m, ndev, dtype) for m in range(nmodes)]
+    blocks = tuple(b for (_, _, b) in per_mode)
+    dims_pad = tuple(b * ndev for b in blocks)
+    nnz_sharding = NamedSharding(mesh, P(None, axis, None))
+    val_sharding = NamedSharding(mesh, P(axis, None))
+    inds_dev = [jax.device_put(i, nnz_sharding) for (i, _, _) in per_mode]
+    vals_dev = [jax.device_put(v, val_sharding) for (_, v, _) in per_mode]
+
+    factors_host = (init if init is not None
+                    else init_factors(tt.dims, rank, opts.seed(),
+                                      dtype=dtype))
+    factors = []
+    for m, U in enumerate(factors_host):
+        U_pad = jnp.zeros((dims_pad[m], U.shape[1]), dtype=dtype)
+        U_pad = U_pad.at[:tt.dims[m]].set(jnp.asarray(U, dtype=dtype))
+        factors.append(jax.device_put(
+            U_pad, NamedSharding(mesh, P(axis, None))))
+    factors = tuple(factors)
+    grams = tuple(jax.device_put(U.T @ U, NamedSharding(mesh, P()))
+                  for U in factors)
+
+    factor_specs = tuple([P(axis, None)] * nmodes)
+    gram_specs = tuple([P()] * nmodes)
+    inds_specs = tuple([P(None, axis, None)] * nmodes)
+    vals_specs = tuple([P(axis, None)] * nmodes)
+    reg = opts.regularization
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(inds_specs, vals_specs, factor_specs, gram_specs,
+                       P()),
+             out_specs=(factor_specs, gram_specs, P(), P(), P()),
+             check_vma=False)
+    def sweep(inds_l, vals_l, factors_l, grams_l, first_flag):
+        factors_l = list(factors_l)
+        grams_l = list(grams_l)
+        lam = None
+        M_l = None
+        for m in range(nmodes):
+            ic = inds_l[m].reshape(nmodes, -1)
+            vc = vals_l[m].reshape(-1)
+            prod = vc[:, None].astype(factors_l[0].dtype)
+            for k in range(nmodes):
+                if k != m:
+                    # ≙ mpi_update_rows: fetch the other factors
+                    U = jax.lax.all_gather(factors_l[k], axis, axis=0,
+                                           tiled=True)
+                    prod = prod * jnp.take(U, ic[k], axis=0, mode="clip")
+            # owner-computes: all nonzeros for my rows are local,
+            # so the MTTKRP block needs NO reduction
+            M_l = jax.ops.segment_sum(prod, ic[m], num_segments=blocks[m])
+            lhs = form_normal_lhs(grams_l, m, reg)
+            U_l = solve_normals(lhs, M_l)
+            lam_2 = jnp.sqrt(jax.lax.psum(jnp.sum(U_l * U_l, axis=0), axis))
+            lam_max = jnp.maximum(
+                jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), axis), 1.0)
+            lam = jnp.where(first_flag > 0, lam_2, lam_max)
+            U_l = U_l / jnp.where(lam > 0, lam, 1.0)
+            factors_l[m] = U_l
+            grams_l[m] = jax.lax.psum(U_l.T @ U_l, axis)
+        had = jnp.outer(lam, lam)
+        for g in grams_l:
+            had = had * g
+        znormsq = jnp.sum(had)
+        inner = jax.lax.psum(
+            jnp.sum(M_l * factors_l[nmodes - 1] * lam[None, :]), axis)
+        return tuple(factors_l), tuple(grams_l), lam, znormsq, inner
+
+    sweep = jax.jit(sweep)
+
+    def step(factors, grams, flag):
+        return sweep(tuple(inds_dev), tuple(vals_dev), factors, grams, flag)
+
+    return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
+                               tt.dims, dtype)

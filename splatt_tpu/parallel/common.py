@@ -1,0 +1,91 @@
+"""Shared machinery for the distributed CPD drivers.
+
+- :func:`bucket_scatter` — the owner-bucketing scatter used by every
+  decomposition's host compiler (≙ the rearrange-to-owners steps of
+  src/mpi/mpi_io.c): place nonzero n in bucket owner[n], pad buckets to
+  the largest, return dense (nmodes, nbuckets, C) arrays.
+- :func:`run_distributed_als` — the iterate/converge/post-process loop
+  shared by the fine/medium/coarse drivers (≙ the outer loop of
+  mpi_cpd_als_iterate + cpd_post_process).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from splatt_tpu.config import Options, Verbosity
+from splatt_tpu.cpd import _fit
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.linalg import normalize_columns
+
+
+def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
+                   nbuckets: int, val_dtype
+                   ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Scatter nonzeros into equally-padded buckets by owner id.
+
+    Returns (binds (nmodes, nbuckets, C) int32, bvals (nbuckets, C), C).
+    Pad slots hold index 0 / value 0 (harmless to every kernel).
+    """
+    nmodes, nnz = inds.shape
+    owner = np.asarray(owner, dtype=np.int64)
+    if owner.shape[0] != nnz:
+        raise ValueError(
+            f"partition/owner length {owner.shape[0]} != nnz {nnz}")
+    if nnz == 0:
+        return (np.zeros((nmodes, nbuckets, 1), dtype=np.int32),
+                np.zeros((nbuckets, 1), dtype=val_dtype), 1)
+    if owner.min() < 0 or owner.max() >= nbuckets:
+        raise ValueError(f"owner ids must lie in [0, {nbuckets})")
+    counts = np.bincount(owner, minlength=nbuckets)
+    C = max(int(counts.max()), 1)
+    order = np.argsort(owner, kind="stable")
+    offsets = np.zeros(nbuckets + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    slot = np.arange(nnz) - offsets[owner[order]]
+    flat = owner[order] * C + slot
+    binds = np.zeros((nmodes, nbuckets * C), dtype=np.int32)
+    for m in range(nmodes):
+        binds[m, flat] = inds[m][order]
+    bvals = np.zeros(nbuckets * C, dtype=val_dtype)
+    bvals[flat] = vals[order]
+    return binds.reshape(nmodes, nbuckets, C), bvals.reshape(nbuckets, C), C
+
+
+def run_distributed_als(step: Callable, factors, grams, rank: int,
+                        opts: Options, xnormsq: float,
+                        dims: Sequence[int], dtype) -> KruskalTensor:
+    """Host convergence loop + post-processing for a distributed sweep.
+
+    `step(factors, grams, first_flag) -> (factors, grams, lam, znormsq,
+    inner)`; factors come back sharded, are gathered, stripped of row
+    padding, and renormalized into λ (≙ cpd_post_process).
+    """
+    fit_prev = 0.0
+    lam = jnp.ones((rank,), dtype=dtype)
+    for it in range(opts.max_iterations):
+        t0 = time.perf_counter()
+        flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
+        factors, grams, lam, znormsq, inner = step(factors, grams, flag)
+        fitval = float(_fit(xnormsq, znormsq, inner))
+        if opts.verbosity >= Verbosity.LOW:
+            print(f"  its = {it + 1:3d} ({time.perf_counter() - t0:.3f}s)"
+                  f"  fit = {fitval:0.5f}  delta = {fitval - fit_prev:+0.4e}")
+        if it > 0 and abs(fitval - fit_prev) < opts.tolerance:
+            fit_prev = fitval
+            break
+        fit_prev = fitval
+
+    out_factors = []
+    for U, d in zip(factors, dims):
+        U_full = jnp.asarray(jax.device_get(U))[:d]
+        U_full, norms = normalize_columns(U_full, "2")
+        lam = lam * norms
+        out_factors.append(U_full)
+    return KruskalTensor(factors=out_factors, lam=lam,
+                         fit=jnp.asarray(fit_prev, dtype=dtype))

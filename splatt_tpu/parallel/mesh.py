@@ -62,3 +62,20 @@ def make_mesh(n_devices: Optional[int] = None,
         grid = (n,) if len(axis_names) == 1 else auto_grid(n, [1] * len(axis_names))
     mesh_devs = np.array(devs).reshape(tuple(grid))
     return Mesh(mesh_devs, tuple(axis_names))
+
+
+def single_axis_of(mesh: Optional[Mesh], default_axis: str) -> Tuple[Optional[Mesh], str]:
+    """Normalize a user mesh for the 1-D decompositions.
+
+    Accepts a mesh with any single axis name (the caller's spec/axis
+    arguments follow it); rejects multi-axis meshes with a clear error
+    instead of a KeyError deep in a sharding.
+    """
+    if mesh is None:
+        return None, default_axis
+    if len(mesh.axis_names) != 1:
+        raise ValueError(
+            f"this decomposition needs a 1-D mesh; got axes "
+            f"{mesh.axis_names} — build one with make_mesh(), or use the "
+            f"MEDIUM grid decomposition for multi-axis meshes")
+    return mesh, mesh.axis_names[0]

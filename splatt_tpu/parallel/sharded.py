@@ -29,7 +29,6 @@ semantics are the spec (SURVEY §5).
 
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import List, Optional, Tuple, Union
 
@@ -39,30 +38,45 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from splatt_tpu.config import Options, Verbosity, default_opts
+from splatt_tpu.config import (Options, Verbosity, default_opts,
+                               resolve_dtype)
 from splatt_tpu.coo import SparseTensor
-from splatt_tpu.cpd import _fit, init_factors
+from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
-from splatt_tpu.ops.linalg import (form_normal_lhs, normalize_columns,
-                                   solve_normals)
-from splatt_tpu.parallel.mesh import make_mesh
+from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
+from splatt_tpu.parallel.common import bucket_scatter, run_distributed_als
+from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
 from splatt_tpu.utils.env import ceil_to as _pad_to
 
 
 def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
-              val_dtype=np.float32) -> Tuple[jax.Array, jax.Array]:
+              val_dtype=np.float32,
+              partition: Optional[np.ndarray] = None
+              ) -> Tuple[jax.Array, jax.Array]:
     """Pad nonzeros to the device count and shard them over `axis`.
 
-    ≙ mpi_tt_read's equal-nnz distribution (mpi_simple_distribute,
-    src/mpi/mpi_io.c:587-648).  Pad entries point at row 0 with value 0 —
-    harmless to every kernel.
+    With `partition=None`: equal contiguous chunks (≙ mpi_tt_read's
+    equal-nnz distribution, mpi_simple_distribute,
+    src/mpi/mpi_io.c:587-648).  With a per-nonzero `partition` array
+    (values in [0, ndev)): nonzero n is placed on device partition[n]
+    — the FINE decomposition's user-supplied nonzero-level partition
+    (≙ p_rearrange_fine, src/mpi/mpi_io.c:486-499), with buckets padded
+    to the largest.  Pad entries point at row 0 with value 0 — harmless
+    to every kernel.
     """
     ndev = mesh.shape[axis]
-    nnz_pad = max(ndev, _pad_to(tt.nnz, ndev))
-    inds = np.zeros((tt.nmodes, nnz_pad), dtype=np.int32)
-    inds[:, :tt.nnz] = tt.inds
-    vals = np.zeros(nnz_pad, dtype=val_dtype)
-    vals[:tt.nnz] = tt.vals
+    if partition is None:
+        nnz_pad = max(ndev, _pad_to(tt.nnz, ndev))
+        inds = np.zeros((tt.nmodes, nnz_pad), dtype=np.int32)
+        inds[:, :tt.nnz] = tt.inds
+        vals = np.zeros(nnz_pad, dtype=val_dtype)
+        vals[:tt.nnz] = tt.vals
+    else:
+        binds, bvals, _ = bucket_scatter(tt.inds, tt.vals,
+                                         np.asarray(partition), ndev,
+                                         val_dtype)
+        inds = binds.reshape(tt.nmodes, -1)
+        vals = bvals.reshape(-1)
     inds_s = jax.device_put(inds, NamedSharding(mesh, P(None, axis)))
     vals_s = jax.device_put(vals, NamedSharding(mesh, P(axis)))
     return inds_s, vals_s
@@ -178,7 +192,8 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
 def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     opts: Optional[Options] = None,
                     init: Optional[List[jax.Array]] = None,
-                    axis: str = "nnz") -> KruskalTensor:
+                    axis: str = "nnz",
+                    partition: Optional[np.ndarray] = None) -> KruskalTensor:
     """Distributed CPD-ALS over a device mesh (≙ the mpirun cpd path,
     src/cmds/mpi_cmd_cpd.c:175-338).
 
@@ -188,20 +203,22 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     sharding, and all reductions are deterministic collectives.
     """
     opts = opts or default_opts()
+    mesh, axis = single_axis_of(mesh, axis)
     mesh = mesh or make_mesh(axis_names=(axis,))
     ndev = mesh.shape[axis]
     nmodes = tt.nmodes
     dims_pad = tuple(_pad_to(d, ndev) for d in tt.dims)
     xnormsq = tt.normsq()
 
-    dtype = jnp.dtype(opts.val_dtype)
-    if tt.vals.dtype == np.float64 and jax.config.jax_enable_x64:
-        dtype = jnp.dtype(np.float64)
+    dtype = resolve_dtype(opts, tt.vals.dtype)
 
-    inds, vals = shard_nnz(tt, mesh, axis=axis, val_dtype=dtype)
+    inds, vals = shard_nnz(tt, mesh, axis=axis, val_dtype=dtype,
+                           partition=partition)
     factors_host = (init if init is not None
                     else init_factors(tt.dims, rank, opts.seed(), dtype=dtype))
-    factors = tuple(shard_factors(list(factors_host), tt.dims, mesh, axis=axis))
+    factors = tuple(shard_factors(
+        [jnp.asarray(f, dtype=dtype) for f in factors_host],
+        tt.dims, mesh, axis=axis))
     gram_sharding = NamedSharding(mesh, P(None, None))
     grams = tuple(
         jax.device_put(U.T @ U, gram_sharding) for U in factors
@@ -210,29 +227,8 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     sweep = make_sharded_sweep(mesh, nmodes, opts.regularization, dims_pad,
                                axis=axis)
 
-    fit_prev = 0.0
-    fitval = 0.0
-    lam = None
-    for it in range(opts.max_iterations):
-        t0 = time.perf_counter()
-        flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
-        factors, grams, lam, znormsq, inner = sweep(inds, vals, factors,
-                                                    grams, flag)
-        fitval = float(_fit(xnormsq, znormsq, inner))
-        if opts.verbosity >= Verbosity.LOW:
-            print(f"  its = {it + 1:3d} ({time.perf_counter() - t0:.3f}s)"
-                  f"  fit = {fitval:0.5f}  delta = {fitval - fit_prev:+0.4e}")
-        if it > 0 and abs(fitval - fit_prev) < opts.tolerance:
-            fit_prev = fitval
-            break
-        fit_prev = fitval
+    def step(factors, grams, flag):
+        return sweep(inds, vals, factors, grams, flag)
 
-    # gather factors, strip row padding, fold norms into λ (cpd_post_process)
-    out_factors = []
-    for U, d in zip(factors, tt.dims):
-        U_full = jnp.asarray(jax.device_get(U))[:d]
-        U_full, norms = normalize_columns(U_full, "2")
-        lam = lam * norms
-        out_factors.append(U_full)
-    return KruskalTensor(factors=out_factors, lam=lam,
-                         fit=jnp.asarray(fit_prev, dtype=dtype))
+    return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
+                               tt.dims, dtype)

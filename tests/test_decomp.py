@@ -1,0 +1,120 @@
+"""Coarse / fine decomposition tests + the unified dispatch.
+
+≙ correctness-under-decomposition: every decomposition type must give
+the single-device answer for the same seed.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from splatt_tpu.config import Decomposition, Options, Verbosity
+from splatt_tpu.cpd import cpd_als, init_factors
+from splatt_tpu.parallel import (coarse_cpd_als, distributed_cpd_als,
+                                 make_mesh, sharded_cpd_als)
+from tests import gen
+
+
+def _opts(**kw):
+    kw.setdefault("random_seed", 42)
+    kw.setdefault("verbosity", Verbosity.NONE)
+    kw.setdefault("val_dtype", np.float64)
+    return Options(**kw)
+
+
+@pytest.fixture(scope="module")
+def med_single():
+    tt = gen.fixture_tensor("med")
+    opts = _opts(max_iterations=6)
+    init = init_factors(tt.dims, 5, opts.seed(), dtype=jnp.float64)
+    return tt, opts, init, cpd_als(tt, rank=5, opts=opts, init=init)
+
+
+def test_coarse_matches_single(med_single):
+    tt, opts, init, single = med_single
+    multi = coarse_cpd_als(tt, rank=5, mesh=make_mesh(n_devices=8,
+                                                      axis_names=("d",)),
+                           opts=opts, init=init)
+    assert float(multi.fit) == pytest.approx(float(single.fit), abs=1e-8)
+    for a, b in zip(single.factors, multi.factors):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_fine_custom_partition_matches_single(med_single):
+    """A deliberately unbalanced user partition still gives the exact
+    answer (≙ FINE with a partition file, p_rearrange_fine)."""
+    tt, opts, init, single = med_single
+    rng = np.random.default_rng(0)
+    # skewed partition: device 0 gets ~half of everything
+    part = np.where(rng.random(tt.nnz) < 0.5, 0,
+                    rng.integers(0, 8, size=tt.nnz))
+    multi = sharded_cpd_als(tt, rank=5, mesh=make_mesh(n_devices=8),
+                            opts=opts, init=init, partition=part)
+    assert float(multi.fit) == pytest.approx(float(single.fit), abs=1e-8)
+
+
+def test_partition_out_of_range_raises():
+    tt = gen.fixture_tensor("small")
+    bad = np.full(tt.nnz, 99)
+    with pytest.raises(ValueError):
+        sharded_cpd_als(tt, rank=2, mesh=make_mesh(n_devices=4),
+                        opts=_opts(max_iterations=2), partition=bad)
+
+
+@pytest.mark.parametrize("decomp", list(Decomposition))
+def test_dispatch_all_decompositions(med_single, decomp):
+    tt, opts0, init, single = med_single
+    opts = _opts(max_iterations=6, decomposition=decomp)
+    multi = distributed_cpd_als(tt, rank=5, opts=opts, init=init)
+    assert float(multi.fit) == pytest.approx(float(single.fit), abs=1e-8)
+
+
+def test_dispatch_accepts_generic_mesh(med_single):
+    """A plain make_mesh() mesh must work with every decomposition —
+    MEDIUM re-arranges its devices into the grid, COARSE/FINE adopt its
+    axis name."""
+    tt, opts0, init, single = med_single
+    generic = make_mesh()  # 1-D ('nnz',) over all 8 devices
+    for decomp in Decomposition:
+        opts = _opts(max_iterations=4, decomposition=decomp)
+        out = distributed_cpd_als(tt, rank=5, opts=opts, init=init,
+                                  mesh=generic)
+        assert np.isfinite(float(out.fit)), decomp
+
+
+def test_grid_uses_mesh_device_subset(med_single):
+    """grid_cpd_als with a 4-device pool mesh sizes the grid to 4."""
+    from splatt_tpu.parallel import grid_cpd_als
+
+    tt, opts0, init, single = med_single
+    pool = make_mesh(n_devices=4)
+    out = grid_cpd_als(tt, rank=5, mesh=pool, opts=_opts(max_iterations=4),
+                       init=init)
+    assert np.isfinite(float(out.fit))
+
+
+def test_multiaxis_mesh_rejected_for_1d_decomps():
+    import jax
+    from jax.sharding import Mesh
+
+    tt = gen.fixture_tensor("small")
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh2d = Mesh(devs, ("a", "b"))
+    with pytest.raises(ValueError, match="1-D mesh"):
+        coarse_cpd_als(tt, rank=2, mesh=mesh2d, opts=_opts(max_iterations=2))
+
+
+def test_partition_wrong_length_raises():
+    tt = gen.fixture_tensor("small")
+    with pytest.raises(ValueError, match="length"):
+        sharded_cpd_als(tt, rank=2, mesh=make_mesh(n_devices=4),
+                        opts=_opts(max_iterations=2),
+                        partition=np.zeros(tt.nnz + 5, dtype=np.int64))
+
+
+def test_zero_iterations_returns_init_shape():
+    """max_iterations=0 must not crash (λ defaults to ones)."""
+    tt = gen.fixture_tensor("small")
+    out = sharded_cpd_als(tt, rank=2, mesh=make_mesh(n_devices=4),
+                          opts=_opts(max_iterations=0))
+    assert out.lam.shape == (2,)
