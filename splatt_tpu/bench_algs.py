@@ -22,9 +22,9 @@ from splatt_tpu.config import BlockAlloc, Options
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.ops.mttkrp import (choose_impl, mttkrp_blocked,
-                                   mttkrp_stream)
+                                   mttkrp_stream, mttkrp_ttbox)
 
-ALGS = ("stream", "blocked", "blocked_pallas", "scatter")
+ALGS = ("stream", "blocked", "blocked_pallas", "scatter", "ttbox")
 
 
 def _time_call(fn, warmup: int = 1, reps: int = 3) -> float:
@@ -52,7 +52,7 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
     vals = jnp.asarray(tt.vals, dtype=dtype)
     results: Dict[str, List[float]] = {}
 
-    needs_blocked = any(a != "stream" for a in algs)
+    needs_blocked = any(a not in ("stream", "ttbox") for a in algs)
     bs = BlockedSparse.from_coo(tt, opts) if needs_blocked else None
 
     for alg in algs:
@@ -61,6 +61,9 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
             if alg == "stream":
                 fn = lambda: mttkrp_stream(inds, vals, factors, mode,
                                            tt.dims[mode])
+            elif alg == "ttbox":
+                fn = lambda: mttkrp_ttbox(inds, vals, factors, mode,
+                                          tt.dims[mode])
             else:
                 layout = bs.layout_for(mode)
                 if alg == "scatter":

@@ -57,7 +57,7 @@ def cmd_cpd(args) -> int:
     from splatt_tpu.blocked import BlockedSparse
     from splatt_tpu.config import Verbosity
     from splatt_tpu.cpd import cpd_als
-    from splatt_tpu.io import load, write_matrix, write_vector
+    from splatt_tpu.io import load
     from splatt_tpu.stats import cpd_stats_text, tensor_stats
     from splatt_tpu.utils.timers import timers
 
@@ -71,10 +71,23 @@ def cmd_cpd(args) -> int:
     print(cpd_stats_text(bs, args.rank, opts))
     out = cpd_als(bs, rank=args.rank, opts=opts)
     print(f"Final fit: {float(out.fit):0.5f}")
+    if opts.verbosity >= Verbosity.HIGH:
+        # per-mode MTTKRP profile (≙ the per-mode times of `cpd -v -v`,
+        # src/cpd.c:361-366 — measured post-hoc since the jitted sweep
+        # fuses all modes)
+        import jax
+        import time as _time
+
+        from splatt_tpu.ops.mttkrp import mttkrp
+
+        print("Per-mode MTTKRP times:")
+        for m in range(bs.nmodes):
+            jax.block_until_ready(mttkrp(bs, out.factors, m))  # compile
+            t0 = _time.perf_counter()
+            jax.block_until_ready(mttkrp(bs, out.factors, m))
+            print(f"  mode {m}: {_time.perf_counter() - t0:0.5f}s")
     if not args.nowrite:
-        for m, U in enumerate(out.factors):
-            write_matrix(np.asarray(U), f"mode{m + 1}.mat")
-        write_vector(np.asarray(out.lam), "lambda.mat")
+        out.save(".")
     timers.stop("total")
     if opts.verbosity >= Verbosity.LOW:
         print(timers.report(level=2 if opts.verbosity >= Verbosity.HIGH
@@ -192,7 +205,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-r", "--rank", type=int, default=16)
     p.add_argument("-a", "--alg", action="append",
                    help="algorithm (repeatable): stream/blocked/"
-                        "blocked_pallas/scatter")
+                        "blocked_pallas/scatter/ttbox")
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--seed", type=int)
     p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])

@@ -15,10 +15,9 @@ TPU-first mapping notes:
   reduction (no scatter at all — the analog of per-thread privatized
   accumulators reduced at the end).
 - ``Decomposition``/``CommPattern`` ≙ the MPI decomposition/comm enums
-  (include/splatt/types_config.h:179-201).  Only the all-to-all semantics
-  are carried forward: on TPU the two row-exchange phases are
-  ``all_gather`` / ``psum_scatter`` over a mesh axis; the point-to-point
-  variant has no ICI analog.
+  (include/splatt/types_config.h:179-201).  ALL2ALL row exchanges map to
+  ``all_gather`` / ``psum_scatter`` over a mesh axis; POINT2POINT maps
+  to a ``ppermute`` ring (memory-lean; splatt_tpu.parallel.ring).
 """
 
 from __future__ import annotations
@@ -62,8 +61,11 @@ class Decomposition(enum.Enum):
 class CommPattern(enum.Enum):
     """Row-exchange pattern (≙ types_config.h:197-201).
 
-    ALL2ALL is the semantic spec carried to TPU (all_gather/psum_scatter);
-    POINT2POINT is accepted for API parity but maps to the same collectives.
+    ALL2ALL (default): all_gather + psum_scatter — fastest when factors
+    fit in HBM.  POINT2POINT: the ppermute ring variant
+    (splatt_tpu.parallel.ring) — factor blocks travel the ICI ring and
+    no device ever materializes a full factor, O(dim/ndev) peak memory
+    per factor (the ring-attention trade for huge modes).
     """
 
     ALL2ALL = "all2all"

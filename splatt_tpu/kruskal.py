@@ -50,6 +50,38 @@ class KruskalTensor:
             out = term * vec if out is None else out + term * vec
         return out
 
+    def save(self, directory: str, stem: str = "") -> None:
+        """Write factors + λ as the reference's terminal outputs
+        (mode<N>.mat / lambda.mat, ≙ src/cmds/cmd_cpd.c:206-233)."""
+        import os
+
+        from splatt_tpu.io import write_matrix, write_vector
+
+        os.makedirs(directory, exist_ok=True)
+        for m, U in enumerate(self.factors):
+            write_matrix(np.asarray(U), os.path.join(directory,
+                                                     f"{stem}mode{m + 1}.mat"))
+        write_vector(np.asarray(self.lam),
+                     os.path.join(directory, f"{stem}lambda.mat"))
+
+    @staticmethod
+    def load(directory: str, nmodes: int, stem: str = "") -> "KruskalTensor":
+        import os
+
+        import jax.numpy as jnp
+
+        from splatt_tpu.io import read_matrix
+
+        factors = [jnp.asarray(read_matrix(
+            os.path.join(directory, f"{stem}mode{m + 1}.mat")))
+            for m in range(nmodes)]
+        lam_raw = read_matrix(os.path.join(directory, f"{stem}lambda.mat"))
+        lam = jnp.asarray(np.asarray(lam_raw).ravel())
+        # the fit is not stored in the factor files — NaN marks it
+        # unknown rather than masquerading as a zero-fit model
+        return KruskalTensor(factors=factors, lam=lam,
+                             fit=jnp.asarray(np.nan, dtype=lam.dtype))
+
     def normsq(self) -> jax.Array:
         """⟨Z,Z⟩ = λᵀ (⊛_m UᵐᵀUᵐ) λ (≙ p_kruskal_norm, src/cpd.c:116-152)."""
         rank = self.factors[0].shape[1]

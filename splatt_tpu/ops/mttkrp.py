@@ -66,6 +66,31 @@ def mttkrp_stream(inds: jax.Array, vals: jax.Array,
     return jax.ops.segment_sum(prod, inds[mode], num_segments=dim)
 
 
+@partial(jax.jit, static_argnames=("mode", "dim"))
+def mttkrp_ttbox(inds: jax.Array, vals: jax.Array,
+                 factors: List[jax.Array], mode: int, dim: int) -> jax.Array:
+    """Column-major rank loop (≙ mttkrp_ttbox, src/mttkrp.c:1655-1695).
+
+    Historical Tensor-Toolbox formulation: one pass over the nonzeros
+    per rank column.  Kept as a bench baseline — rank sequentialism is
+    exactly what the MXU-batched paths avoid.  (The GigaTensor CSR
+    variant, src/mttkrp.c:1604-1649, is deliberately not reproduced:
+    it materializes the Khatri-Rao column space, the one thing a
+    TPU formulation must never do.)
+    """
+
+    def col(r):
+        p = vals.astype(factors[0].dtype)
+        for k, U in enumerate(factors):
+            if k != mode:
+                p = p * jnp.take(U[:, r], inds[k], mode="clip")
+        return jax.ops.segment_sum(p, inds[mode], num_segments=dim)
+
+    rank = factors[0].shape[1]
+    cols = jax.lax.map(col, jnp.arange(rank))
+    return cols.T
+
+
 # -- blocked paths ---------------------------------------------------------
 
 def _block_chunks(nblocks: int, elems_per_block: int,

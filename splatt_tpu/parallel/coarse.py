@@ -33,8 +33,9 @@ from splatt_tpu.config import (Options, Verbosity, default_opts,
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
-from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
-from splatt_tpu.parallel.common import bucket_scatter, run_distributed_als
+from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
+                                        mode_update_tail,
+                                        run_distributed_als)
 from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
 from splatt_tpu.utils.env import ceil_to
 
@@ -48,8 +49,8 @@ def _bucket_by_mode(tt: SparseTensor, mode: int, ndev: int, val_dtype):
     dim_pad = ceil_to(max(tt.dims[mode], ndev), ndev)
     block = dim_pad // ndev
     owner = tt.inds[mode] // block
-    binds, bvals, _ = bucket_scatter(tt.inds, tt.vals, owner, ndev,
-                                     val_dtype)
+    binds, bvals, _, _ = bucket_scatter(tt.inds, tt.vals, owner, ndev,
+                                        val_dtype)
     binds[mode] %= block  # localize to the fence (pad slots stay 0)
     return binds, bvals, block
 
@@ -118,21 +119,12 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
             # owner-computes: all nonzeros for my rows are local,
             # so the MTTKRP block needs NO reduction
             M_l = jax.ops.segment_sum(prod, ic[m], num_segments=blocks[m])
-            lhs = form_normal_lhs(grams_l, m, reg)
-            U_l = solve_normals(lhs, M_l)
-            lam_2 = jnp.sqrt(jax.lax.psum(jnp.sum(U_l * U_l, axis=0), axis))
-            lam_max = jnp.maximum(
-                jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), axis), 1.0)
-            lam = jnp.where(first_flag > 0, lam_2, lam_max)
-            U_l = U_l / jnp.where(lam > 0, lam, 1.0)
+            U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
+                                              first_flag, axis)
             factors_l[m] = U_l
-            grams_l[m] = jax.lax.psum(U_l.T @ U_l, axis)
-        had = jnp.outer(lam, lam)
-        for g in grams_l:
-            had = had * g
-        znormsq = jnp.sum(had)
-        inner = jax.lax.psum(
-            jnp.sum(M_l * factors_l[nmodes - 1] * lam[None, :]), axis)
+            grams_l[m] = gram
+        znormsq, inner = fit_tail(lam, grams_l, M_l, factors_l[nmodes - 1],
+                                  axis)
         return tuple(factors_l), tuple(grams_l), lam, znormsq, inner
 
     sweep = jax.jit(sweep)

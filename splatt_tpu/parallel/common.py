@@ -26,10 +26,11 @@ from splatt_tpu.ops.linalg import normalize_columns
 
 def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
                    nbuckets: int, val_dtype
-                   ) -> Tuple[np.ndarray, np.ndarray, int]:
+                   ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
     """Scatter nonzeros into equally-padded buckets by owner id.
 
-    Returns (binds (nmodes, nbuckets, C) int32, bvals (nbuckets, C), C).
+    Returns (binds (nmodes, nbuckets, C) int32, bvals (nbuckets, C), C,
+    counts (nbuckets,) — true occupancy per bucket).
     Pad slots hold index 0 / value 0 (harmless to every kernel).
     """
     nmodes, nnz = inds.shape
@@ -39,7 +40,8 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
             f"partition/owner length {owner.shape[0]} != nnz {nnz}")
     if nnz == 0:
         return (np.zeros((nmodes, nbuckets, 1), dtype=np.int32),
-                np.zeros((nbuckets, 1), dtype=val_dtype), 1)
+                np.zeros((nbuckets, 1), dtype=val_dtype), 1,
+                np.zeros(nbuckets, dtype=np.int64))
     if owner.min() < 0 or owner.max() >= nbuckets:
         raise ValueError(f"owner ids must lie in [0, {nbuckets})")
     counts = np.bincount(owner, minlength=nbuckets)
@@ -54,7 +56,39 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
         binds[m, flat] = inds[m][order]
     bvals = np.zeros(nbuckets * C, dtype=val_dtype)
     bvals[flat] = vals[order]
-    return binds.reshape(nmodes, nbuckets, C), bvals.reshape(nbuckets, C), C
+    return (binds.reshape(nmodes, nbuckets, C), bvals.reshape(nbuckets, C),
+            C, counts)
+
+
+def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,
+                     lam_axis):
+    """Shared per-mode ALS tail: normal-equations solve on the local
+    block, normalization with the λ allreduce over `lam_axis`
+    (≙ mat_normalize src/matrix.c:117-187), and the Gram allreduce
+    (≙ mat_aTa src/matrix.c:445-452).  Used by every distributed sweep.
+    """
+    from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
+
+    lhs = form_normal_lhs(grams_l, m, reg)
+    U_l = solve_normals(lhs, M_l)
+    lam_2 = jnp.sqrt(jax.lax.psum(jnp.sum(U_l * U_l, axis=0), lam_axis))
+    lam_max = jnp.maximum(
+        jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), lam_axis), 1.0)
+    lam = jnp.where(first_flag > 0, lam_2, lam_max)
+    U_l = U_l / jnp.where(lam > 0, lam, 1.0)
+    gram = jax.lax.psum(U_l.T @ U_l, lam_axis)
+    return U_l, gram, lam
+
+
+def fit_tail(lam, grams_l, M_l, U_last, inner_axis):
+    """Shared fit pieces: ⟨Z,Z⟩ from λ/Grams and ⟨X,Z⟩ from the last
+    mode's MTTKRP block (≙ p_calc_fit + fit allreduce, mpi_cpd.c:92-98)."""
+    had = jnp.outer(lam, lam)
+    for g in grams_l:
+        had = had * g
+    znormsq = jnp.sum(had)
+    inner = jax.lax.psum(jnp.sum(M_l * U_last * lam[None, :]), inner_axis)
+    return znormsq, inner
 
 
 def run_distributed_als(step: Callable, factors, grams, rank: int,

@@ -49,8 +49,9 @@ from splatt_tpu.config import (Options, Verbosity, default_opts,
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
-from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
-from splatt_tpu.parallel.common import bucket_scatter, run_distributed_als
+from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
+                                        mode_update_tail,
+                                        run_distributed_als)
 from splatt_tpu.parallel.mesh import auto_grid
 from splatt_tpu.utils.env import ceil_to
 
@@ -75,6 +76,7 @@ class GridDecomp:
     vals: np.ndarray               # (*grid, cell_nnz)
     nnz: int
     fill: float                    # nnz / (ncells * cell_nnz) — balance
+    cell_counts: np.ndarray        # (ncells,) true occupancy per cell
 
     @property
     def nmodes(self) -> int:
@@ -99,8 +101,9 @@ class GridDecomp:
         for m in range(nmodes):
             cell = cell * grid[m] + tt.inds[m] // block_rows[m]
         ncells = int(np.prod(grid))
-        binds, vals, cell_nnz = bucket_scatter(tt.inds, tt.vals, cell,
-                                               ncells, val_dtype)
+        binds, vals, cell_nnz, counts = bucket_scatter(tt.inds, tt.vals,
+                                                       cell, ncells,
+                                                       val_dtype)
         # localize indices to the cell's block fences (pad slots hold
         # index 0, and 0 % block == 0 — harmless)
         for m in range(nmodes):
@@ -113,6 +116,7 @@ class GridDecomp:
             vals=vals.reshape((*grid, cell_nnz)),
             nnz=tt.nnz,
             fill=tt.nnz / max(ncells * cell_nnz, 1),
+            cell_counts=counts,
         )
 
     def make_mesh(self, devices=None) -> Mesh:
@@ -177,25 +181,14 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float):
             other_axes = tuple(axes[k] for k in range(nmodes) if k != m)
             M_l = jax.lax.psum(partial_out, other_axes) if other_axes \
                 else partial_out
-            lhs = form_normal_lhs(grams_l, m, reg)
-            U_l = solve_normals(lhs, M_l)
-            # λ allreduce over the owning axis only (blocks on the other
-            # axes are replicas; ≙ mat_normalize's allreduce)
-            lam_2 = jnp.sqrt(jax.lax.psum(jnp.sum(U_l * U_l, axis=0),
-                                          axes[m]))
-            lam_max = jnp.maximum(
-                jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), axes[m]), 1.0)
-            lam = jnp.where(first_flag > 0, lam_2, lam_max)
-            U_l = U_l / jnp.where(lam > 0, lam, 1.0)
+            # λ/Gram allreduce over the owning axis only (blocks on the
+            # other axes are replicas)
+            U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
+                                              first_flag, axes[m])
             factors_l[m] = U_l
-            grams_l[m] = jax.lax.psum(U_l.T @ U_l, axes[m])
-        had = jnp.outer(lam, lam)
-        for g in grams_l:
-            had = had * g
-        znormsq = jnp.sum(had)
-        inner = jax.lax.psum(
-            jnp.sum(M_l * factors_l[nmodes - 1] * lam[None, :]),
-            axes[nmodes - 1])
+            grams_l[m] = gram
+        znormsq, inner = fit_tail(lam, grams_l, M_l, factors_l[nmodes - 1],
+                                  axes[nmodes - 1])
         return tuple(factors_l), tuple(grams_l), lam, znormsq, inner
 
     return jax.jit(sweep)

@@ -1,0 +1,80 @@
+"""Memory-lean point-to-point (ring) variant of the fine decomposition.
+
+≙ SPLATT_OPTION_COMM = POINT2POINT (types_config.h:197-201): the
+reference's Isend/Irecv row-exchange variant
+(p_reduce_rows_point2point / p_update_rows_point2point,
+src/mpi/mpi_cpd.c:323-546).  On TPU the point-to-point primitive is
+``ppermute`` over the ICI ring, and the payoff is the same one ring
+attention gets for long sequences: **no device ever materializes a
+full factor matrix or a full MTTKRP output** — peak memory per device
+is one row *block*, O(dim/ndev · R), instead of O(dim · R).
+
+Two building blocks, both inside `shard_map`:
+
+- :func:`ring_gather_rows` (≙ mpi_update_rows): factor blocks travel
+  the ring; at each of the ndev steps a device multiplies in the rows
+  of the block it currently holds for the nonzeros that reference it.
+- :func:`blockwise_reduce_rows` (≙ mpi_reduce_rows): the MTTKRP output
+  is reduced one row-block at a time (psum of a (block, R) buffer per
+  step), so the full (dim_pad, R) partial never exists.
+
+The compute cost is ndev masked passes over the local nonzeros —
+the classic ring trade: O(ndev·nnz_local) work for O(dim/ndev) memory.
+Use it when dims·rank outgrows HBM (e.g. the 1.7B-nnz Amazon config);
+the ALL2ALL variant (sharded.py) is faster when factors fit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_gather_rows(U_l: jax.Array, idx: jax.Array, axis: str,
+                     ndev: int) -> jax.Array:
+    """Rows of a row-sharded factor at global ids `idx`, via a ppermute
+    ring instead of an all_gather.
+
+    U_l: (block, R) local shard (device d initially holds block d).
+    After s forward ppermutes device d holds block (d - s) mod ndev.
+    """
+    block = U_l.shape[0]
+    my_id = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+
+    def body(step, carry):
+        rows, U_cur = carry
+        shard_id = jnp.mod(my_id - step, ndev)
+        mask = (idx // block) == shard_id
+        local = jnp.where(mask, jnp.mod(idx, block), 0)
+        picked = jnp.take(U_cur, local, axis=0, mode="clip")
+        rows = rows + jnp.where(mask[:, None], picked, 0)
+        U_next = jax.lax.ppermute(U_cur, axis, perm)
+        return rows, U_next
+
+    rows0 = jnp.zeros((idx.shape[0], U_l.shape[1]), dtype=U_l.dtype)
+    rows, _ = jax.lax.fori_loop(0, ndev, body, (rows0, U_l))
+    return rows
+
+
+def blockwise_reduce_rows(prod: jax.Array, idx: jax.Array, axis: str,
+                          ndev: int, block: int) -> jax.Array:
+    """Row-sharded MTTKRP output without the full (dim_pad, R) partial:
+    for each row block j, every device reduces its local contribution
+    and the block-psum is kept only by the owner."""
+    my_id = jax.lax.axis_index(axis)
+
+    def body(j, acc):
+        mask = (idx // block) == j
+        p = jax.ops.segment_sum(prod * mask[:, None],
+                                jnp.where(mask, jnp.mod(idx, block), 0),
+                                num_segments=block)
+        tot = jax.lax.psum(p, axis)
+        return jnp.where(j == my_id, tot, acc)
+
+    acc0 = jnp.zeros((block, prod.shape[1]), dtype=prod.dtype)
+    return jax.lax.fori_loop(0, ndev, body, acc0)
+
+
+# The ring ALS sweep itself is built by make_sharded_sweep(variant="ring")
+# — one sweep body, two sets of comm primitives.

@@ -22,9 +22,10 @@ same axis.  Inside one `shard_map`:
 
 No comm plan, no ineed lists, no greedy row assignment: ownership is the
 contiguous row blocks of the sharding, and XLA schedules the collectives
-over ICI.  The point-to-point variants (p_reduce_rows_point2point,
-src/mpi/mpi_cpd.c:323-423) are deliberately not reproduced — all-to-all
-semantics are the spec (SURVEY §5).
+over ICI.  The reference's POINT2POINT row-exchange variant
+(p_reduce_rows_point2point, src/mpi/mpi_cpd.c:323-423) maps to the
+ppermute ring sweep in :mod:`splatt_tpu.parallel.ring`, selected via
+``opts.comm_pattern`` — same math, O(dim/ndev) peak factor memory.
 """
 
 from __future__ import annotations
@@ -38,13 +39,15 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from splatt_tpu.config import (Options, Verbosity, default_opts,
-                               resolve_dtype)
+from splatt_tpu.config import (CommPattern, Options, Verbosity,
+                               default_opts, resolve_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
-from splatt_tpu.parallel.common import bucket_scatter, run_distributed_als
+from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
+                                        mode_update_tail,
+                                        run_distributed_als)
 from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
 from splatt_tpu.utils.env import ceil_to as _pad_to
 
@@ -72,9 +75,9 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
         vals = np.zeros(nnz_pad, dtype=val_dtype)
         vals[:tt.nnz] = tt.vals
     else:
-        binds, bvals, _ = bucket_scatter(tt.inds, tt.vals,
-                                         np.asarray(partition), ndev,
-                                         val_dtype)
+        binds, bvals, _, _ = bucket_scatter(tt.inds, tt.vals,
+                                            np.asarray(partition), ndev,
+                                            val_dtype)
         inds = binds.reshape(tt.nmodes, -1)
         vals = bvals.reshape(-1)
     inds_s = jax.device_put(inds, NamedSharding(mesh, P(None, axis)))
@@ -129,15 +132,46 @@ def sharded_mttkrp(inds: jax.Array, vals: jax.Array, factors: List[jax.Array],
 
 
 def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
-                       dims_pad: Tuple[int, ...], axis: str = "nnz"):
+                       dims_pad: Tuple[int, ...], axis: str = "nnz",
+                       variant: str = "all2all"):
     """Build the jitted, shard_mapped one-iteration ALS sweep.
 
     `first_flag` is a replicated scalar array selecting 2-norm (iteration
     0) vs max-norm normalization (≙ src/cpd.c:343-347) so a single
-    compilation serves every iteration.
+    compilation serves every iteration.  `variant` picks the comm
+    primitives for the two row-exchange phases (≙ SPLATT_OPTION_COMM):
+    "all2all" = all_gather + psum_scatter, "ring" = ppermute ring
+    (splatt_tpu.parallel.ring) with O(dim/ndev) peak factor memory.
     """
+    ndev = mesh.shape[axis]
     factor_specs = tuple([P(axis, None)] * nmodes)
     gram_specs = tuple([P(None, None)] * nmodes)
+
+    if variant == "ring":
+        from splatt_tpu.parallel.ring import (blockwise_reduce_rows,
+                                              ring_gather_rows)
+
+        def gather_rows(U_l, idx):
+            return ring_gather_rows(U_l, idx, axis, ndev)
+
+        def reduce_rows(prod, idx, m):
+            return blockwise_reduce_rows(prod, idx, axis, ndev,
+                                         dims_pad[m] // ndev)
+    elif variant == "all2all":
+        def gather_rows(U_l, idx):
+            # ≙ mpi_update_rows: fetch the rows of the other factors
+            U = jax.lax.all_gather(U_l, axis, axis=0, tiled=True)
+            return jnp.take(U, idx, axis=0, mode="clip")
+
+        def reduce_rows(prod, idx, m):
+            # local MTTKRP partials over the global row space, then
+            # ≙ mpi_reduce_rows: I keep the summed rows I own
+            partial_out = jax.ops.segment_sum(prod, idx,
+                                              num_segments=dims_pad[m])
+            return jax.lax.psum_scatter(partial_out, axis,
+                                        scatter_dimension=0, tiled=True)
+    else:
+        raise ValueError(f"unknown comm variant {variant!r}")
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(None, axis), P(axis), factor_specs, gram_specs,
@@ -151,39 +185,17 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
         lam = None
         M_l = None
         for m in range(nmodes):
-            # ≙ mpi_update_rows: fetch the rows of the other factors
             prod = vals_l[:, None].astype(dtype)
             for k in range(nmodes):
                 if k != m:
-                    U = jax.lax.all_gather(factors_l[k], axis, axis=0,
-                                           tiled=True)
-                    prod = prod * jnp.take(U, inds_l[k], axis=0, mode="clip")
-            # local MTTKRP partials over the global row space
-            # (≙ mttkrp_csf + mpi_add_my_partials)
-            partial_out = jax.ops.segment_sum(prod, inds_l[m],
-                                              num_segments=dims_pad[m])
-            # ≙ mpi_reduce_rows: I keep the summed rows I own
-            M_l = jax.lax.psum_scatter(partial_out, axis,
-                                       scatter_dimension=0, tiled=True)
-            # normal equations, solved for owned rows only (lhs replicated)
-            lhs = form_normal_lhs(grams_l, m, reg)
-            U_l = solve_normals(lhs, M_l)
-            # ≙ mat_normalize with embedded λ allreduce (src/matrix.c:117-187)
-            lam_2 = jnp.sqrt(jax.lax.psum(jnp.sum(U_l * U_l, axis=0), axis))
-            lam_max = jnp.maximum(
-                jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), axis), 1.0)
-            lam = jnp.where(first_flag > 0, lam_2, lam_max)
-            U_l = U_l / jnp.where(lam > 0, lam, 1.0)
+                    prod = prod * gather_rows(factors_l[k], inds_l[k])
+            M_l = reduce_rows(prod, inds_l[m], m)
+            U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
+                                              first_flag, axis)
             factors_l[m] = U_l
-            # ≙ mat_aTa with Gram allreduce (src/matrix.c:445-452)
-            grams_l[m] = jax.lax.psum(U_l.T @ U_l, axis)
-        # fit pieces (≙ p_calc_fit + fit allreduce, mpi_cpd.c:92-98)
-        had = jnp.outer(lam, lam)
-        for g in grams_l:
-            had = had * g
-        znormsq = jnp.sum(had)
-        inner = jax.lax.psum(
-            jnp.sum(M_l * factors_l[nmodes - 1] * lam[None, :]), axis)
+            grams_l[m] = gram
+        znormsq, inner = fit_tail(lam, grams_l, M_l, factors_l[nmodes - 1],
+                                  axis)
         return tuple(factors_l), tuple(grams_l), lam, znormsq, inner
 
     return jax.jit(sweep)
@@ -224,8 +236,10 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         jax.device_put(U.T @ U, gram_sharding) for U in factors
     )
 
-    sweep = make_sharded_sweep(mesh, nmodes, opts.regularization, dims_pad,
-                               axis=axis)
+    variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
+               else "all2all")
+    sweep = make_sharded_sweep(mesh, nmodes, opts.regularization,
+                               dims_pad, axis=axis, variant=variant)
 
     def step(factors, grams, flag):
         return sweep(inds, vals, factors, grams, flag)

@@ -41,6 +41,28 @@ def tensor_stats(tt: SparseTensor, name: str = "tensor") -> str:
     return "\n".join(lines)
 
 
+def grid_stats_text(decomp) -> str:
+    """Distributed decomposition stats (≙ mpi_global_stats /
+    mpi_rank_stats / mpi_cpd_stats, src/stats.c:298-457)."""
+    grid = "x".join(str(g) for g in decomp.grid)
+    ncells = int(np.prod(decomp.grid))
+    lines = [
+        "Decomposition --------------------------------------",
+        f"GRID={grid} CELLS={ncells} CELL-NNZ={decomp.cell_nnz} "
+        f"FILL={decomp.fill:0.3f}",
+        f"LAYER-ROWS={'x'.join(str(b) for b in decomp.block_rows)} "
+        f"(padded dims {'x'.join(str(d) for d in decomp.dims_pad)})",
+    ]
+    # per-cell imbalance: padded slots are wasted work (exact counts
+    # recorded at build time — explicit zero-valued entries count)
+    occupied = np.asarray(decomp.cell_counts).ravel()
+    if occupied.size:
+        lines.append(
+            f"CELL-NNZ min={int(occupied.min())} "
+            f"avg={float(occupied.mean()):0.1f} max={int(occupied.max())}")
+    return "\n".join(lines)
+
+
 def cpd_stats_text(bs_or_tt, rank: int, opts: Options) -> str:
     lines = [
         "Factoring ------------------------------------------",

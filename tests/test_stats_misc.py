@@ -1,0 +1,61 @@
+"""Stats text, ttbox algorithm, Kruskal save/load."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from splatt_tpu.config import Options, Verbosity
+from splatt_tpu.cpd import cpd_als
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.mttkrp import mttkrp_ttbox
+from splatt_tpu.parallel.grid import GridDecomp
+from splatt_tpu.stats import cpd_stats_text, grid_stats_text, tensor_stats
+from tests import gen
+from tests.test_mttkrp import TOL, make_factors, np_mttkrp
+
+
+def test_ttbox_matches_oracle(any_tensor):
+    tt = any_tensor
+    factors = make_factors(tt.dims)
+    for mode in range(tt.nmodes):
+        got = mttkrp_ttbox(jnp.asarray(tt.inds), jnp.asarray(tt.vals),
+                           factors, mode, tt.dims[mode])
+        np.testing.assert_allclose(np.asarray(got),
+                                   np_mttkrp(tt, factors, mode), atol=TOL)
+
+
+def test_grid_stats_text():
+    tt = gen.fixture_tensor("med")
+    d = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64)
+    txt = grid_stats_text(d)
+    assert "GRID=2x2x2" in txt
+    assert "CELLS=8" in txt
+    assert "FILL=" in txt
+    assert "CELL-NNZ min=" in txt
+
+
+def test_tensor_and_cpd_stats_text():
+    tt = gen.fixture_tensor("small")
+    assert "DIMS=" in tensor_stats(tt)
+    from splatt_tpu.blocked import BlockedSparse
+
+    opts = Options(random_seed=1, val_dtype=np.float64)
+    bs = BlockedSparse.from_coo(tt, opts)
+    txt = cpd_stats_text(bs, 4, opts)
+    assert "NFACTORS=4" in txt and "BLOCKED-ALLOC=" in txt
+
+
+def test_kruskal_save_load_roundtrip(tmp_path):
+    tt = gen.fixture_tensor("small")
+    out = cpd_als(tt, rank=3,
+                  opts=Options(random_seed=3, max_iterations=4,
+                               verbosity=Verbosity.NONE,
+                               val_dtype=np.float64))
+    out.save(str(tmp_path))
+    back = KruskalTensor.load(str(tmp_path), nmodes=tt.nmodes)
+    for a, b in zip(out.factors, back.factors):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-15)
+    np.testing.assert_allclose(np.asarray(out.lam), np.asarray(back.lam),
+                               atol=1e-15)
+    # reconstruction from the round-tripped tensor matches
+    np.testing.assert_allclose(back.to_dense(), out.to_dense(), atol=1e-10)
