@@ -86,9 +86,11 @@ def main() -> None:
     except (OSError, json.JSONDecodeError):
         pass
 
+    platform = jax.devices()[0].platform
     print(json.dumps({
         "metric": f"CPD-ALS sec/iteration, synthetic NELL-2-shaped "
-                  f"(3-mode, {nnz} nnz, rank {rank})",
+                  f"(3-mode, {nnz} nnz, rank {rank}) on {platform}; "
+                  f"baseline: reference 1-thread CPU same tensor",
         "value": round(sec_per_iter, 4),
         "unit": "sec/iter",
         "vs_baseline": round(vs, 3),

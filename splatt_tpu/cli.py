@@ -51,31 +51,69 @@ def _build_opts(args) -> "Options":
 
 
 def cmd_cpd(args) -> int:
-    """≙ splatt_cpd_cmd (src/cmds/cmd_cpd.c:159-243)."""
+    """≙ splatt_cpd_cmd (src/cmds/cmd_cpd.c:159-243; distributed flags ≙
+    the mpirun variant's -d, src/cmds/mpi_cmd_cpd.c:175-338)."""
+    import jax
     import jax.numpy as jnp
 
     from splatt_tpu.blocked import BlockedSparse
-    from splatt_tpu.config import Verbosity
+    from splatt_tpu.config import CommPattern, Decomposition, Verbosity
     from splatt_tpu.cpd import cpd_als
-    from splatt_tpu.io import load
+    from splatt_tpu.io import load, read_permutation
     from splatt_tpu.stats import cpd_stats_text, tensor_stats
     from splatt_tpu.utils.timers import timers
 
     opts = _build_opts(args)
+    if getattr(args, "comm", None):
+        opts.comm_pattern = CommPattern(args.comm)
     timers.start("total")
     with timers.time("io"):
         tt = load(args.tensor)
     print(tensor_stats(tt, args.tensor))
-    with timers.time("blocked_build"):
-        bs = BlockedSparse.from_coo(tt, opts)
-    print(cpd_stats_text(bs, args.rank, opts))
-    out = cpd_als(bs, rank=args.rank, opts=opts)
+
+    distributed = (args.decomp is not None or args.grid is not None
+                   or args.partition is not None)
+    if distributed:
+        from splatt_tpu.parallel import distributed_cpd_als
+
+        if args.decomp:
+            opts.decomposition = Decomposition(args.decomp)
+        if args.partition and opts.decomposition is not Decomposition.FINE:
+            raise ValueError(
+                "-p/--partition is a FINE-decomposition input; combine it "
+                f"with --decomp fine, not {opts.decomposition.value}")
+        if args.partition:
+            opts.decomposition = Decomposition.FINE
+        if (args.comm == "point2point"
+                and opts.decomposition is not Decomposition.FINE):
+            raise ValueError(
+                "--comm point2point (ring) applies to the fine "
+                "decomposition only")
+        grid = None
+        if args.grid:
+            grid = tuple(int(g) for g in args.grid.split("x"))
+            if len(grid) != tt.nmodes or any(g < 1 for g in grid):
+                raise ValueError(
+                    f"--grid must give one positive factor per mode "
+                    f"({tt.nmodes} modes), got {args.grid!r}")
+        partition = (read_permutation(args.partition)
+                     if args.partition else None)
+        print(f"DISTRIBUTED decomp={opts.decomposition.value} "
+              f"devices={len(jax.devices())}"
+              + (f" grid={args.grid}" if args.grid else ""))
+        out = distributed_cpd_als(tt, rank=args.rank, opts=opts, grid=grid,
+                                  partition=partition)
+        bs = None
+    else:
+        with timers.time("blocked_build"):
+            bs = BlockedSparse.from_coo(tt, opts)
+        print(cpd_stats_text(bs, args.rank, opts))
+        out = cpd_als(bs, rank=args.rank, opts=opts)
     print(f"Final fit: {float(out.fit):0.5f}")
-    if opts.verbosity >= Verbosity.HIGH:
+    if bs is not None and opts.verbosity >= Verbosity.HIGH:
         # per-mode MTTKRP profile (≙ the per-mode times of `cpd -v -v`,
         # src/cpd.c:361-366 — measured post-hoc since the jitted sweep
         # fuses all modes)
-        import jax
         import time as _time
 
         from splatt_tpu.ops.mttkrp import mttkrp
@@ -198,6 +236,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--f64", action="store_true", help="double precision")
     p.add_argument("--nowrite", action="store_true",
                    help="skip writing factor files")
+    # distributed flags (≙ mpirun splatt cpd -d IxJxK / -d f -p partfile)
+    p.add_argument("--decomp", choices=["medium", "coarse", "fine"],
+                   help="run distributed over all devices with this "
+                        "decomposition")
+    p.add_argument("--grid", metavar="IxJxK",
+                   help="device grid for the medium decomposition")
+    p.add_argument("-p", "--partition", metavar="FILE",
+                   help="per-nonzero partition file (fine decomposition)")
+    p.add_argument("--comm", choices=["all2all", "point2point"],
+                   help="row-exchange pattern for --decomp fine "
+                        "(point2point = ppermute ring, memory-lean)")
     p.set_defaults(fn=cmd_cpd)
 
     p = sub.add_parser("bench", help="benchmark MTTKRP algorithms")

@@ -1,0 +1,99 @@
+"""Interop bindings to other array ecosystems.
+
+≙ the reference's Octave/Matlab MEX bindings layer (matlab/splatt_*.c,
+README.md:177-245): the reference exposes load/cpd/mttkrp to Matlab
+users; here the host ecosystems are torch and scipy, so the bindings
+convert their sparse containers to/from :class:`SparseTensor` and wrap
+the same three operations.
+
+Everything degrades gracefully when torch/scipy are absent.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from splatt_tpu.config import Options
+from splatt_tpu.coo import SparseTensor
+
+
+# -- torch ---------------------------------------------------------------
+
+def from_torch(t) -> SparseTensor:
+    """torch sparse COO (or dense) tensor → SparseTensor."""
+    import torch
+
+    t = t.detach()
+    if t.is_sparse:
+        t = t.coalesce()
+        inds = t.indices().cpu().numpy().astype(np.int64)
+        vals = t.values().cpu().numpy().astype(np.float64)
+        return SparseTensor(inds, vals, tuple(t.shape))
+    dense = t.cpu().numpy()
+    idx = np.nonzero(dense)
+    return SparseTensor(np.stack([i.astype(np.int64) for i in idx]),
+                        dense[idx].astype(np.float64), dense.shape)
+
+
+def to_torch(tt: SparseTensor):
+    """SparseTensor → torch sparse COO tensor."""
+    import torch
+
+    return torch.sparse_coo_tensor(
+        torch.from_numpy(np.ascontiguousarray(tt.inds)),
+        torch.from_numpy(np.ascontiguousarray(tt.vals)),
+        size=tt.dims).coalesce()
+
+
+def kruskal_to_torch(kt) -> tuple:
+    """KruskalTensor → (list of torch factor matrices, λ vector).
+
+    Copies (np.array, not np.asarray): jax host buffers are read-only,
+    and handing torch an aliased view invites undefined behavior on the
+    first in-place op.
+    """
+    import torch
+
+    return ([torch.from_numpy(np.array(U)) for U in kt.factors],
+            torch.from_numpy(np.array(kt.lam)))
+
+
+def cpd_als_torch(t, rank: int, opts: Optional[Options] = None):
+    """CPD of a torch sparse tensor; returns torch factors + λ
+    (≙ the splatt_cpd MEX entry returning struct U/lambda/fit)."""
+    from splatt_tpu.cpd import cpd_als
+
+    out = cpd_als(from_torch(t), rank, opts=opts)
+    factors, lam = kruskal_to_torch(out)
+    return factors, lam, float(out.fit)
+
+
+def mttkrp_torch(t, factors: List, mode: int):
+    """MTTKRP of a torch sparse tensor against torch factor matrices."""
+    import jax.numpy as jnp
+    import torch
+
+    from splatt_tpu.ops.mttkrp import mttkrp
+
+    tt = from_torch(t)
+    fax = [jnp.asarray(f.detach().cpu().numpy()) for f in factors]
+    return torch.from_numpy(np.array(mttkrp(tt, fax, mode)))
+
+
+# -- scipy ---------------------------------------------------------------
+
+def from_scipy(mat) -> SparseTensor:
+    """scipy.sparse matrix → 2-mode SparseTensor."""
+    coo = mat.tocoo()
+    inds = np.stack([coo.row.astype(np.int64), coo.col.astype(np.int64)])
+    return SparseTensor(inds, coo.data.astype(np.float64), coo.shape)
+
+
+def unfold_to_scipy(tt: SparseTensor, mode: int):
+    """Mode unfolding as a scipy CSR matrix (≙ tt_unfold + CSR)."""
+    from scipy.sparse import csr_matrix
+
+    indptr, cols, vals, shape = tt.unfold(mode)
+    return csr_matrix((vals, cols, indptr), shape=shape)

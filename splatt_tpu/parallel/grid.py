@@ -122,6 +122,10 @@ class GridDecomp:
     def make_mesh(self, devices=None) -> Mesh:
         devs = list(devices if devices is not None else jax.devices())
         n = int(np.prod(self.grid))
+        if n > len(devs):
+            grid = "x".join(str(g) for g in self.grid)
+            raise ValueError(f"grid {grid} needs {n} devices, only "
+                             f"{len(devs)} available")
         mesh_devs = np.array(devs[:n]).reshape(self.grid)
         return Mesh(mesh_devs, tuple(_axis(m) for m in range(self.nmodes)))
 

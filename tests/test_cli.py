@@ -92,3 +92,38 @@ def test_bench_runs(tns, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "stream" in out and "blocked" in out and "total:" in out
+
+
+def test_cpd_distributed_flags(tns, capsys):
+    """--decomp runs the distributed path; --comm selects the ring."""
+    rc = main(["cpd", tns, "-r", "3", "-i", "3", "--seed", "2", "--nowrite",
+               "--decomp", "fine", "--comm", "point2point"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "DISTRIBUTED decomp=fine" in out
+    assert "Final fit:" in out
+
+
+def test_cpd_distributed_grid(tns, capsys):
+    rc = main(["cpd", tns, "-r", "3", "-i", "3", "--seed", "2", "--nowrite",
+               "--decomp", "medium", "--grid", "2x2x2"])
+    assert rc == 0
+    assert "grid=2x2x2" in capsys.readouterr().out
+
+
+def test_cpd_bad_flag_combinations(tns, capsys):
+    # -p with a non-fine decomposition is rejected
+    assert main(["cpd", tns, "-r", "2", "--decomp", "medium",
+                 "-p", "whatever.part"]) == 1
+    assert "FINE-decomposition" in capsys.readouterr().err
+    # ring comm outside fine is rejected
+    assert main(["cpd", tns, "-r", "2", "--decomp", "medium",
+                 "--comm", "point2point"]) == 1
+    assert "fine" in capsys.readouterr().err
+    # wrong-arity / non-positive grid is rejected cleanly
+    assert main(["cpd", tns, "-r", "2", "--decomp", "medium",
+                 "--grid", "2x2"]) == 1
+    assert "one positive factor per mode" in capsys.readouterr().err
+    assert main(["cpd", tns, "-r", "2", "--decomp", "medium",
+                 "--grid", "0x2x2"]) == 1
+    assert "positive" in capsys.readouterr().err

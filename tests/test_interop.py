@@ -1,0 +1,81 @@
+"""Interop bindings tests (≙ the MEX binding layer's role)."""
+
+import numpy as np
+import pytest
+
+from splatt_tpu import interop
+from splatt_tpu.config import Options, Verbosity
+from tests import gen
+from tests.test_mttkrp import np_mttkrp
+
+torch = pytest.importorskip("torch")
+
+
+def test_torch_roundtrip():
+    tt = gen.fixture_tensor("med")
+    t = interop.to_torch(tt)
+    back = interop.from_torch(t)
+    assert back.dims == tt.dims
+    # coalesce sorts lexicographically; compare as dense
+    np.testing.assert_allclose(back.to_dense(), tt.to_dense())
+
+
+def test_torch_dense_input():
+    dense = np.zeros((3, 4, 2))
+    dense[0, 1, 0] = 2.0
+    dense[2, 3, 1] = -1.5
+    tt = interop.from_torch(torch.from_numpy(dense))
+    assert tt.nnz == 2
+    np.testing.assert_allclose(tt.to_dense(), dense)
+
+
+def test_cpd_als_torch():
+    tt = gen.fixture_tensor("small")
+    t = interop.to_torch(tt)
+    factors, lam, fit = interop.cpd_als_torch(
+        t, rank=3, opts=Options(random_seed=2, max_iterations=5,
+                                verbosity=Verbosity.NONE,
+                                val_dtype=np.float64))
+    assert len(factors) == 3
+    assert factors[0].shape == (tt.dims[0], 3)
+    assert lam.shape == (3,)
+    assert 0.0 <= fit <= 1.0
+
+
+def test_mttkrp_torch():
+    tt = gen.fixture_tensor("small4")
+    t = interop.to_torch(tt)
+    rng = np.random.default_rng(5)
+    factors = [torch.from_numpy(rng.random((d, 4))) for d in tt.dims]
+    got = interop.mttkrp_torch(t, factors, 1).numpy()
+    # torch coalesce re-sorts the tensor; MTTKRP is order-invariant
+    want = np_mttkrp(interop.from_torch(t), factors, 1)
+    np.testing.assert_allclose(got, want, atol=1e-10)
+
+
+def test_scipy_bridge():
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    tt = gen.fixture_tensor("med")
+    csr = interop.unfold_to_scipy(tt, 0)
+    assert csr.shape[0] == tt.dims[0]
+    assert csr.nnz == tt.nnz
+    mat2 = interop.from_scipy(csr)
+    assert mat2.nmodes == 2
+    assert mat2.nnz == tt.nnz
+
+
+def test_from_torch_requires_grad():
+    dense = torch.rand(3, 4, 2, dtype=torch.float64, requires_grad=True)
+    tt = interop.from_torch(dense)
+    assert tt.nmodes == 3
+
+
+def test_torch_outputs_are_writable():
+    tt = gen.fixture_tensor("small")
+    t = interop.to_torch(tt)
+    factors, lam, fit = interop.cpd_als_torch(
+        t, rank=2, opts=Options(random_seed=1, max_iterations=3,
+                                verbosity=Verbosity.NONE,
+                                val_dtype=np.float64))
+    factors[0].mul_(2.0)  # in-place op must be safe (copied buffers)
+    lam.add_(1.0)
