@@ -140,6 +140,221 @@ int tns_fill(void *h, int64_t *inds, double *vals) {
 void tns_close(void *h) { delete static_cast<TnsFile *>(h); }
 
 // ---------------------------------------------------------------------
+// Streaming text -> binary conversion with bounded memory (two passes
+// over the file, ~8MB buffer), for tensors larger than RAM.  (≙ the
+// reference's streamed chunk ingest, mpi_simple_distribute
+// src/mpi/mpi_io.c:587-648 — here the "ranks" are the per-mode regions
+// of the binary file, written via buffered seeks.)
+//
+// Binary layout must match splatt_tpu/io.py: magic "SPTT", u32
+// {version=1, nmodes, idx_width, val_width=8}, u64 dims[nmodes], u64
+// nnz, then per-mode index arrays, then doubles.
+
+namespace {
+
+constexpr size_t kChunk = 8u << 20;
+
+struct LineScanner {
+  FILE *f;
+  std::vector<char> buf;
+  size_t len = 0, pos = 0;
+  bool eof = false;
+
+  explicit LineScanner(FILE *file) : f(file), buf(kChunk + 1) {}
+
+  // Returns pointer to the next NUL-terminated line (without '\n'),
+  // or nullptr at end of file.  The pointer is valid until next call.
+  char *next_line() {
+    for (;;) {
+      // find '\n' in [pos, len)
+      char *nl = static_cast<char *>(
+          memchr(buf.data() + pos, '\n', len - pos));
+      if (nl) {
+        *nl = '\0';
+        char *line = buf.data() + pos;
+        pos = static_cast<size_t>(nl - buf.data()) + 1;
+        return line;
+      }
+      if (eof) {
+        if (pos < len) {  // final line without '\n'
+          buf[len] = '\0';
+          char *line = buf.data() + pos;
+          pos = len;
+          return line;
+        }
+        return nullptr;
+      }
+      // shift the partial tail to the front and refill
+      size_t tail = len - pos;
+      memmove(buf.data(), buf.data() + pos, tail);
+      pos = 0;
+      len = tail;
+      size_t got = fread(buf.data() + len, 1, kChunk - len, f);
+      len += got;
+      if (got == 0) eof = true;
+    }
+  }
+};
+
+inline bool parse_row(char *line, int ncols, int64_t *idx, double *val) {
+  char *p = line;
+  for (int c = 0; c < ncols - 1; ++c) {
+    while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+    bool neg = (*p == '-');
+    if (neg) ++p;
+    if (*p < '0' || *p > '9') return false;
+    int64_t v = 0;
+    while (*p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+    idx[c] = neg ? -v : v;
+  }
+  char *next = nullptr;
+  *val = strtod(p, &next);
+  if (next == p) return false;
+  while (*next == ' ' || *next == '\t' || *next == '\r') ++next;
+  return *next == '\0';
+}
+
+inline bool line_blank_or_comment(const char *p) {
+  while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+  return *p == '\0' || *p == '#';
+}
+
+struct RegionWriter {
+  FILE *f;
+  long base;
+  int width;  // 4 or 8
+  std::vector<char> buf;
+  size_t used = 0;
+  int64_t written = 0;
+  bool ok = true;  // sticky: any short write (ENOSPC, I/O error) trips it
+
+  RegionWriter(FILE *file, long base_off, int w)
+      : f(file), base(base_off), width(w), buf(1u << 20) {}
+
+  void push(int64_t v) {
+    if (used + 8 > buf.size()) flush();
+    if (width == 4) {
+      int32_t x = static_cast<int32_t>(v);
+      memcpy(buf.data() + used, &x, 4);
+      used += 4;
+    } else {
+      memcpy(buf.data() + used, &v, 8);
+      used += 8;
+    }
+  }
+
+  void push_d(double v) {
+    if (used + 8 > buf.size()) flush();
+    memcpy(buf.data() + used, &v, 8);
+    used += 8;
+  }
+
+  void flush() {
+    if (used) {
+      if (fseek(f, base + written, SEEK_SET) != 0 ||
+          fwrite(buf.data(), 1, used, f) != used)
+        ok = false;
+      written += static_cast<int64_t>(used);
+      used = 0;
+    }
+  }
+};
+
+}  // namespace
+
+int tns_stream_to_bin(const char *src, const char *dst) {
+  // pass 1: rows, cols, per-column min/max
+  FILE *f = fopen(src, "rb");
+  if (!f) return 1;
+  int ncols = 0;
+  int64_t nrows = 0;
+  int64_t idx[64];
+  double val;
+  int64_t mins[64], maxs[64];
+  {
+    LineScanner sc(f);
+    char *line;
+    while ((line = sc.next_line()) != nullptr) {
+      if (line_blank_or_comment(line)) continue;
+      if (ncols == 0) {
+        ncols = count_fields(line, line + strlen(line));
+        if (ncols < 2 || ncols > 65) { fclose(f); return 2; }
+        for (int c = 0; c < ncols - 1; ++c) {
+          mins[c] = INT64_MAX;
+          maxs[c] = INT64_MIN;
+        }
+      }
+      if (!parse_row(line, ncols, idx, &val)) { fclose(f); return 3; }
+      for (int c = 0; c < ncols - 1; ++c) {
+        if (idx[c] < 0) { fclose(f); return 3; }  // negative coordinate
+        if (idx[c] < mins[c]) mins[c] = idx[c];
+        if (idx[c] > maxs[c]) maxs[c] = idx[c];
+      }
+      ++nrows;
+    }
+  }
+  fclose(f);
+  if (ncols == 0 || nrows == 0) return 4;
+  const int nmodes = ncols - 1;
+  // 0/1-index autodetect: any zero anywhere -> 0-indexed (io.py rule)
+  int64_t global_min = INT64_MAX;
+  for (int c = 0; c < nmodes; ++c)
+    if (mins[c] < global_min) global_min = mins[c];
+  const int64_t shift = global_min > 0 ? 1 : 0;
+  int64_t max_idx = 0;
+  for (int c = 0; c < nmodes; ++c)
+    if (maxs[c] - shift > max_idx) max_idx = maxs[c] - shift;
+  const int idx_width = max_idx < (int64_t(1) << 31) ? 4 : 8;
+
+  // header + region offsets
+  FILE *out = fopen(dst, "wb");
+  if (!out) return 5;
+  fwrite("SPTT", 1, 4, out);
+  uint32_t hdr[4] = {1u, static_cast<uint32_t>(nmodes),
+                     static_cast<uint32_t>(idx_width), 8u};
+  fwrite(hdr, 4, 4, out);
+  for (int c = 0; c < nmodes; ++c) {
+    uint64_t d = static_cast<uint64_t>(maxs[c] - shift + 1);
+    fwrite(&d, 8, 1, out);
+  }
+  uint64_t nnz_u = static_cast<uint64_t>(nrows);
+  fwrite(&nnz_u, 8, 1, out);
+  long data_base = ftell(out);
+
+  std::vector<RegionWriter> writers;
+  writers.reserve(nmodes + 1);
+  for (int c = 0; c < nmodes; ++c)
+    writers.emplace_back(out, data_base + (long)c * idx_width * nrows,
+                         idx_width);
+  writers.emplace_back(out, data_base + (long)nmodes * idx_width * nrows, 8);
+
+  // pass 2: parse + scatter into regions
+  f = fopen(src, "rb");
+  if (!f) { fclose(out); return 1; }
+  {
+    LineScanner sc(f);
+    char *line;
+    int64_t r = 0;
+    while ((line = sc.next_line()) != nullptr) {
+      if (line_blank_or_comment(line)) continue;
+      if (!parse_row(line, ncols, idx, &val)) { fclose(f); fclose(out); return 3; }
+      for (int c = 0; c < nmodes; ++c) writers[c].push(idx[c] - shift);
+      writers[nmodes].push_d(val);
+      ++r;
+    }
+    if (r != nrows) { fclose(f); fclose(out); return 6; }
+  }
+  fclose(f);
+  bool ok = true;
+  for (auto &w : writers) {
+    w.flush();
+    ok = ok && w.ok;
+  }
+  if (fclose(out) != 0) ok = false;
+  return ok ? 0 : 7;
+}
+
+// ---------------------------------------------------------------------
 // Blocked-layout sort: lexicographic (key_mode, then remaining modes in
 // a given order) permutation of nnz.  (≙ tt_sort's role in CSF builds,
 // src/sort.c:912-961.)  Counting-bucket on the leading mode + std::sort

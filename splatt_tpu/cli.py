@@ -72,18 +72,19 @@ def cmd_cpd(args) -> int:
     print(tensor_stats(tt, args.tensor))
 
     distributed = (args.decomp is not None or args.grid is not None
-                   or args.partition is not None)
+                   or args.partition is not None or args.comm is not None)
     if distributed:
         from splatt_tpu.parallel import distributed_cpd_als
 
         if args.decomp:
             opts.decomposition = Decomposition(args.decomp)
+        elif args.comm or args.partition:
+            # comm patterns and partitions are fine-decomposition concepts
+            opts.decomposition = Decomposition.FINE
         if args.partition and opts.decomposition is not Decomposition.FINE:
             raise ValueError(
                 "-p/--partition is a FINE-decomposition input; combine it "
                 f"with --decomp fine, not {opts.decomposition.value}")
-        if args.partition:
-            opts.decomposition = Decomposition.FINE
         if (args.comm == "point2point"
                 and opts.decomposition is not Decomposition.FINE):
             raise ValueError(
@@ -178,6 +179,17 @@ def cmd_convert(args) -> int:
     from splatt_tpu.convert import convert
     from splatt_tpu.io import load
 
+    if args.type == "bin":
+        # streaming text→binary when the native runtime is built:
+        # bounded memory, scales past RAM (1.7B-nnz-class ingest)
+        with open(args.tensor, "rb") as f:
+            is_binary = f.read(4) == b"SPTT"
+        if not is_binary:
+            from splatt_tpu import native
+
+            if native.stream_to_bin(args.tensor, args.output):
+                print(f"wrote bin (streamed): {args.output}")
+                return 0
     tt = load(args.tensor)
     convert(tt, args.type, args.output, mode=args.mode)
     print(f"wrote {args.type}: {args.output}")

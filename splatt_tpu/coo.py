@@ -44,7 +44,12 @@ class SparseTensor:
     indmaps: Optional[List[Optional[np.ndarray]]] = None
 
     def __post_init__(self) -> None:
-        self.inds = np.ascontiguousarray(self.inds, dtype=np.int64)
+        # int32 is preserved (memmap-backed huge tensors); anything else
+        # integer-like normalizes to int64.  ascontiguousarray is a
+        # no-op (no copy) for already-contiguous arrays and memmaps.
+        self.inds = np.ascontiguousarray(self.inds)
+        if self.inds.dtype not in (np.dtype(np.int32), np.dtype(np.int64)):
+            self.inds = self.inds.astype(np.int64)
         self.vals = np.ascontiguousarray(self.vals)
         if self.inds.ndim != 2:
             raise ValueError("inds must be (nmodes, nnz)")
@@ -185,9 +190,11 @@ class SparseTensor:
         other = [m for m in range(self.nmodes) if m != mode]
         col = np.zeros(self.nnz, dtype=np.int64)
         stride = 1
-        # row-major over the remaining modes, last mode fastest
+        # row-major over the remaining modes, last mode fastest;
+        # int64 accumulation — int32 inds (memmap path) would wrap under
+        # NEP 50 once the column space exceeds 2^31
         for m in reversed(other):
-            col += self.inds[m] * stride
+            col += self.inds[m].astype(np.int64) * stride
             stride *= self.dims[m]
         ncols = stride
         order = np.lexsort((col, rows))

@@ -92,10 +92,40 @@ def _fit(xnormsq: float, znormsq: jax.Array, inner: jax.Array) -> jax.Array:
     return 1.0 - residual / np.sqrt(xnormsq)
 
 
+def _save_checkpoint(path: str, factors, lam, it: int, fit: float) -> None:
+    """Atomic .npz checkpoint (write + rename)."""
+    import os
+
+    tmp = path + ".tmp.npz"
+    arrays = {f"factor{m}": np.asarray(U) for m, U in enumerate(factors)}
+    np.savez(tmp, nmodes=len(factors), it=it, fit=fit,
+             lam=np.asarray(lam), **arrays)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str):
+    """Load a mid-run ALS checkpoint → (factors, lam, it, fit)."""
+    with np.load(path) as z:
+        nmodes = int(z["nmodes"])
+        factors = [jnp.asarray(z[f"factor{m}"]) for m in range(nmodes)]
+        return factors, jnp.asarray(z["lam"]), int(z["it"]), float(z["fit"])
+
+
 def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             opts: Optional[Options] = None,
-            init: Optional[List[jax.Array]] = None) -> KruskalTensor:
-    """Compute a rank-`rank` CPD of X (≙ splatt_cpd_als, src/cpd.c:22-63)."""
+            init: Optional[List[jax.Array]] = None,
+            checkpoint_path: Optional[str] = None,
+            checkpoint_every: int = 10,
+            resume: bool = True) -> KruskalTensor:
+    """Compute a rank-`rank` CPD of X (≙ splatt_cpd_als, src/cpd.c:22-63).
+
+    Checkpoint/resume (beyond the reference, which only writes terminal
+    outputs): with `checkpoint_path`, factors are written atomically
+    every `checkpoint_every` iterations, and an existing checkpoint is
+    resumed from (pass resume=False to overwrite).  ALS is
+    self-correcting, so restarting from checkpointed factors continues
+    the same optimization.
+    """
     opts = opts or default_opts()
     if isinstance(X, SparseTensor):
         dims, nmodes = X.dims, X.nmodes
@@ -106,6 +136,20 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         xnormsq = X.frobsq()
         dtype = X.layouts[0].vals.dtype
 
+    start_it = 0
+    ck_lam = None
+    ck_fit = 0.0
+    if checkpoint_path is not None and resume:
+        import os
+
+        if os.path.exists(checkpoint_path):
+            ck_factors, ck_lam, start_it, ck_fit = \
+                load_checkpoint(checkpoint_path)
+            init = ck_factors
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  resuming from {checkpoint_path} "
+                      f"(iteration {start_it})")
+
     if init is not None:
         factors = [jnp.asarray(f, dtype=dtype) for f in init]
     else:
@@ -114,11 +158,14 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
 
     sweep = _make_sweep(X, nmodes, opts.regularization)
 
-    fit_prev = 0.0
-    fit = jnp.asarray(0.0, dtype=dtype)
-    lam = jnp.ones((rank,), dtype=dtype)
+    # resuming past max_iterations runs zero sweeps — the checkpointed
+    # λ/fit must survive as the result
+    fit_prev = ck_fit
+    fit = jnp.asarray(ck_fit, dtype=dtype)
+    lam = (jnp.asarray(ck_lam, dtype=dtype) if ck_lam is not None
+           else jnp.ones((rank,), dtype=dtype))
     timers.start("cpd")
-    for it in range(opts.max_iterations):
+    for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         factors, grams, lam, znormsq, inner = sweep(factors, grams, it == 0)
         fit = _fit(xnormsq, znormsq, inner)
@@ -127,6 +174,8 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"
                   f"  delta = {fitval - fit_prev:+0.4e}")
+        if checkpoint_path is not None and (it + 1) % checkpoint_every == 0:
+            _save_checkpoint(checkpoint_path, factors, lam, it + 1, fitval)
         if it > 0 and abs(fitval - fit_prev) < opts.tolerance:
             fit_prev = fitval
             break

@@ -69,6 +69,8 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.sort_perm.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
                               ctypes.c_void_p, ctypes.c_void_p,
                               ctypes.c_void_p]
+    lib.tns_stream_to_bin.restype = ctypes.c_int
+    lib.tns_stream_to_bin.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     _lib = lib
     return lib
 
@@ -99,6 +101,23 @@ def parse_tns(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         return inds, vals
     finally:
         lib.tns_close(h)
+
+
+def stream_to_bin(src: str, dst: str) -> bool:
+    """Two-pass streaming text→binary conversion with ~8MB memory
+    (for tensors larger than RAM).  False → caller should fall back to
+    the in-memory path; raises on malformed input.
+    """
+    lib = _load()
+    if lib is None:
+        return False
+    rc = lib.tns_stream_to_bin(os.fsencode(src), os.fsencode(dst))
+    if rc in (1, 5):
+        raise OSError(f"cannot open {src if rc == 1 else dst}")
+    if rc != 0:
+        raise ValueError(f"{src}: malformed tensor file "
+                         f"(stream converter rc={rc})")
+    return True
 
 
 def sort_perm(inds: np.ndarray, dims: Sequence[int],

@@ -115,3 +115,17 @@ def test_4mode_and_5mode():
         out = cpd_als(bs, rank=4, opts=_opts(max_iterations=5))
         assert np.isfinite(float(out.fit))
         assert out.nmodes == tt.nmodes
+
+
+def test_checkpoint_resume_past_max_iterations(tmp_path):
+    """Resuming a finished run must return the checkpointed model, not
+    a zero-fit shell."""
+    tt = gen.fixture_tensor("med")
+    ck = str(tmp_path / "ck.npz")
+    opts = _opts(max_iterations=6)
+    a = cpd_als(tt, rank=3, opts=opts, checkpoint_path=ck,
+                checkpoint_every=2)
+    b = cpd_als(tt, rank=3, opts=opts, checkpoint_path=ck,
+                checkpoint_every=2)  # start_it == max_iterations
+    assert float(b.fit) == pytest.approx(float(a.fit), abs=1e-8)
+    np.testing.assert_allclose(b.to_dense(), a.to_dense(), atol=1e-8)

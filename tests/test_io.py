@@ -76,3 +76,21 @@ def test_matrix_vector_perm_roundtrip(tmp_path):
     perm = np.array([3, 1, 0, 2])
     write_permutation(perm, str(tmp_path / "p.perm"))
     np.testing.assert_array_equal(read_permutation(str(tmp_path / "p.perm")), perm)
+
+
+def test_load_memmap_roundtrip(tmp_path, any_tensor):
+    from splatt_tpu.io import load_memmap
+
+    tt = any_tensor
+    path = str(tmp_path / "t.bin")
+    save(tt, path)
+    out = load_memmap(path)
+    # no copy on load: arrays are views over the mapped file
+    assert isinstance(out.inds.base, np.memmap)
+    assert isinstance(out.vals.base, np.memmap)
+    assert out.dims == tt.dims
+    np.testing.assert_array_equal(np.asarray(out.inds), tt.inds)
+    np.testing.assert_allclose(np.asarray(out.vals), tt.vals)
+    # memmapped tensors work through the normal pipeline
+    assert out.normsq() == pytest.approx(tt.normsq())
+    assert out.sorted_by(range(out.nmodes)).nnz == tt.nnz

@@ -94,3 +94,41 @@ def test_out_of_range_indices_decline():
     fallback tolerates them)."""
     inds = np.array([[0, 5], [1, 0], [0, 1]])  # 5 >= dims[0]=2
     assert native.sort_perm(inds, (2, 2, 2), [0, 1, 2]) is None
+
+
+def test_stream_to_bin_matches_inmemory(tmp_path, any_tensor):
+    """Streamed conversion must produce a file the normal loader reads
+    back identically (incl. 1-index shift + narrowing)."""
+    tt = any_tensor
+    src = str(tmp_path / "t.tns")
+    save(tt, src, one_indexed=True)
+    dst = str(tmp_path / "t.bin")
+    assert native.stream_to_bin(src, dst)
+    out = load(dst)
+    assert out.dims == tt.dims
+    np.testing.assert_array_equal(out.inds, tt.inds)
+    np.testing.assert_allclose(out.vals, tt.vals)
+
+
+def test_stream_to_bin_comments_zero_indexed(tmp_path):
+    src = tmp_path / "z.tns"
+    src.write_text("# hdr\n0 1 0 1.5\n\n2 0 1 -2.0")  # 0-indexed, no final \n
+    dst = str(tmp_path / "z.bin")
+    assert native.stream_to_bin(str(src), dst)
+    out = load(dst)
+    assert out.dims == (3, 2, 2)
+    np.testing.assert_array_equal(out.inds, [[0, 2], [1, 0], [0, 1]])
+
+
+def test_stream_to_bin_malformed(tmp_path):
+    src = tmp_path / "bad.tns"
+    src.write_text("1 2 x 1.0\n")
+    with pytest.raises(ValueError):
+        native.stream_to_bin(str(src), str(tmp_path / "bad.bin"))
+
+
+def test_stream_to_bin_negative_index_rejected(tmp_path):
+    src = tmp_path / "neg.tns"
+    src.write_text("1 -3 1 2.5\n")
+    with pytest.raises(ValueError):
+        native.stream_to_bin(str(src), str(tmp_path / "neg.bin"))
