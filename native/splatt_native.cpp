@@ -159,11 +159,13 @@ struct LineScanner {
   std::vector<char> buf;
   size_t len = 0, pos = 0;
   bool eof = false;
+  bool error = false;  // line longer than the buffer / read failure
 
   explicit LineScanner(FILE *file) : f(file), buf(kChunk + 1) {}
 
   // Returns pointer to the next NUL-terminated line (without '\n'),
-  // or nullptr at end of file.  The pointer is valid until next call.
+  // or nullptr at end of file or on error (check `error`).  The
+  // pointer is valid until next call.
   char *next_line() {
     for (;;) {
       // find '\n' in [pos, len)
@@ -186,12 +188,21 @@ struct LineScanner {
       }
       // shift the partial tail to the front and refill
       size_t tail = len - pos;
+      if (tail >= kChunk) {
+        // a single line fills the whole buffer: refusing beats
+        // silently truncating the rest of the file
+        error = true;
+        return nullptr;
+      }
       memmove(buf.data(), buf.data() + pos, tail);
       pos = 0;
       len = tail;
       size_t got = fread(buf.data() + len, 1, kChunk - len, f);
       len += got;
-      if (got == 0) eof = true;
+      if (got == 0) {
+        if (ferror(f)) error = true;
+        eof = true;
+      }
     }
   }
 };
@@ -292,6 +303,7 @@ int tns_stream_to_bin(const char *src, const char *dst) {
       }
       ++nrows;
     }
+    if (sc.error) { fclose(f); return 3; }
   }
   fclose(f);
   if (ncols == 0 || nrows == 0) return 4;
@@ -342,7 +354,7 @@ int tns_stream_to_bin(const char *src, const char *dst) {
       writers[nmodes].push_d(val);
       ++r;
     }
-    if (r != nrows) { fclose(f); fclose(out); return 6; }
+    if (sc.error || r != nrows) { fclose(f); fclose(out); return 6; }
   }
   fclose(f);
   bool ok = true;

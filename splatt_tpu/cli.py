@@ -78,6 +78,8 @@ def cmd_cpd(args) -> int:
 
         if args.decomp:
             opts.decomposition = Decomposition(args.decomp)
+        elif args.grid:
+            opts.decomposition = Decomposition.MEDIUM
         elif args.comm or args.partition:
             # comm patterns and partitions are fine-decomposition concepts
             opts.decomposition = Decomposition.FINE
@@ -90,6 +92,9 @@ def cmd_cpd(args) -> int:
             raise ValueError(
                 "--comm point2point (ring) applies to the fine "
                 "decomposition only")
+        if args.grid and opts.decomposition is not Decomposition.MEDIUM:
+            raise ValueError(
+                "--grid applies to the medium decomposition only")
         grid = None
         if args.grid:
             grid = tuple(int(g) for g in args.grid.split("x"))

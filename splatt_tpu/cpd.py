@@ -99,7 +99,9 @@ def _save_checkpoint(path: str, factors, lam, it: int, fit: float) -> None:
     tmp = path + ".tmp.npz"
     arrays = {f"factor{m}": np.asarray(U) for m, U in enumerate(factors)}
     np.savez(tmp, nmodes=len(factors), it=it, fit=fit,
-             lam=np.asarray(lam), **arrays)
+             lam=np.asarray(lam),
+             dims=np.asarray([U.shape[0] for U in factors]),
+             rank=int(factors[0].shape[1]), **arrays)
     os.replace(tmp, path)
 
 
@@ -145,6 +147,13 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         if os.path.exists(checkpoint_path):
             ck_factors, ck_lam, start_it, ck_fit = \
                 load_checkpoint(checkpoint_path)
+            ck_dims = tuple(int(U.shape[0]) for U in ck_factors)
+            ck_rank = int(ck_factors[0].shape[1])
+            if ck_dims != tuple(dims) or ck_rank != rank:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} is for dims={ck_dims} "
+                    f"rank={ck_rank}, not dims={tuple(dims)} rank={rank}; "
+                    f"pass resume=False to overwrite it")
             init = ck_factors
             if opts.verbosity >= Verbosity.LOW:
                 print(f"  resuming from {checkpoint_path} "

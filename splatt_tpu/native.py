@@ -112,9 +112,18 @@ def stream_to_bin(src: str, dst: str) -> bool:
     if lib is None:
         return False
     rc = lib.tns_stream_to_bin(os.fsencode(src), os.fsencode(dst))
-    if rc in (1, 5):
-        raise OSError(f"cannot open {src if rc == 1 else dst}")
     if rc != 0:
+        # never leave a partial binary with a valid header behind
+        try:
+            os.unlink(dst)
+        except OSError:
+            pass
+        if rc in (1, 5):
+            raise OSError(f"cannot open {src if rc == 1 else dst}")
+        if rc in (6, 7):
+            raise OSError(
+                f"{dst}: write failed during conversion (disk full or "
+                f"I/O error, rc={rc})")
         raise ValueError(f"{src}: malformed tensor file "
                          f"(stream converter rc={rc})")
     return True

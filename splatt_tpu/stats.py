@@ -63,6 +63,39 @@ def grid_stats_text(decomp) -> str:
     return "\n".join(lines)
 
 
+def partition_quality_text(tt: SparseTensor, parts: np.ndarray) -> str:
+    """Quality of a nonzero-level partition (≙ the hypergraph partition
+    stats, src/stats.c:53-170): load balance plus the connectivity-1
+    cut of each mode's slice hyperedges — for every slice, the number
+    of extra parts it spans (= factor rows that must be exchanged under
+    the FINE decomposition).
+    """
+    parts = np.asarray(parts)
+    if parts.shape[0] != tt.nnz:
+        raise ValueError(
+            f"partition length {parts.shape[0]} != nnz {tt.nnz}")
+    nparts = int(parts.max()) + 1 if parts.size else 1
+    counts = np.bincount(parts, minlength=nparts)
+    avg = tt.nnz / max(nparts, 1)
+    lines = [
+        "Partition quality ----------------------------------",
+        f"PARTS={nparts} NNZ-BALANCE max/avg={counts.max() / max(avg, 1e-12):0.3f} "
+        f"(min={counts.min()} avg={avg:0.1f} max={counts.max()})",
+    ]
+    total_cut = 0
+    for m in range(tt.nmodes):
+        # distinct (slice, part) pairs minus nonempty slices
+        key = tt.inds[m].astype(np.int64) * nparts + parts
+        pairs = np.unique(key).size
+        nonempty = np.unique(tt.inds[m]).size
+        cut = pairs - nonempty
+        total_cut += cut
+        lines.append(f"  mode {m}: connectivity-1 cut = {cut} "
+                     f"(of {nonempty} slices)")
+    lines.append(f"TOTAL-CUT={total_cut}")
+    return "\n".join(lines)
+
+
 def cpd_stats_text(bs_or_tt, rank: int, opts: Options) -> str:
     lines = [
         "Factoring ------------------------------------------",

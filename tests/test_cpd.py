@@ -129,3 +129,17 @@ def test_checkpoint_resume_past_max_iterations(tmp_path):
                 checkpoint_every=2)  # start_it == max_iterations
     assert float(b.fit) == pytest.approx(float(a.fit), abs=1e-8)
     np.testing.assert_allclose(b.to_dense(), a.to_dense(), atol=1e-8)
+
+
+def test_checkpoint_mismatch_rejected(tmp_path):
+    tt = gen.fixture_tensor("med")
+    ck = str(tmp_path / "ck.npz")
+    cpd_als(tt, rank=3, opts=_opts(max_iterations=4),
+            checkpoint_path=ck, checkpoint_every=2)
+    with pytest.raises(ValueError, match="checkpoint"):
+        cpd_als(tt, rank=8, opts=_opts(max_iterations=4),
+                checkpoint_path=ck, checkpoint_every=2)
+    # resume=False overwrites instead
+    out = cpd_als(tt, rank=8, opts=_opts(max_iterations=4),
+                  checkpoint_path=ck, checkpoint_every=2, resume=False)
+    assert out.rank == 8

@@ -59,3 +59,17 @@ def test_kruskal_save_load_roundtrip(tmp_path):
                                atol=1e-15)
     # reconstruction from the round-tripped tensor matches
     np.testing.assert_allclose(back.to_dense(), out.to_dense(), atol=1e-10)
+
+
+def test_partition_quality_text():
+    from splatt_tpu.stats import partition_quality_text
+
+    tt = gen.fixture_tensor("med")
+    rng = np.random.default_rng(0)
+    parts = rng.integers(0, 4, size=tt.nnz)
+    txt = partition_quality_text(tt, parts)
+    assert "PARTS=4" in txt
+    assert "TOTAL-CUT=" in txt
+    # a single-part partition has zero cut
+    txt1 = partition_quality_text(tt, np.zeros(tt.nnz, dtype=np.int64))
+    assert "TOTAL-CUT=0" in txt1
