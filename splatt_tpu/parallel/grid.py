@@ -202,10 +202,31 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                  grid: Optional[Tuple[int, ...]] = None,
                  mesh: Optional[Mesh] = None,
                  opts: Optional[Options] = None,
-                 init: Optional[List[jax.Array]] = None) -> KruskalTensor:
-    """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition)."""
+                 init: Optional[List[jax.Array]] = None,
+                 relabel: Optional[str] = None) -> KruskalTensor:
+    """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition).
+
+    `relabel` ("random"/"graph"/"fibsched") applies an index relabeling
+    before decomposing — equal fences over relabeled indices ≈ the
+    reference's nnz-balanced layer boundaries (p_find_layer_boundaries)
+    — and restores factor row order afterwards via the permutation
+    bookkeeping.
+    """
     opts = opts or default_opts()
     dtype = resolve_dtype(opts, tt.vals.dtype)
+
+    perm = None
+    if relabel is not None:
+        from splatt_tpu.reorder import reorder
+
+        perm = reorder(tt, relabel, seed=opts.seed())
+        tt = perm.apply(tt)
+        if init is not None:
+            # init rows are in original labels; move them to relabeled
+            # space (row new = row iperm[new] of the original)
+            init = [np.asarray(U)[perm.iperms[m]]
+                    if perm.iperms[m] is not None else U
+                    for m, U in enumerate(init)]
 
     # A user-supplied mesh either already has the m<k> grid axes (use its
     # shape as the grid) or is treated as a pool of devices to arrange.
@@ -239,5 +260,11 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     def step(factors, grams, flag):
         return sweep(inds, vals, factors, grams, flag)
 
-    return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                               tt.dims, dtype)
+    out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
+                              tt.dims, dtype)
+    if perm is not None:
+        out = KruskalTensor(
+            factors=[jnp.asarray(perm.apply_to_factor(np.asarray(U), m))
+                     for m, U in enumerate(out.factors)],
+            lam=out.lam, fit=out.fit)
+    return out

@@ -96,3 +96,31 @@ def test_grid_awkward_dims():
     assert np.isfinite(float(out.fit))
     for U, d in zip(out.factors, dims):
         assert U.shape == (d, 3)
+
+
+def test_grid_relabel_matches_plain():
+    """Relabeled grid CPD returns factors in ORIGINAL row order with the
+    same quality (same init, same math, different cell assignment)."""
+    tt = gen.fixture_tensor("med")
+    opts = _opts(max_iterations=6)
+    init = init_factors(tt.dims, 4, opts.seed(), dtype=jnp.float64)
+    plain = grid_cpd_als(tt, rank=4, grid=(2, 2, 2), opts=opts, init=init)
+    rel = grid_cpd_als(tt, rank=4, grid=(2, 2, 2), opts=opts, init=init,
+                       relabel="random")
+    assert float(rel.fit) == pytest.approx(float(plain.fit), abs=1e-6)
+    # reconstructions agree (factors restored to original labels)
+    np.testing.assert_allclose(rel.to_dense(), plain.to_dense(), atol=1e-5)
+
+
+def test_grid_relabel_improves_balance():
+    """On a skewed tensor, random relabeling improves cell fill."""
+    from splatt_tpu.parallel.grid import GridDecomp
+    from splatt_tpu.reorder import reorder
+
+    tt = gen.fixture_tensor("med")  # zipf-skewed fixture
+    base = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64)
+    perm = reorder(tt, "random", seed=1)
+    relabeled = GridDecomp.build(perm.apply(tt), grid=(2, 2, 2),
+                                 val_dtype=np.float64)
+    # deterministic fixture: 0.24 -> 0.54 observed; assert strict gain
+    assert relabeled.fill > base.fill
