@@ -167,10 +167,21 @@ def cmd_check(args) -> int:
     from splatt_tpu.io import load, save
 
     tt = load(args.tensor)
+    # out-of-range first: the histogram-based stats below assume
+    # in-range indices (negative ones crash np.bincount)
+    noob = sum(int(np.count_nonzero((tt.inds[m] < 0)
+                                    | (tt.inds[m] >= tt.dims[m])))
+               for m in range(tt.nmodes))
+    if noob:
+        print(f"out-of-range: {noob}")
+        print("error: tensor declares indices outside its dimensions "
+              "(corrupt file?)")
+        return 1
     ndup = tt.count_duplicates()
     nempty = sum(tt.dims[m] - tt.nslices_nonempty(m)
                  for m in range(tt.nmodes))
-    print(f"duplicates: {ndup}  empty slices: {nempty}")
+    print(f"duplicates: {ndup}  empty slices: {nempty}  "
+          f"out-of-range: 0")
     if args.fix:
         fixed = tt.deduplicate().remove_empty_slices()
         save(fixed, args.fix)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from splatt_tpu.cli import main
+from splatt_tpu.coo import SparseTensor
 from splatt_tpu.io import load, read_matrix
 from tests import gen
 
@@ -127,3 +128,18 @@ def test_cpd_bad_flag_combinations(tns, capsys):
     assert main(["cpd", tns, "-r", "2", "--decomp", "medium",
                  "--grid", "0x2x2"]) == 1
     assert "positive" in capsys.readouterr().err
+
+
+def test_check_out_of_range(tmp_path, capsys):
+    """A binary declaring indices beyond its dims is flagged."""
+    from splatt_tpu.io import save
+
+    tt = gen.fixture_tensor("small")
+    bad = SparseTensor(tt.inds.copy(), tt.vals.copy(),
+                       (tt.dims[0] - 1, *tt.dims[1:]))  # dims too small
+    path = str(tmp_path / "bad.bin")
+    save(bad, path)
+    rc = main(["check", path])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "out-of-range" in out

@@ -1,0 +1,82 @@
+"""Property-based tests (hypothesis): algebraic invariants that hold for
+every tensor, beyond the fixed-fixture differential tests."""
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from splatt_tpu.blocked import BlockedSparse
+from splatt_tpu.config import BlockAlloc, Options
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.ops.mttkrp import mttkrp
+from tests.test_mttkrp import np_mttkrp
+
+
+@st.composite
+def sparse_tensors(draw):
+    nmodes = draw(st.integers(2, 4))
+    dims = tuple(draw(st.integers(2, 12)) for _ in range(nmodes))
+    nnz = draw(st.integers(1, 60))
+    seed = draw(st.integers(0, 2**16))
+    rng = np.random.default_rng(seed)
+    inds = np.stack([rng.integers(0, d, size=nnz) for d in dims])
+    vals = rng.standard_normal(nnz)
+    return SparseTensor(inds, vals, dims)
+
+
+@given(sparse_tensors())
+@settings(max_examples=25, deadline=None)
+def test_dedup_idempotent_and_preserves_sum(tt):
+    d1 = tt.deduplicate()
+    d2 = d1.deduplicate()
+    assert d1.nnz == d2.nnz
+    np.testing.assert_allclose(d1.vals.sum(), tt.vals.sum(), atol=1e-9)
+    np.testing.assert_allclose(d1.to_dense(), tt.to_dense(), atol=1e-9)
+
+
+@given(sparse_tensors(), st.integers(0, 3))
+@settings(max_examples=25, deadline=None)
+def test_sort_preserves_dense(tt, lead):
+    lead = lead % tt.nmodes
+    order = [lead] + [m for m in range(tt.nmodes) if m != lead]
+    np.testing.assert_allclose(tt.sorted_by(order).to_dense(),
+                               tt.to_dense(), atol=0)
+
+
+@given(sparse_tensors(), st.integers(0, 3), st.integers(1, 5))
+@settings(max_examples=15, deadline=None)
+def test_blocked_mttkrp_matches_bruteforce(tt, mode, rank):
+    mode = mode % tt.nmodes
+    tt = tt.deduplicate()
+    bs = BlockedSparse.from_coo(
+        tt, Options(block_alloc=BlockAlloc.ALLMODE, nnz_block=128,
+                    val_dtype=np.float64))
+    rng = np.random.default_rng(0)
+    factors = [jnp.asarray(rng.random((d, rank))) for d in tt.dims]
+    got = np.asarray(mttkrp(bs, factors, mode))
+    np.testing.assert_allclose(got, np_mttkrp(tt, factors, mode),
+                               atol=1e-9)
+
+
+@given(sparse_tensors())
+@settings(max_examples=20, deadline=None)
+def test_remove_empty_then_dense_consistent(tt):
+    out = tt.remove_empty_slices()
+    dense = tt.to_dense()
+    # collapse the dense tensor along each mode's empty slices
+    for m in range(tt.nmodes):
+        keep = (out.indmaps[m] if out.indmaps and out.indmaps[m] is not None
+                else np.arange(tt.dims[m]))
+        dense = np.take(dense, keep, axis=m)
+    np.testing.assert_allclose(out.to_dense(), dense, atol=0)
+
+
+@given(sparse_tensors(), st.integers(0, 2**16))
+@settings(max_examples=20, deadline=None)
+def test_permute_roundtrip_property(tt, seed):
+    from splatt_tpu.reorder import Permutation
+
+    rng = np.random.default_rng(seed)
+    perm = Permutation.from_perms([rng.permutation(d) for d in tt.dims])
+    back = perm.undo(perm.apply(tt))
+    np.testing.assert_array_equal(back.inds, tt.inds)
