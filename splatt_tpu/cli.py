@@ -229,12 +229,15 @@ def cmd_reorder(args) -> int:
 
 
 def cmd_stats(args) -> int:
-    """≙ splatt_stats_cmd (src/cmds/cmd_stats.c)."""
-    from splatt_tpu.io import load
-    from splatt_tpu.stats import tensor_stats
+    """≙ splatt_stats_cmd (src/cmds/cmd_stats.c; -p gives the hypergraph
+    partition-quality stats, src/stats.c:53-170)."""
+    from splatt_tpu.io import load, read_permutation
+    from splatt_tpu.stats import partition_quality_text, tensor_stats
 
     tt = load(args.tensor)
     print(tensor_stats(tt, args.tensor))
+    if args.partition:
+        print(partition_quality_text(tt, read_permutation(args.partition)))
     for m in range(tt.nmodes):
         hist = tt.mode_histogram(m)
         nz = hist[hist > 0]
@@ -316,6 +319,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("stats", help="print tensor statistics")
     _common_opts(p)
+    p.add_argument("-p", "--partition", metavar="FILE",
+                   help="also report quality of this nonzero partition")
     p.set_defaults(fn=cmd_stats)
 
     return ap

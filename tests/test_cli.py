@@ -143,3 +143,15 @@ def test_check_out_of_range(tmp_path, capsys):
     assert rc == 1
     out = capsys.readouterr().out
     assert "out-of-range" in out
+
+
+def test_stats_partition_flag(tns, tmp_path, capsys):
+    tt = gen.fixture_tensor("med")
+    part = tmp_path / "p.part"
+    rng = np.random.default_rng(1)
+    part.write_text("\n".join(str(int(x))
+                              for x in rng.integers(0, 4, size=tt.nnz)))
+    rc = main(["stats", tns, "-p", str(part)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Partition quality" in out and "TOTAL-CUT=" in out
