@@ -109,14 +109,27 @@ def main() -> None:
     # blocked/one-hot layout (Pallas on TPU, XLA engine elsewhere) and
     # the stream formulation. Degrade gracefully if one fails to
     # compile (e.g. a Mosaic lowering issue on new hardware).
+    def release():
+        # free the previous path's device buffers and jit executables so
+        # measurements don't pollute each other (the sweeps close over
+        # multi-GB layout arrays)
+        import gc
+
+        gc.collect()
+        jax.clear_caches()
+
     results = {}
     opts = Options(random_seed=7, verbosity=Verbosity.NONE,
                    val_dtype=np.float32)
+    blocked_failed = False
     try:
         results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
     except Exception as e:
         print(f"bench: blocked path failed ({type(e).__name__}: {e})",
               file=sys.stderr, flush=True)
+        blocked_failed = True
+    release()  # outside any handler: no live traceback pinning buffers
+    if blocked_failed:
         try:
             opts_x = Options(random_seed=7, verbosity=Verbosity.NONE,
                              val_dtype=np.float32, use_pallas=False)
@@ -124,6 +137,7 @@ def main() -> None:
         except Exception as e2:
             print(f"bench: blocked XLA engine failed too "
                   f"({type(e2).__name__})", file=sys.stderr, flush=True)
+        release()
     try:
         results["stream"] = run(tt)
     except Exception as e:

@@ -28,7 +28,7 @@ mirroring CSF's root vs. internal/leaf mode traversals.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -54,7 +54,6 @@ def cmd_cpd(args) -> int:
     """≙ splatt_cpd_cmd (src/cmds/cmd_cpd.c:159-243; distributed flags ≙
     the mpirun variant's -d, src/cmds/mpi_cmd_cpd.c:175-338)."""
     import jax
-    import jax.numpy as jnp
 
     from splatt_tpu.blocked import BlockedSparse
     from splatt_tpu.config import CommPattern, Decomposition, Verbosity

@@ -7,8 +7,6 @@ nnz hypergraph, binary coordinate, text coordinate.
 
 from __future__ import annotations
 
-import numpy as np
-
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.graph import (hypergraph_fibers, hypergraph_nnz,
                               tensor_to_graph, write_graph, write_hypergraph)

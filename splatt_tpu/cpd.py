@@ -30,10 +30,10 @@ import numpy as np
 from splatt_tpu.blocked import BlockedSparse
 from splatt_tpu.config import Options, Verbosity, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
-from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.kruskal import KruskalTensor, post_process
 from splatt_tpu.ops.linalg import (form_normal_lhs, gram, normalize_columns,
                                    solve_normals)
-from splatt_tpu.ops.mttkrp import mttkrp, mttkrp_blocked, mttkrp_stream
+from splatt_tpu.ops.mttkrp import mttkrp, mttkrp_stream
 from splatt_tpu.utils.timers import timers
 
 
@@ -191,11 +191,4 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         fit_prev = fitval
     timers.stop("cpd")
 
-    # post-process: fold remaining column norms into λ (cpd_post_process)
-    out_factors = []
-    for U in factors:
-        U, norms = normalize_columns(U, "2")
-        lam = lam * norms
-        out_factors.append(U)
-    return KruskalTensor(factors=out_factors, lam=lam,
-                         fit=jnp.asarray(fit_prev, dtype=dtype))
+    return post_process(factors, lam, jnp.asarray(fit_prev, dtype=dtype))

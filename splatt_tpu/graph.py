@@ -20,7 +20,7 @@ supplied to the reorderer instead (≙ the FINE decomposition's partfile).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

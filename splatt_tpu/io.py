@@ -19,9 +19,8 @@ The text parser uses a vectorized numpy parse; a C++ fast path
 
 from __future__ import annotations
 
-import os
 import struct
-from typing import List, Optional, Tuple, Union
+from typing import Optional, Tuple
 
 import numpy as np
 

@@ -89,3 +89,20 @@ class KruskalTensor:
         for f in self.factors:
             had = had * (f.T @ f)
         return jnp.sum(had)
+
+
+def post_process(factors, lam, fit, dims=None) -> "KruskalTensor":
+    """Fold remaining column norms into λ (≙ cpd_post_process,
+    src/cpd.c:391-411), optionally cropping padded rows first.  The
+    shared finalization of every CPD driver."""
+    from splatt_tpu.ops.linalg import normalize_columns  # noqa: deferred — linalg is heavier than this module needs at import
+
+    out = []
+    for m, U in enumerate(factors):
+        U = jnp.asarray(U)
+        if dims is not None:
+            U = U[:dims[m]]
+        U, norms = normalize_columns(U, "2")
+        lam = lam * norms
+        out.append(U)
+    return KruskalTensor(factors=out, lam=lam, fit=fit)

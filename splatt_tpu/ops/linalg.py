@@ -16,7 +16,7 @@ is hostile to XLA; two rank³ solves at rank ≤ a few hundred are noise.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

@@ -31,10 +31,9 @@ from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from splatt_tpu.blocked import BlockedSparse, ModeLayout
-from splatt_tpu.config import Options, default_opts
+from splatt_tpu.config import Options
 from splatt_tpu.coo import SparseTensor
 
 PATHS = ("stream", "sorted_onehot", "privatized", "scatter", "sorted_scatter")

@@ -25,12 +25,10 @@ Both take `interpret=` so the differential tests run on CPU
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from splatt_tpu.utils.env import ceil_to
 

@@ -20,16 +20,14 @@ TPU mapping over a 1-D mesh axis ``d``:
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from splatt_tpu.config import (Options, Verbosity, default_opts,
-                               resolve_dtype)
+from splatt_tpu.config import Options, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor

@@ -20,8 +20,7 @@ import numpy as np
 
 from splatt_tpu.config import Options, Verbosity
 from splatt_tpu.cpd import _fit
-from splatt_tpu.kruskal import KruskalTensor
-from splatt_tpu.ops.linalg import normalize_columns
+from splatt_tpu.kruskal import KruskalTensor, post_process
 
 
 def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
@@ -115,11 +114,5 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             break
         fit_prev = fitval
 
-    out_factors = []
-    for U, d in zip(factors, dims):
-        U_full = jnp.asarray(jax.device_get(U))[:d]
-        U_full, norms = normalize_columns(U_full, "2")
-        lam = lam * norms
-        out_factors.append(U_full)
-    return KruskalTensor(factors=out_factors, lam=lam,
-                         fit=jnp.asarray(fit_prev, dtype=dtype))
+    return post_process([jax.device_get(U) for U in factors], lam,
+                        jnp.asarray(fit_prev, dtype=dtype), dims=dims)

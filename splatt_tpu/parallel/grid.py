@@ -44,8 +44,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from splatt_tpu.config import (Options, Verbosity, default_opts,
-                               resolve_dtype)
+from splatt_tpu.config import Options, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor

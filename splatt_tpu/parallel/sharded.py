@@ -31,7 +31,7 @@ ppermute ring sweep in :mod:`splatt_tpu.parallel.ring`, selected via
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Tuple, Union
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,12 +39,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from splatt_tpu.config import (CommPattern, Options, Verbosity,
-                               default_opts, resolve_dtype)
+from splatt_tpu.config import (CommPattern, Options, default_opts,
+                               resolve_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
-from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
 from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
                                         mode_update_tail,
                                         run_distributed_als)

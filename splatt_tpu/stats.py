@@ -8,8 +8,6 @@
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from splatt_tpu.blocked import BlockedSparse

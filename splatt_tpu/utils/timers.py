@@ -14,7 +14,7 @@ profiler, not wall clocks.
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 # Report levels (≙ timer_lvl in src/timer.h): 0 none, 1 summary, 2 detail.
 _DEFAULT_LEVELS = {
