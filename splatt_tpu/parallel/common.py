@@ -114,5 +114,17 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             break
         fit_prev = fitval
 
-    return post_process([jax.device_get(U) for U in factors], lam,
+    return post_process([_gather_global(U) for U in factors], lam,
                         jnp.asarray(fit_prev, dtype=dtype), dims=dims)
+
+
+def _gather_global(U):
+    """Bring a (possibly cross-host) sharded factor to this host.
+
+    device_get cannot fetch shards on non-addressable devices; in a
+    multi-controller program every process allgathers instead."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(U)
+    return jax.device_get(U)

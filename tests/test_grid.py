@@ -62,27 +62,26 @@ def test_grid_cell_assignment_exact():
     assert found == tt.nnz
 
 
-@pytest.mark.parametrize("grid", [(2, 2, 2), (4, 2, 1), (8, 1, 1), (1, 1, 1)])
-def test_grid_cpd_matches_single_device(grid):
-    """Every grid shape gives the single-device fit (same seed/init) —
-    the TPU analog of 'same answer at any rank count'."""
-    tt = gen.fixture_tensor("med")
-    opts = _opts(max_iterations=6)
-    init = init_factors(tt.dims, 5, opts.seed(), dtype=jnp.float64)
-    single = cpd_als(tt, rank=5, opts=opts, init=init)
-    multi = grid_cpd_als(tt, rank=5, grid=grid, opts=opts, init=init)
+def _assert_grid_matches_single(tt, rank, grid, its):
+    """Shared single-vs-grid comparison: same seed/init must give the
+    single-device fit and factors at any grid shape."""
+    opts = _opts(max_iterations=its)
+    init = init_factors(tt.dims, rank, opts.seed(), dtype=jnp.float64)
+    single = cpd_als(tt, rank=rank, opts=opts, init=init)
+    multi = grid_cpd_als(tt, rank=rank, grid=grid, opts=opts, init=init)
     assert float(multi.fit) == pytest.approx(float(single.fit), abs=1e-8)
     for a, b in zip(single.factors, multi.factors):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.parametrize("grid", [(2, 2, 2), (4, 2, 1), (8, 1, 1), (1, 1, 1)])
+def test_grid_cpd_matches_single_device(grid):
+    """The TPU analog of 'same answer at any rank count'."""
+    _assert_grid_matches_single(gen.fixture_tensor("med"), 5, grid, 6)
+
+
 def test_grid_cpd_4mode():
-    tt = gen.fixture_tensor("med4")
-    opts = _opts(max_iterations=4)
-    init = init_factors(tt.dims, 3, opts.seed(), dtype=jnp.float64)
-    single = cpd_als(tt, rank=3, opts=opts, init=init)
-    multi = grid_cpd_als(tt, rank=3, grid=(2, 2, 2, 1), opts=opts, init=init)
-    assert float(multi.fit) == pytest.approx(float(single.fit), abs=1e-8)
+    _assert_grid_matches_single(gen.fixture_tensor("med4"), 3, (2, 2, 2, 1), 4)
 
 
 def test_grid_awkward_dims():
@@ -124,3 +123,15 @@ def test_grid_relabel_improves_balance():
                                  val_dtype=np.float64)
     # deterministic fixture: 0.24 -> 0.54 observed; assert strict gain
     assert relabeled.fill > base.fill
+
+
+def test_grid_midscale_exactness():
+    """100k-nnz grid CPD matches single-device bit-for-bit-ish — guards
+    the host bucketing arithmetic at sizes the tiny fixtures never hit."""
+    rng = np.random.default_rng(77)
+    dims = (1201, 907, 1511)
+    nnz = 100_000
+    tt = SparseTensor(
+        np.stack([rng.integers(0, d, size=nnz) for d in dims]),
+        rng.random(nnz), dims).deduplicate()
+    _assert_grid_matches_single(tt, 6, (2, 2, 2), 3)
