@@ -27,6 +27,23 @@ from splatt_tpu.ops.mttkrp import (choose_impl, mttkrp_blocked,
 ALGS = ("stream", "blocked", "blocked_pallas", "scatter", "ttbox")
 
 
+def _alg_plan(alg: str, layout, mode: int, dim: int, opts: Options):
+    """Map a bench algorithm name to (path, impl) for mttkrp_blocked,
+    or None when the config cannot run (privatized width over cap).
+    Raises on unknown names — shared by timing and cross-checking."""
+    if alg == "scatter":
+        return (("sorted_scatter" if layout.mode == mode else "scatter"),
+                "xla")
+    if alg in ("blocked", "blocked_pallas"):
+        path = "sorted_onehot" if layout.mode == mode else "privatized"
+        if path == "privatized" and dim + 16 > opts.priv_cap:
+            return None
+        impl = ("xla" if alg == "blocked" else choose_impl(
+            Options(use_pallas=True, val_dtype=opts.val_dtype)))
+        return path, impl
+    raise ValueError(f"unknown algorithm {alg!r}")
+
+
 def _time_call(fn, warmup: int = 1, reps: int = 3) -> float:
     for _ in range(warmup):
         jax.block_until_ready(fn())
@@ -66,31 +83,61 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
                                           tt.dims[mode])
             else:
                 layout = bs.layout_for(mode)
-                if alg == "scatter":
-                    path = ("sorted_scatter" if layout.mode == mode
-                            else "scatter")
-                    impl = "xla"
-                elif alg == "blocked":
-                    path = ("sorted_onehot" if layout.mode == mode
-                            else "privatized")
-                    impl = "xla"
-                elif alg == "blocked_pallas":
-                    path = ("sorted_onehot" if layout.mode == mode
-                            else "privatized")
-                    impl = choose_impl(
-                        Options(use_pallas=True, val_dtype=opts.val_dtype))
-                else:
-                    raise ValueError(f"unknown algorithm {alg!r}")
-                if path == "privatized":
-                    width = tt.dims[mode] + 16
-                    if width > opts.priv_cap:
-                        times.append(float("nan"))
-                        continue
+                plan = _alg_plan(alg, layout, mode, tt.dims[mode], opts)
+                if plan is None:
+                    times.append(float("nan"))
+                    continue
+                path, impl = plan
                 fn = lambda: mttkrp_blocked(layout, factors, mode,
                                             path=path, impl=impl)
             times.append(_time_call(fn, reps=reps))
         results[alg] = times
     return results
+
+
+def crosscheck_mttkrp(tt: SparseTensor, rank: int = 16,
+                      algs: Sequence[str] = ALGS,
+                      opts: Optional[Options] = None) -> float:
+    """Verify every algorithm computes the same MTTKRP (max abs
+    deviation from the stream result over all modes).  ≙ the role of
+    the reference's `bench --write` dumps: cross-validating algorithm
+    outputs rather than timing them."""
+    import sys
+
+    from splatt_tpu.config import resolve_dtype
+
+    opts = opts or Options(block_alloc=BlockAlloc.ALLMODE)
+    dtype = resolve_dtype(opts)
+    factors = init_factors(tt.dims, rank, opts.seed() or 1, dtype=dtype)
+    inds = jnp.asarray(tt.inds)
+    vals = jnp.asarray(tt.vals, dtype=dtype)
+    bs = BlockedSparse.from_coo(tt, opts)
+    worst = 0.0
+    skipped = 0
+    for mode in range(tt.nmodes):
+        ref = np.asarray(mttkrp_stream(inds, vals, factors, mode,
+                                       tt.dims[mode]))
+        for alg in algs:
+            if alg == "stream":
+                continue
+            if alg == "ttbox":
+                out = mttkrp_ttbox(inds, vals, factors, mode,
+                                   tt.dims[mode])
+            else:
+                layout = bs.layout_for(mode)
+                plan = _alg_plan(alg, layout, mode, tt.dims[mode], opts)
+                if plan is None:
+                    skipped += 1
+                    continue
+                path, impl = plan
+                out = mttkrp_blocked(layout, factors, mode, path=path,
+                                     impl=impl)
+            dev = float(np.max(np.abs(np.asarray(out) - ref)))
+            worst = max(worst, dev)
+    if skipped:
+        print(f"crosscheck: {skipped} (alg, mode) configs skipped "
+              f"(privatized width over priv_cap)", file=sys.stderr)
+    return worst
 
 
 def format_bench(results: Dict[str, List[float]]) -> str:

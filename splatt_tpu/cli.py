@@ -157,6 +157,18 @@ def cmd_bench(args) -> int:
                            reps=args.reps)
     print(f"Benchmarking MTTKRP, rank {args.rank}, {args.reps} reps")
     print(format_bench(results))
+    if args.check:
+        from splatt_tpu.bench_algs import crosscheck_mttkrp
+        from splatt_tpu.config import resolve_dtype
+
+        dev = crosscheck_mttkrp(tt, rank=args.rank, algs=algs, opts=opts)
+        print(f"cross-check max |alg - stream| = {dev:.3e}")
+        # tolerance follows the dtype actually computed in (a float64
+        # request degrades to float32 when x64 is off)
+        tol = 1e-10 if resolve_dtype(opts) == np.float64 else 9e-3
+        if dev > tol:
+            print(f"error: algorithms disagree beyond tolerance {tol}")
+            return 1
     return 0
 
 
@@ -292,6 +304,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--f64", action="store_true")
     p.add_argument("--permute", choices=["random", "graph", "fibsched"],
                    help="reorder the tensor first")
+    p.add_argument("--check", action="store_true",
+                   help="cross-validate algorithm outputs against stream "
+                        "(≙ the reference's --write dumps)")
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser("check", help="check for duplicates/empty slices")

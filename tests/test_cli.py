@@ -155,3 +155,11 @@ def test_stats_partition_flag(tns, tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "Partition quality" in out and "TOTAL-CUT=" in out
+
+
+def test_bench_check(tns, capsys):
+    rc = main(["bench", tns, "-r", "4", "--reps", "1", "--block", "128",
+               "--check", "--f64"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cross-check max" in out
