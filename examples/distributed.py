@@ -7,6 +7,11 @@ Run on any device count (simulate a mesh on CPU with:
 single-device factors for the same seed.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 from splatt_tpu.utils.env import apply_env_platform
 
 apply_env_platform()

@@ -4,7 +4,10 @@ Run:  python examples/quickstart.py [tensor.tns]
 (with no argument, a small synthetic tensor is generated)
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from splatt_tpu.utils.env import apply_env_platform
 

@@ -98,10 +98,11 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
 def crosscheck_mttkrp(tt: SparseTensor, rank: int = 16,
                       algs: Sequence[str] = ALGS,
                       opts: Optional[Options] = None) -> float:
-    """Verify every algorithm computes the same MTTKRP (max abs
-    deviation from the stream result over all modes).  ≙ the role of
-    the reference's `bench --write` dumps: cross-validating algorithm
-    outputs rather than timing them."""
+    """Verify every algorithm computes the same MTTKRP: max deviation
+    from the stream result over all modes, *relative* to the result's
+    magnitude (summation-order noise scales with value magnitudes and
+    nnz).  ≙ the role of the reference's `bench --write` dumps:
+    cross-validating algorithm outputs rather than timing them."""
     import sys
 
     from splatt_tpu.config import resolve_dtype
@@ -132,7 +133,8 @@ def crosscheck_mttkrp(tt: SparseTensor, rank: int = 16,
                 path, impl = plan
                 out = mttkrp_blocked(layout, factors, mode, path=path,
                                      impl=impl)
-            dev = float(np.max(np.abs(np.asarray(out) - ref)))
+            scale = max(float(np.max(np.abs(ref))), 1.0)
+            dev = float(np.max(np.abs(np.asarray(out) - ref))) / scale
             worst = max(worst, dev)
     if skipped:
         print(f"crosscheck: {skipped} (alg, mode) configs skipped "

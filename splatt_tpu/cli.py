@@ -162,7 +162,7 @@ def cmd_bench(args) -> int:
         from splatt_tpu.config import resolve_dtype
 
         dev = crosscheck_mttkrp(tt, rank=args.rank, algs=algs, opts=opts)
-        print(f"cross-check max |alg - stream| = {dev:.3e}")
+        print(f"cross-check max relative |alg - stream| = {dev:.3e}")
         # tolerance follows the dtype actually computed in (a float64
         # request degrades to float32 when x64 is off)
         tol = 1e-10 if resolve_dtype(opts) == np.float64 else 9e-3

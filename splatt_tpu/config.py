@@ -158,7 +158,11 @@ def resolve_dtype(opts: Options, data_dtype=None):
     import jax
 
     d = np.dtype(opts.val_dtype)
-    if (data_dtype is not None and np.dtype(data_dtype) == np.float64
+    # float64 host data upgrades the *default* float32 request when x64
+    # is live; explicit low-precision requests (bf16/f16/f32-by-choice
+    # carry the same dtype object, so only f32 upgrades) are respected
+    if (d == np.float32 and data_dtype is not None
+            and np.dtype(data_dtype) == np.float64
             and jax.config.jax_enable_x64):
         d = np.dtype(np.float64)
     if d == np.float64 and not jax.config.jax_enable_x64:

@@ -69,12 +69,15 @@ def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
         lam = None
         M = None
         for m in range(nmodes):
+            factor_dtype = factors[m].dtype
             M = do_mttkrp(factors, m)
             lhs = form_normal_lhs(grams, m, reg)
             U = solve_normals(lhs, M)
             U, lam = normalize_columns(U, "2" if first else "max")
-            factors[m] = U
-            grams[m] = gram(U)
+            # mixed precision: factors stay in their (possibly bf16)
+            # storage dtype; MTTKRP/Gram/solve accumulated in f32 above
+            factors[m] = U.astype(factor_dtype)
+            grams[m] = gram(factors[m])
         # ⟨Z,Z⟩ = λᵀ(⊛ Grams)λ
         had = jnp.outer(lam, lam)
         for g in grams:

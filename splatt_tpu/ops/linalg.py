@@ -26,9 +26,13 @@ def gram(U: jax.Array) -> jax.Array:
     """UᵀU (rank×rank Gram matrix; ≙ mat_aTa).
 
     The reference only fills the upper triangle then mirrors; XLA emits a
-    full syrk-like matmul on the MXU either way.
+    full syrk-like matmul on the MXU either way.  Low-precision factors
+    (bf16/f16) accumulate in f32 — Gram matrices feed the normal
+    equations and cannot afford bf16 accumulation error.
     """
-    return U.T @ U
+    acc = (jnp.float32 if U.dtype in (jnp.bfloat16, jnp.float16)
+           else U.dtype)
+    return jnp.matmul(U.T, U, preferred_element_type=acc)
 
 
 def form_normal_lhs(grams: Sequence[jax.Array], mode: int,

@@ -55,6 +55,17 @@ def _gather_prod(inds: jax.Array, vals: jax.Array,
     return prod
 
 
+def _acc_dtype(dtype):
+    """Accumulate bf16/f16 operands in f32 (the MXU-native mixed
+    pattern: low-precision reads, full-precision accumulation)."""
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return dtype
+
+
+acc_dtype = _acc_dtype  # public name for the sharded sweeps
+
+
 # -- stream (oracle) -------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("mode", "dim"))
@@ -62,7 +73,9 @@ def mttkrp_stream(inds: jax.Array, vals: jax.Array,
                   factors: List[jax.Array], mode: int, dim: int) -> jax.Array:
     """COO streaming MTTKRP — the gold oracle (≙ src/mttkrp.c:1697-1757)."""
     prod = _gather_prod(inds, vals, factors, mode)
-    return jax.ops.segment_sum(prod, inds[mode], num_segments=dim)
+    acc = _acc_dtype(prod.dtype)
+    return jax.ops.segment_sum(prod.astype(acc), inds[mode],
+                               num_segments=dim)
 
 
 @partial(jax.jit, static_argnames=("mode", "dim"))
@@ -123,18 +136,19 @@ def _scan_onehot(local: jax.Array, prod: jax.Array, width: int,
     prod = prod.reshape(nsteps, C, B, R)
 
     iota = jnp.arange(width, dtype=jnp.int32)
+    acc_dtype = _acc_dtype(dtype)
 
     def step(carry, xs):
         loc, prd = xs
         onehot = (loc[:, None, :] == iota[None, :, None]).astype(dtype)
         part = jnp.einsum("cwb,cbr->cwr", onehot, prd,
-                          preferred_element_type=dtype)
+                          preferred_element_type=acc_dtype)
         if accumulate:
             return carry + jnp.sum(part, axis=0), None
         return carry, part
 
     if accumulate:
-        init = jnp.zeros((width, R), dtype=dtype)
+        init = jnp.zeros((width, R), dtype=acc_dtype)
         acc, _ = jax.lax.scan(step, init, (local, prod))
         return acc
     _, parts = jax.lax.scan(step, None, (local, prod))
@@ -165,7 +179,8 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
 
     if path in ("scatter", "sorted_scatter"):
         nseg = dim + 1 if mode == layout.mode else dim
-        out = jax.ops.segment_sum(prod, seg, num_segments=nseg,
+        out = jax.ops.segment_sum(prod.astype(_acc_dtype(prod.dtype)), seg,
+                                  num_segments=nseg,
                                   indices_are_sorted=(path == "sorted_scatter"))
         return out[:dim]
 

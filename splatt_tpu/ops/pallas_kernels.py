@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from splatt_tpu.ops.mttkrp import _acc_dtype
 from splatt_tpu.utils.env import ceil_to
 
 # Max blocks per grid step; the actual chunk is sized against VMEM by
@@ -38,15 +39,20 @@ _CHUNK = 8
 
 
 def vmem_chunk(width: int, block: int, rank: int,
-               itemsize: int = 4, budget_bytes: int = 8 << 20) -> int:
+               itemsize: int = 4, budget_bytes: int = 8 << 20,
+               out_itemsize: int = None) -> int:
     """Blocks per grid step such that the kernel's working set —
     one-hot (C,width,block) + prod (C,block,rank) + out (C,width,rank) —
     fits the VMEM budget (half of the ~16MB scratchpad, leaving room
-    for double buffering).  Returns 0 when even one block does not fit:
-    callers must fall back to the XLA engine, which streams the one-hot
-    through HBM instead.
+    for double buffering).  The out term is costed at the accumulator
+    width (f32 even for bf16 inputs).  Returns 0 when even one block
+    does not fit: callers must fall back to the XLA engine, which
+    streams the one-hot through HBM instead.
     """
-    per_block = (width * block + block * rank + width * rank) * itemsize
+    if out_itemsize is None:
+        out_itemsize = max(itemsize, 4)
+    per_block = ((width * block + block * rank) * itemsize
+                 + width * rank * out_itemsize)
     if per_block <= 0:
         return _CHUNK
     return min(_CHUNK, budget_bytes // per_block)
@@ -61,7 +67,7 @@ def _sorted_kernel(local_ref, prod_ref, out_ref, *, seg_width: int):
     out_ref[...] = jax.lax.dot_general(
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=prod.dtype)
+        preferred_element_type=out_ref.dtype)
 
 
 def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
@@ -73,7 +79,7 @@ def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
     part = jax.lax.dot_general(
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=prod.dtype)      # (C, width, R)
+        preferred_element_type=out_ref.dtype)   # (C, width, R)
     acc = jnp.sum(part, axis=0)
 
     @pl.when(pl.program_id(0) == 0)
@@ -114,7 +120,8 @@ def onehot_reduce_sorted(local: jax.Array, prod: jax.Array, seg_width: int,
             pl.BlockSpec((chunk, B, R), lambda i: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((chunk, seg_width, R), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb_pad, seg_width, R), prod.dtype),
+        out_shape=jax.ShapeDtypeStruct((nb_pad, seg_width, R),
+                                       _acc_dtype(prod.dtype)),
         interpret=interpret,
     )(local, prod)
     return out[:nb]
@@ -138,7 +145,7 @@ def onehot_reduce_full(local: jax.Array, prod: jax.Array, width: int,
             pl.BlockSpec((chunk, B, R), lambda i: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((width, R), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((width, R), prod.dtype),
+        out_shape=jax.ShapeDtypeStruct((width, R), _acc_dtype(prod.dtype)),
         interpret=interpret,
     )(local, prod)
     return out

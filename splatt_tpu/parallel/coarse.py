@@ -31,6 +31,7 @@ from splatt_tpu.config import Options, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.mttkrp import acc_dtype
 from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
                                         mode_update_tail,
                                         run_distributed_als)
@@ -85,7 +86,9 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         factors.append(jax.device_put(
             U_pad, NamedSharding(mesh, P(axis, None))))
     factors = tuple(factors)
-    grams = tuple(jax.device_put(U.T @ U, NamedSharding(mesh, P()))
+    from splatt_tpu.ops.linalg import gram
+
+    grams = tuple(jax.device_put(gram(U), NamedSharding(mesh, P()))
                   for U in factors)
 
     factor_specs = tuple([P(axis, None)] * nmodes)
@@ -116,9 +119,12 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     prod = prod * jnp.take(U, ic[k], axis=0, mode="clip")
             # owner-computes: all nonzeros for my rows are local,
             # so the MTTKRP block needs NO reduction
-            M_l = jax.ops.segment_sum(prod, ic[m], num_segments=blocks[m])
+            M_l = jax.ops.segment_sum(
+                prod.astype(acc_dtype(prod.dtype)), ic[m],
+                num_segments=blocks[m])
             U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
-                                              first_flag, axis)
+                                              first_flag, axis,
+                                              store_dtype=dtype)
             factors_l[m] = U_l
             grams_l[m] = gram
         znormsq, inner = fit_tail(lam, grams_l, M_l, factors_l[nmodes - 1],

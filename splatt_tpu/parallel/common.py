@@ -60,13 +60,18 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
 
 
 def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,
-                     lam_axis):
+                     lam_axis, store_dtype=None):
     """Shared per-mode ALS tail: normal-equations solve on the local
     block, normalization with the λ allreduce over `lam_axis`
     (≙ mat_normalize src/matrix.c:117-187), and the Gram allreduce
     (≙ mat_aTa src/matrix.c:445-452).  Used by every distributed sweep.
+
+    `store_dtype` keeps mixed precision consistent with the
+    single-device driver: the factor is stored back in its (possibly
+    bf16) dtype while solve/normalize/Gram run at accumulator width.
     """
-    from splatt_tpu.ops.linalg import form_normal_lhs, solve_normals
+    from splatt_tpu.ops.linalg import form_normal_lhs, gram as gram_fn, \
+        solve_normals
 
     lhs = form_normal_lhs(grams_l, m, reg)
     U_l = solve_normals(lhs, M_l)
@@ -75,7 +80,9 @@ def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,
         jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), lam_axis), 1.0)
     lam = jnp.where(first_flag > 0, lam_2, lam_max)
     U_l = U_l / jnp.where(lam > 0, lam, 1.0)
-    gram = jax.lax.psum(U_l.T @ U_l, lam_axis)
+    if store_dtype is not None:
+        U_l = U_l.astype(store_dtype)
+    gram = jax.lax.psum(gram_fn(U_l), lam_axis)
     return U_l, gram, lam
 
 

@@ -48,6 +48,7 @@ from splatt_tpu.config import Options, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.mttkrp import acc_dtype
 from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
                                         mode_update_tail,
                                         run_distributed_als)
@@ -177,8 +178,9 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float):
                 if k != m:
                     prod = prod * jnp.take(factors_l[k], inds_c[k], axis=0,
                                            mode="clip")
-            partial_out = jax.ops.segment_sum(prod, inds_c[m],
-                                              num_segments=block_rows[m])
+            partial_out = jax.ops.segment_sum(
+                prod.astype(acc_dtype(prod.dtype)), inds_c[m],
+                num_segments=block_rows[m])
             # layer reduce (≙ mpi_reduce_rows + mpi_update_rows): after
             # this, every device in the mode-m layer holds the block
             other_axes = tuple(axes[k] for k in range(nmodes) if k != m)
@@ -187,7 +189,8 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float):
             # λ/Gram allreduce over the owning axis only (blocks on the
             # other axes are replicas)
             U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
-                                              first_flag, axes[m])
+                                              first_flag, axes[m],
+                                              store_dtype=dtype)
             factors_l[m] = U_l
             grams_l[m] = gram
         znormsq, inner = fit_tail(lam, grams_l, M_l, factors_l[nmodes - 1],
@@ -251,8 +254,10 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                                       dtype=dtype))
     factors = decomp.shard_factors(
         [jnp.asarray(f, dtype=dtype) for f in factors_host], mesh)
+    from splatt_tpu.ops.linalg import gram
+
     gram_sharding = NamedSharding(mesh, P())
-    grams = tuple(jax.device_put(U.T @ U, gram_sharding) for U in factors)
+    grams = tuple(jax.device_put(gram(U), gram_sharding) for U in factors)
 
     sweep = make_grid_sweep(mesh, decomp, opts.regularization)
 

@@ -62,17 +62,21 @@ def blockwise_reduce_rows(prod: jax.Array, idx: jax.Array, axis: str,
     """Row-sharded MTTKRP output without the full (dim_pad, R) partial:
     for each row block j, every device reduces its local contribution
     and the block-psum is kept only by the owner."""
+    from splatt_tpu.ops.mttkrp import acc_dtype
+
     my_id = jax.lax.axis_index(axis)
+    out_dtype = acc_dtype(prod.dtype)
 
     def body(j, acc):
         mask = (idx // block) == j
-        p = jax.ops.segment_sum(prod * mask[:, None],
-                                jnp.where(mask, jnp.mod(idx, block), 0),
-                                num_segments=block)
+        p = jax.ops.segment_sum(
+            (prod * mask[:, None]).astype(out_dtype),
+            jnp.where(mask, jnp.mod(idx, block), 0),
+            num_segments=block)
         tot = jax.lax.psum(p, axis)
         return jnp.where(j == my_id, tot, acc)
 
-    acc0 = jnp.zeros((block, prod.shape[1]), dtype=prod.dtype)
+    acc0 = jnp.zeros((block, prod.shape[1]), dtype=out_dtype)
     return jax.lax.fori_loop(0, ndev, body, acc0)
 
 

@@ -44,6 +44,7 @@ from splatt_tpu.config import (CommPattern, Options, default_opts,
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.ops.mttkrp import acc_dtype
 from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
                                         mode_update_tail,
                                         run_distributed_als)
@@ -163,10 +164,12 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
             return jnp.take(U, idx, axis=0, mode="clip")
 
         def reduce_rows(prod, idx, m):
-            # local MTTKRP partials over the global row space, then
+            # local MTTKRP partials over the global row space (f32
+            # accumulation for low-precision operands), then
             # ≙ mpi_reduce_rows: I keep the summed rows I own
-            partial_out = jax.ops.segment_sum(prod, idx,
-                                              num_segments=dims_pad[m])
+            partial_out = jax.ops.segment_sum(
+                prod.astype(acc_dtype(prod.dtype)), idx,
+                num_segments=dims_pad[m])
             return jax.lax.psum_scatter(partial_out, axis,
                                         scatter_dimension=0, tiled=True)
     else:
@@ -190,7 +193,8 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
                     prod = prod * gather_rows(factors_l[k], inds_l[k])
             M_l = reduce_rows(prod, inds_l[m], m)
             U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
-                                              first_flag, axis)
+                                              first_flag, axis,
+                                              store_dtype=dtype)
             factors_l[m] = U_l
             grams_l[m] = gram
         znormsq, inner = fit_tail(lam, grams_l, M_l, factors_l[nmodes - 1],
@@ -230,9 +234,11 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     factors = tuple(shard_factors(
         [jnp.asarray(f, dtype=dtype) for f in factors_host],
         tt.dims, mesh, axis=axis))
+    from splatt_tpu.ops.linalg import gram
+
     gram_sharding = NamedSharding(mesh, P(None, None))
     grams = tuple(
-        jax.device_put(U.T @ U, gram_sharding) for U in factors
+        jax.device_put(gram(U), gram_sharding) for U in factors
     )
 
     variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT

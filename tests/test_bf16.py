@@ -1,0 +1,88 @@
+"""Mixed-precision (bfloat16 storage, float32 accumulation) tests.
+
+The MXU-native pattern: factors and partial products in bf16, every
+reduction (segment sums, one-hot contractions, Grams) accumulated in
+f32.  CPD quality must survive bf16 storage.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from splatt_tpu.blocked import BlockedSparse
+from splatt_tpu.config import BlockAlloc, Options, Verbosity
+from splatt_tpu.cpd import cpd_als
+from splatt_tpu.ops.linalg import gram
+from splatt_tpu.ops.mttkrp import mttkrp, mttkrp_stream
+from tests.test_cpd import lowrank_tensor
+from tests.test_mttkrp import np_mttkrp
+
+
+def test_gram_accumulates_f32():
+    rng = np.random.default_rng(0)
+    U = jnp.asarray(rng.random((300, 8)), dtype=jnp.bfloat16)
+    g = gram(U)
+    assert g.dtype == jnp.float32
+    want = np.asarray(U, dtype=np.float64).T @ np.asarray(U, dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(g), want, rtol=3e-2)
+
+
+def test_bf16_mttkrp_f32_output(any_tensor):
+    """bf16 operands → f32-accumulated output within bf16 tolerance of
+    the f64 oracle."""
+    tt = any_tensor
+    rng = np.random.default_rng(1)
+    factors64 = [rng.random((d, 8)) for d in tt.dims]
+    factors16 = [jnp.asarray(f, dtype=jnp.bfloat16) for f in factors64]
+    factors_ref = [np.asarray(f, dtype=np.float64) for f in factors16]
+    for mode in range(tt.nmodes):
+        got = mttkrp_stream(jnp.asarray(tt.inds), jnp.asarray(tt.vals),
+                            factors16, mode, tt.dims[mode])
+        assert got.dtype == jnp.float32
+        want = np_mttkrp(tt, factors_ref, mode)
+        scale = max(np.abs(want).max(), 1.0)
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float64), want,
+                                   atol=3e-2 * scale)
+
+
+def test_bf16_blocked_paths(any_tensor):
+    tt = any_tensor
+    opts = Options(block_alloc=BlockAlloc.ALLMODE, nnz_block=128,
+                   val_dtype=jnp.bfloat16)
+    bs = BlockedSparse.from_coo(tt, opts)
+    rng = np.random.default_rng(2)
+    factors16 = [jnp.asarray(rng.random((d, 8)), dtype=jnp.bfloat16)
+                 for d in tt.dims]
+    factors_ref = [np.asarray(f, dtype=np.float64) for f in factors16]
+    for mode in range(tt.nmodes):
+        got = mttkrp(bs, factors16, mode)
+        want = np_mttkrp(tt, factors_ref, mode)
+        scale = max(np.abs(want).max(), 1.0)
+        np.testing.assert_allclose(np.asarray(got, dtype=np.float64), want,
+                                   atol=3e-2 * scale)
+
+
+def test_bf16_cpd_quality():
+    """CPD with bf16 factor storage still recovers a low-rank tensor."""
+    tt = lowrank_tensor((15, 12, 10), rank=3)
+    opts = Options(random_seed=42, max_iterations=60, tolerance=1e-7,
+                   verbosity=Verbosity.NONE, val_dtype=jnp.bfloat16)
+    out = cpd_als(tt, rank=5, opts=opts)
+    assert out.factors[0].dtype == jnp.bfloat16
+    assert float(out.fit) > 0.98
+
+
+def test_bf16_distributed_matches_single():
+    """bf16 distributed CPD carries the same f32-accumulation contract
+    as the single-device driver."""
+    from splatt_tpu.cpd import init_factors
+    from splatt_tpu.parallel import distributed_cpd_als
+    from tests import gen
+
+    tt = gen.fixture_tensor("med")
+    opts = Options(random_seed=42, max_iterations=5,
+                   verbosity=Verbosity.NONE, val_dtype=jnp.bfloat16)
+    init = init_factors(tt.dims, 4, 42, dtype=jnp.bfloat16)
+    single = cpd_als(tt, rank=4, opts=opts, init=init)
+    multi = distributed_cpd_als(tt, rank=4, opts=opts, init=init)
+    assert abs(float(multi.fit) - float(single.fit)) < 5e-3

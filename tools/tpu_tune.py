@@ -1,0 +1,88 @@
+"""TPU tuning sweep: measure MTTKRP paths/engines/dtypes/block sizes on
+real hardware and record the results for path-selection heuristics.
+
+Run (on a machine with a TPU):  python tools/tpu_tune.py
+Writes tune_results.json: one record per (config, path, engine, dtype).
+
+This is the round-2 entry point for the perf work the blocked format
+was designed around — the one-hot/scatter/privatized trade-offs and
+the Pallas-vs-XLA engine choice are all heavily shape-dependent and
+must be measured, not guessed (the CPU measurements that shaped
+choose_path's off-TPU branch are in BASELINE_MEASURED.json).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from splatt_tpu.utils.env import apply_env_platform
+
+apply_env_platform()
+
+import jax
+import jax.numpy as jnp
+
+from bench import synthetic_nell2_like
+from splatt_tpu.bench_algs import _time_call as timeit
+from splatt_tpu.blocked import build_layout
+from splatt_tpu.ops.mttkrp import mttkrp_blocked, mttkrp_stream
+
+
+def main() -> None:
+    nnz = int(sys.argv[1]) if len(sys.argv) > 1 else 20_000_000
+    rank = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    tt = synthetic_nell2_like(nnz)
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    results = []
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        factors = [jnp.asarray(rng.random((d, rank)), dtype=dtype)
+                   for d in tt.dims]
+        inds = jnp.asarray(tt.inds)
+        vals = jnp.asarray(tt.vals, dtype=dtype)
+        t = timeit(lambda: mttkrp_stream(inds, vals, factors, 0,
+                                         tt.dims[0]))
+        results.append(dict(path="stream", engine="xla",
+                            dtype=str(np.dtype(dtype)), block=None,
+                            sec=round(t, 4)))
+        print(results[-1], flush=True)
+        for block in (1024, 4096, 16384):
+            lay = build_layout(tt, 0, block=block, val_dtype=dtype)
+            for path, engines in (("sorted_onehot", ("xla", "pallas")),
+                                  ("sorted_scatter", ("xla",))):
+                for engine in engines:
+                    if engine == "pallas" and platform != "tpu":
+                        continue
+                    try:
+                        t = timeit(lambda: mttkrp_blocked(
+                            lay, factors, 0, path=path, impl=engine))
+                        rec = dict(path=path, engine=engine,
+                                   dtype=str(np.dtype(dtype)), block=block,
+                                   seg_width=lay.seg_width,
+                                   sec=round(t, 4))
+                    except Exception as e:
+                        rec = dict(path=path, engine=engine,
+                                   dtype=str(np.dtype(dtype)), block=block,
+                                   error=f"{type(e).__name__}: {e}"[:120])
+                    results.append(rec)
+                    print(rec, flush=True)
+            del lay
+
+    out = dict(platform=platform, nnz=nnz, rank=rank, dims=tt.dims,
+               results=results)
+    with open("tune_results.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote tune_results.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()
