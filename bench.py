@@ -10,7 +10,8 @@ downloadable in this environment).  Prints ONE JSON line:
 a measured reference number exists in BASELINE_MEASURED.json; else 1.0.
 
 Env knobs: SPLATT_BENCH_NNZ (default 20_000_000), SPLATT_BENCH_RANK (50),
-SPLATT_BENCH_ITERS (3 timed iterations).
+SPLATT_BENCH_ITERS (3 timed iterations), SPLATT_BENCH_DTYPE
+(float32 default; bfloat16 stores factors in bf16 with f32 accumulation).
 """
 
 from __future__ import annotations
@@ -86,10 +87,19 @@ def main() -> None:
     nnz = int(os.environ.get("SPLATT_BENCH_NNZ", 20_000_000))
     rank = int(os.environ.get("SPLATT_BENCH_RANK", 50))
     iters = int(os.environ.get("SPLATT_BENCH_ITERS", 3))
+    try:
+        bench_dtype = jnp.dtype(os.environ.get("SPLATT_BENCH_DTYPE",
+                                               "float32"))
+        if not jnp.issubdtype(bench_dtype, jnp.floating):
+            raise TypeError(f"non-floating dtype {bench_dtype}")
+    except TypeError as e:
+        print(f"bench: bad SPLATT_BENCH_DTYPE ({e}); using float32",
+              file=sys.stderr, flush=True)
+        bench_dtype = jnp.dtype("float32")
 
     tt = synthetic_nell2_like(nnz)
 
-    factors = init_factors(tt.dims, rank, 7, dtype=jnp.float32)
+    factors = init_factors(tt.dims, rank, 7, dtype=bench_dtype)
     grams = [gram(U) for U in factors]
 
     def run(X):
@@ -120,7 +130,7 @@ def main() -> None:
 
     results = {}
     opts = Options(random_seed=7, verbosity=Verbosity.NONE,
-                   val_dtype=np.float32)
+                   val_dtype=bench_dtype)
     blocked_failed = False
     try:
         results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
@@ -132,7 +142,7 @@ def main() -> None:
     if blocked_failed:
         try:
             opts_x = Options(random_seed=7, verbosity=Verbosity.NONE,
-                             val_dtype=np.float32, use_pallas=False)
+                             val_dtype=bench_dtype, use_pallas=False)
             results["blocked_xla"] = run(BlockedSparse.from_coo(tt, opts_x))
         except Exception as e2:
             print(f"bench: blocked XLA engine failed too "
@@ -165,7 +175,8 @@ def main() -> None:
     platform = jax.devices()[0].platform
     print(json.dumps({
         "metric": f"CPD-ALS sec/iteration, synthetic NELL-2-shaped "
-                  f"(3-mode, {nnz} nnz, rank {rank}) on {platform}; "
+                  f"(3-mode, {nnz} nnz, rank {rank}, "
+                  f"{jnp.dtype(factors[0].dtype).name}) on {platform}; "
                   f"baseline: reference 1-thread CPU same tensor",
         "value": round(sec_per_iter, 4),
         "unit": "sec/iter",

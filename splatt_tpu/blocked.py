@@ -170,7 +170,7 @@ class BlockedSparse:
         Every mode maps to its own layout when one exists, else to the
         first layout (generic path).
         """
-        opts = opts or default_opts()
+        opts = (opts or default_opts()).validate()
         nmodes = tt.nmodes
         by_size = sorted(range(nmodes), key=lambda m: (tt.dims[m], m))
         if opts.block_alloc is BlockAlloc.ONEMODE:

@@ -122,6 +122,29 @@ class Options:
     # Numerics: device compute dtype. Host COO stays float64.
     val_dtype: np.dtype = dataclasses.field(default_factory=lambda: np.dtype(np.float32))
 
+    def validate(self) -> "Options":
+        """Sanity-check option values once, centrally (≙ the reference's
+        argp-level validation); returns self for chaining."""
+        if self.tolerance < 0:
+            raise ValueError(f"tolerance must be >= 0, got {self.tolerance}")
+        if self.max_iterations < 0:
+            raise ValueError(
+                f"max_iterations must be >= 0, got {self.max_iterations}")
+        if self.regularization < 0:
+            raise ValueError(
+                f"regularization must be >= 0, got {self.regularization}")
+        if self.nnz_block < 1:
+            raise ValueError(f"nnz_block must be >= 1, got {self.nnz_block}")
+        if not 0 <= self.priv_threshold:
+            raise ValueError(
+                f"priv_threshold must be >= 0, got {self.priv_threshold}")
+        import jax.numpy as jnp
+
+        if not jnp.issubdtype(jnp.dtype(self.val_dtype), jnp.floating):
+            raise ValueError(
+                f"val_dtype must be a floating dtype, got {self.val_dtype}")
+        return self
+
     def seed(self) -> int:
         """Resolve (and pin) the RNG seed.
 

@@ -131,7 +131,7 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     self-correcting, so restarting from checkpointed factors continues
     the same optimization.
     """
-    opts = opts or default_opts()
+    opts = (opts or default_opts()).validate()
     if isinstance(X, SparseTensor):
         dims, nmodes = X.dims, X.nmodes
         xnormsq = X.normsq()

@@ -28,7 +28,7 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
       user-supplied per-nonzero `partition`), all_gather inputs +
       psum_scatter outputs (:func:`sharded_cpd_als`)
     """
-    opts = opts or default_opts()
+    opts = (opts or default_opts()).validate()
     if opts.decomposition is Decomposition.MEDIUM and partition is None:
         return grid_cpd_als(tt, rank, grid=grid, mesh=mesh, opts=opts,
                             init=init)

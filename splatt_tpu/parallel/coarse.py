@@ -59,7 +59,7 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                    init: Optional[List[jax.Array]] = None,
                    axis: str = "d") -> KruskalTensor:
     """Distributed CPD-ALS, coarse-grained owner-computes."""
-    opts = opts or default_opts()
+    opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
     mesh = mesh or make_mesh(axis_names=(axis,))
     ndev = mesh.shape[axis]

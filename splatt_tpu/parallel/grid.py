@@ -214,7 +214,7 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     — and restores factor row order afterwards via the permutation
     bookkeeping.
     """
-    opts = opts or default_opts()
+    opts = (opts or default_opts()).validate()
     dtype = resolve_dtype(opts, tt.vals.dtype)
 
     perm = None

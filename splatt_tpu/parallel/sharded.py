@@ -217,7 +217,7 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     because initialization happens in the global row space before
     sharding, and all reductions are deterministic collectives.
     """
-    opts = opts or default_opts()
+    opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
     mesh = mesh or make_mesh(axis_names=(axis,))
     ndev = mesh.shape[axis]

@@ -58,3 +58,16 @@ def test_max_nmodes_guard():
     with pytest.raises(ValueError):
         splatt_tpu.SparseTensor(np.zeros((9, 1), dtype=np.int64),
                                 np.ones(1), tuple([2] * 9))
+
+def test_options_validate():
+    import pytest
+
+    from splatt_tpu.config import Options
+
+    Options().validate()
+    with pytest.raises(ValueError):
+        Options(tolerance=-1.0).validate()
+    with pytest.raises(ValueError):
+        Options(max_iterations=-1).validate()
+    with pytest.raises(ValueError):
+        Options(nnz_block=0).validate()

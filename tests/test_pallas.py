@@ -92,3 +92,24 @@ def test_vmem_chunk_bounds():
     assert vmem_chunk(64, 512, 128) >= 1          # typical config fits
     assert vmem_chunk(4096, 4096, 128) == 0       # pathological: fall back
     assert 1 <= vmem_chunk(8, 128, 8) <= 8
+
+
+def test_pallas_bf16_accumulates_f32():
+    """bf16 inputs through the Pallas kernels produce f32 outputs that
+    match the f64 brute force at bf16 tolerance."""
+    rng = np.random.default_rng(3)
+    nb, B, S, R = 4, 128, 16, 8
+    local = rng.integers(-1, S + 2, size=(nb, B)).astype(np.int32)
+    prod16 = jnp.asarray(rng.random((nb, B, R)), dtype=jnp.bfloat16)
+    got = onehot_reduce_sorted(jnp.asarray(local), prod16, S, interpret=True)
+    assert got.dtype == jnp.float32
+    want = _np_onehot_sorted(local, np.asarray(prod16, dtype=np.float64), S)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float64), want,
+                               atol=3e-2)
+    got2 = onehot_reduce_full(jnp.asarray(local), prod16, S + 8,
+                              interpret=True)
+    assert got2.dtype == jnp.float32
+    want2 = _np_onehot_sorted(local, np.asarray(prod16, dtype=np.float64),
+                              S + 8).sum(axis=0)
+    np.testing.assert_allclose(np.asarray(got2, dtype=np.float64), want2,
+                               atol=3e-2)
