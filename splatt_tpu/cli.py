@@ -17,6 +17,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from splatt_tpu.reorder import PERM_TYPES
 from splatt_tpu.utils.env import apply_env_platform
 
 apply_env_platform()
@@ -302,7 +303,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
     p.add_argument("--block", type=int)
     p.add_argument("--f64", action="store_true")
-    p.add_argument("--permute", choices=["random", "graph", "fibsched"],
+    p.add_argument("--permute", choices=list(PERM_TYPES),
                    help="reorder the tensor first")
     p.add_argument("--check", action="store_true",
                    help="cross-validate algorithm outputs against stream "
@@ -325,7 +326,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("reorder", help="relabel tensor indices")
     _common_opts(p)
-    p.add_argument("type", choices=["random", "graph", "fibsched"])
+    p.add_argument("type", choices=list(PERM_TYPES))
     p.add_argument("output")
     p.add_argument("--seed", type=int)
     p.add_argument("--write-perms", action="store_true")

@@ -208,7 +208,8 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                  relabel: Optional[str] = None) -> KruskalTensor:
     """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition).
 
-    `relabel` ("random"/"graph"/"fibsched") applies an index relabeling
+    `relabel` (any splatt_tpu.reorder PERM_TYPES entry, e.g.
+    "random"/"graph"/"hgraph"/"fibsched") applies an index relabeling
     before decomposing — equal fences over relabeled indices ≈ the
     reference's nnz-balanced layer boundaries (p_find_layer_boundaries)
     — and restores factor row order afterwards via the permutation

@@ -10,6 +10,10 @@ Strategies (≙ splatt_perm_type, src/reorder.h:15-22):
   external partitioner we use the locality-driven BFS ordering, and
   accept explicit partition files via :func:`partition_to_perm`
   (≙ the partition-driven relabeling path).
+- ``hgraph``: hypergraph-locality ordering — each mode's slices
+  labeled by the centroid of their nonzeros under a sort keyed by the
+  other modes (≙ the HGRAPH partition-driven relabeling, perm_hgraph
+  src/reorder.c:364, without an external partitioner).
 - ``fibsched``: fiber-locality ordering derived from the fiber
   hypergraph of the smallest mode.
 
@@ -27,7 +31,7 @@ import numpy as np
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.graph import tensor_to_graph, hypergraph_fibers, _mode_offsets
 
-PERM_TYPES = ("random", "graph", "fibsched")
+PERM_TYPES = ("random", "graph", "hgraph", "fibsched")
 
 
 @dataclasses.dataclass
@@ -73,6 +77,8 @@ def reorder(tt: SparseTensor, how: str = "graph",
             [rng.permutation(d) for d in tt.dims])
     if how == "graph":
         return _graph_bfs_perm(tt)
+    if how == "hgraph":
+        return _hgraph_perm(tt)
     if how == "fibsched":
         return _fiber_perm(tt)
     raise ValueError(f"unknown reorder type {how!r} (one of {PERM_TYPES})")
@@ -115,6 +121,36 @@ def _graph_bfs_perm(tt: SparseTensor) -> Permutation:
         idx = v - offs[m]
         perms[m][idx] = next_label[m]
         next_label[m] += 1
+    return Permutation.from_perms(perms)
+
+
+def _hgraph_perm(tt: SparseTensor) -> Permutation:
+    """Hypergraph-locality relabeling (≙ the HGRAPH reorder type,
+    src/reorder.h:15-22 / perm_hgraph src/reorder.c:364).
+
+    The reference relabels from an external hypergraph partitioning;
+    without a partitioner, the locality objective is served directly:
+    for each mode, sort the nonzeros by the *other* modes (the
+    hyperedges that mode's slices share) and label the slices by the
+    mean position of their nonzeros — slices co-occurring in the same
+    fibers receive nearby labels.  (Sorting must exclude the mode being
+    relabeled: a sort keyed by it would make every centroid increasing
+    in the original index and yield the identity.)
+    """
+    perms: List[np.ndarray] = []
+    for m in range(tt.nmodes):
+        others = [k for k in range(tt.nmodes) if k != m]
+        order = tt.sort_order(others)
+        pos = np.empty(tt.nnz, dtype=np.float64)
+        pos[order] = np.arange(tt.nnz)
+        sums = np.bincount(tt.inds[m], weights=pos, minlength=tt.dims[m])
+        counts = tt.mode_histogram(m)
+        centroid = np.where(counts > 0, sums / np.maximum(counts, 1),
+                            np.inf)  # empty slices sort last
+        by_centroid = np.argsort(centroid, kind="stable")
+        p = np.empty(tt.dims[m], dtype=np.int64)
+        p[by_centroid] = np.arange(tt.dims[m])
+        perms.append(p)
     return Permutation.from_perms(perms)
 
 

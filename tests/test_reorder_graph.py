@@ -111,3 +111,12 @@ def test_partition_to_perm():
     assert p[1] == 0 and p[3] == 1
     assert p[2] == 2 and p[5] == 3
     assert p[0] == 4 and p[4] == 5
+
+
+def test_hgraph_nontrivial_all_modes():
+    """hgraph must relabel every mode (a sort keyed by the mode itself
+    would degenerate to the identity for that mode)."""
+    tt = gen.fixture_tensor("med")
+    perm = reorder(tt, "hgraph")
+    for m, p in enumerate(perm.perms):
+        assert not np.array_equal(p, np.arange(tt.dims[m])), f"mode {m}"
