@@ -118,3 +118,35 @@ def test_zero_iterations_returns_init_shape():
     out = sharded_cpd_als(tt, rank=2, mesh=make_mesh(n_devices=4),
                           opts=_opts(max_iterations=0))
     assert out.lam.shape == (2,)
+
+
+def test_bucket_scatter_unit():
+    from splatt_tpu.parallel.common import bucket_scatter
+
+    inds = np.array([[0, 1, 2, 3, 4], [4, 3, 2, 1, 0]])
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    owner = np.array([2, 0, 2, 0, 1])
+    binds, bvals, C, counts = bucket_scatter(inds, vals, owner, 3,
+                                             np.float64)
+    assert C == 2
+    np.testing.assert_array_equal(counts, [2, 1, 2])
+    # bucket contents: owner order preserved (stable)
+    np.testing.assert_allclose(sorted(bvals[0][bvals[0] != 0]), [2.0, 4.0])
+    np.testing.assert_allclose(bvals[1][:1], [5.0])
+    np.testing.assert_allclose(sorted(bvals[2]), [1.0, 3.0])
+    # index columns travel with their values
+    flat_v = bvals.ravel()
+    flat_i0 = binds[0].ravel()
+    for v, i0 in [(1.0, 0), (2.0, 1), (3.0, 2), (4.0, 3), (5.0, 4)]:
+        slot = np.flatnonzero(np.isclose(flat_v, v))[0]
+        assert flat_i0[slot] == i0
+
+
+def test_bucket_scatter_empty_tensor():
+    from splatt_tpu.parallel.common import bucket_scatter
+
+    binds, bvals, C, counts = bucket_scatter(
+        np.zeros((3, 0), dtype=np.int64), np.zeros(0),
+        np.zeros(0, dtype=np.int64), 4, np.float64)
+    assert binds.shape == (3, 4, 1) and bvals.shape == (4, 1) and C == 1
+    np.testing.assert_array_equal(counts, np.zeros(4))
