@@ -131,7 +131,17 @@ def cmd_cpd(args) -> int:
             jax.block_until_ready(mttkrp(bs, out.factors, m))
             print(f"  mode {m}: {_time.perf_counter() - t0:0.5f}s")
     if not args.nowrite:
-        out.save(".")
+        # ≙ the reference's -s file-stem semantics (cmd_cpd.c:209,219):
+        # <stem>mode<N>.mat; a directory-like stem writes inside it
+        import os as _os
+
+        stem_arg = args.stem
+        if (stem_arg.endswith(_os.sep) or stem_arg in (".", "./")
+                or _os.path.isdir(stem_arg)):
+            out.save(stem_arg.rstrip(_os.sep) or ".", stem="")
+        else:
+            d, base = _os.path.split(stem_arg)
+            out.save(d or ".", stem=base)
     timers.stop("total")
     if opts.verbosity >= Verbosity.LOW:
         print(timers.report(level=2 if opts.verbosity >= Verbosity.HIGH
@@ -261,10 +271,14 @@ def cmd_stats(args) -> int:
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from splatt_tpu.version import __version__
+
     ap = argparse.ArgumentParser(
         prog="splatt-tpu",
         description="Sparse tensor factorization on TPU "
                     "(CPD-ALS over blocked sparse formats)")
+    ap.add_argument("-V", "--version", action="version",
+                    version=f"splatt-tpu {__version__}")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("cpd", help="compute the CPD of a sparse tensor")
@@ -279,6 +293,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--f64", action="store_true", help="double precision")
     p.add_argument("--nowrite", action="store_true",
                    help="skip writing factor files")
+    p.add_argument("-s", "--stem", default="./", metavar="PATH",
+                   help="file stem for factor output files (default: ./) "
+                        "— reference semantics: <stem>mode1.mat etc.; a "
+                        "trailing / (or an existing directory) writes "
+                        "into that directory")
     # distributed flags (≙ mpirun splatt cpd -d IxJxK / -d f -p partfile)
     p.add_argument("--decomp", choices=["medium", "coarse", "fine"],
                    help="run distributed over all devices with this "

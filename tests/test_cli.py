@@ -163,3 +163,29 @@ def test_bench_check(tns, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "cross-check max" in out
+
+
+def test_version_flag(capsys):
+    import splatt_tpu
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--version"])
+    assert exc.value.code == 0
+    assert splatt_tpu.__version__ in capsys.readouterr().out
+
+
+def test_cpd_stem(tns, tmp_path, capsys):
+    # trailing slash => directory semantics
+    outdir = str(tmp_path / "factors") + os.sep
+    rc = main(["cpd", tns, "-r", "2", "-i", "2", "--seed", "1",
+               "-s", outdir])
+    assert rc == 0
+    assert os.path.exists(os.path.join(outdir, "mode1.mat"))
+    assert os.path.exists(os.path.join(outdir, "lambda.mat"))
+    # bare stem => reference-style filename prefix <stem>mode1.mat
+    prefix = str(tmp_path / "run1.")
+    rc = main(["cpd", tns, "-r", "2", "-i", "2", "--seed", "1",
+               "-s", prefix])
+    assert rc == 0
+    assert os.path.exists(str(tmp_path / "run1.mode1.mat"))
+    assert os.path.exists(str(tmp_path / "run1.lambda.mat"))
