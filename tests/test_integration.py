@@ -1,0 +1,66 @@
+"""End-to-end integration journeys — the full user paths in one test
+each, crossing every subsystem seam (IO → format → compute → output)."""
+
+import numpy as np
+import pytest
+
+import splatt_tpu
+from splatt_tpu import native
+from splatt_tpu.config import BlockAlloc, Options, Verbosity
+from splatt_tpu.io import load_memmap, save
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.parallel import distributed_cpd_als
+from tests import gen
+
+
+def _opts(**kw):
+    kw.setdefault("random_seed", 42)
+    kw.setdefault("verbosity", Verbosity.NONE)
+    kw.setdefault("val_dtype", np.float64)
+    return Options(**kw)
+
+
+def test_journey_text_to_factors(tmp_path):
+    """text file → load → check → blocked → cpd → save → reload →
+    reconstruct."""
+    tt0 = gen.fixture_tensor("med")
+    path = str(tmp_path / "t.tns")
+    save(tt0, path)
+
+    tt = splatt_tpu.load(path)
+    assert tt.count_duplicates() == 0
+    bs = splatt_tpu.BlockedSparse.from_coo(tt, _opts(nnz_block=256))
+    out = splatt_tpu.cpd_als(bs, rank=5, opts=_opts(max_iterations=8))
+    out.save(str(tmp_path / "factors"))
+    back = KruskalTensor.load(str(tmp_path / "factors"), nmodes=tt.nmodes)
+    # the reloaded model reconstructs identically to the computed one
+    np.testing.assert_allclose(back.to_dense(), out.to_dense(), atol=1e-10)
+    # and approximates the data no worse than a fit-consistent bound
+    rel = (np.linalg.norm(back.to_dense() - tt.to_dense())
+           / np.linalg.norm(tt.to_dense()))
+    assert rel == pytest.approx(1.0 - float(out.fit), abs=1e-6)
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native runtime not built")
+def test_journey_streamed_binary_to_distributed(tmp_path):
+    """beyond-RAM route: text → streamed binary → memmap load →
+    distributed grid CPD → factors match the in-memory route."""
+    tt0 = gen.fixture_tensor("med4")
+    text = str(tmp_path / "t.tns")
+    save(tt0, text)
+    binary = str(tmp_path / "t.bin")
+    assert native.stream_to_bin(text, binary)
+
+    mm = load_memmap(binary)
+    assert isinstance(mm.inds.base, np.memmap)
+
+    from splatt_tpu.cpd import init_factors
+
+    opts = _opts(max_iterations=5)
+    init = init_factors(mm.dims, 4, opts.seed(), dtype=np.float64)
+    via_mm = distributed_cpd_als(mm, rank=4, opts=opts, init=init)
+    via_ram = splatt_tpu.cpd_als(tt0, rank=4, opts=opts, init=init)
+    assert float(via_mm.fit) == pytest.approx(float(via_ram.fit), abs=1e-8)
+    for a, b in zip(via_mm.factors, via_ram.factors):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
