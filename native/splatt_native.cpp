@@ -122,6 +122,12 @@ int tns_fill(void *h, int64_t *inds, double *vals) {
       if (p >= end || *p < '0' || *p > '9') return 1;
       int64_t v = 0;
       while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+      // an index field must end at whitespace — otherwise a short row
+      // like "1 2 0.7" would silently donate its value's integer part
+      // to the index column (rc=1 ≙ the reference aborting on a bad
+      // parse, src/io.c:85-97)
+      if (p < end && *p != ' ' && *p != '\t' && *p != '\r' && *p != '\n')
+        return 1;
       inds[m * nrows + r] = neg ? -v : v;
     }
     p = skip_ws(p, end);
@@ -216,6 +222,10 @@ inline bool parse_row(char *line, int ncols, int64_t *idx, double *val) {
     if (*p < '0' || *p > '9') return false;
     int64_t v = 0;
     while (*p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+    // index fields terminate at whitespace (same short-row guard as
+    // tns_fill): "1 2 0.7" must not parse 0 as an index and .7 as val
+    if (*p != ' ' && *p != '\t' && *p != '\r' && *p != '\n' && *p != '\0')
+      return false;
     idx[c] = neg ? -v : v;
   }
   char *next = nullptr;

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from splatt_tpu.blocked import BlockedSparse
-from splatt_tpu.config import BlockAlloc, Options
+from splatt_tpu.config import BlockAlloc, Options, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.ops.mttkrp import (choose_impl, mttkrp_blocked,
@@ -63,7 +63,7 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
     ≙ the per-mode timing loop of src/bench.c:84-117.
     """
     opts = opts or Options(block_alloc=BlockAlloc.ALLMODE)
-    dtype = jnp.dtype(opts.val_dtype)
+    dtype = resolve_dtype(opts, tt.vals.dtype)
     factors = init_factors(tt.dims, rank, opts.seed() or 1, dtype=dtype)
     inds = jnp.asarray(tt.inds)
     vals = jnp.asarray(tt.vals, dtype=dtype)
@@ -105,10 +105,9 @@ def crosscheck_mttkrp(tt: SparseTensor, rank: int = 16,
     cross-validating algorithm outputs rather than timing them."""
     import sys
 
-    from splatt_tpu.config import resolve_dtype
 
     opts = opts or Options(block_alloc=BlockAlloc.ALLMODE)
-    dtype = resolve_dtype(opts)
+    dtype = resolve_dtype(opts, tt.vals.dtype)
     factors = init_factors(tt.dims, rank, opts.seed() or 1, dtype=dtype)
     inds = jnp.asarray(tt.inds)
     vals = jnp.asarray(tt.vals, dtype=dtype)

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from splatt_tpu.config import BlockAlloc, Options, default_opts
+from splatt_tpu.config import BlockAlloc, Options, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.utils.env import ceil_to as _ceil_to
 
@@ -183,7 +183,7 @@ class BlockedSparse:
             build_modes = list(range(nmodes))
 
         layouts = [build_layout(tt, m, block=opts.nnz_block,
-                                val_dtype=opts.val_dtype)
+                                val_dtype=resolve_dtype(opts, tt.vals.dtype))
                    for m in build_modes]
         mode_map = {}
         for m in range(nmodes):
@@ -192,7 +192,12 @@ class BlockedSparse:
                              dims=tt.dims, nnz=tt.nnz, opts=opts)
 
     def frobsq(self) -> float:
-        """Squared Frobenius norm from device values (≙ csf_frobsq)."""
-        v = self.layouts[0].vals
-        return float(jnp.sum(v.astype(jnp.float64 if v.dtype == jnp.float64
-                                      else jnp.float32) ** 2))
+        """Squared Frobenius norm (≙ csf_frobsq, src/csf.c:828-851).
+
+        Accumulated in f64 on host so both cpd_als drivers (COO via
+        coo.normsq, blocked via this) share the same ⟨X,X⟩ to full
+        precision — at 77M+ nnz an f32 accumulation loses digits in the
+        fit denominator.
+        """
+        v = np.asarray(self.layouts[0].vals, dtype=np.float64)
+        return float(np.dot(v, v))

@@ -131,8 +131,10 @@ def cmd_cpd(args) -> int:
             jax.block_until_ready(mttkrp(bs, out.factors, m))
             print(f"  mode {m}: {_time.perf_counter() - t0:0.5f}s")
     if not args.nowrite:
-        # ≙ the reference's -s file-stem semantics (cmd_cpd.c:209,219):
-        # <stem>mode<N>.mat; a directory-like stem writes inside it
+        # ≙ the reference's -s file-stem semantics (cmd_cpd.c:209-230):
+        # a bare stem writes <stem>.mode<N>.mat / <stem>.lambda.mat (the
+        # reference's asprintf inserts the '.'); a directory-like stem
+        # writes plain mode<N>.mat inside that directory.
         import os as _os
 
         stem_arg = args.stem
@@ -141,7 +143,7 @@ def cmd_cpd(args) -> int:
             out.save(stem_arg.rstrip(_os.sep) or ".", stem="")
         else:
             d, base = _os.path.split(stem_arg)
-            out.save(d or ".", stem=base)
+            out.save(d or ".", stem=base + ".")
     timers.stop("total")
     if opts.verbosity >= Verbosity.LOW:
         print(timers.report(level=2 if opts.verbosity >= Verbosity.HIGH
@@ -295,9 +297,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip writing factor files")
     p.add_argument("-s", "--stem", default="./", metavar="PATH",
                    help="file stem for factor output files (default: ./) "
-                        "— reference semantics: <stem>mode1.mat etc.; a "
+                        "— reference semantics: <stem>.mode1.mat etc.; a "
                         "trailing / (or an existing directory) writes "
-                        "into that directory")
+                        "plain mode1.mat into that directory")
     # distributed flags (≙ mpirun splatt cpd -d IxJxK / -d f -p partfile)
     p.add_argument("--decomp", choices=["medium", "coarse", "fine"],
                    help="run distributed over all devices with this "
@@ -361,6 +363,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # Mirror JAX_PLATFORMS into jax.config before any backend
+    # initializes: site plugins may pre-register an accelerator backend
+    # programmatically, which ignores the env var (bench.py does the
+    # same; ≙ the reference CLI honoring its environment unconditionally).
+    import os as _os
+    p = _os.environ.get("JAX_PLATFORMS")
+    if p:
+        import jax
+        try:
+            jax.config.update("jax_platforms", p)
+        except Exception:
+            pass
     args = build_parser().parse_args(argv)
     if getattr(args, "rank", 1) < 1:
         print(f"splatt-tpu: error: rank must be >= 1 (got {args.rank})",

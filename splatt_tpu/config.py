@@ -119,8 +119,12 @@ class Options:
     decomposition: Decomposition = Decomposition.MEDIUM
     comm_pattern: CommPattern = CommPattern.ALL2ALL
 
-    # Numerics: device compute dtype. Host COO stays float64.
-    val_dtype: np.dtype = dataclasses.field(default_factory=lambda: np.dtype(np.float32))
+    # Numerics: device compute dtype. None = auto (float32, upgraded to
+    # float64 when host data is f64 and x64 is enabled).  An explicit
+    # dtype — including an explicit float32 — is respected as-is, so a
+    # deliberate f32 run on f64 inputs does not silently double
+    # memory/compute.  Host COO stays float64.
+    val_dtype: Optional[np.dtype] = None
 
     def validate(self) -> "Options":
         """Sanity-check option values once, centrally (≙ the reference's
@@ -140,7 +144,9 @@ class Options:
                 f"priv_threshold must be >= 0, got {self.priv_threshold}")
         import jax.numpy as jnp
 
-        if not jnp.issubdtype(jnp.dtype(self.val_dtype), jnp.floating):
+        if (self.val_dtype is not None
+                and not jnp.issubdtype(jnp.dtype(self.val_dtype),
+                                       jnp.floating)):
             raise ValueError(
                 f"val_dtype must be a floating dtype, got {self.val_dtype}")
         return self
@@ -171,23 +177,23 @@ _warned_f64 = False
 def resolve_dtype(opts: Options, data_dtype=None):
     """Resolve the device compute dtype once, centrally.
 
-    Rules: start from ``opts.val_dtype``; float64 host data upgrades to
-    float64 when x64 is enabled; float64 without x64 degrades to
-    float32 with ONE clear warning instead of a truncation warning at
-    every array construction site.
+    Rules: ``val_dtype=None`` (the default) means auto — float32,
+    upgraded to float64 when the host data is f64 and x64 is enabled.
+    Any explicit dtype (including explicit float32) is respected as-is.
+    float64 without x64 degrades to float32 with ONE clear warning
+    instead of a truncation warning at every array construction site.
     """
     import warnings
 
     import jax
 
-    d = np.dtype(opts.val_dtype)
-    # float64 host data upgrades the *default* float32 request when x64
-    # is live; explicit low-precision requests (bf16/f16/f32-by-choice
-    # carry the same dtype object, so only f32 upgrades) are respected
-    if (d == np.float32 and data_dtype is not None
-            and np.dtype(data_dtype) == np.float64
-            and jax.config.jax_enable_x64):
-        d = np.dtype(np.float64)
+    if opts.val_dtype is None:
+        d = np.dtype(np.float32)
+        if (data_dtype is not None and np.dtype(data_dtype) == np.float64
+                and jax.config.jax_enable_x64):
+            d = np.dtype(np.float64)
+    else:
+        d = np.dtype(opts.val_dtype)
     if d == np.float64 and not jax.config.jax_enable_x64:
         global _warned_f64
         if not _warned_f64:

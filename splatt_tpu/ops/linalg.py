@@ -82,12 +82,16 @@ def normalize_columns(U: jax.Array, which: str = "2") -> tuple[jax.Array, jax.Ar
 
     which="2": 2-norm (used on ALS iteration 0); which="max": max-norm with
     a floor of 1 so λ never shrinks columns (≙ p_mat_2norm / p_mat_maxnorm,
-    src/matrix.c:87-205 — the max-norm path clamps norms below 1 to 1).
+    src/matrix.c:87-205).  The max-norm is the *signed* max like the
+    reference (p_mat_maxnorm accumulates SS_MAX over raw vals from 0,
+    then clamps to >= 1, src/matrix.c:164-194) — a column whose entries
+    are all negative gets λ=1, keeping iteration trajectories comparable
+    bit-for-bit with reference runs.
     """
     if which == "2":
         lam = jnp.sqrt(jnp.sum(U * U, axis=0))
     elif which == "max":
-        lam = jnp.maximum(jnp.max(jnp.abs(U), axis=0), 1.0)
+        lam = jnp.maximum(jnp.max(U, axis=0), 1.0)
     else:
         raise ValueError(f"unknown norm {which!r}")
     safe = jnp.where(lam > 0, lam, 1.0)

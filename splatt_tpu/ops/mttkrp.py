@@ -178,6 +178,10 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     interpret = impl == "pallas_interpret"
 
     if path in ("scatter", "sorted_scatter"):
+        if path == "sorted_scatter" and mode != layout.mode:
+            # indices_are_sorted=True on unsorted indices is a
+            # correctness-affecting XLA hint, not just a pessimization.
+            raise ValueError("sorted_scatter requires the layout's own mode")
         nseg = dim + 1 if mode == layout.mode else dim
         out = jax.ops.segment_sum(prod.astype(_acc_dtype(prod.dtype)), seg,
                                   num_segments=nseg,

@@ -76,8 +76,10 @@ def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,
     lhs = form_normal_lhs(grams_l, m, reg)
     U_l = solve_normals(lhs, M_l)
     lam_2 = jnp.sqrt(jax.lax.psum(jnp.sum(U_l * U_l, axis=0), lam_axis))
+    # signed max clamped at 1, matching normalize_columns and the
+    # reference's p_mat_maxnorm (src/matrix.c:164-194 — no fabs)
     lam_max = jnp.maximum(
-        jax.lax.pmax(jnp.max(jnp.abs(U_l), axis=0), lam_axis), 1.0)
+        jax.lax.pmax(jnp.max(U_l, axis=0), lam_axis), 1.0)
     lam = jnp.where(first_flag > 0, lam_2, lam_max)
     U_l = U_l / jnp.where(lam > 0, lam, 1.0)
     if store_dtype is not None:

@@ -123,7 +123,8 @@ def sharded_mttkrp(inds: jax.Array, vals: jax.Array, factors: List[jax.Array],
             if k != mode:
                 U = jax.lax.all_gather(factors_l[k], axis, axis=0, tiled=True)
                 prod = prod * jnp.take(U, inds_l[k], axis=0, mode="clip")
-        partial_out = jax.ops.segment_sum(prod, inds_l[mode],
+        partial_out = jax.ops.segment_sum(prod.astype(acc_dtype(prod.dtype)),
+                                          inds_l[mode],
                                           num_segments=dims_pad[mode])
         return jax.lax.psum_scatter(partial_out, axis, scatter_dimension=0,
                                     tiled=True)

@@ -182,8 +182,9 @@ def test_cpd_stem(tns, tmp_path, capsys):
     assert rc == 0
     assert os.path.exists(os.path.join(outdir, "mode1.mat"))
     assert os.path.exists(os.path.join(outdir, "lambda.mat"))
-    # bare stem => reference-style filename prefix <stem>mode1.mat
-    prefix = str(tmp_path / "run1.")
+    # bare stem => reference-style <stem>.mode1.mat (cmd_cpd.c:219
+    # inserts the '.' itself)
+    prefix = str(tmp_path / "run1")
     rc = main(["cpd", tns, "-r", "2", "-i", "2", "--seed", "1",
                "-s", prefix])
     assert rc == 0

@@ -57,12 +57,18 @@ def test_normalize_2norm():
 
 
 def test_normalize_maxnorm_floor():
-    """Max-norm clamps λ below 1 to 1 (≙ p_mat_maxnorm)."""
+    """Max-norm is the *signed* max clamped at 1 (≙ p_mat_maxnorm,
+    src/matrix.c:164-194 — SS_MAX over raw vals, no fabs)."""
     U = jnp.asarray(np.array([[0.5, 3.0], [0.25, -6.0]]))
     out, lam = normalize_columns(U, "max")
-    np.testing.assert_allclose(np.asarray(lam), [1.0, 6.0])
+    np.testing.assert_allclose(np.asarray(lam), [1.0, 3.0])
     np.testing.assert_allclose(np.asarray(out),
-                               [[0.5, 0.5], [0.25, -1.0]])
+                               [[0.5, 1.0], [0.25, -2.0]])
+    # all-negative column: signed max < 1 -> λ clamps to 1, no scaling
+    V = jnp.asarray(np.array([[-2.0], [-3.0]]))
+    outv, lamv = normalize_columns(V, "max")
+    np.testing.assert_allclose(np.asarray(lamv), [1.0])
+    np.testing.assert_allclose(np.asarray(outv), np.asarray(V))
 
 
 def test_normalize_zero_column_safe():

@@ -44,6 +44,15 @@ def test_parse_ragged_raises(tmp_path):
         native.parse_tns(str(p))
 
 
+def test_parse_short_row_raises(tmp_path):
+    # a short row whose value has a decimal point must not silently
+    # donate the value's integer part to an index column
+    p = tmp_path / "s.tns"
+    p.write_text("1 2 3 0.5\n1 2 0.7\n")
+    with pytest.raises(ValueError):
+        native.parse_tns(str(p))
+
+
 def test_parse_nonnumeric_raises(tmp_path):
     p = tmp_path / "x.tns"
     p.write_text("1 a 1 5.0\n")

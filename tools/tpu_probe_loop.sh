@@ -1,0 +1,21 @@
+#!/bin/bash
+# Sequential TPU claim attempts (single-lease discipline: one client at a
+# time, clean exits, never a mid-claim kill).  Stops when a probe
+# succeeds or when tools/STOP_PROBE exists (checked only between
+# attempts so a running claim is never interrupted).
+cd "$(dirname "$0")/.."
+rm -f tools/STOP_PROBE
+for i in $(seq 1 40); do
+  [ -e tools/STOP_PROBE ] && { echo "probe loop: stopped by sentinel"; exit 0; }
+  echo "=== probe attempt $i $(date -u +%H:%M:%S) ==="
+  python tools/tpu_probe.py
+  rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "probe loop: SUCCESS on attempt $i"
+    exit 0
+  fi
+  [ -e tools/STOP_PROBE ] && { echo "probe loop: stopped by sentinel"; exit 0; }
+  sleep 420
+done
+echo "probe loop: exhausted attempts"
+exit 1
