@@ -88,17 +88,44 @@ class ModeLayout:
                 + self.row_start.size * self.row_start.dtype.itemsize)
 
 
+def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
+                    custom=None) -> List[int]:
+    """Order of the non-output modes within a layout
+    (≙ csf_find_mode_order, src/csf.c:694-726; see ModeOrder for the
+    mapping — the output mode is always the primary key here)."""
+    from splatt_tpu.config import ModeOrder
+
+    policy = policy or ModeOrder.SMALLFIRST
+    others = [m for m in range(len(dims)) if m != mode]
+    if policy in (ModeOrder.SMALLFIRST, ModeOrder.SORTED_MINUSONE):
+        return sorted(others, key=lambda m: (dims[m], m))
+    if policy is ModeOrder.BIGFIRST:
+        return sorted(others, key=lambda m: (-dims[m], m))
+    if policy is ModeOrder.INORDER_MINUSONE:
+        return others
+    if policy is ModeOrder.CUSTOM:
+        if custom is None:
+            raise ValueError("ModeOrder.CUSTOM requires mode_order_custom")
+        seq = [m for m in custom if m != mode]
+        if sorted(seq) != others:
+            raise ValueError(
+                f"mode_order_custom {custom!r} is not a permutation "
+                f"covering all non-output modes for mode {mode}")
+        return seq
+    raise ValueError(f"unknown mode order {policy!r}")
+
+
 def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
-                 val_dtype=np.float32) -> ModeLayout:
+                 val_dtype=np.float32, mode_order=None,
+                 mode_order_custom=None) -> ModeLayout:
     """Sort, block and pad the tensor for output mode `mode`.
 
-    ≙ csf_alloc's sort + fiber build (src/csf.c:613-726) with the
-    secondary modes ordered small-first for deterministic layouts
-    (≙ csf_find_mode_order SMALLFIRST policy).
+    ≙ csf_alloc's sort + fiber build (src/csf.c:613-726); the secondary
+    mode ordering follows `mode_order` (default SMALLFIRST,
+    ≙ csf_find_mode_order).
     """
     nmodes, nnz = tt.nmodes, tt.nnz
-    others = sorted((m for m in range(nmodes) if m != mode),
-                    key=lambda m: (tt.dims[m], m))
+    others = secondary_order(tt.dims, mode, mode_order, mode_order_custom)
     order = [mode] + others
     perm = tt.sort_order(order)
     dim = tt.dims[mode]
@@ -183,7 +210,9 @@ class BlockedSparse:
             build_modes = list(range(nmodes))
 
         layouts = [build_layout(tt, m, block=opts.nnz_block,
-                                val_dtype=resolve_dtype(opts, tt.vals.dtype))
+                                val_dtype=resolve_dtype(opts, tt.vals.dtype),
+                                mode_order=opts.mode_order,
+                                mode_order_custom=opts.mode_order_custom)
                    for m in build_modes]
         mode_map = {}
         for m in range(nmodes):

@@ -48,6 +48,9 @@ def _build_opts(args) -> "Options":
         opts.nnz_block = args.block
     if getattr(args, "f64", False):
         opts.val_dtype = np.dtype(np.float64)
+    if getattr(args, "mode_order", None):
+        from splatt_tpu.config import ModeOrder
+        opts.mode_order = ModeOrder(args.mode_order)
     return opts
 
 
@@ -178,7 +181,8 @@ def cmd_bench(args) -> int:
         print(f"cross-check max relative |alg - stream| = {dev:.3e}")
         # tolerance follows the dtype actually computed in (a float64
         # request degrades to float32 when x64 is off)
-        tol = 1e-10 if resolve_dtype(opts) == np.float64 else 9e-3
+        tol = (1e-10 if resolve_dtype(opts, tt.vals.dtype) == np.float64
+               else 9e-3)
         if dev > tol:
             print(f"error: algorithms disagree beyond tolerance {tol}")
             return 1
@@ -291,6 +295,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reg", type=float)
     p.add_argument("--seed", type=int)
     p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
+    p.add_argument("--mode-order", dest="mode_order",
+                   choices=["smallfirst", "bigfirst", "inorder_minusone",
+                            "sorted_minusone"],
+                   help="secondary mode ordering within a layout "
+                        "(reference csf_find_mode_order policies)")
     p.add_argument("--block", type=int, help="nnz per block")
     p.add_argument("--f64", action="store_true", help="double precision")
     p.add_argument("--nowrite", action="store_true",
@@ -322,6 +331,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--seed", type=int)
     p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
+    p.add_argument("--mode-order", dest="mode_order",
+                   choices=["smallfirst", "bigfirst", "inorder_minusone",
+                            "sorted_minusone"],
+                   help="secondary mode ordering within a layout "
+                        "(reference csf_find_mode_order policies)")
     p.add_argument("--block", type=int)
     p.add_argument("--f64", action="store_true")
     p.add_argument("--permute", choices=list(PERM_TYPES),

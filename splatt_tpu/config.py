@@ -41,13 +41,23 @@ class BlockAlloc(enum.Enum):
 
 
 class ModeOrder(enum.Enum):
-    """Mode permutation policy for a layout (≙ src/csf.h:12-19)."""
+    """Secondary mode-ordering policy for a layout (≙ csf_find_mode_order,
+    src/csf.h:12-19, src/csf.c:694-726).
 
-    SMALLFIRST = "smallfirst"
-    BIGFIRST = "bigfirst"
-    INORDER_MINUSONE = "inorder_minusone"
-    SORTED_MINUSONE = "sorted_minusone"
-    CUSTOM = "custom"
+    In the blocked design the output mode is *always* the primary sort
+    key (that is what makes the sorted one-hot reduction work), so the
+    policy orders the remaining modes — which controls gather locality
+    for the other factors.  Consequently SMALLFIRST here equals the
+    reference's SORTED_MINUSONE (target first, rest ascending); the
+    reference's SMALLFIRST/BIGFIRST placements of the target mid-tree
+    have no analog (root/internal/leaf traversal collapsed by design).
+    """
+
+    SMALLFIRST = "smallfirst"            # rest ascending by dim (default)
+    BIGFIRST = "bigfirst"                # rest descending by dim
+    INORDER_MINUSONE = "inorder_minusone"  # rest in natural order
+    SORTED_MINUSONE = "sorted_minusone"  # alias of SMALLFIRST here
+    CUSTOM = "custom"                    # opts.mode_order_custom
 
 
 class Decomposition(enum.Enum):
@@ -101,6 +111,11 @@ class Options:
     # Blocked format (≙ CSF_ALLOC / TILE / TILELEVEL)
     block_alloc: BlockAlloc = BlockAlloc.TWOMODE
     nnz_block: int = 4096          # nnz per block (≙ dense-tile granularity)
+    # Secondary mode ordering within a layout (≙ csf_find_mode_order);
+    # CUSTOM reads mode_order_custom, a permutation of all modes whose
+    # relative order of the non-output modes is used.
+    mode_order: ModeOrder = ModeOrder.SMALLFIRST
+    mode_order_custom: Optional[tuple] = None
     # ≙ SPLATT_OPTION_PRIVTHRESH: a mode is "privatized" (full-width
     # one-hot reduction, no scatter) when its dim ≤ priv_threshold * nnz
     # — i.e. short relative to the nonzero count — and ≤ priv_cap.

@@ -112,46 +112,67 @@ def _block_chunks(nblocks: int, elems_per_block: int,
     return min(c, nblocks)
 
 
-def _scan_onehot(local: jax.Array, prod: jax.Array, width: int,
-                 accumulate: bool) -> jax.Array:
-    """Per-block one-hot reduce: out[b] = onehot(local[b]) @ prod[b].
+def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
+                width: int, accumulate: bool) -> jax.Array:
+    """Fused gather + Hadamard + one-hot reduce as a scan over block
+    chunks (the XLA engine of the fused MTTKRP).
 
-    local: (nb, B) int32 in [0, width) (out-of-range lanes contribute 0).
-    prod:  (nb, B, R).
-    Returns (nb, width, R) partials, or (width, R) if `accumulate`.
-    Runs as a scan over chunks of blocks so the transient one-hot
-    (chunk, width, B) stays bounded; inside a chunk the one-hot contraction
-    is a batched matmul on the MXU.
+    The (nnz, R) partial-product tensor never exists in HBM: each scan
+    step gathers the factor rows for one chunk of blocks, forms the
+    Hadamard products, and reduces them with the one-hot contraction —
+    all inside one fusion.  ≙ the reference's hot loop reading factor
+    rows once per fiber inside the traversal (src/mttkrp.c:427-463)
+    rather than staging an intermediate.
     """
-    nb, B = local.shape
-    R = prod.shape[-1]
-    dtype = prod.dtype
+    nb, B = layout.nblocks, layout.block
+    R = int(factors[0].shape[1])
+    dtype = factors[0].dtype
+    nmodes = layout.nmodes
     C = _block_chunks(nb, width * B)
     nsteps = -(-nb // C)
     nb_pad = nsteps * C
+
+    inds = layout.inds
+    vals = layout.vals
+    row_start = layout.row_start
     if nb_pad != nb:
-        local = jnp.pad(local, ((0, nb_pad - nb), (0, 0)), constant_values=-1)
-        prod = jnp.pad(prod, ((0, nb_pad - nb), (0, 0), (0, 0)))
-    local = local.reshape(nsteps, C, B)
-    prod = prod.reshape(nsteps, C, B, R)
+        # pad with whole sentinel blocks: mode index = dim (falls in the
+        # dropped tail rows), other indices 0, values 0
+        pad = (nb_pad - nb) * B
+        inds = jnp.pad(inds, ((0, 0), (0, pad)))
+        inds = inds.at[mode, nb * B:].set(layout.dim)
+        vals = jnp.pad(vals, (0, pad))
+        row_start = jnp.pad(row_start, (0, nb_pad - nb),
+                            constant_values=layout.dim)
+
+    inds_s = inds.reshape(nmodes, nsteps, C, B).transpose(1, 0, 2, 3)
+    vals_s = vals.reshape(nsteps, C, B)
+    rs_s = row_start.reshape(nsteps, C)
 
     iota = jnp.arange(width, dtype=jnp.int32)
-    acc_dtype = _acc_dtype(dtype)
+    acc = _acc_dtype(dtype)
 
     def step(carry, xs):
-        loc, prd = xs
-        onehot = (loc[:, None, :] == iota[None, :, None]).astype(dtype)
-        part = jnp.einsum("cwb,cbr->cwr", onehot, prd,
-                          preferred_element_type=acc_dtype)
+        inds_c, vals_c, rs_c = xs          # (nmodes,C,B), (C,B), (C,)
+        prod = vals_c.astype(dtype)[..., None]
+        for k in range(nmodes):
+            if k != mode:
+                rows = jnp.take(factors[k], inds_c[k].reshape(-1), axis=0,
+                                mode="clip").reshape(C, B, R)
+                prod = prod * rows
+        local = inds_c[mode] - rs_c[:, None] if not accumulate else inds_c[mode]
+        onehot = (local[:, None, :] == iota[None, :, None]).astype(dtype)
+        part = jnp.einsum("cwb,cbr->cwr", onehot, prod,
+                          preferred_element_type=acc)
         if accumulate:
             return carry + jnp.sum(part, axis=0), None
         return carry, part
 
     if accumulate:
-        init = jnp.zeros((width, R), dtype=acc_dtype)
-        acc, _ = jax.lax.scan(step, init, (local, prod))
-        return acc
-    _, parts = jax.lax.scan(step, None, (local, prod))
+        init = jnp.zeros((width, R), dtype=acc)
+        out, _ = jax.lax.scan(step, init, (inds_s, vals_s, rs_s))
+        return out
+    _, parts = jax.lax.scan(step, None, (inds_s, vals_s, rs_s))
     return parts.reshape(nb_pad, width, R)[:nb]
 
 
@@ -162,17 +183,24 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     """Blocked MTTKRP over one :class:`ModeLayout`.
 
     `path` picks the algorithm (static dispatch); `impl` picks the
-    one-hot reduction engine: "xla" (scanned einsum), "pallas"
-    (VMEM-resident Mosaic kernel, TPU only) or "pallas_interpret"
-    (kernel semantics on CPU, for tests).
+    one-hot reduction engine:
+
+    - "xla": fused scan — gather, Hadamard and the one-hot contraction
+      all live inside one scan step, so the (nnz, R) partial-product
+      tensor never hits HBM;
+    - "pallas" (TPU): the fused Mosaic kernel when every input factor
+      fits VMEM next to the working set (gather + Hadamard + reduce in
+      VMEM; HBM traffic ≈ inds + vals + touched factor rows + output),
+      else the unfused kernel on a precomputed prod;
+    - "pallas_interpret": kernel semantics on CPU, for tests.
     """
-    from splatt_tpu.ops.pallas_kernels import (onehot_reduce_full,
+    from splatt_tpu.ops.pallas_kernels import (fused_mttkrp, fused_vmem_ok,
+                                               onehot_reduce_full,
                                                onehot_reduce_sorted,
                                                vmem_chunk)
 
     dim = int(factors[mode].shape[0])
     R = factors[mode].shape[1]
-    prod = _gather_prod(layout.inds, layout.vals, factors, mode)
     seg = layout.inds[mode]
     pallas = impl in ("pallas", "pallas_interpret")
     interpret = impl == "pallas_interpret"
@@ -182,6 +210,9 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             # indices_are_sorted=True on unsorted indices is a
             # correctness-affecting XLA hint, not just a pessimization.
             raise ValueError("sorted_scatter requires the layout's own mode")
+        # XLA fuses the gather+Hadamard producers into the scatter-add,
+        # so this path has no (nnz, R) HBM intermediate either.
+        prod = _gather_prod(layout.inds, layout.vals, factors, mode)
         nseg = dim + 1 if mode == layout.mode else dim
         out = jax.ops.segment_sum(prod.astype(_acc_dtype(prod.dtype)), seg,
                                   num_segments=nseg,
@@ -189,31 +220,43 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
         return out[:dim]
 
     nb, B = layout.nblocks, layout.block
-    prod = prod.reshape(nb, B, R)
-
-    itemsize = jnp.dtype(prod.dtype).itemsize
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
 
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
-        local = seg.reshape(nb, B)
-        chunk = vmem_chunk(width, B, int(R), itemsize)
-        if pallas and chunk >= 1:
-            return onehot_reduce_full(local, prod, width,
-                                      interpret=interpret,
-                                      chunk=chunk)[:dim]
-        return _scan_onehot(local, prod, width, accumulate=True)[:dim]
+        if pallas:
+            if fused_vmem_ok(factors, mode, width, B):
+                return fused_mttkrp(layout, factors, mode, width,
+                                    accumulate=True,
+                                    interpret=interpret)[:dim]
+            chunk = vmem_chunk(width, B, int(R), itemsize)
+            if chunk >= 1:
+                prod = _gather_prod(layout.inds, layout.vals, factors,
+                                    mode).reshape(nb, B, R)
+                local = seg.reshape(nb, B)
+                return onehot_reduce_full(local, prod, width,
+                                          interpret=interpret,
+                                          chunk=chunk)[:dim]
+        return _scan_fused(layout, factors, mode, width,
+                           accumulate=True)[:dim]
 
     if path == "sorted_onehot":
         if mode != layout.mode:
             raise ValueError("sorted_onehot requires the layout's own mode")
         S = layout.seg_width
-        local = seg.reshape(nb, B) - layout.row_start[:, None]
         chunk = vmem_chunk(S, B, int(R), itemsize)
-        if pallas and chunk >= 1:
-            parts = onehot_reduce_sorted(local, prod, S, interpret=interpret,
-                                         chunk=chunk)
+        if pallas and fused_vmem_ok(factors, mode, S, B):
+            parts = fused_mttkrp(layout, factors, mode, S,
+                                 accumulate=False, interpret=interpret)
+        elif pallas and chunk >= 1:
+            prod = _gather_prod(layout.inds, layout.vals, factors,
+                                mode).reshape(nb, B, R)
+            local = seg.reshape(nb, B) - layout.row_start[:, None]
+            parts = onehot_reduce_sorted(local, prod, S,
+                                         interpret=interpret, chunk=chunk)
         else:
-            parts = _scan_onehot(local, prod, S, accumulate=False)  # (nb,S,R)
+            parts = _scan_fused(layout, factors, mode, S,
+                                accumulate=False)    # (nb, S, R)
         idx = (layout.row_start[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
         out = jnp.zeros((dim + S + 1, R), dtype=parts.dtype)
         out = out.at[idx].add(parts.reshape(-1, R))

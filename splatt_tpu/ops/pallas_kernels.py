@@ -127,6 +127,130 @@ def onehot_reduce_sorted(local: jax.Array, prod: jax.Array, seg_width: int,
     return out[:nb]
 
 
+# -- fused gather + Hadamard + reduce ---------------------------------------
+
+def fused_vmem_ok(factors, mode: int, width: int, block: int,
+                  budget_bytes: int = 12 << 20) -> bool:
+    """Whether the fused kernel's VMEM plan fits: every *input* factor
+    resident in VMEM for the whole grid, plus the per-step working set
+    (gathered rows ×2, one-hot, partials).  The ~16MB/core scratchpad
+    keeps ~4MB back for double-buffered block streams.
+    """
+    R = int(factors[0].shape[1])
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
+    fac = sum(int(f.shape[0]) * R * itemsize
+              for k, f in enumerate(factors) if k != mode)
+    work = (2 * block * R * itemsize          # gathered rows + prod
+            + width * block * itemsize       # one-hot
+            + width * R * max(itemsize, 4)   # partials (acc width)
+            + (len(factors) + 1) * block * 4)  # index + val streams
+    return fac + work <= budget_bytes
+
+
+def _fused_kernel(local_ref, vals_ref, ginds_ref, *refs,
+                  width: int, accumulate: bool, nother: int):
+    out_ref = refs[nother]
+    u_refs = refs[:nother]
+    local = local_ref[...]                   # (C, B) int32
+    vals = vals_ref[...]                     # (C, B)
+    C, B = local.shape
+    dtype = vals.dtype
+    prod = vals[..., None]                   # (C, B, 1)
+    for j in range(nother):
+        u = u_refs[j][...]                   # (dim_j, R) resident in VMEM
+        idx = ginds_ref[j, :, :].reshape(C * B)
+        rows = jnp.take(u, idx, axis=0, mode="clip",
+                        unique_indices=False, indices_are_sorted=False)
+        prod = prod * rows.reshape(C, B, u.shape[1])
+    iota = jax.lax.broadcasted_iota(jnp.int32, (C, width, B), 1)
+    onehot = (local[:, None, :] == iota).astype(dtype)
+    part = jax.lax.dot_general(
+        onehot, prod,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=out_ref.dtype)    # (C, width, R)
+    if not accumulate:
+        out_ref[...] = part
+        return
+    acc = jnp.sum(part, axis=0)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = acc
+
+    @pl.when(pl.program_id(0) != 0)
+    def _accum():
+        out_ref[...] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "width", "accumulate",
+                                             "interpret", "chunk"))
+def fused_mttkrp(layout, factors, mode: int, width: int,
+                 accumulate: bool, interpret: bool = False,
+                 chunk: int = 1) -> jax.Array:
+    """Fused MTTKRP kernel: gather factor rows, Hadamard, one-hot reduce
+    — entirely in VMEM (≙ the reference's register-blocked fiber loops,
+    src/mttkrp.c:427-463, which read each factor row once inside the
+    traversal).  The (nnz, R) partial-product tensor never exists in HBM:
+    traffic is inds + vals + resident factors + output partials.
+
+    Layout contract: `layout.inds` sorted by `mode` (for the sorted
+    path) with sentinel-padded tails; every input factor must pass
+    :func:`fused_vmem_ok`.  Output: (nb, width, R) block partials, or
+    (width, R) totals when `accumulate` (privatized short modes).
+    """
+    nmodes = layout.nmodes
+    nb, B = layout.nblocks, layout.block
+    R = int(factors[0].shape[1])
+    dtype = factors[0].dtype
+    others = [k for k in range(nmodes) if k != mode]
+
+    seg = layout.inds[mode]
+    if accumulate:
+        local = seg.reshape(nb, B)
+    else:
+        local = seg.reshape(nb, B) - layout.row_start[:, None]
+    vals = layout.vals.reshape(nb, B).astype(dtype)
+    ginds = layout.inds[jnp.asarray(others)].reshape(len(others), nb, B)
+
+    nb_pad = ceil_to(max(nb, 1), chunk)
+    if nb_pad != nb:
+        local = jnp.pad(local, ((0, nb_pad - nb), (0, 0)),
+                        constant_values=-1)
+        vals = jnp.pad(vals, ((0, nb_pad - nb), (0, 0)))
+        ginds = jnp.pad(ginds, ((0, 0), (0, nb_pad - nb), (0, 0)))
+    grid = (nb_pad // chunk,)
+
+    factor_specs = [
+        pl.BlockSpec((int(factors[k].shape[0]), R), lambda i: (0, 0))
+        for k in others
+    ]
+    acc = _acc_dtype(dtype)
+    if accumulate:
+        out_spec = pl.BlockSpec((width, R), lambda i: (0, 0))
+        out_shape = jax.ShapeDtypeStruct((width, R), acc)
+    else:
+        out_spec = pl.BlockSpec((chunk, width, R), lambda i: (i, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((nb_pad, width, R), acc)
+
+    out = pl.pallas_call(
+        functools.partial(_fused_kernel, width=width, accumulate=accumulate,
+                          nother=len(others)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
+            pl.BlockSpec((len(others), chunk, B), lambda i: (0, i, 0)),
+            *factor_specs,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(local, vals, ginds, *[factors[k] for k in others])
+    if accumulate:
+        return out
+    return out[:nb]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("width", "interpret", "chunk"))
 def onehot_reduce_full(local: jax.Array, prod: jax.Array, width: int,

@@ -59,6 +59,48 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
             C, counts)
 
 
+def balanced_relabel(hist: np.ndarray, nparts: int, cap: int) -> np.ndarray:
+    """nnz-balanced row→label map for equal-width fences.
+
+    ≙ the reference's nnz-balanced layer boundary search
+    (p_find_layer_boundaries, src/mpi/mpi_io.c:365-439).  The TPU grid
+    needs *equal-width* fences for static shapes, so instead of moving
+    the boundaries we move the rows: a capacity-constrained LPT bin
+    packing assigns rows (heaviest first) to the least-loaded fence with
+    free slots, then labels fence p's rows ``p*cap .. p*cap+count_p-1``.
+    Underfull fences leave empty labels inside their own span — exactly
+    the padding rows the grid already carries.
+
+    Args: hist (dim,) per-row nnz counts; nparts fences of cap labels
+    each (nparts*cap >= dim).  Returns (dim,) int64 old→new labels in
+    [0, nparts*cap).
+    """
+    import heapq
+
+    dim = int(hist.shape[0])
+    if nparts * cap < dim:
+        raise ValueError(f"{nparts} fences x {cap} labels < {dim} rows")
+    order = np.argsort(-hist, kind="stable")
+    counts = np.zeros(nparts, dtype=np.int64)
+    part_of = np.empty(dim, dtype=np.int64)
+    heap = [(0, p) for p in range(nparts)]
+    for r in order:
+        load, p = heapq.heappop(heap)
+        part_of[r] = p
+        counts[p] += 1
+        if counts[p] < cap:  # full fences never return to the heap
+            heapq.heappush(heap, (load + int(hist[r]), p))
+    # fence p's rows keep their relative order within the fence
+    by_part = np.lexsort((np.arange(dim), part_of))
+    starts = np.zeros(nparts, dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    part_sorted = part_of[by_part]
+    slot = np.arange(dim) - starts[part_sorted]
+    relabel = np.empty(dim, dtype=np.int64)
+    relabel[by_part] = part_sorted * cap + slot
+    return relabel
+
+
 def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,
                      lam_axis, store_dtype=None):
     """Shared per-mode ALS tail: normal-equations solve on the local
@@ -101,12 +143,16 @@ def fit_tail(lam, grams_l, M_l, U_last, inner_axis):
 
 def run_distributed_als(step: Callable, factors, grams, rank: int,
                         opts: Options, xnormsq: float,
-                        dims: Sequence[int], dtype) -> KruskalTensor:
+                        dims: Sequence[int], dtype,
+                        row_select=None) -> KruskalTensor:
     """Host convergence loop + post-processing for a distributed sweep.
 
     `step(factors, grams, first_flag) -> (factors, grams, lam, znormsq,
     inner)`; factors come back sharded, are gathered, stripped of row
     padding, and renormalized into λ (≙ cpd_post_process).
+    `row_select[m]`, when given, is a (dim_m,) index array mapping the
+    gathered padded factor back to original row order (the inverse of a
+    balanced-fence relabeling).
     """
     fit_prev = 0.0
     lam = jnp.ones((rank,), dtype=dtype)
@@ -123,7 +169,11 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             break
         fit_prev = fitval
 
-    return post_process([_gather_global(U) for U in factors], lam,
+    gathered = [_gather_global(U) for U in factors]
+    if row_select is not None:
+        gathered = [U if sel is None else jnp.asarray(np.asarray(U)[sel])
+                    for U, sel in zip(gathered, row_select)]
+    return post_process(gathered, lam,
                         jnp.asarray(fit_prev, dtype=dtype), dims=dims)
 
 

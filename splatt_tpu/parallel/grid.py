@@ -49,8 +49,8 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
-                                        mode_update_tail,
+from splatt_tpu.parallel.common import (balanced_relabel, bucket_scatter,
+                                        fit_tail, mode_update_tail,
                                         run_distributed_als)
 from splatt_tpu.parallel.mesh import auto_grid
 from splatt_tpu.utils.env import ceil_to
@@ -77,6 +77,10 @@ class GridDecomp:
     nnz: int
     fill: float                    # nnz / (ncells * cell_nnz) — balance
     cell_counts: np.ndarray        # (ncells,) true occupancy per cell
+    # per-mode old→new row label maps from the nnz-balanced fences
+    # (None per mode = identity; ≙ the relabeling after
+    # p_find_layer_boundaries / mpi_mat_distribute's perm)
+    relabels: Optional[List[Optional[np.ndarray]]] = None
 
     @property
     def nmodes(self) -> int:
@@ -85,9 +89,25 @@ class GridDecomp:
     @staticmethod
     def build(tt: SparseTensor, grid: Optional[Tuple[int, ...]] = None,
               n_devices: Optional[int] = None,
-              val_dtype=np.float32) -> "GridDecomp":
+              val_dtype=np.float32,
+              balance: Optional[bool] = False) -> "GridDecomp":
         """≙ mpi_tt_read's rearrange-to-owners (p_rearrange_medium,
-        src/mpi/mpi_io.c:451-473) done as a host-side bucketing."""
+        src/mpi/mpi_io.c:451-473) done as a host-side bucketing.
+
+        `balance`: nnz-balance the row fences by relabeling rows
+        (balanced_relabel ≙ p_find_layer_boundaries,
+        src/mpi/mpi_io.c:365-439).  None = auto: balance when the
+        equal-fence fill is poor (< 0.5) and the relabeling improves it
+        — this is what *acts* on the fill statistic the reference
+        prints.  Every cell is padded to the fullest cell, so fill is
+        both the memory and the compute efficiency of the sweep.
+
+        Default is False (no relabeling) because a relabeled build
+        changes factor row placement: callers that scatter factors
+        through :meth:`shard_factors` must restore order with
+        :meth:`row_select` when gathering.  grid_cpd_als does and
+        enables auto mode; direct build() users opt in explicitly.
+        """
         nmodes = tt.nmodes
         if grid is None:
             ndev = n_devices if n_devices is not None else len(jax.devices())
@@ -95,13 +115,36 @@ class GridDecomp:
         grid = tuple(int(g) for g in grid)
         dims_pad = tuple(ceil_to(max(d, g), g) for d, g in zip(tt.dims, grid))
         block_rows = tuple(dp // g for dp, g in zip(dims_pad, grid))
-
-        # cell id per nonzero from block coordinates
-        cell = np.zeros(tt.nnz, dtype=np.int64)
-        for m in range(nmodes):
-            cell = cell * grid[m] + tt.inds[m] // block_rows[m]
         ncells = int(np.prod(grid))
-        binds, vals, cell_nnz, counts = bucket_scatter(tt.inds, tt.vals,
+
+        def cells_of(inds_rel):
+            cell = np.zeros(tt.nnz, dtype=np.int64)
+            for m in range(nmodes):
+                cell = cell * grid[m] + inds_rel[m] // block_rows[m]
+            return cell
+
+        def fill_of(cell):
+            if tt.nnz == 0:
+                return 1.0
+            counts = np.bincount(cell, minlength=ncells)
+            return tt.nnz / max(ncells * int(counts.max()), 1)
+
+        inds_rel = tt.inds
+        relabels: Optional[List[Optional[np.ndarray]]] = None
+        cell = cells_of(inds_rel)
+        fill0 = fill_of(cell)
+        if balance or (balance is None and fill0 < 0.5):
+            rl = [balanced_relabel(tt.mode_histogram(m), grid[m],
+                                   block_rows[m])
+                  if grid[m] > 1 else None
+                  for m in range(nmodes)]
+            cand = np.stack([r[tt.inds[m]] if r is not None else tt.inds[m]
+                             for m, r in enumerate(rl)])
+            cell_b = cells_of(cand)
+            if balance or fill_of(cell_b) > fill0:
+                inds_rel, relabels, cell = cand, rl, cell_b
+
+        binds, vals, cell_nnz, counts = bucket_scatter(inds_rel, tt.vals,
                                                        cell, ncells,
                                                        val_dtype)
         # localize indices to the cell's block fences (pad slots hold
@@ -117,6 +160,7 @@ class GridDecomp:
             nnz=tt.nnz,
             fill=tt.nnz / max(ncells * cell_nnz, 1),
             cell_counts=counts,
+            relabels=relabels,
         )
 
     def make_mesh(self, devices=None) -> Mesh:
@@ -142,10 +186,20 @@ class GridDecomp:
         for m, U in enumerate(factors):
             dp = self.dims_pad[m]
             U_pad = jnp.zeros((dp, U.shape[1]), dtype=U.dtype)
-            U_pad = U_pad.at[:U.shape[0]].set(U)
+            rl = self.relabels[m] if self.relabels is not None else None
+            if rl is None:
+                U_pad = U_pad.at[:U.shape[0]].set(U)
+            else:
+                # balanced fences: row `old` lives at label rl[old]
+                U_pad = U_pad.at[jnp.asarray(rl)].set(U)
             out.append(jax.device_put(
                 U_pad, NamedSharding(mesh, P(_axis(m), None))))
         return tuple(out)
+
+    def row_select(self) -> Optional[List[Optional[np.ndarray]]]:
+        """Per-mode gather indices restoring original row order from a
+        padded factor (for run_distributed_als)."""
+        return None if self.relabels is None else list(self.relabels)
 
 
 def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float):
@@ -208,16 +262,26 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                  relabel: Optional[str] = None) -> KruskalTensor:
     """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition).
 
-    `relabel` (any splatt_tpu.reorder PERM_TYPES entry, e.g.
-    "random"/"graph"/"hgraph"/"fibsched") applies an index relabeling
-    before decomposing — equal fences over relabeled indices ≈ the
-    reference's nnz-balanced layer boundaries (p_find_layer_boundaries)
-    — and restores factor row order afterwards via the permutation
-    bookkeeping.
+    `relabel` picks the fence-balancing strategy:
+
+    - "balanced" (also the automatic default when the equal-fence fill
+      is poor): nnz-balanced fences via capacity-constrained row
+      relabeling (balanced_relabel ≙ p_find_layer_boundaries,
+      src/mpi/mpi_io.c:365-439);
+    - any splatt_tpu.reorder PERM_TYPES entry ("random"/"graph"/
+      "hgraph"/"fibsched"): a full index relabeling before decomposing
+      — equal fences over relabeled indices ≈ balanced statistically.
+
+    Factor row order is restored afterwards in both cases.
     """
     opts = (opts or default_opts()).validate()
     dtype = resolve_dtype(opts, tt.vals.dtype)
 
+    balance = None  # auto: balance when equal fences fill poorly
+    if relabel == "balanced":
+        balance, relabel = True, None
+    elif relabel is not None:
+        balance = False  # explicit relabeling supersedes fence balancing
     perm = None
     if relabel is not None:
         from splatt_tpu.reorder import reorder
@@ -245,7 +309,7 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
 
     decomp = GridDecomp.build(tt, grid=grid,
                               n_devices=len(devices) if devices else None,
-                              val_dtype=dtype)
+                              val_dtype=dtype, balance=balance)
     mesh = mesh or decomp.make_mesh(devices=devices)
     xnormsq = tt.normsq()
 
@@ -266,7 +330,8 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
         return sweep(inds, vals, factors, grams, flag)
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                              tt.dims, dtype)
+                              tt.dims, dtype,
+                              row_select=decomp.row_select())
     if perm is not None:
         out = KruskalTensor(
             factors=[jnp.asarray(perm.apply_to_factor(np.asarray(U), m))

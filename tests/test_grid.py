@@ -117,12 +117,71 @@ def test_grid_relabel_improves_balance():
     from splatt_tpu.reorder import reorder
 
     tt = gen.fixture_tensor("med")  # zipf-skewed fixture
-    base = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64)
+    base = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64,
+                            balance=False)
     perm = reorder(tt, "random", seed=1)
     relabeled = GridDecomp.build(perm.apply(tt), grid=(2, 2, 2),
-                                 val_dtype=np.float64)
+                                 val_dtype=np.float64, balance=False)
     # deterministic fixture: 0.24 -> 0.54 observed; assert strict gain
     assert relabeled.fill > base.fill
+
+
+def test_balanced_relabel_unit():
+    """Capacity-constrained LPT: bijection into fence spans, ~equal nnz
+    per fence (≙ p_find_layer_boundaries semantics)."""
+    from splatt_tpu.parallel.common import balanced_relabel
+
+    rng = np.random.default_rng(0)
+    hist = (rng.zipf(1.3, size=103) % 1000).astype(np.int64)
+    nparts, cap = 8, 13  # 8*13=104 >= 103
+    rl = balanced_relabel(hist, nparts, cap)
+    assert sorted(set(rl)) == sorted(rl)          # injective
+    assert rl.min() >= 0 and rl.max() < nparts * cap
+    loads = np.zeros(nparts)
+    counts = np.zeros(nparts, dtype=int)
+    for r, new in enumerate(rl):
+        p = new // cap
+        loads[p] += hist[r]
+        counts[p] += 1
+    assert counts.max() <= cap
+    ideal = hist.sum() / nparts
+    assert loads.max() <= max(ideal * 1.5, ideal + hist.max())
+    with pytest.raises(ValueError):
+        balanced_relabel(hist, 2, 13)  # capacity too small
+
+
+def test_balanced_fences_beat_equal_on_zipf():
+    """VERDICT round-1 target: fill within ~1.5x of ideal on a zipf-1.3
+    skewed tensor at 8 devices, without relabel='random'."""
+    rng = np.random.default_rng(7)
+    dims = (160, 120, 200)
+    nnz = 60000
+    inds = np.stack([rng.zipf(1.3, size=nnz) % d for d in dims])
+    tt = SparseTensor(inds, rng.random(nnz), dims).deduplicate()
+    equal = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64,
+                             balance=False)
+    bal = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64,
+                           balance=True)
+    assert bal.fill > equal.fill
+    assert bal.fill >= 1 / 1.5, (bal.fill, equal.fill)
+    # auto mode (balance=None) picks the balanced build when equal
+    # fences are poor
+    auto = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float64,
+                            balance=None)
+    assert auto.fill >= bal.fill * 0.999
+
+
+def test_grid_balanced_matches_plain():
+    """Balanced-fence grid CPD returns factors in ORIGINAL row order
+    with the same math (same init, different row placement)."""
+    tt = gen.fixture_tensor("med")
+    opts = _opts(max_iterations=6)
+    init = init_factors(tt.dims, 4, opts.seed(), dtype=jnp.float64)
+    plain = grid_cpd_als(tt, rank=4, grid=(2, 2, 2), opts=opts, init=init)
+    bal = grid_cpd_als(tt, rank=4, grid=(2, 2, 2), opts=opts, init=init,
+                       relabel="balanced")
+    assert float(bal.fit) == pytest.approx(float(plain.fit), abs=1e-6)
+    np.testing.assert_allclose(bal.to_dense(), plain.to_dense(), atol=1e-5)
 
 
 def test_grid_midscale_exactness():

@@ -68,6 +68,41 @@ def test_blocked_config_matrix(any_tensor, alloc, block):
                                    err_msg=f"alloc={alloc} block={block} mode={mode}")
 
 
+def test_mode_order_config_matrix(any_tensor):
+    """alloc × mode-order sweep matches the oracle, and the secondary
+    orderings actually differ (≙ csf_find_mode_order policies,
+    src/csf.c:694-726, exercised by the config matrix of
+    tests/mttkrp_test.c:168-259)."""
+    from splatt_tpu.blocked import secondary_order
+    from splatt_tpu.config import ModeOrder
+
+    tt = any_tensor
+    factors = make_factors(tt.dims)
+    orders = [ModeOrder.SMALLFIRST, ModeOrder.BIGFIRST,
+              ModeOrder.INORDER_MINUSONE]
+    seen = set()
+    for mo in orders:
+        seen.add(tuple(secondary_order(tt.dims, 0, mo)))
+        opts = Options(block_alloc=BlockAlloc.ALLMODE, nnz_block=128,
+                       val_dtype=np.float64, mode_order=mo)
+        bs = BlockedSparse.from_coo(tt, opts)
+        for mode in range(tt.nmodes):
+            got = mttkrp(bs, factors, mode)
+            np.testing.assert_allclose(
+                np.asarray(got), np_mttkrp(tt, factors, mode), atol=TOL,
+                err_msg=f"mode_order={mo} mode={mode}")
+    if len(set(tt.dims)) == tt.nmodes and tt.nmodes > 2:
+        assert len(seen) > 1  # policies produce distinct layouts
+    # CUSTOM: explicit permutation (reversed natural) + validation
+    custom = tuple(range(tt.nmodes))[::-1]
+    assert secondary_order(tt.dims, 0, ModeOrder.CUSTOM, custom) == \
+        [m for m in custom if m != 0]
+    with pytest.raises(ValueError):
+        secondary_order(tt.dims, 0, ModeOrder.CUSTOM, None)
+    with pytest.raises(ValueError):
+        secondary_order(tt.dims, 0, ModeOrder.CUSTOM, (0, 1))
+
+
 @pytest.mark.parametrize("path", ["sorted_onehot", "sorted_scatter",
                                   "privatized", "scatter"])
 def test_forced_paths(any_tensor, path):

@@ -93,8 +93,33 @@ try:
             p, l, num_segments=width))(loc2, prod)
         err2 = float(jnp.max(jnp.abs(out2 - ref2)))
         info["pallas_sorted"] = {"ok": bool(err2 < 1e-2), "max_err": err2}
+
+        STAGE[0] = "pallas_fused"
+        # fused kernel (gather+Hadamard+reduce in VMEM) — the round-2
+        # flagship; exercises in-kernel jnp.take lowering on Mosaic
+        from splatt_tpu.blocked import build_layout
+        from splatt_tpu.coo import SparseTensor
+        from splatt_tpu.ops import mttkrp as mk
+
+        dims = (96, 80, 112)
+        nz = 4096
+        tinds = np.stack([rng.integers(0, d, nz) for d in dims]).astype(np.int64)
+        tvals = rng.standard_normal(nz)
+        tt = SparseTensor(inds=tinds, vals=tvals, dims=dims)
+        fac = [jnp.asarray(rng.standard_normal((d, 32)).astype(np.float32))
+               for d in dims]
+        lay = build_layout(tt, 0, block=512, val_dtype=np.float32)
+        got = mk.mttkrp_blocked(lay, fac, 0, path="sorted_onehot",
+                                impl="pallas")
+        got.block_until_ready()
+        ref3 = mk.mttkrp_stream(jnp.asarray(tinds),
+                                jnp.asarray(tvals, jnp.float32), fac, 0,
+                                dims[0])
+        err3 = float(jnp.max(jnp.abs(got - ref3)))
+        info["pallas_fused"] = {"ok": bool(err3 < 1e-2), "max_err": err3}
     except Exception as e:
-        info["pallas_" + ("sorted" if STAGE[0] == "pallas_sorted" else "onehot")] = {
+        info["pallas_" + {"pallas": "onehot", "pallas_sorted": "sorted",
+                          "pallas_fused": "fused"}.get(STAGE[0], "onehot")] = {
             "ok": False, "error": f"{type(e).__name__}: {e}"}
 
     signal.alarm(0)
