@@ -30,9 +30,12 @@ def gram(U: jax.Array) -> jax.Array:
     (bf16/f16) accumulate in f32 — Gram matrices feed the normal
     equations and cannot afford bf16 accumulation error.
     """
+    from splatt_tpu.ops.mttkrp import mxu_precision
+
     acc = (jnp.float32 if U.dtype in (jnp.bfloat16, jnp.float16)
            else U.dtype)
-    return jnp.matmul(U.T, U, preferred_element_type=acc)
+    return jnp.matmul(U.T, U, preferred_element_type=acc,
+                      precision=mxu_precision(U.dtype))
 
 
 def form_normal_lhs(grams: Sequence[jax.Array], mode: int,
@@ -67,10 +70,14 @@ def solve_normals(lhs: jax.Array, rhs: jax.Array) -> jax.Array:
     # Cutoff at √eps·‖w‖: normal equations square the condition number, so
     # eigenvalues below √eps·max|w| carry no information; eps-level cutoffs
     # keep eigh noise and blow the solve up.
+    from splatt_tpu.ops.mttkrp import mxu_precision
+
+    prec = mxu_precision(lhs.dtype)
     w, v = jnp.linalg.eigh(lhs)
     tol = jnp.sqrt(jnp.finfo(lhs.dtype).eps) * jnp.max(jnp.abs(w))
     w_inv = jnp.where(jnp.abs(w) > tol, 1.0 / w, 0.0)
-    x_pinv = rhs @ (v * w_inv) @ v.T
+    x_pinv = jnp.matmul(jnp.matmul(rhs, v * w_inv, precision=prec), v.T,
+                        precision=prec)
 
     spd = (jnp.min(w) > tol) & jnp.all(jnp.isfinite(x_chol))
     return jnp.where(spd, x_chol, x_pinv)

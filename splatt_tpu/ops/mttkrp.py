@@ -66,6 +66,21 @@ def _acc_dtype(dtype):
 acc_dtype = _acc_dtype  # public name for the sharded sweeps
 
 
+def mxu_precision(dtype):
+    """MXU pass policy for dots with `dtype` operands.
+
+    The TPU MXU multiplies in bf16: a DEFAULT-precision f32 dot rounds
+    each operand to one bf16 pass (measured max_err ~7e-2 on the one-hot
+    contraction on a v5e — outside even the reference's float tolerance,
+    tests/mttkrp_test.c:25-30).  HIGHEST decomposes each f32 operand
+    into bf16 pieces for f32-faithful products; bf16 operands are native
+    single-pass and keep DEFAULT.
+    """
+    if dtype == jnp.float32:
+        return jax.lax.Precision.HIGHEST
+    return jax.lax.Precision.DEFAULT
+
+
 # -- stream (oracle) -------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("mode", "dim"))
@@ -163,7 +178,8 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
         local = inds_c[mode] - rs_c[:, None] if not accumulate else inds_c[mode]
         onehot = (local[:, None, :] == iota[None, :, None]).astype(dtype)
         part = jnp.einsum("cwb,cbr->cwr", onehot, prod,
-                          preferred_element_type=acc)
+                          preferred_element_type=acc,
+                          precision=mxu_precision(dtype))
         if accumulate:
             return carry + jnp.sum(part, axis=0), None
         return carry, part
@@ -194,7 +210,8 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
       else the unfused kernel on a precomputed prod;
     - "pallas_interpret": kernel semantics on CPU, for tests.
     """
-    from splatt_tpu.ops.pallas_kernels import (fused_mttkrp, fused_vmem_ok,
+    from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
+                                               fused_mttkrp, fused_vmem_ok,
                                                onehot_reduce_full,
                                                onehot_reduce_sorted,
                                                vmem_chunk)
@@ -222,10 +239,12 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     nb, B = layout.nblocks, layout.block
     itemsize = jnp.dtype(factors[0].dtype).itemsize
 
+    fused_ok = pallas and (interpret or fused_gather_supported())
+
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
         if pallas:
-            if fused_vmem_ok(factors, mode, width, B):
+            if fused_ok and fused_vmem_ok(factors, mode, width, B):
                 return fused_mttkrp(layout, factors, mode, width,
                                     accumulate=True,
                                     interpret=interpret)[:dim]
@@ -245,7 +264,7 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             raise ValueError("sorted_onehot requires the layout's own mode")
         S = layout.seg_width
         chunk = vmem_chunk(S, B, int(R), itemsize)
-        if pallas and fused_vmem_ok(factors, mode, S, B):
+        if pallas and fused_ok and fused_vmem_ok(factors, mode, S, B):
             parts = fused_mttkrp(layout, factors, mode, S,
                                  accumulate=False, interpret=interpret)
         elif pallas and chunk >= 1:

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from splatt_tpu.ops.mttkrp import _acc_dtype
+from splatt_tpu.ops.mttkrp import _acc_dtype, mxu_precision
 from splatt_tpu.utils.env import ceil_to
 
 # Max blocks per grid step; the actual chunk is sized against VMEM by
@@ -59,7 +59,7 @@ def vmem_chunk(width: int, block: int, rank: int,
 
 
 def _sorted_kernel(local_ref, prod_ref, out_ref, *, seg_width: int):
-    local = local_ref[...]                      # (C, B) int32
+    local = local_ref[:, 0, :]                  # (C, B) int32
     prod = prod_ref[...]                        # (C, B, R)
     C, B = local.shape
     iota = jax.lax.broadcasted_iota(jnp.int32, (C, seg_width, B), 1)
@@ -67,11 +67,12 @@ def _sorted_kernel(local_ref, prod_ref, out_ref, *, seg_width: int):
     out_ref[...] = jax.lax.dot_general(
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=out_ref.dtype)
+        preferred_element_type=out_ref.dtype,
+        precision=mxu_precision(prod.dtype))
 
 
 def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
-    local = local_ref[...]                      # (C, B) int32
+    local = local_ref[:, 0, :]                  # (C, B) int32
     prod = prod_ref[...]                        # (C, B, R)
     C, B = local.shape
     iota = jax.lax.broadcasted_iota(jnp.int32, (C, width, B), 1)
@@ -79,7 +80,8 @@ def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
     part = jax.lax.dot_general(
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=out_ref.dtype)   # (C, width, R)
+        preferred_element_type=out_ref.dtype,
+        precision=mxu_precision(prod.dtype))    # (C, width, R)
     acc = jnp.sum(part, axis=0)
 
     @pl.when(pl.program_id(0) == 0)
@@ -92,13 +94,16 @@ def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
 
 
 def _pad_blocks(local: jax.Array, prod: jax.Array, chunk: int):
+    """Pad to whole chunks; local gains a singleton middle dim so its
+    Mosaic block shape (chunk, 1, B) is legal for any chunk (the last
+    two block dims must divide (8, 128) or equal the array dims)."""
     nb = local.shape[0]
     nb_pad = ceil_to(max(nb, 1), chunk)
     if nb_pad != nb:
         local = jnp.pad(local, ((0, nb_pad - nb), (0, 0)),
                         constant_values=-1)
         prod = jnp.pad(prod, ((0, nb_pad - nb), (0, 0), (0, 0)))
-    return local, prod, nb_pad
+    return local[:, None, :], prod, nb_pad
 
 
 @functools.partial(jax.jit,
@@ -116,7 +121,7 @@ def onehot_reduce_sorted(local: jax.Array, prod: jax.Array, seg_width: int,
         functools.partial(_sorted_kernel, seg_width=seg_width),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, 1, B), lambda i: (i, 0, 0)),
             pl.BlockSpec((chunk, B, R), lambda i: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((chunk, seg_width, R), lambda i: (i, 0, 0)),
@@ -128,6 +133,38 @@ def onehot_reduce_sorted(local: jax.Array, prod: jax.Array, seg_width: int,
 
 
 # -- fused gather + Hadamard + reduce ---------------------------------------
+
+@functools.cache
+def fused_gather_supported() -> bool:
+    """Whether Mosaic can lower the fused kernel's in-VMEM row gather.
+
+    jax 0.9.0's Mosaic gather rule only lowers same-shaped
+    take_along_axis forms (tpu.dynamic_gather); an arbitrary
+    ``u[idx]`` row gather with len(idx) != dim raises at lowering.
+    Probe by *lowering* (not running) a tiny fused kernel once per
+    process — callers fall back to the unfused kernels / XLA scan.
+    """
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        import numpy as np
+
+        from splatt_tpu.blocked import build_layout
+        from splatt_tpu.coo import SparseTensor
+
+        rng = np.random.default_rng(0)
+        dims = (16, 24, 32)
+        inds = np.stack([rng.integers(0, d, 256) for d in dims])
+        tt = SparseTensor(inds=inds.astype(np.int64),
+                          vals=np.ones(256), dims=dims)
+        lay = build_layout(tt, 0, block=128, val_dtype=np.float32)
+        fac = [jnp.zeros((d, 8), jnp.float32) for d in dims]
+        fused_mttkrp.lower(lay, fac, mode=0, width=lay.seg_width,
+                           accumulate=False, interpret=False)
+        return True
+    except Exception:
+        return False
+
 
 def fused_vmem_ok(factors, mode: int, width: int, block: int,
                   budget_bytes: int = 12 << 20) -> bool:
@@ -151,14 +188,14 @@ def _fused_kernel(local_ref, vals_ref, ginds_ref, *refs,
                   width: int, accumulate: bool, nother: int):
     out_ref = refs[nother]
     u_refs = refs[:nother]
-    local = local_ref[...]                   # (C, B) int32
-    vals = vals_ref[...]                     # (C, B)
+    local = local_ref[:, 0, :]               # (C, B) int32
+    vals = vals_ref[:, 0, :]                 # (C, B)
     C, B = local.shape
     dtype = vals.dtype
     prod = vals[..., None]                   # (C, B, 1)
     for j in range(nother):
         u = u_refs[j][...]                   # (dim_j, R) resident in VMEM
-        idx = ginds_ref[j, :, :].reshape(C * B)
+        idx = ginds_ref[:, j, :].reshape(C * B)
         rows = jnp.take(u, idx, axis=0, mode="clip",
                         unique_indices=False, indices_are_sorted=False)
         prod = prod * rows.reshape(C, B, u.shape[1])
@@ -167,7 +204,8 @@ def _fused_kernel(local_ref, vals_ref, ginds_ref, *refs,
     part = jax.lax.dot_general(
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=out_ref.dtype)    # (C, width, R)
+        preferred_element_type=out_ref.dtype,
+        precision=mxu_precision(dtype))          # (C, width, R)
     if not accumulate:
         out_ref[...] = part
         return
@@ -210,14 +248,19 @@ def fused_mttkrp(layout, factors, mode: int, width: int,
     else:
         local = seg.reshape(nb, B) - layout.row_start[:, None]
     vals = layout.vals.reshape(nb, B).astype(dtype)
-    ginds = layout.inds[jnp.asarray(others)].reshape(len(others), nb, B)
+    # (nb, nother, B): blocks (chunk, nother, B) keep the last two dims
+    # equal to the array dims, legal for any chunk under Mosaic's rule.
+    ginds = (layout.inds[jnp.asarray(others)]
+             .reshape(len(others), nb, B).transpose(1, 0, 2))
 
     nb_pad = ceil_to(max(nb, 1), chunk)
     if nb_pad != nb:
         local = jnp.pad(local, ((0, nb_pad - nb), (0, 0)),
                         constant_values=-1)
         vals = jnp.pad(vals, ((0, nb_pad - nb), (0, 0)))
-        ginds = jnp.pad(ginds, ((0, 0), (0, nb_pad - nb), (0, 0)))
+        ginds = jnp.pad(ginds, ((0, nb_pad - nb), (0, 0), (0, 0)))
+    local = local[:, None, :]
+    vals = vals[:, None, :]
     grid = (nb_pad // chunk,)
 
     factor_specs = [
@@ -237,9 +280,9 @@ def fused_mttkrp(layout, factors, mode: int, width: int,
                           nother=len(others)),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
-            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
-            pl.BlockSpec((len(others), chunk, B), lambda i: (0, i, 0)),
+            pl.BlockSpec((chunk, 1, B), lambda i: (i, 0, 0)),
+            pl.BlockSpec((chunk, 1, B), lambda i: (i, 0, 0)),
+            pl.BlockSpec((chunk, len(others), B), lambda i: (i, 0, 0)),
             *factor_specs,
         ],
         out_specs=out_spec,
@@ -265,7 +308,7 @@ def onehot_reduce_full(local: jax.Array, prod: jax.Array, width: int,
         functools.partial(_full_kernel, width=width),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((chunk, B), lambda i: (i, 0)),
+            pl.BlockSpec((chunk, 1, B), lambda i: (i, 0, 0)),
             pl.BlockSpec((chunk, B, R), lambda i: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((width, R), lambda i: (0, 0)),

@@ -60,7 +60,7 @@ try:
 
     STAGE[0] = "memstats"
     try:
-        ms = d.memory_stats()
+        ms = d.memory_stats() or {}
         info["hbm_limit_gb"] = round(ms.get("bytes_limit", 0) / 2**30, 2)
     except Exception as e:  # pragma: no cover
         info["memstats_error"] = str(e)
@@ -97,9 +97,14 @@ try:
         STAGE[0] = "pallas_fused"
         # fused kernel (gather+Hadamard+reduce in VMEM) — the round-2
         # flagship; exercises in-kernel jnp.take lowering on Mosaic
+        import importlib
+
         from splatt_tpu.blocked import build_layout
         from splatt_tpu.coo import SparseTensor
-        from splatt_tpu.ops import mttkrp as mk
+
+        # `from splatt_tpu.ops import mttkrp` resolves to the *function*
+        # re-exported by ops/__init__, not the module — load the module.
+        mk = importlib.import_module("splatt_tpu.ops.mttkrp")
 
         dims = (96, 80, 112)
         nz = 4096
@@ -109,6 +114,11 @@ try:
         fac = [jnp.asarray(rng.standard_normal((d, 32)).astype(np.float32))
                for d in dims]
         lay = build_layout(tt, 0, block=512, val_dtype=np.float32)
+        from splatt_tpu.ops.pallas_kernels import fused_gather_supported
+
+        # Record whether the fused kernel itself can lower on this jax/
+        # Mosaic, or whether dispatch fell back to the unfused kernels.
+        info["fused_gather_supported"] = fused_gather_supported()
         got = mk.mttkrp_blocked(lay, fac, 0, path="sorted_onehot",
                                 impl="pallas")
         got.block_until_ready()
