@@ -121,18 +121,11 @@ def cmd_cpd(args) -> int:
     print(f"Final fit: {float(out.fit):0.5f}")
     if bs is not None and opts.verbosity >= Verbosity.HIGH:
         # per-mode MTTKRP profile (≙ the per-mode times of `cpd -v -v`,
-        # src/cpd.c:361-366 — measured post-hoc since the jitted sweep
-        # fuses all modes)
-        import time as _time
-
-        from splatt_tpu.ops.mttkrp import mttkrp
-
-        print("Per-mode MTTKRP times:")
+        # src/cpd.c:361-366) — at HIGH verbosity cpd_als runs the
+        # split-jit profiled sweep, so these are true in-loop totals
+        print("Per-mode MTTKRP time (in-loop totals):")
         for m in range(bs.nmodes):
-            jax.block_until_ready(mttkrp(bs, out.factors, m))  # compile
-            t0 = _time.perf_counter()
-            jax.block_until_ready(mttkrp(bs, out.factors, m))
-            print(f"  mode {m}: {_time.perf_counter() - t0:0.5f}s")
+            print(f"  mode {m}: {timers[f'mttkrp_mode{m}']:0.3f}s")
     if not args.nowrite:
         # ≙ the reference's -s file-stem semantics (cmd_cpd.c:209-230):
         # a bare stem writes <stem>.mode<N>.mat / <stem>.lambda.mat (the

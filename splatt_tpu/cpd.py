@@ -50,9 +50,8 @@ def init_factors(dims: Tuple[int, ...], rank: int, seed: int,
     return out
 
 
-def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
-                reg: float) -> Callable:
-    """Build the jitted one-sweep function for this tensor."""
+def _mttkrp_closure(X: Union[SparseTensor, BlockedSparse]) -> Callable:
+    """The per-tensor MTTKRP callable both sweep builders share."""
     if isinstance(X, SparseTensor):
         inds = jnp.asarray(X.inds)
         vals = jnp.asarray(X.vals)
@@ -63,6 +62,23 @@ def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     else:
         def do_mttkrp(factors, m):
             return mttkrp(X, factors, m)
+    return do_mttkrp
+
+
+def _zz_inner(lam, grams, M, U_last):
+    """⟨Z,Z⟩ = λᵀ(⊛ Grams)λ and ⟨X,Z⟩ from the last-mode MTTKRP result
+    (p_kruskal_norm / p_tt_kruskal_inner, src/cpd.c:116-218) — shared by
+    both sweep builders."""
+    had = jnp.outer(lam, lam)
+    for g in grams:
+        had = had * g
+    return jnp.sum(had), jnp.sum(M * U_last * lam[None, :])
+
+
+def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
+                reg: float) -> Callable:
+    """Build the jitted one-sweep function for this tensor."""
+    do_mttkrp = _mttkrp_closure(X)
 
     @partial(jax.jit, static_argnames=("first",))
     def sweep(factors, grams, first: bool):
@@ -78,13 +94,63 @@ def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
             # storage dtype; MTTKRP/Gram/solve accumulated in f32 above
             factors[m] = U.astype(factor_dtype)
             grams[m] = gram(factors[m])
-        # ⟨Z,Z⟩ = λᵀ(⊛ Grams)λ
-        had = jnp.outer(lam, lam)
-        for g in grams:
-            had = had * g
-        znormsq = jnp.sum(had)
-        # ⟨X,Z⟩ from the last mode's MTTKRP result
-        inner = jnp.sum(M * factors[nmodes - 1] * lam[None, :])
+        znormsq, inner = _zz_inner(lam, grams, M, factors[nmodes - 1])
+        return factors, grams, lam, znormsq, inner
+
+    return sweep
+
+
+def _make_profiled_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
+                         reg: float) -> Callable:
+    """Split-jit sweep for `-v -v`: each ALS phase is its own jitted
+    call bracketed by blocking timers, so mttkrp/solve/normalize/gram/
+    fit wall-clock is attributed truthfully (≙ the reference bracketing
+    TIMER_MTTKRP / TIMER_INV / TIMER_FIT around each call,
+    src/cpd.c:318-352).  Costs cross-phase fusion — use the fused
+    :func:`_make_sweep` when not profiling.
+    """
+    do_mttkrp = _mttkrp_closure(X)
+
+    @partial(jax.jit, static_argnames=("m",))
+    def solve_phase(grams, M, m: int):
+        return solve_normals(form_normal_lhs(grams, m, reg), M)
+
+    @partial(jax.jit, static_argnames=("first",))
+    def normalize_phase(U, first: bool):
+        return normalize_columns(U, "2" if first else "max")
+
+    gram_phase = jax.jit(gram)
+    fit_phase = jax.jit(_zz_inner)
+
+    def sync(x):
+        """Force true completion: block_until_ready plus a one-element
+        host fetch — tunneled/relayed devices can ack block_until_ready
+        before execution finishes, which would time dispatch only."""
+        leaf = jax.tree_util.tree_leaves(x)[0]
+        jax.block_until_ready(x)
+        jax.device_get(leaf.ravel()[0])
+        return x
+
+    def sweep(factors, grams, first: bool):
+        lam = None
+        M = None
+        for m in range(nmodes):
+            factor_dtype = factors[m].dtype
+            # per-mode timers at level 3: the CLI prints them in its own
+            # per-mode block, keeping them out of the level-2 report
+            timers.get(f"mttkrp_mode{m}", level=3)
+            with timers.time("mttkrp"), timers.time(f"mttkrp_mode{m}"):
+                M = sync(do_mttkrp(factors, m))
+            with timers.time("solve"):
+                U = sync(solve_phase(grams, M, m))
+            with timers.time("normalize"):
+                U, lam = sync(normalize_phase(U, first))
+            factors[m] = U.astype(factor_dtype)
+            with timers.time("gram"):
+                grams[m] = sync(gram_phase(factors[m]))
+        with timers.time("fit"):
+            znormsq, inner = sync(
+                fit_phase(lam, grams, M, factors[nmodes - 1]))
         return factors, grams, lam, znormsq, inner
 
     return sweep
@@ -168,7 +234,19 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         factors = init_factors(dims, rank, opts.seed(), dtype=dtype)
     grams = [gram(U) for U in factors]
 
-    sweep = _make_sweep(X, nmodes, opts.regularization)
+    # -v -v: split-jit profiled sweep with real per-phase attribution
+    profiled = opts.verbosity >= Verbosity.HIGH
+    sweep = (_make_profiled_sweep if profiled
+             else _make_sweep)(X, nmodes, opts.regularization)
+    if profiled:
+        # warm both specializations of every split-jit phase on copies,
+        # then zero the phase timers: the report shows steady-state
+        # kernel cost, not trace+compile time
+        for first in (True, False):
+            sweep(list(factors), list(grams), first)
+        for name in ("mttkrp", "solve", "normalize", "gram", "fit",
+                     *(f"mttkrp_mode{m}" for m in range(nmodes))):
+            timers.get(name).reset()
 
     # resuming past max_iterations runs zero sweeps — the checkpointed
     # λ/fit must survive as the result

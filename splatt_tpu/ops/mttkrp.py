@@ -210,8 +210,7 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
       else the unfused kernel on a precomputed prod;
     - "pallas_interpret": kernel semantics on CPU, for tests.
     """
-    from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
-                                               fused_mttkrp, fused_vmem_ok,
+    from splatt_tpu.ops.pallas_kernels import (fused_mttkrp, fused_mttkrp_t,
                                                onehot_reduce_full,
                                                onehot_reduce_sorted,
                                                vmem_chunk)
@@ -219,7 +218,6 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     dim = int(factors[mode].shape[0])
     R = factors[mode].shape[1]
     seg = layout.inds[mode]
-    pallas = impl in ("pallas", "pallas_interpret")
     interpret = impl == "pallas_interpret"
 
     if path in ("scatter", "sorted_scatter"):
@@ -239,23 +237,27 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     nb, B = layout.nblocks, layout.block
     itemsize = jnp.dtype(factors[0].dtype).itemsize
 
-    fused_ok = pallas and (interpret or fused_gather_supported())
+    # single source of dispatch truth, shared with benches/tests
+    plan = engine_plan(layout, factors, mode, path, impl)
 
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
-        if pallas:
-            if fused_ok and fused_vmem_ok(factors, mode, width, B):
-                return fused_mttkrp(layout, factors, mode, width,
-                                    accumulate=True,
-                                    interpret=interpret)[:dim]
-            chunk = vmem_chunk(width, B, int(R), itemsize)
-            if chunk >= 1:
-                prod = _gather_prod(layout.inds, layout.vals, factors,
-                                    mode).reshape(nb, B, R)
-                local = seg.reshape(nb, B)
-                return onehot_reduce_full(local, prod, width,
-                                          interpret=interpret,
-                                          chunk=chunk)[:dim]
+        if plan == "fused_t":
+            return fused_mttkrp_t(layout, factors, mode, width,
+                                  accumulate=True,
+                                  interpret=interpret)[:dim]
+        if plan == "fused":
+            return fused_mttkrp(layout, factors, mode, width,
+                                accumulate=True,
+                                interpret=interpret)[:dim]
+        if plan == "unfused_pallas":
+            prod = _gather_prod(layout.inds, layout.vals, factors,
+                                mode).reshape(nb, B, R)
+            local = seg.reshape(nb, B)
+            return onehot_reduce_full(local, prod, width,
+                                      interpret=interpret,
+                                      chunk=vmem_chunk(width, B, int(R),
+                                                       itemsize))[:dim]
         return _scan_fused(layout, factors, mode, width,
                            accumulate=True)[:dim]
 
@@ -263,16 +265,20 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
         if mode != layout.mode:
             raise ValueError("sorted_onehot requires the layout's own mode")
         S = layout.seg_width
-        chunk = vmem_chunk(S, B, int(R), itemsize)
-        if pallas and fused_ok and fused_vmem_ok(factors, mode, S, B):
+        if plan == "fused_t":
+            parts = fused_mttkrp_t(layout, factors, mode, S,
+                                   accumulate=False, interpret=interpret)
+        elif plan == "fused":
             parts = fused_mttkrp(layout, factors, mode, S,
                                  accumulate=False, interpret=interpret)
-        elif pallas and chunk >= 1:
+        elif plan == "unfused_pallas":
             prod = _gather_prod(layout.inds, layout.vals, factors,
                                 mode).reshape(nb, B, R)
             local = seg.reshape(nb, B) - layout.row_start[:, None]
             parts = onehot_reduce_sorted(local, prod, S,
-                                         interpret=interpret, chunk=chunk)
+                                         interpret=interpret,
+                                         chunk=vmem_chunk(S, B, int(R),
+                                                          itemsize))
         else:
             parts = _scan_fused(layout, factors, mode, S,
                                 accumulate=False)    # (nb, S, R)
@@ -282,6 +288,41 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
         return out[:dim]
 
     raise ValueError(f"unknown path {path!r}")
+
+
+def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
+                path: str = "sorted_onehot", impl: str = "xla") -> str:
+    """Which engine :func:`mttkrp_blocked` will actually run for this
+    call — "fused_t", "fused", "unfused_pallas", or "xla_scan"/"xla".
+    Dispatch falls back silently (VMEM gates, Mosaic capability), so
+    benches and tests use this to label results truthfully.
+    """
+    from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
+                                               fused_t_supported,
+                                               fused_t_vmem_ok,
+                                               fused_vmem_ok, vmem_chunk)
+
+    dim = int(factors[mode].shape[0])
+    R = int(factors[0].shape[1])
+    B = layout.block
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
+    pallas = impl in ("pallas", "pallas_interpret")
+    interpret = impl == "pallas_interpret"
+    if path in ("scatter", "sorted_scatter", "stream"):
+        return "xla"
+    if path == "privatized":
+        width = -(-(dim + 1) // 8) * 8
+    else:
+        width = layout.seg_width
+    fused_t_ok = pallas and (interpret or fused_t_supported())
+    fused_ok = pallas and (interpret or fused_gather_supported())
+    if fused_t_ok and fused_t_vmem_ok(factors, mode, width, B):
+        return "fused_t"
+    if fused_ok and fused_vmem_ok(factors, mode, width, B):
+        return "fused"
+    if pallas and vmem_chunk(width, B, R, itemsize) >= 1:
+        return "unfused_pallas"
+    return "xla_scan"
 
 
 def _onehot_pays(opts: Options) -> bool:

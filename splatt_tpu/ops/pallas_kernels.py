@@ -37,18 +37,49 @@ from splatt_tpu.utils.env import ceil_to
 # vmem_chunk() below.
 _CHUNK = 8
 
+# v5e VMEM is 128MiB (measured: a 120MB-working-set kernel compiles once
+# the limit is raised; Mosaic's *default* scoped limit is ~16MB and
+# rejects anything bigger).  v2/v3 cores have 16MiB — budgets derive
+# from the device generation so dispatch gates stay truthful there.
+_VMEM_BY_KIND = {"TPU v2": 16 << 20, "TPU v3": 16 << 20}
+
+
+@functools.cache
+def _vmem_limit() -> int:
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = ""
+    for prefix, size in _VMEM_BY_KIND.items():
+        if kind.startswith(prefix):
+            return size - (2 << 20)
+    return 100 << 20
+
+
+def _vmem_budget() -> int:
+    return (_vmem_limit() * 24) // 25
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(vmem_limit_bytes=_vmem_limit())
+
 
 def vmem_chunk(width: int, block: int, rank: int,
-               itemsize: int = 4, budget_bytes: int = 8 << 20,
+               itemsize: int = 4, budget_bytes: int = None,
                out_itemsize: int = None) -> int:
     """Blocks per grid step such that the kernel's working set —
     one-hot (C,width,block) + prod (C,block,rank) + out (C,width,rank) —
-    fits the VMEM budget (half of the ~16MB scratchpad, leaving room
-    for double buffering).  The out term is costed at the accumulator
+    fits the VMEM budget (_vmem_budget()//2, against the measured 128MiB
+    v5e VMEM and the raised _VMEM_LIMIT compiler cap, leaving room for
+    double buffering).  The out term is costed at the accumulator
     width (f32 even for bf16 inputs).  Returns 0 when even one block
     does not fit: callers must fall back to the XLA engine, which
     streams the one-hot through HBM instead.
     """
+    if budget_bytes is None:
+        budget_bytes = _vmem_budget() // 2
     if out_itemsize is None:
         out_itemsize = max(itemsize, 4)
     per_block = ((width * block + block * rank) * itemsize
@@ -128,22 +159,210 @@ def onehot_reduce_sorted(local: jax.Array, prod: jax.Array, seg_width: int,
         out_shape=jax.ShapeDtypeStruct((nb_pad, seg_width, R),
                                        _acc_dtype(prod.dtype)),
         interpret=interpret,
+        compiler_params=_compiler_params(),
     )(local, prod)
     return out[:nb]
 
 
-# -- fused gather + Hadamard + reduce ---------------------------------------
+# -- fused gather + Hadamard + reduce (transposed tables) -------------------
+#
+# The flagship kernel.  HBM traffic per MTTKRP is inds + vals + block
+# partials — the factor tables are VMEM-resident for the whole sweep, so
+# the (nnz, R) partial-product tensor of the unfused paths (3.7GB logical,
+# 9.5GB after XLA's R→128 lane padding at NELL-2 scale — an HBM OOM)
+# never exists anywhere.  ≙ the reference's register-blocked fiber loops
+# reading factor rows in-cache (src/mttkrp.c:427-463).
+#
+# Two Mosaic constraints shape the design (jax 0.9.0):
+# - only *same-shaped* take_along_axis gathers lower (tpu.dynamic_gather);
+#   an arbitrary B-row gather from a (D, R) table must be phrased as
+#   lane-wise take_along_axis on a *transposed* (R, D) table with the
+#   request vector padded to D — so per-block gather cost scales with
+#   max(B, D), and callers pick block ≈ max other-mode dim to amortize;
+# - a (D, R) f32 table in VMEM pads R→128 lanes (14.7MB for NELL-2's
+#   28818×50), while the transposed (R, D) form pads R→56 sublanes
+#   (6.5MB): transposed tables are what make rank-50 f32 fit at all.
+# Gathers run in 8-sublane tiles so temporaries stay ≤ (8, D).
 
-@functools.cache
-def fused_gather_supported() -> bool:
-    """Whether Mosaic can lower the fused kernel's in-VMEM row gather.
+_SUBLANE = 8
 
-    jax 0.9.0's Mosaic gather rule only lowers same-shaped
-    take_along_axis forms (tpu.dynamic_gather); an arbitrary
-    ``u[idx]`` row gather with len(idx) != dim raises at lowering.
-    Probe by *lowering* (not running) a tiny fused kernel once per
-    process — callers fall back to the unfused kernels / XLA scan.
+
+def _tile_gather(u_t, gidx, B: int):
+    """rows_t = u_t[:, idx] inside a Mosaic kernel, layout-safely.
+
+    u_t: (R8, D) transposed factor table (VMEM-resident), R8 a multiple
+    of 8, D of 128.  gidx: (ck, 8, D) int32 — the request vector
+    pre-chunked into ck lane-aligned groups of D and replicated across
+    8 sublanes *outside* the kernel.  Mosaic's layout inference rejects
+    broadcasts/slices whose input carries a nonzero lane offset, so the
+    kernel must only read whole aligned tiles: each take_along_axis here
+    is the exact same-shaped (8, D) form tpu.dynamic_gather supports,
+    and the only slice taken is [:, :B] at offset 0.
     """
+    R8, D = u_t.shape
+    ck = gidx.shape[0]
+    pieces = []
+    for c in range(ck):
+        idx8 = gidx[c]                       # (8, D), aligned tile
+        tiles = [jnp.take_along_axis(u_t[r0:r0 + _SUBLANE, :], idx8, axis=1)
+                 for r0 in range(0, R8, _SUBLANE)]
+        pieces.append(tiles[0] if len(tiles) == 1
+                      else jnp.concatenate(tiles, axis=0))   # (R8, D)
+    rows = pieces[0] if ck == 1 else jnp.concatenate(pieces, axis=1)
+    return rows[:, :B]
+
+
+def _fused_t_kernel(local_ref, vals_ref, *refs,
+                    width: int, accumulate: bool, nother: int):
+    gidx_refs = refs[:nother]
+    ut_refs = refs[nother:2 * nother]
+    out_ref = refs[2 * nother]
+    local = local_ref[0, :, :]               # (1, B) int32
+    vals = vals_ref[0, :, :]                 # (1, B)
+    B = local.shape[1]
+    dtype = vals.dtype
+    acc = out_ref.dtype
+    prod = vals                              # (1, B), broadcasts up
+    for j in range(nother):
+        u_t = ut_refs[j][...]                # (R8, D_j) resident in VMEM
+        rows_t = _tile_gather(u_t, gidx_refs[j][0], B)     # (R8, B)
+        prod = prod * rows_t
+    iota = jax.lax.broadcasted_iota(jnp.int32, (width, B), 0)
+    onehot = (jnp.broadcast_to(local, (width, B)) == iota).astype(dtype)
+    # (R8, B) · (S, B)ᵀ on the MXU → (R8, S) transposed block partials
+    part = jax.lax.dot_general(
+        prod, onehot,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=acc,
+        precision=mxu_precision(dtype))
+    if not accumulate:
+        out_ref[...] = part[None]
+        return
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = part
+
+    @pl.when(pl.program_id(0) != 0)
+    def _accum():
+        out_ref[...] += part
+
+
+def fused_t_vmem_ok(factors, mode: int, width: int, block: int,
+                    budget_bytes: int = None) -> bool:
+    """VMEM plan of the transposed-table fused kernel: every input
+    factor resident as (R8, D) (R padded to 8 sublanes, D to 128
+    lanes), plus per-step working set — the pre-replicated (ck, 8, D)
+    index tiles, gathered rows and the accumulating (R8, B) product,
+    the (S, B) one-hot, streams and partials.
+    """
+    if budget_bytes is None:
+        budget_bytes = _vmem_budget()
+    R = int(factors[0].shape[1])
+    r8 = ceil_to(R, _SUBLANE)
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
+    b_pad = ceil_to(block, 128)
+    fac = 0
+    work = 0
+    for k, f in enumerate(factors):
+        if k != mode:
+            d = ceil_to(int(f.shape[0]), 128)
+            ck = -(-b_pad // d)
+            fac += r8 * d * itemsize                  # resident table
+            work += ck * _SUBLANE * d * 4             # replicated idx tiles
+            work += r8 * ck * d * itemsize            # gathered rows
+    work += (r8 * b_pad * itemsize                    # accumulating product
+             + ceil_to(width, _SUBLANE) * b_pad * itemsize   # one-hot
+             + r8 * ceil_to(width, 128) * 4                  # partials
+             + 2 * b_pad * 4)                                # local + vals
+    return fac + work <= budget_bytes
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "width", "accumulate",
+                                             "interpret"))
+def fused_mttkrp_t(layout, factors, mode: int, width: int,
+                   accumulate: bool, interpret: bool = False) -> jax.Array:
+    """Fused MTTKRP with VMEM-resident transposed factor tables.
+
+    Output: (nb, width, R) block partials (sorted layouts), or
+    (width, R) totals when `accumulate` (privatized short modes) —
+    same contract as :func:`fused_mttkrp`.
+    """
+    nmodes = layout.nmodes
+    nb, B = layout.nblocks, layout.block
+    R = int(factors[0].shape[1])
+    R8 = ceil_to(R, _SUBLANE)
+    dtype = factors[0].dtype
+    others = [k for k in range(nmodes) if k != mode]
+
+    seg = layout.inds[mode]
+    if accumulate:
+        local = seg.reshape(nb, B)
+    else:
+        local = seg.reshape(nb, B) - layout.row_start[:, None]
+    vals = layout.vals.reshape(nb, B).astype(dtype)
+    local = local[:, None, :]
+    vals = vals[:, None, :]
+    grid = (nb,)
+
+    # per-factor: (R8, D128) transposed tables + (nb, ck, 8, D128)
+    # pre-chunked/replicated request tiles (see _tile_gather)
+    uts = []
+    gidxs = []
+    ut_specs = []
+    gidx_specs = []
+    for k in others:
+        d = int(factors[k].shape[0])
+        d_pad = ceil_to(d, 128)
+        u_t = factors[k].T
+        u_t = jnp.pad(u_t, ((0, R8 - R), (0, d_pad - d)))
+        uts.append(u_t)
+        ut_specs.append(pl.BlockSpec((R8, d_pad), lambda i: (0, 0)))
+        ck = -(-B // d_pad)
+        idx = jnp.minimum(layout.inds[k], d - 1).reshape(nb, B)
+        if ck * d_pad != B:
+            idx = jnp.pad(idx, ((0, 0), (0, ck * d_pad - B)))
+        gidx = jnp.broadcast_to(idx.reshape(nb, ck, 1, d_pad),
+                                (nb, ck, _SUBLANE, d_pad))
+        gidxs.append(gidx)
+        gidx_specs.append(pl.BlockSpec((1, ck, _SUBLANE, d_pad),
+                                       lambda i: (i, 0, 0, 0)))
+
+    acc = _acc_dtype(dtype)
+    if accumulate:
+        out_spec = pl.BlockSpec((R8, width), lambda i: (0, 0))
+        out_shape = jax.ShapeDtypeStruct((R8, width), acc)
+    else:
+        out_spec = pl.BlockSpec((1, R8, width), lambda i: (i, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((nb, R8, width), acc)
+
+    out = pl.pallas_call(
+        functools.partial(_fused_t_kernel, width=width,
+                          accumulate=accumulate, nother=len(others)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, B), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, B), lambda i: (i, 0, 0)),
+            *gidx_specs,
+            *ut_specs,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(local, vals, *gidxs, *uts)
+    # back to the (…, width, R) contract of the untransposed kernels
+    if accumulate:
+        return out.T[:, :R]
+    return jnp.swapaxes(out, 1, 2)[:, :, :R]
+
+
+def _probe_compiles(kernel_fn) -> bool:
+    """Whether `kernel_fn(layout, factors, mode, width, accumulate,
+    interpret)` COMPILES for this backend on a tiny problem.  Lowering
+    alone is not enough: Mosaic layout inference (e.g. the "Invalid
+    input layout" broadcast restriction) only runs at compile time, so
+    a lowering-only probe reports false positives."""
     if jax.default_backend() != "tpu":
         return False
     try:
@@ -159,20 +378,40 @@ def fused_gather_supported() -> bool:
                           vals=np.ones(256), dims=dims)
         lay = build_layout(tt, 0, block=128, val_dtype=np.float32)
         fac = [jnp.zeros((d, 8), jnp.float32) for d in dims]
-        fused_mttkrp.lower(lay, fac, mode=0, width=lay.seg_width,
-                           accumulate=False, interpret=False)
+        kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
+                        accumulate=False, interpret=False).compile()
         return True
     except Exception:
         return False
 
 
+@functools.cache
+def fused_t_supported() -> bool:
+    """Whether the transposed-table fused kernel compiles here (its
+    lane-wise same-shape take_along_axis gather is the form Mosaic
+    supports on jax 0.9.0)."""
+    return _probe_compiles(fused_mttkrp_t)
+
+
+@functools.cache
+def fused_gather_supported() -> bool:
+    """Whether the row-major fused kernel compiles here.  Its arbitrary
+    ``u[idx]`` row gather is NOT a form jax 0.9.0's Mosaic lowers (only
+    same-shaped take_along_axis is), so this is False on current
+    hardware — kept for future jax versions; interpret mode covers it
+    in tests."""
+    return _probe_compiles(fused_mttkrp)
+
+
 def fused_vmem_ok(factors, mode: int, width: int, block: int,
-                  budget_bytes: int = 12 << 20) -> bool:
+                  budget_bytes: int = None) -> bool:
     """Whether the fused kernel's VMEM plan fits: every *input* factor
     resident in VMEM for the whole grid, plus the per-step working set
-    (gathered rows ×2, one-hot, partials).  The ~16MB/core scratchpad
-    keeps ~4MB back for double-buffered block streams.
+    (gathered rows ×2, one-hot, partials), against _vmem_budget() (the
+    measured 128MiB v5e VMEM minus double-buffering headroom).
     """
+    if budget_bytes is None:
+        budget_bytes = _vmem_budget()
     R = int(factors[0].shape[1])
     itemsize = jnp.dtype(factors[0].dtype).itemsize
     fac = sum(int(f.shape[0]) * R * itemsize
@@ -288,6 +527,7 @@ def fused_mttkrp(layout, factors, mode: int, width: int,
         out_specs=out_spec,
         out_shape=out_shape,
         interpret=interpret,
+        compiler_params=_compiler_params(),
     )(local, vals, ginds, *[factors[k] for k in others])
     if accumulate:
         return out
@@ -314,5 +554,6 @@ def onehot_reduce_full(local: jax.Array, prod: jax.Array, width: int,
         out_specs=pl.BlockSpec((width, R), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((width, R), _acc_dtype(prod.dtype)),
         interpret=interpret,
+        compiler_params=_compiler_params(),
     )(local, prod)
     return out

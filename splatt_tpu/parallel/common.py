@@ -59,6 +59,86 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
             C, counts)
 
 
+def is_memmapped(arr) -> bool:
+    """Whether an array is (a view of) an np.memmap — SparseTensor's
+    ascontiguousarray normalization strips the subclass but keeps the
+    mmap-backed buffer as .base."""
+    while arr is not None:
+        if isinstance(arr, np.memmap):
+            return True
+        arr = getattr(arr, "base", None)
+    return False
+
+
+def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
+                            chunk: int = 1 << 22, out_dir: str = None,
+                            postprocess=None, counts: np.ndarray = None
+                            ) -> Tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+    """:func:`bucket_scatter` in two chunked passes over (possibly
+    memmapped) nonzeros, with optionally memmap-backed outputs — host
+    RSS stays O(chunk + bucket metadata) no matter the tensor size
+    (≙ the reference streaming equal-nnz chunks from the root rank,
+    mpi_simple_distribute, src/mpi/mpi_io.c:587-648).
+
+    `owner_fn(inds_chunk) -> (n,) bucket ids` is evaluated per chunk
+    (twice — recomputing beats materializing an O(nnz) owner array).
+    `postprocess(binds_chunk) -> binds_chunk`, if given, is applied to
+    each chunk's indices before placement (e.g. cell-localization).
+    With `out_dir`, the bucketed arrays are numpy memmaps under it —
+    device_put streams from disk and the arrays never sit in RAM.
+    `counts`, when the caller already computed per-bucket occupancies
+    (grid builds do, deciding fence balancing), skips the counting pass
+    — one full read of the tensor saved.
+    """
+    import os
+
+    nmodes, nnz = inds.shape
+    if counts is None:
+        counts = np.zeros(nbuckets, dtype=np.int64)
+        for s in range(0, nnz, chunk):
+            e = min(nnz, s + chunk)
+            own = np.asarray(owner_fn(np.asarray(inds[:, s:e])),
+                             dtype=np.int64)
+            if own.min(initial=0) < 0 or own.max(initial=0) >= nbuckets:
+                raise ValueError(f"owner ids must lie in [0, {nbuckets})")
+            counts += np.bincount(own, minlength=nbuckets)
+    C = max(int(counts.max()), 1)
+
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+        # mode="w+" creates zero-filled (sparse) files — no explicit
+        # zeroing pass over the multi-GB outputs needed
+        binds = np.lib.format.open_memmap(
+            os.path.join(out_dir, "binds.npy"), mode="w+",
+            dtype=np.int32, shape=(nmodes, nbuckets, C))
+        bvals = np.lib.format.open_memmap(
+            os.path.join(out_dir, "bvals.npy"), mode="w+",
+            dtype=np.dtype(val_dtype), shape=(nbuckets, C))
+    else:
+        binds = np.zeros((nmodes, nbuckets, C), dtype=np.int32)
+        bvals = np.zeros((nbuckets, C), dtype=val_dtype)
+
+    cursor = np.zeros(nbuckets, dtype=np.int64)
+    for s in range(0, nnz, chunk):
+        e = min(nnz, s + chunk)
+        ichunk = np.asarray(inds[:, s:e])
+        own = np.asarray(owner_fn(ichunk), dtype=np.int64)
+        order = np.argsort(own, kind="stable")
+        own_s = own[order]
+        ccounts = np.bincount(own_s, minlength=nbuckets)
+        # slot of each (sorted) nonzero inside its bucket
+        offs = np.zeros(nbuckets + 1, dtype=np.int64)
+        np.cumsum(ccounts, out=offs[1:])
+        slot = cursor[own_s] + (np.arange(own_s.size) - offs[own_s])
+        placed = ichunk[:, order].astype(np.int32)
+        if postprocess is not None:
+            placed = postprocess(placed)
+        binds[:, own_s, slot] = placed
+        bvals[own_s, slot] = np.asarray(vals[s:e])[order]
+        cursor += ccounts
+    return binds, bvals, C, counts
+
+
 def balanced_relabel(hist: np.ndarray, nparts: int, cap: int) -> np.ndarray:
     """nnz-balanced row→label map for equal-width fences.
 
@@ -99,6 +179,69 @@ def balanced_relabel(hist: np.ndarray, nparts: int, cap: int) -> np.ndarray:
     relabel = np.empty(dim, dtype=np.int64)
     relabel[by_part] = part_sorted * cap + slot
     return relabel
+
+
+def imbalance_report(counts: np.ndarray, label: str = "device") -> str:
+    """nnz-per-worker balance line (≙ thd_time_stats imbalance,
+    src/thd_info.c, and mpi_rank_stats, src/stats.c:298-457).
+
+    Under SPMD every device executes identical padded shapes, so load
+    imbalance does not appear as time skew the way it does across MPI
+    ranks — it appears as wasted padded work.  max/avg is exactly that
+    waste factor (1.0 = perfectly balanced).
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    if counts.size == 0 or counts.sum() == 0:
+        return f"  {label} nnz: (empty)"
+    avg = counts.mean()
+    imb = counts.max() / avg if avg > 0 else 1.0
+    return (f"  {label} nnz: min={int(counts.min())} avg={avg:.1f} "
+            f"max={int(counts.max())} imbalance={imb:.2f}")
+
+
+def comm_volume_report(dims_pad: Sequence[int], rank: int, itemsize: int,
+                       *, ndev: int = None, grid: Sequence[int] = None,
+                       acc_itemsize: int = 4) -> list:
+    """Per-iteration per-device logical collective volume
+    (≙ mpi_send_recv_stats, src/splatt_mpi.h:453-463).
+
+    Volumes are the ring-algorithm lower bounds XLA's collectives
+    achieve on ICI: all_gather/psum_scatter of an (n, R) array move
+    ~(w-1)/w · n·R·itemsize bytes per device over a w-wide axis; a psum
+    (allreduce) moves ~2x that.  Gram/λ allreduces are R²-sized noise
+    but reported for parity with the reference's stats.
+    """
+    nmodes = len(dims_pad)
+    lines = []
+    gather = scatter = allred = 0.0
+    if grid is not None:
+        # medium grid: per mode, one psum of the (block_rows, R) layer
+        # block over the other axes + Gram/λ allreduce over axis m
+        for m in range(nmodes):
+            layer = int(np.prod([g for k, g in enumerate(grid) if k != m]))
+            block = dims_pad[m] // max(grid[m], 1)
+            if layer > 1:
+                allred += 2.0 * (layer - 1) / layer * block * rank * acc_itemsize
+            allred += 2.0 * rank * rank * acc_itemsize  # gram psum
+    else:
+        # 1-D nnz sharding: per mode, all_gather every input factor and
+        # psum_scatter the output (the two row-exchange phases)
+        w = max(int(ndev), 1)
+        for m in range(nmodes):
+            for k in range(nmodes):
+                if k != m:
+                    gather += (w - 1) / w * dims_pad[k] * rank * itemsize
+            scatter += (w - 1) / w * dims_pad[m] * rank * acc_itemsize
+            allred += 2.0 * rank * rank * acc_itemsize
+    mb = 1.0 / (1 << 20)
+    if gather or scatter:
+        lines.append(f"  comm/iter/device: all_gather {gather * mb:.2f}MB  "
+                     f"psum_scatter {scatter * mb:.2f}MB  "
+                     f"allreduce {allred * mb:.2f}MB")
+    else:
+        lines.append(f"  comm/iter/device: layer psum + gram allreduce "
+                     f"{allred * mb:.2f}MB")
+    return lines
 
 
 def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,

@@ -44,14 +44,16 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from splatt_tpu.config import Options, default_opts, resolve_dtype
+from splatt_tpu.config import Options, Verbosity, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
 from splatt_tpu.parallel.common import (balanced_relabel, bucket_scatter,
-                                        fit_tail, mode_update_tail,
-                                        run_distributed_als)
+                                        comm_volume_report, fit_tail,
+                                        imbalance_report, mode_update_tail,
+                                        run_distributed_als,
+                                        streamed_bucket_scatter)
 from splatt_tpu.parallel.mesh import auto_grid
 from splatt_tpu.utils.env import ceil_to
 
@@ -90,7 +92,10 @@ class GridDecomp:
     def build(tt: SparseTensor, grid: Optional[Tuple[int, ...]] = None,
               n_devices: Optional[int] = None,
               val_dtype=np.float32,
-              balance: Optional[bool] = False) -> "GridDecomp":
+              balance: Optional[bool] = False,
+              streamed: Optional[bool] = None,
+              out_dir: Optional[str] = None,
+              chunk: int = 1 << 22) -> "GridDecomp":
         """≙ mpi_tt_read's rearrange-to-owners (p_rearrange_medium,
         src/mpi/mpi_io.c:451-473) done as a host-side bucketing.
 
@@ -107,6 +112,13 @@ class GridDecomp:
         through :meth:`shard_factors` must restore order with
         :meth:`row_select` when gathering.  grid_cpd_als does and
         enables auto mode; direct build() users opt in explicitly.
+
+        `streamed` (auto: when tt holds memmapped indices) bounds host
+        RSS at O(chunk + cell metadata) by running the decomposition in
+        chunked passes (streamed_bucket_scatter ≙ the reference's
+        root-streamed chunk distribution, src/mpi/mpi_io.c:587-648);
+        with `out_dir` the bucketed arrays are disk-backed memmaps, so
+        a tensor bigger than host RAM decomposes end-to-end.
         """
         nmodes = tt.nmodes
         if grid is None:
@@ -116,6 +128,14 @@ class GridDecomp:
         dims_pad = tuple(ceil_to(max(d, g), g) for d, g in zip(tt.dims, grid))
         block_rows = tuple(dp // g for dp, g in zip(dims_pad, grid))
         ncells = int(np.prod(grid))
+        if streamed is None:
+            from splatt_tpu.parallel.common import is_memmapped
+
+            streamed = is_memmapped(tt.inds)
+        if streamed:
+            return GridDecomp._build_streamed(
+                tt, grid, dims_pad, block_rows, ncells, val_dtype,
+                balance, out_dir, chunk)
 
         def cells_of(inds_rel):
             cell = np.zeros(tt.nnz, dtype=np.int64)
@@ -159,6 +179,76 @@ class GridDecomp:
             vals=vals.reshape((*grid, cell_nnz)),
             nnz=tt.nnz,
             fill=tt.nnz / max(ncells * cell_nnz, 1),
+            cell_counts=counts,
+            relabels=relabels,
+        )
+
+    @staticmethod
+    def _build_streamed(tt, grid, dims_pad, block_rows, ncells, val_dtype,
+                        balance, out_dir, chunk) -> "GridDecomp":
+        """Chunked-pass build: never materializes an O(nnz) temporary
+        beyond the (optionally disk-backed) bucketed output itself."""
+        nmodes = tt.nmodes
+        nnz = tt.nnz
+
+        def cells_of_chunk(ic, rl):
+            cell = np.zeros(ic.shape[1], dtype=np.int64)
+            for m in range(nmodes):
+                col = rl[m][ic[m]] if rl and rl[m] is not None else ic[m]
+                cell = cell * grid[m] + col // block_rows[m]
+            return cell
+
+        def counts_for(rl):
+            c = np.zeros(ncells, dtype=np.int64)
+            for s in range(0, nnz, chunk):
+                ic = np.asarray(tt.inds[:, s:min(nnz, s + chunk)])
+                c += np.bincount(cells_of_chunk(ic, rl), minlength=ncells)
+            return c
+
+        def fill_of(counts):
+            return (nnz / max(ncells * int(counts.max()), 1)
+                    if nnz else 1.0)
+
+        def hist_of(m):
+            h = np.zeros(tt.dims[m], dtype=np.int64)
+            col = tt.inds[m]
+            for s in range(0, nnz, chunk):
+                h += np.bincount(np.asarray(col[s:min(nnz, s + chunk)]),
+                                 minlength=tt.dims[m])
+            return h
+
+        relabels = None
+        counts = counts_for(None)
+        fill0 = fill_of(counts)
+        if balance or (balance is None and fill0 < 0.5):
+            cand = [balanced_relabel(hist_of(m), grid[m], block_rows[m])
+                    if grid[m] > 1 else None for m in range(nmodes)]
+            counts_b = counts_for(cand)
+            if balance or fill_of(counts_b) > fill0:
+                relabels, counts = cand, counts_b
+
+        def postprocess(placed):
+            for m in range(nmodes):
+                rl = relabels[m] if relabels is not None else None
+                col = rl[placed[m]] if rl is not None else placed[m]
+                placed[m] = col % block_rows[m]
+            return placed
+
+        # counts already computed while deciding balance: the scatter
+        # needs only one more pass over the tensor
+        binds, bvals, cell_nnz, counts = streamed_bucket_scatter(
+            tt.inds, tt.vals,
+            lambda ic: cells_of_chunk(ic, relabels),
+            ncells, val_dtype, chunk=chunk, out_dir=out_dir,
+            postprocess=postprocess, counts=counts)
+
+        return GridDecomp(
+            grid=grid, dims_pad=dims_pad, block_rows=block_rows,
+            cell_nnz=cell_nnz,
+            inds_local=binds.reshape((nmodes, *grid, cell_nnz)),
+            vals=bvals.reshape((*grid, cell_nnz)),
+            nnz=nnz,
+            fill=nnz / max(ncells * cell_nnz, 1),
             cell_counts=counts,
             relabels=relabels,
         )
@@ -312,6 +402,17 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                               val_dtype=dtype, balance=balance)
     mesh = mesh or decomp.make_mesh(devices=devices)
     xnormsq = tt.normsq()
+
+    if opts.verbosity >= Verbosity.HIGH:
+        # ≙ mpi_rank_stats + mpi_send_recv_stats (src/stats.c:298-457,
+        # src/splatt_mpi.h:453-463)
+        print(f"GRID {'x'.join(str(g) for g in decomp.grid)} "
+              f"fill={decomp.fill:0.2f}")
+        print(imbalance_report(decomp.cell_counts, "cell"))
+        for line in comm_volume_report(
+                decomp.dims_pad, rank,
+                np.dtype(dtype).itemsize, grid=decomp.grid):
+            print(line)
 
     inds, vals = decomp.device_put(mesh)
     factors_host = (init if init is not None

@@ -39,13 +39,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from splatt_tpu.config import (CommPattern, Options, default_opts,
+from splatt_tpu.config import (CommPattern, Options, Verbosity, default_opts,
                                resolve_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
+from splatt_tpu.parallel.common import (bucket_scatter, comm_volume_report,
+                                        fit_tail, imbalance_report,
                                         mode_update_tail,
                                         run_distributed_als)
 from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
@@ -244,6 +245,17 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
 
     variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
                else "all2all")
+    if opts.verbosity >= Verbosity.HIGH:
+        # ≙ mpi_rank_stats + mpi_send_recv_stats; equal contiguous
+        # chunks unless a FINE partition reshuffled the nonzeros
+        if partition is not None:
+            counts = np.bincount(np.asarray(partition), minlength=ndev)
+        else:
+            counts = np.full(ndev, tt.nnz // max(ndev, 1))
+        print(imbalance_report(counts, "shard"))
+        for line in comm_volume_report(dims_pad, rank,
+                                       np.dtype(dtype).itemsize, ndev=ndev):
+            print(line)
     sweep = make_sharded_sweep(mesh, nmodes, opts.regularization,
                                dims_pad, axis=axis, variant=variant)
 

@@ -25,6 +25,8 @@ _DEFAULT_LEVELS = {
     "cpd": 1,             # ≙ TIMER_CPD
     "mttkrp": 2,          # ≙ TIMER_MTTKRP
     "solve": 2,           # ≙ TIMER_INV
+    "normalize": 2,       # ≙ TIMER_MATNORM
+    "gram": 2,            # ≙ TIMER_ATA
     "fit": 2,             # ≙ TIMER_FIT
     "reorder": 2,         # ≙ TIMER_PART
     "bench": 1,
@@ -62,9 +64,9 @@ class TimerRegistry:
         for name, lvl in _DEFAULT_LEVELS.items():
             self._timers[name] = Timer(name, lvl)
 
-    def get(self, name: str) -> Timer:
+    def get(self, name: str, level: int = 2) -> Timer:
         if name not in self._timers:
-            self._timers[name] = Timer(name)
+            self._timers[name] = Timer(name, level)
         return self._timers[name]
 
     def start(self, name: str) -> None:

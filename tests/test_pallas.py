@@ -134,14 +134,19 @@ def test_pallas_unfused_fallback_matches(monkeypatch):
                    val_dtype=np.float64)
     bs = BlockedSparse.from_coo(tt, opts)
     factors = make_factors(tt.dims)
-    monkeypatch.setattr(pk, "fused_vmem_ok",
-                        lambda *a, **k: False)
+    monkeypatch.setattr(pk, "fused_vmem_ok", lambda *a, **k: False)
+    monkeypatch.setattr(pk, "fused_t_vmem_ok", lambda *a, **k: False)
     # identical statics/avals were traced earlier in this file with the
     # fused branch; drop the cache so the monkeypatch is consulted
     mttkrp_blocked.clear_cache()
+    from splatt_tpu.ops.mttkrp import engine_plan
+
     for mode in range(tt.nmodes):
+        lay = bs.layout_for(mode)
+        assert engine_plan(lay, factors, mode, "sorted_onehot",
+                           "pallas_interpret") == "unfused_pallas"
         want = np_mttkrp(tt, factors, mode)
-        got = mttkrp_blocked(bs.layout_for(mode), factors, mode,
+        got = mttkrp_blocked(lay, factors, mode,
                              path="sorted_onehot", impl="pallas_interpret")
         np.testing.assert_allclose(np.asarray(got), want, atol=TOL,
                                    err_msg=f"unfused fallback mode={mode}")

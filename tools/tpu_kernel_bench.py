@@ -1,0 +1,106 @@
+"""Chained-timing MTTKRP kernel bench on the real chip.
+
+The axon relay acks block_until_ready before device execution finishes,
+so naive wall timing reads ~0.  Honest method: chain N calls with a data
+dependency (each call's inputs are multiplied by a scalar derived from
+the previous output), force completion with a host scalar fetch, and
+take the slope between two chain lengths — fetch latency and residual
+compile time cancel.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from splatt_tpu.utils.env import apply_env_platform
+
+apply_env_platform()
+
+import jax
+import jax.numpy as jnp
+
+from bench import synthetic_nell2_like
+from splatt_tpu.blocked import build_layout
+from splatt_tpu.ops.mttkrp import engine_plan, mttkrp_blocked, mttkrp_stream
+
+
+def chain_time(call, factors, n1=2, n2=10, trials=3):
+    """Marginal sec/call via the chained-dependency slope method,
+    median over trials (the relay adds jitter on the fetch)."""
+    def run(n):
+        f = list(factors)
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = call(f)
+            eps = out.ravel()[0] * 0.0 + 1.0
+            f = [U * eps for U in f]
+        float(jnp.sum(out))
+        return time.perf_counter() - t0
+    run(1)          # warm every compile incl. the sum fetch
+    est = []
+    for _ in range(trials):
+        t1, t2 = run(n1), run(n2)
+        est.append(max((t2 - t1) / (n2 - n1), 0.0))
+    est.sort()
+    return est[len(est) // 2]
+
+
+def main() -> None:
+    nnz = int(os.environ.get("KB_NNZ", 20_000_000))
+    rank = int(os.environ.get("KB_RANK", 50))
+    mode = 0
+    tt = synthetic_nell2_like(nnz)
+    rng = np.random.default_rng(0)
+    results = []
+    rec = lambda **kw: (results.append(kw), print(kw, flush=True))
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        dname = str(np.dtype(dtype))
+        factors = [jnp.asarray(rng.random((d, rank)), dtype=dtype)
+                   for d in tt.dims]
+        inds = jnp.asarray(tt.inds)
+        vals = jnp.asarray(tt.vals, dtype=dtype)
+        dim0 = tt.dims[mode]
+        try:
+            t = chain_time(lambda f: mttkrp_stream(inds, vals, f, mode, dim0),
+                           factors)
+            rec(path="stream", engine="xla", dtype=dname, block=None,
+                sec=round(t, 5))
+        except Exception as e:
+            rec(path="stream", engine="xla", dtype=dname,
+                error=f"{type(e).__name__}: {e}"[:140])
+        for block in (4096, 14336, 28800, 57600):
+            lay = build_layout(tt, mode, block=block, val_dtype=dtype)
+            for path, engine in (("sorted_onehot", "pallas"),
+                                 ("sorted_onehot", "xla"),
+                                 ("sorted_scatter", "xla")):
+                if engine == "xla" and block != 4096:
+                    continue  # XLA engines: one representative block
+                plan = engine_plan(lay, factors, mode, path, engine)
+                try:
+                    t = chain_time(lambda f: mttkrp_blocked(
+                        lay, f, mode, path=path, impl=engine), factors)
+                    rec(path=path, engine=engine, plan=plan, dtype=dname,
+                        block=block, seg_width=lay.seg_width,
+                        sec=round(t, 5))
+                except Exception as e:
+                    rec(path=path, engine=engine, plan=plan, dtype=dname,
+                        block=block,
+                        error=f"{type(e).__name__}: {e}"[:140])
+            del lay
+
+    with open("tools/kernel_bench.json", "w") as f:
+        json.dump(dict(nnz=nnz, rank=rank, dims=tt.dims,
+                       platform=jax.devices()[0].platform,
+                       results=results), f, indent=1)
+    print("wrote tools/kernel_bench.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""axon relay: block_until_ready acks before execution completes, so
+wall timing must chain data dependencies and fetch a scalar to host.
+Validates the chained-timing harness against known bandwidth/flops."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+x = jnp.asarray(np.random.default_rng(0).random(20_000_000, np.float32))
+
+@jax.jit
+def f(x):
+    return x * 0.999999 + 1e-9
+
+jax.block_until_ready(f(x))
+for reps in (1, 4, 16):
+    t0 = time.perf_counter()
+    s = x
+    for _ in range(reps):
+        s = f(s)
+    float(jnp.sum(s))  # host fetch forces the whole chain
+    dt = time.perf_counter() - t0
+    print({"reps": reps, "total_ms": round(dt*1e3, 2),
+           "per_rep_ms": round(dt/reps*1e3, 3)})
+
+# matmul flops check: 2048^3 * 2 = 17.2 GFLOP/call
+a = jnp.asarray(np.random.default_rng(1).random((2048, 2048)), jnp.bfloat16)
+@jax.jit
+def g(a):
+    return (a @ a) * 0.5
+jax.block_until_ready(g(a))
+t0 = time.perf_counter(); s = a
+for _ in range(16):
+    s = g(s)
+float(jnp.sum(s.astype(jnp.float32)))
+dt = (time.perf_counter() - t0) / 16
+print({"matmul2k_ms": round(dt*1e3, 3), "tflops": round(17.18 / dt / 1e12, 1)})
