@@ -102,17 +102,25 @@ def main() -> None:
     factors = init_factors(tt.dims, rank, 7, dtype=bench_dtype)
     grams = [gram(U) for U in factors]
 
+    def sync(f2):
+        # tunneled/relayed devices can ack block_until_ready before
+        # execution finishes; a one-element host fetch is a true fence.
+        # The timed sweeps chain (each consumes the previous factors),
+        # so fencing the last one fences them all.
+        jax.block_until_ready(f2)
+        jax.device_get(f2[0].ravel()[0])
+
     def run(X):
         sweep = _make_sweep(X, tt.nmodes, 0.0)
         # warmup / compile
         f2, g2, *_ = sweep(factors, grams, True)
-        jax.block_until_ready(f2)
+        sync(f2)
         f2, g2, *_ = sweep(f2, g2, False)
-        jax.block_until_ready(f2)
+        sync(f2)
         t0 = time.perf_counter()
         for _ in range(iters):
             f2, g2, *_ = sweep(f2, g2, False)
-        jax.block_until_ready(f2)
+        sync(f2)
         return (time.perf_counter() - t0) / iters
 
     # Measure both tensor representations and report the best: the

@@ -75,7 +75,8 @@ def cmd_cpd(args) -> int:
     print(tensor_stats(tt, args.tensor))
 
     distributed = (args.decomp is not None or args.grid is not None
-                   or args.partition is not None or args.comm is not None)
+                   or args.partition is not None or args.comm is not None
+                   or getattr(args, "rowdist", None) is not None)
     if distributed:
         from splatt_tpu.parallel import distributed_cpd_als
 
@@ -83,8 +84,9 @@ def cmd_cpd(args) -> int:
             opts.decomposition = Decomposition(args.decomp)
         elif args.grid:
             opts.decomposition = Decomposition.MEDIUM
-        elif args.comm or args.partition:
-            # comm patterns and partitions are fine-decomposition concepts
+        elif args.comm or args.partition or getattr(args, "rowdist", None):
+            # comm patterns, partitions and row distribution are
+            # fine-decomposition concepts
             opts.decomposition = Decomposition.FINE
         if args.partition and opts.decomposition is not Decomposition.FINE:
             raise ValueError(
@@ -111,7 +113,9 @@ def cmd_cpd(args) -> int:
               f"devices={len(jax.devices())}"
               + (f" grid={args.grid}" if args.grid else ""))
         out = distributed_cpd_als(tt, rank=args.rank, opts=opts, grid=grid,
-                                  partition=partition)
+                                  partition=partition,
+                                  row_distribute=getattr(args, "rowdist",
+                                                         None))
         bs = None
     else:
         with timers.time("blocked_build"):
@@ -313,6 +317,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--comm", choices=["all2all", "point2point"],
                    help="row-exchange pattern for --decomp fine "
                         "(point2point = ppermute ring, memory-lean)")
+    p.add_argument("--rowdist", choices=["greedy"],
+                   help="comm-minimizing factor-row distribution for "
+                        "--decomp fine (greedy row claiming, reference "
+                        "mpi_mat_distribute semantics)")
     p.set_defaults(fn=cmd_cpd)
 
     p = sub.add_parser("bench", help="benchmark MTTKRP algorithms")

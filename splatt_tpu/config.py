@@ -104,6 +104,12 @@ class Options:
     tolerance: float = 1e-5
     max_iterations: int = 50
     regularization: float = 0.0
+    # Check convergence (and fetch the fit to host) every k iterations.
+    # The fit is computed on device every sweep regardless; k > 1 only
+    # batches the host synchronization — on tunneled/remote accelerators
+    # a fetch costs ~10-100ms, dominating small iterations.  k=1 is the
+    # reference semantics (src/cpd.c:357-370).
+    fit_check_every: int = 1
     # RNG: None ≙ seed-from-time (src/opts.c RANDSEED default)
     random_seed: Optional[int] = None
     verbosity: Verbosity = Verbosity.LOW
@@ -152,6 +158,9 @@ class Options:
         if self.regularization < 0:
             raise ValueError(
                 f"regularization must be >= 0, got {self.regularization}")
+        if self.fit_check_every < 1:
+            raise ValueError(
+                f"fit_check_every must be >= 1, got {self.fit_check_every}")
         if self.nnz_block < 1:
             raise ValueError(f"nnz_block must be >= 1, got {self.nnz_block}")
         if not 0 <= self.priv_threshold:

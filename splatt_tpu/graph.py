@@ -60,32 +60,82 @@ def _mode_offsets(dims: Tuple[int, ...]) -> np.ndarray:
     return np.concatenate([[0], np.cumsum(dims)]).astype(np.int64)
 
 
-def tensor_to_graph(tt: SparseTensor) -> Graph:
+def _merge_unique(uniq, cnts, u, c):
+    """Merge two (sorted-unique keys, counts) pairs, summing counts."""
+    merged = np.concatenate([uniq, u])
+    mc = np.concatenate([cnts, c])
+    u2, inv = np.unique(merged, return_inverse=True)
+    c2 = np.zeros(u2.shape[0], dtype=np.int64)
+    np.add.at(c2, inv, mc)
+    return u2, c2
+
+
+class _UniqueAccumulator:
+    """Streaming (sorted-unique keys, counts) accumulation via
+    binary-counter run merging (mergesort-run / LSM style): pushing n
+    runs costs O(E log n) merge work with only O(log n) runs
+    outstanding — neither n re-sorts of the running set nor all runs
+    held at once."""
+
+    def __init__(self):
+        self._levels = []
+
+    def push(self, u, c):
+        run = (u, c)
+        for i in range(len(self._levels)):
+            if self._levels[i] is None:
+                self._levels[i] = run
+                return
+            run = _merge_unique(*self._levels[i], *run)
+            self._levels[i] = None
+        self._levels.append(run)
+
+    def result(self):
+        out = None
+        for lvl in self._levels:
+            if lvl is None:
+                continue
+            out = lvl if out is None else _merge_unique(*out, *lvl)
+        if out is None:
+            return np.empty(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+        return out
+
+
+def tensor_to_graph(tt: SparseTensor, chunk: int = 1 << 23) -> Graph:
     """m-partite graph: vertex v = offset[m] + index, edges between all
-    co-occurring coordinate pairs, weight = #co-occurrences."""
+    co-occurring coordinate pairs, weight = #co-occurrences.
+
+    Edge keys are accumulated pair-by-pair in nnz chunks (unique per
+    chunk, merged into the running unique set) — peak temporaries are
+    O(chunk + edges), not the m·(m−1)·nnz concatenation that made
+    NELL-2-scale graphs cost ~3.7GB of int64.
+    """
     offs = _mode_offsets(tt.dims)
     nvtxs = int(offs[-1])
-    srcs, dsts = [], []
+    nnz = tt.nnz
+    acc = _UniqueAccumulator()
     for a in range(tt.nmodes):
         for b in range(tt.nmodes):
-            if a != b:
-                srcs.append(tt.inds[a] + offs[a])
-                dsts.append(tt.inds[b] + offs[b])
-    src = np.concatenate(srcs)
-    dst = np.concatenate(dsts)
-    # combine parallel edges, accumulating weights
-    key = src * nvtxs + dst
-    uniq, counts = np.unique(key, return_counts=True)
+            if a == b:
+                continue
+            for s in range(0, max(nnz, 1), chunk):
+                e = min(nnz, s + chunk)
+                key = ((np.asarray(tt.inds[a][s:e], dtype=np.int64)
+                        + offs[a]) * nvtxs
+                       + np.asarray(tt.inds[b][s:e], dtype=np.int64)
+                       + offs[b])
+                u, c = np.unique(key, return_counts=True)
+                acc.push(u, c.astype(np.int64))
+    uniq, counts = acc.result()
+    # keys are sorted, so (src, dst) is already lexicographic
     src_u = (uniq // nvtxs).astype(np.int64)
     dst_u = (uniq % nvtxs).astype(np.int64)
-    order = np.lexsort((dst_u, src_u))
-    src_u, dst_u, counts = src_u[order], dst_u[order], counts[order]
     indptr = np.zeros(nvtxs + 1, dtype=np.int64)
     np.add.at(indptr, src_u + 1, 1)
     np.cumsum(indptr, out=indptr)
     vwts = np.concatenate([tt.mode_histogram(m) for m in range(tt.nmodes)])
     return Graph(indptr=indptr, adj=dst_u, vwts=vwts,
-                 ewts=counts.astype(np.int64), nvtxs=nvtxs)
+                 ewts=counts, nvtxs=nvtxs)
 
 
 def hypergraph_nnz(tt: SparseTensor) -> Hypergraph:

@@ -16,7 +16,8 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
                         init=None,
                         grid: Optional[Tuple[int, ...]] = None,
                         partition: Optional[np.ndarray] = None,
-                        mesh=None) -> KruskalTensor:
+                        mesh=None,
+                        row_distribute: Optional[str] = None) -> KruskalTensor:
     """Distributed CPD-ALS, dispatching on ``opts.decomposition``
     (≙ SPLATT_OPTION_DECOMP, types_config.h:179-190):
 
@@ -30,12 +31,20 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
     """
     opts = (opts or default_opts()).validate()
     if opts.decomposition is Decomposition.MEDIUM and partition is None:
+        if row_distribute is not None:
+            raise ValueError("row_distribute applies to the FINE "
+                             "decomposition (the medium grid's layer "
+                             "fences already localize inputs)")
         return grid_cpd_als(tt, rank, grid=grid, mesh=mesh, opts=opts,
                             init=init)
     if opts.decomposition is Decomposition.COARSE:
+        if row_distribute is not None:
+            raise ValueError("row_distribute applies to the FINE "
+                             "decomposition, not COARSE")
         return coarse_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init)
     return sharded_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
-                           partition=partition)
+                           partition=partition,
+                           row_distribute=row_distribute)
 
 
 __all__ = [

@@ -70,6 +70,29 @@ def is_memmapped(arr) -> bool:
     return False
 
 
+def _drop_pages(*arrays) -> None:
+    """flush + MADV_DONTNEED every memmap backing these arrays.
+
+    Touched file pages — dirty output pages especially — stay resident
+    and count toward RSS until reclaimed; advising them away after each
+    chunk group is what makes the streamed decomposition's peak RSS
+    O(chunk), not O(tensor).  Pages re-fault from disk on next access.
+    """
+    import mmap as _mmap
+
+    for arr in arrays:
+        a = arr
+        while a is not None and not isinstance(a, np.memmap):
+            a = getattr(a, "base", None)
+        if a is None:
+            continue
+        try:
+            a.flush()
+            a._mmap.madvise(_mmap.MADV_DONTNEED)
+        except (AttributeError, ValueError, OSError):
+            pass  # platform without madvise, or non-mmap base
+
+
 def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
                             chunk: int = 1 << 22, out_dir: str = None,
                             postprocess=None, counts: np.ndarray = None
@@ -102,6 +125,7 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
             if own.min(initial=0) < 0 or own.max(initial=0) >= nbuckets:
                 raise ValueError(f"owner ids must lie in [0, {nbuckets})")
             counts += np.bincount(own, minlength=nbuckets)
+            _drop_pages(inds, vals)
     C = max(int(counts.max()), 1)
 
     if out_dir is not None:
@@ -136,6 +160,11 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
         binds[:, own_s, slot] = placed
         bvals[own_s, slot] = np.asarray(vals[s:e])[order]
         cursor += ccounts
+        if out_dir is not None:
+            # bounded RSS is the whole point of disk-backed outputs:
+            # writeback+drop after every chunk caps dirty pages at one
+            # chunk's scatter footprint
+            _drop_pages(binds, bvals, inds, vals)
     return binds, bvals, C, counts
 
 
@@ -299,15 +328,22 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     """
     fit_prev = 0.0
     lam = jnp.ones((rank,), dtype=dtype)
+    k = opts.fit_check_every
     for it in range(opts.max_iterations):
         t0 = time.perf_counter()
         flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
         factors, grams, lam, znormsq, inner = step(factors, grams, flag)
+        # same sync batching as cpd_als: fetch the fit only at check
+        # iterations (each float() is a host round trip)
+        if (it + 1) % k != 0 and it + 1 != opts.max_iterations:
+            if opts.verbosity >= Verbosity.HIGH:
+                print(f"  its = {it + 1:3d} (deferred fit check)")
+            continue
         fitval = float(_fit(xnormsq, znormsq, inner))
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({time.perf_counter() - t0:.3f}s)"
                   f"  fit = {fitval:0.5f}  delta = {fitval - fit_prev:+0.4e}")
-        if it > 0 and abs(fitval - fit_prev) < opts.tolerance:
+        if it > 0 and abs(fitval - fit_prev) < opts.tolerance * k:
             fit_prev = fitval
             break
         fit_prev = fitval

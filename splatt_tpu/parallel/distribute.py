@@ -1,0 +1,146 @@
+"""Comm-volume-aware factor-row distribution (≙ src/mpi/mpi_mat_distribute.c).
+
+The reference's medium/fine decompositions assign factor rows to ranks
+with a greedy comm-minimizing protocol: rows touched by exactly one
+rank are auto-claimed, contested rows go to the rank that touches them
+most, under capacity constraints, and the tensor is relabeled so each
+rank's rows are contiguous (p_greedy_mat_distribution,
+src/mpi/mpi_mat_distribute.c:436-548, perm applied :616-621).
+
+On TPU the row-exchange collectives move statically-shaped blocks, so
+ownership does not change the *wire volume* of all_gather/psum_scatter
+— what it changes is **locality**: the fraction of a shard's factor-row
+touches that land in its own fence.  That is exactly the quantity the
+reference minimizes (its "ineed" lists), it is what a halo/ring
+exchange pays for, and it is reported here the way
+mpi_send_recv_stats reports comm volume (src/splatt_mpi.h:453-463).
+
+Design: one host-side greedy pass per mode (vectorized numpy):
+
+1. count touches T[row, shard] of each row by each nnz-shard;
+2. visit rows by total touch count (hottest first, the rows whose
+   placement matters most ≙ the claim-priority of the reference's
+   work-queue protocol) and claim each for its heaviest-touching shard
+   with fence capacity left;
+3. label shard p's rows contiguously inside fence p (equal-width
+   fences keep shapes static — the relabeling moves rows, not fences,
+   like balanced_relabel does for nnz balance).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from splatt_tpu.utils.env import ceil_to
+
+
+def touch_matrix(row_ids: np.ndarray, shard_of: np.ndarray, dim: int,
+                 nshards: int) -> np.ndarray:
+    """T[row, shard] = number of nonzeros of `shard` touching `row`."""
+    flat = row_ids.astype(np.int64) * nshards + shard_of
+    return np.bincount(flat, minlength=dim * nshards).reshape(dim, nshards)
+
+
+def greedy_row_distribution(touch: np.ndarray, cap: int) -> np.ndarray:
+    """Assign each row to a shard, minimizing non-local touches greedily.
+
+    touch: (dim, nshards) touch counts; cap: fence capacity per shard
+    (nshards*cap >= dim).  Returns (dim,) shard ids.  ≙ the claim logic
+    of p_greedy_mat_distribution: single-toucher rows go home for free;
+    contested rows go to their heaviest remaining toucher, hottest rows
+    first.
+
+    Vectorized as auction rounds: each unassigned row bids for its
+    heaviest-touching shard that still has capacity; each shard accepts
+    its hottest bidders up to capacity; losers re-bid next round.  A
+    row is rejected only by a shard that fills in that round, so there
+    are at most `nshards` rounds — million-row modes stay in numpy, not
+    a per-row Python loop.
+    """
+    dim, nshards = touch.shape
+    if nshards * cap < dim:
+        raise ValueError(f"{nshards} fences x {cap} rows < {dim}")
+    touch = touch.astype(np.int64)
+    counts = np.zeros(nshards, dtype=np.int64)
+    owner = np.full(dim, -1, dtype=np.int64)
+    remaining = np.arange(dim)
+    for _ in range(nshards):
+        if remaining.size == 0:
+            break
+        avail = counts < cap
+        tw = np.where(avail[None, :], touch[remaining], -1)
+        bid = np.argmax(tw, axis=1)              # best available shard
+        strength = tw[np.arange(remaining.size), bid]
+        rejected = []
+        for p in np.flatnonzero(avail):
+            cand = np.flatnonzero(bid == p)
+            room = cap - counts[p]
+            if cand.size > room:
+                # hottest bidders win (stable: ties keep row order)
+                by_heat = cand[np.argsort(-strength[cand], kind="stable")]
+                cand, spill = by_heat[:room], by_heat[room:]
+                rejected.append(spill)
+            owner[remaining[cand]] = p
+            counts[p] += cand.size
+        remaining = (remaining[np.sort(np.concatenate(rejected))]
+                     if rejected else remaining[:0])
+    return owner
+
+
+def owner_to_relabel(owner: np.ndarray, nshards: int, cap: int) -> np.ndarray:
+    """Contiguous labels inside each owner's fence: row r → label
+    owner[r]*cap + slot (rows keep relative order within a fence,
+    ≙ the contiguity relabeling of mpi_mat_distribute.c:616-621)."""
+    dim = owner.shape[0]
+    by_owner = np.lexsort((np.arange(dim), owner))
+    counts = np.bincount(owner, minlength=nshards)
+    starts = np.zeros(nshards, dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    slot = np.arange(dim) - starts[owner[by_owner]]
+    relabel = np.empty(dim, dtype=np.int64)
+    relabel[by_owner] = owner[by_owner] * cap + slot
+    return relabel
+
+
+def local_touch_fraction(row_ids: np.ndarray, shard_of: np.ndarray,
+                         fence_cap: int) -> float:
+    """Fraction of (nonzero, factor-row) touches that are shard-local —
+    the complement of the reference's "ineed" volume."""
+    if row_ids.size == 0:
+        return 1.0
+    return float(np.mean(row_ids // fence_cap == shard_of))
+
+
+def comm_minimizing_relabels(
+        inds: np.ndarray, dims: Sequence[int], nshards: int,
+        shard_of: Optional[np.ndarray] = None
+) -> Tuple[List[np.ndarray], List[dict]]:
+    """Per-mode comm-minimizing row relabelings + before/after stats.
+
+    `shard_of`: (nnz,) nnz→shard map (default: equal contiguous chunks,
+    the sharded driver's layout).  Returns (relabels, stats) where
+    relabels[m] maps old row id → new label in [0, nshards*cap_m), and
+    stats[m] records the local-touch fraction before/after (the
+    measurable ≙ of mpi_send_recv_stats volume reduction).
+    """
+    nmodes, nnz = inds.shape
+    if shard_of is None:
+        per = -(-nnz // nshards) if nnz else 1
+        shard_of = np.minimum(np.arange(nnz) // per, nshards - 1)
+    shard_of = np.asarray(shard_of, dtype=np.int64)
+    relabels = []
+    stats = []
+    for m in range(nmodes):
+        dim = int(dims[m])
+        cap = ceil_to(max(dim, nshards), nshards) // nshards
+        touch = touch_matrix(inds[m], shard_of, dim, nshards)
+        owner = greedy_row_distribution(touch, cap)
+        rl = owner_to_relabel(owner, nshards, cap)
+        before = local_touch_fraction(inds[m], shard_of, cap)
+        after = local_touch_fraction(rl[inds[m]], shard_of, cap)
+        relabels.append(rl)
+        stats.append(dict(mode=m, cap=cap, local_before=round(before, 4),
+                          local_after=round(after, 4)))
+    return relabels, stats

@@ -199,10 +199,15 @@ class GridDecomp:
             return cell
 
         def counts_for(rl):
+            from splatt_tpu.parallel.common import _drop_pages
+
             c = np.zeros(ncells, dtype=np.int64)
             for s in range(0, nnz, chunk):
                 ic = np.asarray(tt.inds[:, s:min(nnz, s + chunk)])
                 c += np.bincount(cells_of_chunk(ic, rl), minlength=ncells)
+                # mapped input pages count toward RSS until advised
+                # away — per-chunk keeps the pass O(chunk) resident
+                _drop_pages(tt.inds)
             return c
 
         def fill_of(counts):
@@ -210,11 +215,14 @@ class GridDecomp:
                     if nnz else 1.0)
 
         def hist_of(m):
+            from splatt_tpu.parallel.common import _drop_pages
+
             h = np.zeros(tt.dims[m], dtype=np.int64)
             col = tt.inds[m]
             for s in range(0, nnz, chunk):
                 h += np.bincount(np.asarray(col[s:min(nnz, s + chunk)]),
                                  minlength=tt.dims[m])
+                _drop_pages(tt.inds)
             return h
 
         relabels = None

@@ -87,19 +87,27 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
 
 
 def shard_factors(factors: List[jax.Array], dims: Tuple[int, ...],
-                  mesh: Mesh, axis: str = "nnz") -> List[jax.Array]:
+                  mesh: Mesh, axis: str = "nnz",
+                  relabels: Optional[List[Optional[np.ndarray]]] = None
+                  ) -> List[jax.Array]:
     """Row-shard factors, zero-padding rows to the device count.
 
     Zero pad rows keep Grams, norms and solves exact (they contribute
     nothing), mirroring how the reference's ownership fences
     (mat_ptrs, src/mpi/mpi_mat_distribute.c:558-582) exclude non-owned
-    rows from every reduction.
+    rows from every reduction.  `relabels[m]`, when given, places row
+    `old` at label `relabels[m][old]` (comm-minimizing distribution).
     """
     ndev = mesh.shape[axis]
     out = []
-    for U, d in zip(factors, dims):
+    for m, (U, d) in enumerate(zip(factors, dims)):
         d_pad = _pad_to(d, ndev)
-        U_pad = jnp.zeros((d_pad, U.shape[1]), dtype=U.dtype).at[:d].set(U[:d])
+        U_pad = jnp.zeros((d_pad, U.shape[1]), dtype=U.dtype)
+        rl = relabels[m] if relabels is not None else None
+        if rl is None:
+            U_pad = U_pad.at[:d].set(U[:d])
+        else:
+            U_pad = U_pad.at[jnp.asarray(rl)].set(U[:d])
         out.append(jax.device_put(U_pad, NamedSharding(mesh, P(axis, None))))
     return out
 
@@ -210,7 +218,8 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     opts: Optional[Options] = None,
                     init: Optional[List[jax.Array]] = None,
                     axis: str = "nnz",
-                    partition: Optional[np.ndarray] = None) -> KruskalTensor:
+                    partition: Optional[np.ndarray] = None,
+                    row_distribute: Optional[str] = None) -> KruskalTensor:
     """Distributed CPD-ALS over a device mesh (≙ the mpirun cpd path,
     src/cmds/mpi_cmd_cpd.c:175-338).
 
@@ -218,6 +227,11 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     factors at any device count (≙ mpi_mat_rand, src/splatt_mpi.h:368-386)
     because initialization happens in the global row space before
     sharding, and all reductions are deterministic collectives.
+
+    `row_distribute="greedy"`: comm-minimizing factor-row relabeling —
+    each shard's touched rows are greedily claimed into its own fence
+    (≙ p_greedy_mat_distribution, src/mpi/mpi_mat_distribute.c:436-548)
+    — before fences are cut; original row order is restored on gather.
     """
     opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
@@ -229,13 +243,37 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
 
     dtype = resolve_dtype(opts, tt.vals.dtype)
 
+    orig_dims = tt.dims
+    relabels = None
+    if row_distribute == "greedy":
+        from splatt_tpu.parallel.distribute import comm_minimizing_relabels
+
+        shard_of = (np.asarray(partition, dtype=np.int64)
+                    if partition is not None else None)
+        relabels, dstats = comm_minimizing_relabels(
+            np.asarray(tt.inds), orig_dims, ndev, shard_of=shard_of)
+        if opts.verbosity >= Verbosity.HIGH:
+            # ≙ the comm-volume reduction mpi_send_recv_stats reports
+            for st in dstats:
+                print(f"  rowdist mode {st['mode']}: local touches "
+                      f"{st['local_before']:.1%} -> {st['local_after']:.1%}")
+        tt = SparseTensor(
+            np.stack([relabels[m][np.asarray(tt.inds[m])]
+                      for m in range(nmodes)]),
+            tt.vals, dims_pad)
+    elif row_distribute is not None:
+        raise ValueError(f"unknown row_distribute {row_distribute!r}")
+
     inds, vals = shard_nnz(tt, mesh, axis=axis, val_dtype=dtype,
                            partition=partition)
+    # init in the ORIGINAL row space (rank-count/distribution
+    # invariance, ≙ mpi_mat_rand); relabels only affect placement
     factors_host = (init if init is not None
-                    else init_factors(tt.dims, rank, opts.seed(), dtype=dtype))
+                    else init_factors(orig_dims, rank, opts.seed(),
+                                      dtype=dtype))
     factors = tuple(shard_factors(
         [jnp.asarray(f, dtype=dtype) for f in factors_host],
-        tt.dims, mesh, axis=axis))
+        orig_dims, mesh, axis=axis, relabels=relabels))
     from splatt_tpu.ops.linalg import gram
 
     gram_sharding = NamedSharding(mesh, P(None, None))
@@ -263,4 +301,4 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         return sweep(inds, vals, factors, grams, flag)
 
     return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                               tt.dims, dtype)
+                               orig_dims, dtype, row_select=relabels)

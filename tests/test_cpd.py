@@ -143,3 +143,37 @@ def test_checkpoint_mismatch_rejected(tmp_path):
     out = cpd_als(tt, rank=8, opts=_opts(max_iterations=4),
                   checkpoint_path=ck, checkpoint_every=2, resume=False)
     assert out.rank == 8
+
+
+def test_fit_check_every_same_result():
+    """k>1 batches host syncs between convergence checks; with
+    convergence disabled (tol=0) the math is identical to k=1."""
+    import numpy as np
+
+    from splatt_tpu import BlockedSparse, cpd_als, default_opts
+    from tests.gen import fixture_tensor
+
+    tt = fixture_tensor("small")
+    res = {}
+    for k in (1, 4):
+        opts = default_opts()
+        opts.random_seed = 5
+        opts.max_iterations = 8
+        opts.tolerance = 0.0
+        opts.fit_check_every = k
+        res[k] = cpd_als(BlockedSparse.from_coo(tt, opts), rank=3, opts=opts)
+    assert abs(float(res[1].fit) - float(res[4].fit)) < 1e-6
+    for a, b in zip(res[1].factors, res[4].factors):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fit_check_every_validation():
+    import pytest
+
+    from splatt_tpu import default_opts
+
+    opts = default_opts()
+    opts.fit_check_every = 0
+    with pytest.raises(ValueError, match="fit_check_every"):
+        opts.validate()

@@ -1,0 +1,97 @@
+"""Comm-minimizing factor-row distribution
+(≙ p_greedy_mat_distribution, src/mpi/mpi_mat_distribute.c:436-548)."""
+
+import numpy as np
+import pytest
+
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.parallel.distribute import (comm_minimizing_relabels,
+                                            greedy_row_distribution,
+                                            local_touch_fraction,
+                                            owner_to_relabel, touch_matrix)
+
+
+def test_touch_matrix_counts():
+    rows = np.array([0, 0, 1, 2, 2, 2])
+    shards = np.array([0, 1, 1, 0, 0, 1])
+    T = touch_matrix(rows, shards, 3, 2)
+    np.testing.assert_array_equal(T, [[1, 1], [0, 1], [2, 1]])
+
+
+def test_greedy_prefers_heaviest_toucher():
+    # row 0 touched 5x by shard 1, 1x by shard 0 → shard 1 claims it
+    T = np.array([[1, 5], [3, 0], [0, 2], [2, 2]])
+    owner = greedy_row_distribution(T, cap=2)
+    assert owner[0] == 1 and owner[1] == 0 and owner[2] == 1
+    assert np.bincount(owner, minlength=2).max() <= 2
+
+
+def test_greedy_respects_capacity():
+    # every row prefers shard 0; only cap fit, rest spill to shard 1
+    T = np.tile(np.array([[10, 1]]), (6, 1))
+    owner = greedy_row_distribution(T, cap=3)
+    assert np.bincount(owner, minlength=2).tolist() == [3, 3]
+    with pytest.raises(ValueError):
+        greedy_row_distribution(T, cap=2)
+
+
+def test_owner_to_relabel_contiguous_and_bijective():
+    owner = np.array([1, 0, 1, 0, 0])
+    rl = owner_to_relabel(owner, 2, cap=3)
+    # shard 0's rows (1,3,4) get labels 0,1,2; shard 1's (0,2) get 3,4
+    np.testing.assert_array_equal(rl, [3, 0, 4, 1, 2])
+    assert len(set(rl.tolist())) == 5
+
+
+def _clustered_tensor(seed=0, nnz=4000, dims=(64, 48, 80), ndev=4):
+    """Nonzeros whose rows correlate with their shard — scrambled, so
+    equal fences are maximally non-local but a greedy distribution can
+    recover locality."""
+    rng = np.random.default_rng(seed)
+    shard = np.arange(nnz) * ndev // nnz  # equal contiguous chunks
+    scramble = [rng.permutation(d) for d in dims]
+    inds = np.empty((3, nnz), dtype=np.int64)
+    for m, d in enumerate(dims):
+        within = rng.integers(0, d // ndev, nnz)
+        inds[m] = scramble[m][(shard * (d // ndev) + within) % d]
+    return SparseTensor(inds=inds, vals=rng.random(nnz), dims=dims)
+
+
+def test_relabels_improve_locality():
+    tt = _clustered_tensor()
+    rls, stats = comm_minimizing_relabels(np.asarray(tt.inds), tt.dims, 4)
+    for m, st in enumerate(stats):
+        assert st["local_after"] > st["local_before"] + 0.3, st
+        assert st["local_after"] > 0.95, st
+        # a permutation into [0, nshards*cap)
+        rl = rls[m]
+        assert len(set(rl.tolist())) == tt.dims[m]
+        assert rl.min() >= 0 and rl.max() < 4 * st["cap"]
+
+
+def test_sharded_cpd_greedy_matches_plain():
+    import jax.numpy as jnp
+
+    from splatt_tpu import default_opts
+    from splatt_tpu.parallel.sharded import sharded_cpd_als
+
+    tt = _clustered_tensor(1, nnz=1200, dims=(32, 24, 40))
+    opts = default_opts()
+    opts.random_seed = 9
+    opts.max_iterations = 4
+    plain = sharded_cpd_als(tt, rank=3, opts=opts)
+    greedy = sharded_cpd_als(tt, rank=3, opts=opts, row_distribute="greedy")
+    assert abs(float(plain.fit) - float(greedy.fit)) < 1e-5
+    for a, b in zip(plain.factors, greedy.factors):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_sharded_cpd_greedy_unknown_kind():
+    from splatt_tpu import default_opts
+    from splatt_tpu.parallel.sharded import sharded_cpd_als
+
+    tt = _clustered_tensor(2, nnz=400, dims=(16, 12, 20))
+    with pytest.raises(ValueError, match="row_distribute"):
+        sharded_cpd_als(tt, rank=2, opts=default_opts(),
+                        row_distribute="nope")

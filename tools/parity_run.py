@@ -1,0 +1,81 @@
+"""End-to-end CLI parity run (VERDICT round-1 item 8).
+
+Same .tns file through the reference `splatt cpd` binary and
+`splatt-tpu cpd` with fixed seeds; asserts final-fit agreement within a
+small tolerance (different RNGs → different inits → nearby optima, so
+the bar is fit-level, not factor-level).  ≙ src/cpd.c:357-367 output.
+
+Usage: python tools/parity_run.py [ref_binary] (default
+/tmp/splatt-build/bin/splatt; rebuild with
+  cmake -S /root/reference -B /tmp/splatt-build -DCMAKE_BUILD_TYPE=Release \
+    -DBLAS_LIBRARIES=/tmp/lapack-shim/libblas.so \
+    -DLAPACK_LIBRARIES=/tmp/lapack-shim/liblapack.so && \
+  cmake --build /tmp/splatt-build -j4
+with .so.3 symlinked into /tmp/lapack-shim).
+Writes tools/parity_run.json.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def gen_tensor(path, seed=3, nnz=60_000, dims=(120, 90, 150)):
+    rng = np.random.default_rng(seed)
+    # unique coordinates so both sides see the identical effective tensor
+    flat = rng.choice(int(np.prod(dims)), size=nnz, replace=False)
+    inds = np.stack(np.unravel_index(flat, dims))
+    # low-rank-ish structure so the fit is meaningfully > 0
+    f = [rng.random((d, 4)) for d in dims]
+    vals = (f[0][inds[0]] * f[1][inds[1]] * f[2][inds[2]]).sum(1)
+    vals += 0.01 * rng.random(nnz)
+    with open(path, "w") as fh:
+        for n in range(nnz):
+            fh.write(f"{inds[0][n]+1} {inds[1][n]+1} {inds[2][n]+1} "
+                     f"{vals[n]:.10f}\n")
+
+
+def main():
+    ref_bin = sys.argv[1] if len(sys.argv) > 1 else "/tmp/splatt-build/bin/splatt"
+    if not os.path.exists(ref_bin):
+        print(json.dumps({"skipped": f"reference binary not found: {ref_bin}"}))
+        return
+    rank, iters, tol = 8, 50, 1e-6
+    with tempfile.TemporaryDirectory() as td:
+        tns = os.path.join(td, "parity.tns")
+        gen_tensor(tns)
+        ref = subprocess.run(
+            [ref_bin, "cpd", tns, "-r", str(rank), "-i", str(iters),
+             "--tol", str(tol), "--seed", "42", "--nowrite", "-t", "1"],
+            capture_output=True, text=True, check=True)
+        m = re.search(r"Final fit:\s*([0-9.eE+-]+)", ref.stdout)
+        ref_fit = float(m.group(1))
+
+        ours = subprocess.run(
+            [sys.executable, "-m", "splatt_tpu.cli", "cpd", tns,
+             "-r", str(rank), "-i", str(iters), "-t", str(tol),
+             "--seed", "42", "--f64", "--nowrite"],
+            capture_output=True, text=True, check=True,
+            env={**os.environ, "JAX_PLATFORMS":
+                 os.environ.get("PARITY_PLATFORM", "cpu")},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        m2 = re.search(r"Final fit:\s*([0-9.eE+-]+)", ours.stdout)
+        our_fit = float(m2.group(1))
+
+    rec = dict(ref_fit=ref_fit, our_fit=our_fit,
+               abs_diff=round(abs(ref_fit - our_fit), 6),
+               rank=rank, iters=iters, tol=tol,
+               agree=abs(ref_fit - our_fit) < 5e-3)
+    with open("tools/parity_run.json", "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
