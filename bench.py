@@ -11,7 +11,13 @@ a measured reference number exists in BASELINE_MEASURED.json; else 1.0.
 
 Env knobs: SPLATT_BENCH_NNZ (default 20_000_000), SPLATT_BENCH_RANK (50),
 SPLATT_BENCH_ITERS (3 timed iterations), SPLATT_BENCH_DTYPE
-(float32 default; bfloat16 stores factors in bf16 with f32 accumulation).
+(float32 default; bfloat16 stores factors in bf16 with f32 accumulation),
+SPLATT_BENCH_ENGINE (auto|pallas|xla — one-hot reduction engine; auto
+lets dispatch probe Mosaic capability on TPU), SPLATT_BENCH_ALLOC
+(allmode default — every mode gets its sorted layout; twomode/onemode
+for the reference's memory-lean policies), SPLATT_BENCH_JIT
+(auto|fused|phased — whole-sweep jit vs. per-phase jits; auto picks
+phased on TPU where the fused program wedges the remote compiler).
 """
 
 from __future__ import annotations
@@ -80,8 +86,8 @@ def main() -> None:
     import jax.numpy as jnp
 
     from splatt_tpu.blocked import BlockedSparse
-    from splatt_tpu.config import Options, Verbosity
-    from splatt_tpu.cpd import _make_sweep, init_factors
+    from splatt_tpu.config import BlockAlloc, Options, Verbosity
+    from splatt_tpu.cpd import _make_phased_sweep, _make_sweep, init_factors
     from splatt_tpu.ops.linalg import gram
 
     nnz = int(os.environ.get("SPLATT_BENCH_NNZ", 20_000_000))
@@ -97,26 +103,46 @@ def main() -> None:
               file=sys.stderr, flush=True)
         bench_dtype = jnp.dtype("float32")
 
+    _T0 = time.perf_counter()
     tt = synthetic_nell2_like(nnz)
 
     factors = init_factors(tt.dims, rank, 7, dtype=bench_dtype)
     grams = [gram(U) for U in factors]
 
     def sync(f2):
-        # tunneled/relayed devices can ack block_until_ready before
-        # execution finishes; a one-element host fetch is a true fence.
         # The timed sweeps chain (each consumes the previous factors),
         # so fencing the last one fences them all.
-        jax.block_until_ready(f2)
-        jax.device_get(f2[0].ravel()[0])
+        from splatt_tpu.utils.env import host_fence
+
+        host_fence(f2)
+
+    def note(msg):
+        print(f"bench: {msg} [t+{time.perf_counter() - _T0:.0f}s]",
+              file=sys.stderr, flush=True)
+
+    jit_mode = os.environ.get("SPLATT_BENCH_JIT", "auto").lower()
+    if jit_mode not in ("auto", "fused", "phased"):
+        print(f"bench: bad SPLATT_BENCH_JIT {jit_mode!r}; using auto",
+              file=sys.stderr, flush=True)
+        jit_mode = "auto"
 
     def run(X):
-        sweep = _make_sweep(X, tt.nmodes, 0.0)
+        # auto: phased per-phase jits on TPU (a whole-sweep program at
+        # NELL scale wedges the tunneled remote-compile service), the
+        # fully fused sweep elsewhere.
+        phased = (jit_mode == "phased"
+                  or (jit_mode == "auto"
+                      and jax.default_backend() == "tpu"))
+        sweep = (_make_phased_sweep if phased
+                 else _make_sweep)(X, tt.nmodes, 0.0)
         # warmup / compile
+        note("compiling + first sweep")
         f2, g2, *_ = sweep(factors, grams, True)
         sync(f2)
+        note("warm sweep")
         f2, g2, *_ = sweep(f2, g2, False)
         sync(f2)
+        note(f"timing {iters} sweeps")
         t0 = time.perf_counter()
         for _ in range(iters):
             f2, g2, *_ = sweep(f2, g2, False)
@@ -137,10 +163,24 @@ def main() -> None:
         jax.clear_caches()
 
     results = {}
+    engine = os.environ.get("SPLATT_BENCH_ENGINE", "auto").lower()
+    use_pallas = {"auto": None, "pallas": True, "xla": False}.get(engine)
+    if engine not in ("auto", "pallas", "xla"):
+        print(f"bench: bad SPLATT_BENCH_ENGINE {engine!r}; using auto",
+              file=sys.stderr, flush=True)
+        use_pallas = None
+    try:
+        alloc = BlockAlloc(os.environ.get("SPLATT_BENCH_ALLOC", "allmode"))
+    except ValueError:
+        print("bench: bad SPLATT_BENCH_ALLOC; using allmode",
+              file=sys.stderr, flush=True)
+        alloc = BlockAlloc.ALLMODE
     opts = Options(random_seed=7, verbosity=Verbosity.NONE,
-                   val_dtype=bench_dtype)
+                   val_dtype=bench_dtype, use_pallas=use_pallas,
+                   block_alloc=alloc)
     blocked_failed = False
     try:
+        note("building blocked layouts")
         results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
     except Exception as e:
         print(f"bench: blocked path failed ({type(e).__name__}: {e})",
@@ -149,14 +189,17 @@ def main() -> None:
     release()  # outside any handler: no live traceback pinning buffers
     if blocked_failed:
         try:
+            note("retrying blocked with the XLA engine")
             opts_x = Options(random_seed=7, verbosity=Verbosity.NONE,
-                             val_dtype=bench_dtype, use_pallas=False)
+                             val_dtype=bench_dtype, use_pallas=False,
+                             block_alloc=alloc)
             results["blocked_xla"] = run(BlockedSparse.from_coo(tt, opts_x))
         except Exception as e2:
             print(f"bench: blocked XLA engine failed too "
                   f"({type(e2).__name__})", file=sys.stderr, flush=True)
         release()
     try:
+        note("stream path")
         results["stream"] = run(tt)
     except Exception as e:
         print(f"bench: stream path failed ({type(e).__name__})",

@@ -76,7 +76,7 @@ def cmd_cpd(args) -> int:
 
     distributed = (args.decomp is not None or args.grid is not None
                    or args.partition is not None or args.comm is not None
-                   or getattr(args, "rowdist", None) is not None)
+                   or args.rowdist is not None)
     if distributed:
         from splatt_tpu.parallel import distributed_cpd_als
 
@@ -84,7 +84,7 @@ def cmd_cpd(args) -> int:
             opts.decomposition = Decomposition(args.decomp)
         elif args.grid:
             opts.decomposition = Decomposition.MEDIUM
-        elif args.comm or args.partition or getattr(args, "rowdist", None):
+        elif args.comm or args.partition or args.rowdist:
             # comm patterns, partitions and row distribution are
             # fine-decomposition concepts
             opts.decomposition = Decomposition.FINE
@@ -114,8 +114,7 @@ def cmd_cpd(args) -> int:
               + (f" grid={args.grid}" if args.grid else ""))
         out = distributed_cpd_als(tt, rank=args.rank, opts=opts, grid=grid,
                                   partition=partition,
-                                  row_distribute=getattr(args, "rowdist",
-                                                         None))
+                                  row_distribute=args.rowdist)
         bs = None
     else:
         with timers.time("blocked_build"):

@@ -100,6 +100,47 @@ def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     return sweep
 
 
+def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
+                       reg: float) -> Callable:
+    """Same contract as :func:`_make_sweep`, but each ALS phase is its
+    own small jitted program (per-mode MTTKRP, one solve+normalize+gram
+    update, one fit) chained asynchronously — no host syncs, so timing
+    behaves like the fused sweep.
+
+    Rationale: one fused whole-sweep XLA program at NELL scale never
+    returned from the tunneled remote-compile service (>40 min,
+    measured 2026-07-29), while the individual per-mode MTTKRP programs
+    compile in ~35 s each there.  Dispatch overhead between phases is
+    host-side microseconds against 100 ms-scale kernels.
+    """
+    do_mttkrp = _mttkrp_closure(X)
+
+    @partial(jax.jit, static_argnames=("m", "first", "factor_dtype"))
+    def update_phase(grams, M, m: int, first: bool, factor_dtype):
+        U = solve_normals(form_normal_lhs(grams, m, reg), M)
+        U, lam = normalize_columns(U, "2" if first else "max")
+        U = U.astype(factor_dtype)
+        return U, lam, gram(U)
+
+    fit_phase = jax.jit(_zz_inner)
+
+    def sweep(factors, grams, first: bool):
+        # contract parity with the jitted _make_sweep: never mutate the
+        # caller's lists (bench reuses one factor list across paths)
+        factors = list(factors)
+        grams = list(grams)
+        lam = None
+        M = None
+        for m in range(nmodes):
+            M = do_mttkrp(factors, m)
+            factors[m], lam, grams[m] = update_phase(
+                grams, M, m, first, factors[m].dtype)
+        znormsq, inner = fit_phase(lam, grams, M, factors[nmodes - 1])
+        return factors, grams, lam, znormsq, inner
+
+    return sweep
+
+
 def _make_profiled_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
                          reg: float) -> Callable:
     """Split-jit sweep for `-v -v`: each ALS phase is its own jitted
@@ -122,14 +163,7 @@ def _make_profiled_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     gram_phase = jax.jit(gram)
     fit_phase = jax.jit(_zz_inner)
 
-    def sync(x):
-        """Force true completion: block_until_ready plus a one-element
-        host fetch — tunneled/relayed devices can ack block_until_ready
-        before execution finishes, which would time dispatch only."""
-        leaf = jax.tree_util.tree_leaves(x)[0]
-        jax.block_until_ready(x)
-        jax.device_get(leaf.ravel()[0])
-        return x
+    from splatt_tpu.utils.env import host_fence as sync
 
     def sweep(factors, grams, first: bool):
         lam = None
@@ -234,10 +268,17 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         factors = init_factors(dims, rank, opts.seed(), dtype=dtype)
     grams = [gram(U) for U in factors]
 
-    # -v -v: split-jit profiled sweep with real per-phase attribution
+    # -v -v: split-jit profiled sweep with real per-phase attribution.
+    # On TPU the default is the phased sweep: one whole-sweep XLA
+    # program at NELL scale wedges the tunneled remote-compile service
+    # (>40 min), while the per-phase programs compile in seconds each.
     profiled = opts.verbosity >= Verbosity.HIGH
-    sweep = (_make_profiled_sweep if profiled
-             else _make_sweep)(X, nmodes, opts.regularization)
+    if profiled:
+        sweep = _make_profiled_sweep(X, nmodes, opts.regularization)
+    else:
+        phased = jax.default_backend() == "tpu"
+        sweep = (_make_phased_sweep if phased
+                 else _make_sweep)(X, nmodes, opts.regularization)
     if profiled:
         # warm both specializations of every split-jit phase on copies,
         # then zero the phase timers: the report shows steady-state
@@ -256,6 +297,7 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
            else jnp.ones((rank,), dtype=dtype))
     timers.start("cpd")
     k = opts.fit_check_every
+    last_check_it = start_it
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         factors, grams, lam, znormsq, inner = sweep(factors, grams, it == 0)
@@ -280,7 +322,12 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                   f"  delta = {fitval - fit_prev:+0.4e}")
         if checkpoint_due:
             _save_checkpoint(checkpoint_path, factors, lam, it + 1, fitval)
-        if it > 0 and abs(fitval - fit_prev) < opts.tolerance * k:
+        # tolerance scales with the *actual* delta window: k sweeps
+        # between regular checks, but a checkpoint-forced check can land
+        # mid-window (≙ the k=1 per-iteration test, src/cpd.c:368-370)
+        window = (it + 1) - last_check_it
+        last_check_it = it + 1
+        if it > 0 and abs(fitval - fit_prev) < opts.tolerance * window:
             fit_prev = fitval
             break
         fit_prev = fitval

@@ -359,10 +359,13 @@ def fused_mttkrp_t(layout, factors, mode: int, width: int,
 
 def _probe_compiles(kernel_fn) -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
-    interpret)` COMPILES for this backend on a tiny problem.  Lowering
-    alone is not enough: Mosaic layout inference (e.g. the "Invalid
-    input layout" broadcast restriction) only runs at compile time, so
-    a lowering-only probe reports false positives."""
+    interpret)` COMPILES for this backend at a *representative* shape.
+    Lowering alone is not enough: Mosaic layout inference (e.g. the
+    "Invalid input layout" broadcast restriction) only runs at compile
+    time.  And a toy shape is not enough either — measured on a v5e, a
+    (16,24,32)/block-128 probe compiles while every block-4096 case
+    crashes the Mosaic compiler subprocess (tools/fused_bisect.py), so
+    the probe uses a production-like block and dims."""
     if jax.default_backend() != "tpu":
         return False
     try:
@@ -372,12 +375,12 @@ def _probe_compiles(kernel_fn) -> bool:
         from splatt_tpu.coo import SparseTensor
 
         rng = np.random.default_rng(0)
-        dims = (16, 24, 32)
-        inds = np.stack([rng.integers(0, d, 256) for d in dims])
+        dims = (512, 384, 1024)
+        inds = np.stack([rng.integers(0, d, 8192) for d in dims])
         tt = SparseTensor(inds=inds.astype(np.int64),
-                          vals=np.ones(256), dims=dims)
-        lay = build_layout(tt, 0, block=128, val_dtype=np.float32)
-        fac = [jnp.zeros((d, 8), jnp.float32) for d in dims]
+                          vals=np.ones(8192), dims=dims)
+        lay = build_layout(tt, 0, block=4096, val_dtype=np.float32)
+        fac = [jnp.zeros((d, 48), jnp.float32) for d in dims]
         kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
                         accumulate=False, interpret=False).compile()
         return True

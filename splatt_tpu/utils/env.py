@@ -10,6 +10,22 @@ def ceil_to(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
 
+def host_fence(x):
+    """Force true device completion of `x` and everything it depends on.
+
+    block_until_ready alone is not enough on tunneled/relayed devices
+    (e.g. the axon TPU relay), which can ack readiness before execution
+    finishes — a one-element host fetch is a true data-dependency fence.
+    Returns `x` for chaining.
+    """
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    jax.block_until_ready(x)
+    jax.device_get(leaf.ravel()[0])
+    return x
+
+
 def apply_env_platform() -> None:
     """Mirror JAX_PLATFORMS into jax.config.
 

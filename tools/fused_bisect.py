@@ -1,0 +1,92 @@
+"""Bisect the fused_t Mosaic compile failure: compile fused_mttkrp_t
+over growing largest-mode dims (each case in a subprocess with a hard
+timeout so a wedged remote compile cannot eat the session).
+
+Usage: python tools/fused_bisect.py            # run all cases
+       python tools/fused_bisect.py CASE_JSON  # (internal) one case
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def one_case(spec):
+    from splatt_tpu.utils.env import apply_env_platform
+
+    apply_env_platform()
+    import numpy as np
+
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.coo import SparseTensor
+    from splatt_tpu.ops.pallas_kernels import fused_mttkrp_t
+
+    import jax.numpy as jnp
+
+    dims = tuple(spec["dims"])
+    nnz = spec["nnz"]
+    block = spec["block"]
+    rank = spec.get("rank", 50)
+    rng = np.random.default_rng(0)
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    tt = SparseTensor(inds=inds, vals=rng.random(nnz), dims=dims)
+    lay = build_layout(tt, 0, block=block, val_dtype=np.float32)
+    fac = [jnp.asarray(rng.random((d, rank)), jnp.float32) for d in dims]
+    t0 = time.perf_counter()
+    fused_mttkrp_t.lower(lay, fac, mode=0, width=lay.seg_width,
+                         accumulate=False, interpret=False).compile()
+    return dict(ok=True, compile_s=round(time.perf_counter() - t0, 1),
+                seg_width=lay.seg_width)
+
+
+def main():
+    if len(sys.argv) > 1:
+        spec = json.loads(sys.argv[1])
+        try:
+            out = one_case(spec)
+        except Exception as e:
+            out = dict(ok=False, error=f"{type(e).__name__}: {e}"[:400])
+        print("RESULT " + json.dumps(out), flush=True)
+        return
+
+    cases = [
+        dict(dims=(512, 384, 1024), nnz=200_000, block=4096),
+        dict(dims=(1024, 768, 4096), nnz=500_000, block=4096),
+        dict(dims=(2048, 1536, 8192), nnz=1_000_000, block=4096),
+        dict(dims=(4096, 3072, 16384), nnz=1_000_000, block=4096),
+        dict(dims=(12092, 9184, 28818), nnz=1_000_000, block=4096),
+        dict(dims=(12092, 9184, 28818), nnz=20_000_000, block=4096),
+    ]
+    results = []
+    for spec in cases:
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), json.dumps(spec)],
+                capture_output=True, text=True, timeout=420)
+            line = [l for l in p.stdout.splitlines()
+                    if l.startswith("RESULT ")]
+            out = (json.loads(line[0][7:]) if line
+                   else dict(ok=False, error=("exit %d: %s" % (
+                       p.returncode, p.stderr[-300:]))))
+        except subprocess.TimeoutExpired:
+            out = dict(ok=False, error="TIMEOUT 420s")
+        out["case"] = spec
+        out["wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+        if not out["ok"] and "TIMEOUT" in str(out.get("error")):
+            break  # a wedged compile service will wedge the rest too
+    with open(os.path.join(HERE, "fused_bisect.json"), "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""Isolate which op inside the fused_t kernel crashes the Mosaic
+compiler subprocess (tools/fused_bisect.py: every block-4096 case dies
+with HTTP 500 while the tiny block-128 probe compiles), and validate the
+*unfused* kernels at production block sizes on real hardware.
+
+Each case compiles (and runs) one variant kernel in a subprocess with a
+hard timeout.  Variants strip the fused_t kernel down op by op:
+
+  k_dot      — one-hot build + MXU dot only (no gather)
+  k_gather1  — a single (8, D) take_along_axis gather, no dot
+  k_gatherN  — the full _tile_gather loop (R8/8 tiles), no dot
+  k_full     — the real fused_t kernel
+  u_sorted   — onehot_reduce_sorted (unfused) at block 4096
+  u_full     — onehot_reduce_full (unfused, privatized width)
+
+Writes tools/mosaic_bisect.json.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def build(case: str):
+    from splatt_tpu.utils.env import apply_env_platform
+
+    apply_env_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.coo import SparseTensor
+    from splatt_tpu.ops import pallas_kernels as pk
+    from splatt_tpu.ops.mttkrp import mxu_precision
+
+    rng = np.random.default_rng(0)
+    dims = (512, 384, 1024)
+    nnz = 8192
+    B = 4096
+    R = 48
+    R8 = 48
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    tt = SparseTensor(inds=inds, vals=rng.random(nnz), dims=dims)
+    lay = build_layout(tt, 0, block=B, val_dtype=np.float32)
+    fac = [jnp.asarray(rng.random((d, R)), jnp.float32) for d in dims]
+    width = lay.seg_width
+    nb = lay.nblocks
+
+    if case == "k_full":
+        out = pk.fused_mttkrp_t(lay, fac, mode=0, width=width,
+                                accumulate=False, interpret=False)
+        out.block_until_ready()
+        return dict(shape=list(out.shape))
+
+    if case in ("u_sorted", "u_full"):
+        from splatt_tpu.ops.mttkrp import _gather_prod
+
+        prod = _gather_prod(lay.inds, lay.vals, fac, 0).reshape(nb, B, R)
+        if case == "u_sorted":
+            local = (lay.inds[0].reshape(nb, B)
+                     - lay.row_start[:, None]).astype(jnp.int32)
+            chunk = pk.vmem_chunk(width, B, R, 4)
+            out = pk.onehot_reduce_sorted(local, prod, width,
+                                          interpret=False, chunk=max(chunk, 1))
+        else:
+            local = lay.inds[0].reshape(nb, B).astype(jnp.int32)
+            w = -(-(dims[0] + 1) // 8) * 8
+            chunk = pk.vmem_chunk(w, B, R, 4)
+            out = pk.onehot_reduce_full(local, prod, w,
+                                        interpret=False, chunk=max(chunk, 1))
+        out.block_until_ready()
+        return dict(shape=list(out.shape))
+
+    # hand-stripped kernel variants at the same shapes as fused_t
+    others = [1, 2]
+    d_pads = [((dims[k] + 127) // 128) * 128 for k in others]
+    local = lay.inds[0].reshape(nb, B) - lay.row_start[:, None]
+    local = local[:, None, :]
+    vals = lay.vals.reshape(nb, B)[:, None, :]
+    uts = []
+    gidxs = []
+    for k, d_pad in zip(others, d_pads):
+        d = dims[k]
+        u_t = jnp.pad(fac[k].T, ((0, 0), (0, d_pad - d)))
+        uts.append(u_t)
+        ck = -(-B // d_pad)
+        idx = jnp.minimum(lay.inds[k], d - 1).reshape(nb, B)
+        if ck * d_pad != B:
+            idx = jnp.pad(idx, ((0, 0), (0, ck * d_pad - B)))
+        gidxs.append(jnp.broadcast_to(
+            idx.reshape(nb, ck, 1, d_pad), (nb, ck, 8, d_pad)).astype(jnp.int32))
+
+    if case == "k_dot":
+        def kern(local_ref, vals_ref, out_ref):
+            local = local_ref[0, :, :]
+            vals = vals_ref[0, :, :]
+            iota = jax.lax.broadcasted_iota(jnp.int32, (width, B), 0)
+            onehot = (jnp.broadcast_to(local, (width, B)) == iota
+                      ).astype(jnp.float32)
+            prod = jnp.broadcast_to(vals, (R8, B))
+            out_ref[...] = jax.lax.dot_general(
+                prod, onehot, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=mxu_precision(jnp.float32))[None]
+
+        out = pl.pallas_call(
+            kern, grid=(nb,),
+            in_specs=[pl.BlockSpec((1, 1, B), lambda i: (i, 0, 0)),
+                      pl.BlockSpec((1, 1, B), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, R8, width), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((nb, R8, width), jnp.float32),
+            compiler_params=pk._compiler_params(),
+        )(local, vals)
+        out.block_until_ready()
+        return dict(shape=list(out.shape))
+
+    if case in ("k_gather1", "k_gatherN"):
+        d_pad = d_pads[0]
+        ck = gidxs[0].shape[1]
+
+        def kern(gidx_ref, ut_ref, out_ref):
+            u_t = ut_ref[...]
+            if case == "k_gather1":
+                rows = jnp.take_along_axis(u_t[:8, :], gidx_ref[0, 0],
+                                           axis=1)
+                out_ref[...] = jnp.sum(rows).reshape(1, 1)
+            else:
+                rows = pk._tile_gather(u_t, gidx_ref[0], B)
+                out_ref[...] = jnp.sum(rows).reshape(1, 1)
+
+        out = pl.pallas_call(
+            kern, grid=(nb,),
+            in_specs=[pl.BlockSpec((1, ck, 8, d_pad), lambda i: (i, 0, 0, 0)),
+                      pl.BlockSpec((R8, d_pad), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            compiler_params=pk._compiler_params(),
+        )(gidxs[0], uts[0])
+        out.block_until_ready()
+        return dict(shape=list(out.shape))
+
+    raise ValueError(case)
+
+
+CASES = ["k_dot", "k_gather1", "k_gatherN", "k_full", "u_sorted", "u_full"]
+
+
+def main():
+    if len(sys.argv) > 1:
+        case = sys.argv[1]
+        try:
+            out = build(case)
+            out["ok"] = True
+        except Exception as e:
+            out = dict(ok=False, error=f"{type(e).__name__}: {e}"[:300])
+        print("RESULT " + json.dumps(out), flush=True)
+        return
+
+    results = []
+    for case in CASES:
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), case],
+                capture_output=True, text=True, timeout=420)
+            line = [l for l in p.stdout.splitlines()
+                    if l.startswith("RESULT ")]
+            out = (json.loads(line[0][7:]) if line
+                   else dict(ok=False,
+                             error="exit %d: %s" % (p.returncode,
+                                                    p.stderr[-300:])))
+        except subprocess.TimeoutExpired:
+            out = dict(ok=False, error="TIMEOUT 420s")
+        out["case"] = case
+        out["wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    with open(os.path.join(HERE, "mosaic_bisect.json"), "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

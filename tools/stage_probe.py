@@ -1,0 +1,122 @@
+"""Staged TPU health probe: claim → small compile → bulk transfer →
+single big MTTKRP compile+run → full-sweep compile.  Each stage prints
+its wall time; run under `timeout` so a wedged stage is attributable.
+
+Usage: python tools/stage_probe.py [stage...]   (default: all stages)
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+from splatt_tpu.utils.env import apply_env_platform
+
+apply_env_platform()
+
+T0 = time.perf_counter()
+
+
+def note(msg):
+    print(f"[t+{time.perf_counter() - T0:7.1f}s] {msg}", flush=True)
+
+
+def main():
+    stages = sys.argv[1:] or ["claim", "small", "xfer", "one_mttkrp",
+                              "phased_sweep"]
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if "claim" in stages:
+        d = jax.devices()[0]
+        note(f"claimed {d.device_kind} ({d.platform})")
+
+    if "small" in stages:
+        x = jnp.ones((1024, 1024), jnp.bfloat16)
+        (x @ x).block_until_ready()
+        note("small matmul compiled+ran")
+
+    if "xfer" in stages:
+        a = np.random.default_rng(0).random((40_000_000,), np.float32)
+        t = time.perf_counter()
+        da = jax.device_put(a)
+        da.block_until_ready()
+        dt = time.perf_counter() - t
+        note(f"160MB host->device in {dt:.1f}s ({160 / max(dt, 1e-9):.0f} MB/s)")
+        t = time.perf_counter()
+        float(jnp.sum(da))
+        note(f"reduce+fetch in {time.perf_counter() - t:.1f}s")
+
+    nnz = int(os.environ.get("PROBE_NNZ", 20_000_000))
+    rank = 50
+    if {"one_mttkrp", "sweep", "phased_sweep"} & set(stages):
+        from bench import synthetic_nell2_like
+
+        tt = synthetic_nell2_like(nnz)
+        note(f"synthesized {nnz} nnz")
+
+    if "one_mttkrp" in stages:
+        from splatt_tpu.blocked import build_layout
+        from splatt_tpu.ops.mttkrp import mttkrp_blocked
+
+        rng = np.random.default_rng(0)
+        lay = build_layout(tt, 0, block=4096, val_dtype=np.float32)
+        fac = [jnp.asarray(rng.random((d, rank)), jnp.float32)
+               for d in tt.dims]
+        note("layout built")
+        t = time.perf_counter()
+        out = mttkrp_blocked(lay, fac, 0, path="sorted_onehot", impl="xla")
+        out.block_until_ready()
+        float(jnp.sum(out))
+        note(f"single sorted_onehot xla compile+run in "
+             f"{time.perf_counter() - t:.1f}s")
+        t = time.perf_counter()
+        out = mttkrp_blocked(lay, [f * 1.0 for f in fac], 0,
+                             path="sorted_onehot", impl="xla")
+        float(jnp.sum(out))
+        note(f"warm run {time.perf_counter() - t:.2f}s")
+        del lay
+
+    if "sweep" in stages or "phased_sweep" in stages:
+        from splatt_tpu.blocked import BlockedSparse
+        from splatt_tpu.config import BlockAlloc, Options, Verbosity
+        from splatt_tpu.cpd import (_make_phased_sweep, _make_sweep,
+                                    init_factors)
+        from splatt_tpu.ops.linalg import gram
+
+        opts = Options(random_seed=7, verbosity=Verbosity.NONE,
+                       val_dtype=jnp.float32, use_pallas=False,
+                       block_alloc=BlockAlloc.ALLMODE)
+        X = BlockedSparse.from_coo(tt, opts)
+        note("blocked ALLMODE built")
+        factors = init_factors(tt.dims, rank, 7, dtype=jnp.float32)
+        grams = [gram(U) for U in factors]
+        builder = (_make_phased_sweep if "phased_sweep" in stages
+                   else _make_sweep)
+        sweep = builder(X, tt.nmodes, 0.0)
+        t = time.perf_counter()
+        f2, g2, *_ = sweep(factors, grams, True)
+        jax.block_until_ready(f2)
+        jax.device_get(f2[0].ravel()[0])
+        note(f"full first-sweep compile+run in {time.perf_counter() - t:.1f}s")
+        t = time.perf_counter()
+        f2, g2, *_ = sweep(f2, g2, False)
+        jax.block_until_ready(f2)
+        jax.device_get(f2[0].ravel()[0])
+        note(f"subsequent sweep compile+run in {time.perf_counter() - t:.1f}s")
+        t = time.perf_counter()
+        for _ in range(3):
+            f2, g2, *_ = sweep(f2, g2, False)
+        jax.block_until_ready(f2)
+        jax.device_get(f2[0].ravel()[0])
+        note(f"3 warm sweeps in {time.perf_counter() - t:.1f}s "
+             f"({(time.perf_counter() - t) / 3:.2f} s/it)")
+
+
+if __name__ == "__main__":
+    main()
