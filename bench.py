@@ -164,11 +164,11 @@ def main() -> None:
 
     results = {}
     engine = os.environ.get("SPLATT_BENCH_ENGINE", "auto").lower()
-    use_pallas = {"auto": None, "pallas": True, "xla": False}.get(engine)
     if engine not in ("auto", "pallas", "xla"):
         print(f"bench: bad SPLATT_BENCH_ENGINE {engine!r}; using auto",
               file=sys.stderr, flush=True)
-        use_pallas = None
+        engine = "auto"
+    use_pallas = {"auto": None, "pallas": True, "xla": False}[engine]
     try:
         alloc = BlockAlloc(os.environ.get("SPLATT_BENCH_ALLOC", "allmode"))
     except ValueError:

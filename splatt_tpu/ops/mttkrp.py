@@ -81,6 +81,23 @@ def mxu_precision(dtype):
     return jax.lax.Precision.DEFAULT
 
 
+def onehot_precision(dtype, onehot_side: str = "lhs"):
+    """Per-operand MXU precision for one-hot contractions.
+
+    A one-hot operand holds only 0.0/1.0 — exactly representable in one
+    bf16 pass — so only the *values* operand needs the HIGHEST bf16
+    decomposition for f32-faithful products.  Per-operand precision
+    keeps exactness while dropping the pass count versus HIGHEST on
+    both sides.  `onehot_side` names which dot operand is the one-hot.
+    """
+    if dtype != jnp.float32:
+        p = jax.lax.Precision.DEFAULT
+        return (p, p)
+    if onehot_side == "lhs":
+        return (jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST)
+    return (jax.lax.Precision.HIGHEST, jax.lax.Precision.DEFAULT)
+
+
 # -- stream (oracle) -------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("mode", "dim"))
@@ -179,7 +196,7 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
         onehot = (local[:, None, :] == iota[None, :, None]).astype(dtype)
         part = jnp.einsum("cwb,cbr->cwr", onehot, prod,
                           preferred_element_type=acc,
-                          precision=mxu_precision(dtype))
+                          precision=onehot_precision(dtype, "lhs"))
         if accumulate:
             return carry + jnp.sum(part, axis=0), None
         return carry, part
@@ -320,9 +337,20 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
         return "fused_t"
     if fused_ok and fused_vmem_ok(factors, mode, width, B):
         return "fused"
-    if pallas and vmem_chunk(width, B, R, itemsize) >= 1:
+    if (pallas and vmem_chunk(width, B, R, itemsize) >= 1
+            and _unfused_hbm_ok(layout, R, itemsize)):
         return "unfused_pallas"
     return "xla_scan"
+
+
+def _unfused_hbm_ok(layout: ModeLayout, R: int, itemsize: int,
+                    budget_bytes: int = 6 << 30) -> bool:
+    """Whether the unfused Pallas plan's (nnz_pad, R) HBM partial-product
+    intermediate fits comfortably (XLA pads R to 128 lanes for the
+    gather output, so cost the padded width).  The xla_scan engine never
+    materializes it and has no such limit."""
+    lanes = -(-R // 128) * 128
+    return layout.nnz_pad * lanes * itemsize <= budget_bytes
 
 
 def _onehot_pays(opts: Options) -> bool:

@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from splatt_tpu.ops.mttkrp import _acc_dtype, mxu_precision
+from splatt_tpu.ops.mttkrp import (_acc_dtype, mxu_precision,
+                                   onehot_precision)
 from splatt_tpu.utils.env import ceil_to
 
 # Max blocks per grid step; the actual chunk is sized against VMEM by
@@ -99,7 +100,7 @@ def _sorted_kernel(local_ref, prod_ref, out_ref, *, seg_width: int):
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
         preferred_element_type=out_ref.dtype,
-        precision=mxu_precision(prod.dtype))
+        precision=onehot_precision(prod.dtype, "lhs"))
 
 
 def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
@@ -112,7 +113,7 @@ def _full_kernel(local_ref, prod_ref, out_ref, *, width: int):
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
         preferred_element_type=out_ref.dtype,
-        precision=mxu_precision(prod.dtype))    # (C, width, R)
+        precision=onehot_precision(prod.dtype, "lhs"))    # (C, width, R)
     acc = jnp.sum(part, axis=0)
 
     @pl.when(pl.program_id(0) == 0)
@@ -234,7 +235,7 @@ def _fused_t_kernel(local_ref, vals_ref, *refs,
         prod, onehot,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=acc,
-        precision=mxu_precision(dtype))
+        precision=onehot_precision(dtype, "rhs"))
     if not accumulate:
         out_ref[...] = part[None]
         return
@@ -368,7 +369,8 @@ def _probe_compiles(kernel_fn) -> bool:
     the probe uses a production-like block and dims."""
     if jax.default_backend() != "tpu":
         return False
-    try:
+
+    def compile_case():
         import numpy as np
 
         from splatt_tpu.blocked import build_layout
@@ -384,8 +386,23 @@ def _probe_compiles(kernel_fn) -> bool:
         kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
                         accumulate=False, interpret=False).compile()
         return True
+
+    # The compile runs on a worker thread with a deadline: a wedged
+    # remote-compile service (observed: >40 min hangs) must degrade to
+    # "unsupported" — blocking dispatch here would wedge the whole
+    # session.  A subprocess cannot be used instead: the parent already
+    # holds the single chip lease and the relay serializes claims.  On
+    # timeout the orphaned compile thread is left to finish/error on
+    # its own (daemon; its exception is swallowed).
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    try:
+        return pool.submit(compile_case).result(timeout=240)
     except Exception:
         return False
+    finally:
+        pool.shutdown(wait=False)
 
 
 @functools.cache
@@ -447,7 +464,7 @@ def _fused_kernel(local_ref, vals_ref, ginds_ref, *refs,
         onehot, prod,
         dimension_numbers=(((2,), (1,)), ((0,), (0,))),
         preferred_element_type=out_ref.dtype,
-        precision=mxu_precision(dtype))          # (C, width, R)
+        precision=onehot_precision(dtype, "lhs"))          # (C, width, R)
     if not accumulate:
         out_ref[...] = part
         return

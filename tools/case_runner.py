@@ -1,0 +1,53 @@
+"""Shared subprocess-per-case harness for the TPU bisect tools.
+
+Each case re-execs the calling script with one argument; the child
+prints ``RESULT <json>`` and exits.  A hard timeout per case keeps a
+wedged remote-compile service from eating the session; on timeout the
+remaining cases are skipped (a wedged service wedges them too).
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+
+def run_child(fn, arg):
+    """Child-side: run `fn(arg)`, print the RESULT line."""
+    try:
+        out = fn(arg)
+        out.setdefault("ok", True)
+    except Exception as e:
+        out = dict(ok=False, error=f"{type(e).__name__}: {e}"[:400])
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+def run_cases(script_path, cases, out_path, case_arg=json.dumps,
+              timeout=420):
+    """Parent-side: run every case in a subprocess, collect to out_path."""
+    results = []
+    for case in cases:
+        t0 = time.perf_counter()
+        try:
+            p = subprocess.run(
+                [sys.executable, script_path, case_arg(case)],
+                capture_output=True, text=True, timeout=timeout)
+            line = [l for l in p.stdout.splitlines()
+                    if l.startswith("RESULT ")]
+            out = (json.loads(line[0][7:]) if line
+                   else dict(ok=False, error="exit %d: %s" % (
+                       p.returncode, p.stderr[-300:])))
+        except subprocess.TimeoutExpired:
+            out = dict(ok=False, error=f"TIMEOUT {timeout}s")
+        out["case"] = case
+        out["wall_s"] = round(time.perf_counter() - t0, 1)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+        if "TIMEOUT" in str(out.get("error", "")):
+            print("case timed out; skipping the rest (wedged service)",
+                  flush=True)
+            break
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=1)
+    return results

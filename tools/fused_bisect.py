@@ -9,13 +9,13 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
 
 
 def one_case(spec):
@@ -47,13 +47,10 @@ def one_case(spec):
 
 
 def main():
+    from case_runner import run_cases, run_child
+
     if len(sys.argv) > 1:
-        spec = json.loads(sys.argv[1])
-        try:
-            out = one_case(spec)
-        except Exception as e:
-            out = dict(ok=False, error=f"{type(e).__name__}: {e}"[:400])
-        print("RESULT " + json.dumps(out), flush=True)
+        run_child(one_case, json.loads(sys.argv[1]))
         return
 
     cases = [
@@ -64,28 +61,8 @@ def main():
         dict(dims=(12092, 9184, 28818), nnz=1_000_000, block=4096),
         dict(dims=(12092, 9184, 28818), nnz=20_000_000, block=4096),
     ]
-    results = []
-    for spec in cases:
-        t0 = time.perf_counter()
-        try:
-            p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), json.dumps(spec)],
-                capture_output=True, text=True, timeout=420)
-            line = [l for l in p.stdout.splitlines()
-                    if l.startswith("RESULT ")]
-            out = (json.loads(line[0][7:]) if line
-                   else dict(ok=False, error=("exit %d: %s" % (
-                       p.returncode, p.stderr[-300:]))))
-        except subprocess.TimeoutExpired:
-            out = dict(ok=False, error="TIMEOUT 420s")
-        out["case"] = spec
-        out["wall_s"] = round(time.perf_counter() - t0, 1)
-        results.append(out)
-        print(json.dumps(out), flush=True)
-        if not out["ok"] and "TIMEOUT" in str(out.get("error")):
-            break  # a wedged compile service will wedge the rest too
-    with open(os.path.join(HERE, "fused_bisect.json"), "w") as f:
-        json.dump(results, f, indent=1)
+    run_cases(os.path.abspath(__file__), cases,
+              os.path.join(HERE, "fused_bisect.json"))
 
 
 if __name__ == "__main__":

@@ -17,16 +17,13 @@ Writes tools/mosaic_bisect.json.
 """
 from __future__ import annotations
 
-import functools
-import json
 import os
-import subprocess
 import sys
-import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
 
 
 def build(case: str):
@@ -156,37 +153,14 @@ CASES = ["k_dot", "k_gather1", "k_gatherN", "k_full", "u_sorted", "u_full"]
 
 
 def main():
+    from case_runner import run_cases, run_child
+
     if len(sys.argv) > 1:
-        case = sys.argv[1]
-        try:
-            out = build(case)
-            out["ok"] = True
-        except Exception as e:
-            out = dict(ok=False, error=f"{type(e).__name__}: {e}"[:300])
-        print("RESULT " + json.dumps(out), flush=True)
+        run_child(build, sys.argv[1])
         return
 
-    results = []
-    for case in CASES:
-        t0 = time.perf_counter()
-        try:
-            p = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), case],
-                capture_output=True, text=True, timeout=420)
-            line = [l for l in p.stdout.splitlines()
-                    if l.startswith("RESULT ")]
-            out = (json.loads(line[0][7:]) if line
-                   else dict(ok=False,
-                             error="exit %d: %s" % (p.returncode,
-                                                    p.stderr[-300:])))
-        except subprocess.TimeoutExpired:
-            out = dict(ok=False, error="TIMEOUT 420s")
-        out["case"] = case
-        out["wall_s"] = round(time.perf_counter() - t0, 1)
-        results.append(out)
-        print(json.dumps(out), flush=True)
-    with open(os.path.join(HERE, "mosaic_bisect.json"), "w") as f:
-        json.dump(results, f, indent=1)
+    run_cases(os.path.abspath(__file__), CASES,
+              os.path.join(HERE, "mosaic_bisect.json"), case_arg=str)
 
 
 if __name__ == "__main__":

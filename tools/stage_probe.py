@@ -69,16 +69,16 @@ def main():
         fac = [jnp.asarray(rng.random((d, rank)), jnp.float32)
                for d in tt.dims]
         note("layout built")
+        from splatt_tpu.utils.env import host_fence
+
         t = time.perf_counter()
-        out = mttkrp_blocked(lay, fac, 0, path="sorted_onehot", impl="xla")
-        out.block_until_ready()
-        float(jnp.sum(out))
+        host_fence(mttkrp_blocked(lay, fac, 0, path="sorted_onehot",
+                                  impl="xla"))
         note(f"single sorted_onehot xla compile+run in "
              f"{time.perf_counter() - t:.1f}s")
         t = time.perf_counter()
-        out = mttkrp_blocked(lay, [f * 1.0 for f in fac], 0,
-                             path="sorted_onehot", impl="xla")
-        float(jnp.sum(out))
+        host_fence(mttkrp_blocked(lay, [f * 1.0 for f in fac], 0,
+                                  path="sorted_onehot", impl="xla"))
         note(f"warm run {time.perf_counter() - t:.2f}s")
         del lay
 
@@ -99,21 +99,20 @@ def main():
         builder = (_make_phased_sweep if "phased_sweep" in stages
                    else _make_sweep)
         sweep = builder(X, tt.nmodes, 0.0)
+        from splatt_tpu.utils.env import host_fence
+
         t = time.perf_counter()
         f2, g2, *_ = sweep(factors, grams, True)
-        jax.block_until_ready(f2)
-        jax.device_get(f2[0].ravel()[0])
+        host_fence(f2)
         note(f"full first-sweep compile+run in {time.perf_counter() - t:.1f}s")
         t = time.perf_counter()
         f2, g2, *_ = sweep(f2, g2, False)
-        jax.block_until_ready(f2)
-        jax.device_get(f2[0].ravel()[0])
+        host_fence(f2)
         note(f"subsequent sweep compile+run in {time.perf_counter() - t:.1f}s")
         t = time.perf_counter()
         for _ in range(3):
             f2, g2, *_ = sweep(f2, g2, False)
-        jax.block_until_ready(f2)
-        jax.device_get(f2[0].ravel()[0])
+        host_fence(f2)
         note(f"3 warm sweeps in {time.perf_counter() - t:.1f}s "
              f"({(time.perf_counter() - t) / 3:.2f} s/it)")
 

@@ -1,0 +1,37 @@
+#!/bin/bash
+# One TPU work session, ordered by value-per-minute, each step its own
+# process (single client at a time — the axon relay serializes claims
+# and a killed client can wedge the lease; timeouts are generous and
+# SIGTERM-only).  Run: nohup bash tools/tpu_session.sh > tools/tpu_session.out 2>&1 &
+cd "$(dirname "$0")/.."
+set -u
+note() { echo "=== $1 $(date -u +%H:%M:%S) ==="; }
+
+note "stage A: staged probe (claim/transfer/single-kernel health)"
+timeout 1800 python -u tools/stage_probe.py claim small xfer one_mttkrp
+rc=$?
+echo "stage A rc=$rc"
+if [ $rc -ne 0 ]; then
+  echo "chip unhealthy; aborting session"
+  exit 1
+fi
+
+note "stage B: bench.py (the flagship number; phased jit, auto engine)"
+timeout 2400 python -u bench.py > BENCH_TPU_CAND.json
+echo "stage B rc=$?"
+cat BENCH_TPU_CAND.json
+
+note "stage C: mosaic op-level bisect + unfused HW validation"
+timeout 2400 python -u tools/mosaic_bisect.py
+echo "stage C rc=$?"
+
+note "stage D: tuning sweep (paths x engines x dtypes x blocks)"
+timeout 3600 python -u tools/tpu_tune.py
+echo "stage D rc=$?"
+
+note "stage E: rank-200 bench row"
+SPLATT_BENCH_RANK=200 SPLATT_BENCH_ITERS=2 timeout 2400 python -u bench.py > BENCH_TPU_R200.json
+echo "stage E rc=$?"
+cat BENCH_TPU_R200.json
+
+note "session done"
