@@ -17,7 +17,9 @@ lets dispatch probe Mosaic capability on TPU), SPLATT_BENCH_ALLOC
 (allmode default — every mode gets its sorted layout; twomode/onemode
 for the reference's memory-lean policies), SPLATT_BENCH_JIT
 (auto|fused|phased — whole-sweep jit vs. per-phase jits; auto picks
-phased on TPU where the fused program wedges the remote compiler).
+phased on TPU where the fused program wedges the remote compiler),
+SPLATT_BENCH_SHAPE (nell2 default | enron4 — the 4-mode Enron-shaped
+workload of BASELINE.md row 2).
 """
 
 from __future__ import annotations
@@ -34,19 +36,48 @@ from splatt_tpu.utils.env import apply_env_platform
 apply_env_platform()
 
 
-def synthetic_nell2_like(nnz: int, seed: int = 0):
-    """Power-law 3-mode tensor with NELL-2-ish dims (12k × 9k × 29k)."""
+def synthetic_tensor(dims, nnz: int, seed: int = 0):
+    """Power-law synthetic tensor: zipf-skewed indices per mode."""
     from splatt_tpu.coo import SparseTensor
 
-    dims = (12092, 9184, 28818)
     rng = np.random.default_rng(seed)
-    inds = np.empty((3, nnz), dtype=np.int64)
+    inds = np.empty((len(dims), nnz), dtype=np.int64)
     for m, d in enumerate(dims):
         # zipf-ish skew, cycled through the mode so every slice is nonempty
         raw = rng.zipf(1.3, size=nnz).astype(np.int64)
         inds[m] = (raw * 2654435761 + rng.integers(0, d, size=nnz)) % d
     vals = rng.random(nnz)
     return SparseTensor(inds, vals, dims)
+
+
+# workload shapes: NELL-2-like 3-mode (flagship) and Enron-like 4-mode
+# (exercises the n-mode generic paths, ≙ BASELINE.md rows 2-3)
+SHAPES = {
+    "nell2": (12092, 9184, 28818),
+    "enron4": (6066, 5699, 244268, 1176),
+}
+
+
+def synthetic_nell2_like(nnz: int, seed: int = 0):
+    """Power-law 3-mode tensor with NELL-2-ish dims (12k × 9k × 29k)."""
+    return synthetic_tensor(SHAPES["nell2"], nnz, seed)
+
+
+def _ref_sec_per_iter(measured: dict, shape: str, nnz: int, rank: int):
+    """Reference sec/it for this exact workload from
+    BASELINE_MEASURED.json, or None when it was never measured (then
+    vs_baseline stays 1.0 rather than comparing unlike workloads)."""
+    det = measured.get("details", {})
+    if shape == "nell2":
+        if rank == 200 and nnz == 20_000_000:
+            return det.get("nell2_20m_rank200",
+                           {}).get("reference_sec_per_iter")
+        if rank == 50:
+            return measured.get("cpd_sec_per_iter", {}).get(str(nnz))
+    if shape == "enron4" and nnz == 5_000_000 and rank == 25:
+        return det.get("enron4mode_5m_rank25",
+                       {}).get("reference_sec_per_iter")
+    return None
 
 
 def _device_precheck(timeout_sec: int = 180) -> None:
@@ -103,8 +134,16 @@ def main() -> None:
               file=sys.stderr, flush=True)
         bench_dtype = jnp.dtype("float32")
 
+    shape = os.environ.get("SPLATT_BENCH_SHAPE", "nell2")
+    if shape not in SHAPES:
+        print(f"bench: bad SPLATT_BENCH_SHAPE {shape!r}; using nell2",
+              file=sys.stderr, flush=True)
+        shape = "nell2"
     _T0 = time.perf_counter()
-    tt = synthetic_nell2_like(nnz)
+    # seeds match the tensors the reference was measured on
+    # (BASELINE_MEASURED.json description: nell2 seed 0, enron4 seed 1)
+    tt = synthetic_tensor(SHAPES[shape], nnz,
+                          seed=1 if shape == "enron4" else 0)
 
     factors = init_factors(tt.dims, rank, 7, dtype=bench_dtype)
     grams = [gram(U) for U in factors]
@@ -217,16 +256,17 @@ def main() -> None:
         with open(os.path.join(os.path.dirname(__file__),
                                "BASELINE_MEASURED.json")) as f:
             measured = json.load(f)
-        ref = measured.get("cpd_sec_per_iter", {}).get(str(nnz))
+        ref = _ref_sec_per_iter(measured, shape, nnz, rank)
         if ref:
             vs = ref / sec_per_iter
     except (OSError, json.JSONDecodeError):
         pass
 
+    names = {"nell2": "NELL-2-shaped", "enron4": "Enron-shaped"}
     platform = jax.devices()[0].platform
     print(json.dumps({
-        "metric": f"CPD-ALS sec/iteration, synthetic NELL-2-shaped "
-                  f"(3-mode, {nnz} nnz, rank {rank}, "
+        "metric": f"CPD-ALS sec/iteration, synthetic {names[shape]} "
+                  f"({tt.nmodes}-mode, {nnz} nnz, rank {rank}, "
                   f"{jnp.dtype(factors[0].dtype).name}) on {platform}; "
                   f"baseline: reference 1-thread CPU same tensor",
         "value": round(sec_per_iter, 4),

@@ -30,8 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from splatt_tpu.ops.mttkrp import (_acc_dtype, mxu_precision,
-                                   onehot_precision)
+from splatt_tpu.ops.mttkrp import _acc_dtype, onehot_precision
 from splatt_tpu.utils.env import ceil_to
 
 # Max blocks per grid step; the actual chunk is sized against VMEM by
@@ -387,22 +386,28 @@ def _probe_compiles(kernel_fn) -> bool:
                         accumulate=False, interpret=False).compile()
         return True
 
-    # The compile runs on a worker thread with a deadline: a wedged
+    # The compile runs on a daemon thread with a deadline: a wedged
     # remote-compile service (observed: >40 min hangs) must degrade to
     # "unsupported" — blocking dispatch here would wedge the whole
     # session.  A subprocess cannot be used instead: the parent already
-    # holds the single chip lease and the relay serializes claims.  On
-    # timeout the orphaned compile thread is left to finish/error on
-    # its own (daemon; its exception is swallowed).
-    import concurrent.futures
+    # holds the single chip lease and the relay serializes claims.  A
+    # daemon thread (not ThreadPoolExecutor, whose non-daemon workers
+    # are joined at interpreter exit) lets the process exit even if the
+    # orphaned compile never returns; its exception is swallowed.
+    import threading
 
-    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    try:
-        return pool.submit(compile_case).result(timeout=240)
-    except Exception:
-        return False
-    finally:
-        pool.shutdown(wait=False)
+    result = []
+
+    def runner():
+        try:
+            result.append(compile_case())
+        except Exception:
+            result.append(False)
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join(timeout=240)
+    return bool(result and result[0])
 
 
 @functools.cache

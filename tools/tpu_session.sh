@@ -34,4 +34,10 @@ SPLATT_BENCH_RANK=200 SPLATT_BENCH_ITERS=2 timeout 2400 python -u bench.py > BEN
 echo "stage E rc=$?"
 cat BENCH_TPU_R200.json
 
+note "stage F: 4-mode Enron-shaped bench row"
+SPLATT_BENCH_SHAPE=enron4 SPLATT_BENCH_NNZ=5000000 SPLATT_BENCH_RANK=25 \
+  timeout 2400 python -u bench.py > BENCH_TPU_ENRON4.json
+echo "stage F rc=$?"
+cat BENCH_TPU_ENRON4.json
+
 note "session done"
