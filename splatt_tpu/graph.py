@@ -188,6 +188,26 @@ def hypergraph_fibers(tt: SparseTensor, mode: int) -> Hypergraph:
     return Hypergraph(nvtxs=nfibers, eptr=eptr, eind=vtx, vwts=None)
 
 
+def hypergraph_uncut(h: Hypergraph, parts: np.ndarray) -> np.ndarray:
+    """Hyperedges NOT cut by `parts`: every pin in one part
+    (≙ hgraph_uncut, src/graph.c:576-624; empty hyperedges are
+    trivially uncut).  `parts` maps vertex → part id."""
+    parts = np.asarray(parts)
+    pin_parts = parts[h.eind]
+    # vectorized: an edge is cut iff any *within-edge* adjacent pin pair
+    # disagrees (adjacency inequality detects any disagreement without
+    # requiring sorted pins); edge-start positions are masked out so
+    # pairs never straddle edges
+    diff = np.zeros(len(pin_parts), dtype=bool)
+    if len(pin_parts) > 1:
+        diff[1:] = pin_parts[1:] != pin_parts[:-1]
+    starts = h.eptr[:-1]
+    diff[starts[starts < len(diff)]] = False
+    pos = np.nonzero(diff)[0]
+    cut = np.unique(np.searchsorted(h.eptr, pos, side="right") - 1)
+    return np.setdiff1d(np.arange(h.nhedges), cut, assume_unique=True)
+
+
 def write_graph(g: Graph, path: str) -> None:
     """METIS-like text format (≙ graph writers in src/io.c)."""
     has_ew = g.ewts is not None

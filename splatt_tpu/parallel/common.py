@@ -364,5 +364,9 @@ def _gather_global(U):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(U)
+        # tiled=True: concatenate shards along their sharded axis —
+        # required for global (non-fully-addressable) arrays, and the
+        # row-sharded semantics we want (measured: the default stacking
+        # path raises ValueError on global arrays)
+        return multihost_utils.process_allgather(U, tiled=True)
     return jax.device_get(U)

@@ -1,0 +1,64 @@
+"""Worker for the two-process multi-host test (≙ the reference's
+`mpirun -np N test_mpi` pattern, scripts/mpi_test.sh — multi-process
+correctness checked on one machine).
+
+Invoked as:  python tests/multihost_worker.py <process_id> <nprocs>
+                <coordinator> <decomp> <out.npz>
+
+Each process joins the jax.distributed process group (CPU backend, 2
+virtual devices per process), runs distributed_cpd_als on the same
+deterministically generated tensor, and writes its gathered factors +
+fit; the parent test asserts both processes agree with the
+single-process ground truth (device-count invariance across *process*
+counts, ≙ mpi_mat_rand's rank-count invariance, src/splatt_mpi.h:368-386).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def main():
+    pid, nprocs = int(sys.argv[1]), int(sys.argv[2])
+    coordinator, decomp, out_path = sys.argv[3], sys.argv[4], sys.argv[5]
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nprocs, process_id=pid)
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from splatt_tpu.config import Decomposition, Options, Verbosity
+    from splatt_tpu.coo import SparseTensor
+    from splatt_tpu.parallel import distributed_cpd_als
+
+    rng = np.random.default_rng(17)
+    dims = (24, 18, 30)
+    nnz = 800
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    tt = SparseTensor(inds=inds, vals=rng.random(nnz), dims=dims)
+
+    opts = Options(random_seed=5, verbosity=Verbosity.NONE,
+                   max_iterations=8, tolerance=0.0,
+                   val_dtype=np.float64,
+                   decomposition=Decomposition(decomp))
+    out = distributed_cpd_als(tt, rank=4, opts=opts)
+    np.savez(out_path,
+             fit=float(out.fit),
+             lam=np.asarray(out.lam, dtype=np.float64),
+             **{f"f{m}": np.asarray(out.factors[m], dtype=np.float64)
+                for m in range(tt.nmodes)})
+    print(f"worker {pid}: fit={float(out.fit):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

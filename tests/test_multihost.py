@@ -1,0 +1,81 @@
+"""Two-process multi-controller tests (≙ `mpirun -np 4/-np 7 test_mpi`
+on one machine, scripts/mpi_test.sh:4-5).
+
+Each test launches two OS processes that join one jax.distributed
+process group (CPU backend, 2 virtual devices each → a 4-device global
+mesh spanning processes), runs distributed_cpd_als, and compares
+against the in-process single-controller run of the same problem —
+process-count invariance, the property the reference engineers with
+rank-invariant seeding (mpi_mat_rand, src/splatt_mpi.h:368-386).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_pair(decomp: str, tmp_path):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"p{i}.npz") for i in range(2)]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), "2", coordinator, decomp, outs[i]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in range(2)]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        logs.append(out)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-2000:]}"
+    return [np.load(o) for o in outs]
+
+
+def _ground_truth(decomp: str):
+    from splatt_tpu.config import Decomposition, Options, Verbosity
+    from splatt_tpu.coo import SparseTensor
+    from splatt_tpu.parallel import distributed_cpd_als
+
+    rng = np.random.default_rng(17)
+    dims = (24, 18, 30)
+    nnz = 800
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    tt = SparseTensor(inds=inds, vals=rng.random(nnz), dims=dims)
+    opts = Options(random_seed=5, verbosity=Verbosity.NONE,
+                   max_iterations=8, tolerance=0.0, val_dtype=np.float64,
+                   decomposition=Decomposition(decomp))
+    return distributed_cpd_als(tt, rank=4, opts=opts)
+
+
+@pytest.mark.parametrize("decomp", ["medium", "fine"])
+def test_two_process_matches_single(decomp, tmp_path):
+    results = _run_pair(decomp, tmp_path)
+    ref = _ground_truth(decomp)
+    for r in results:
+        assert abs(float(r["fit"]) - float(ref.fit)) < 1e-9
+        np.testing.assert_allclose(r["lam"], np.asarray(ref.lam),
+                                   rtol=1e-9, atol=1e-12)
+        for m in range(3):
+            np.testing.assert_allclose(r[f"f{m}"],
+                                       np.asarray(ref.factors[m]),
+                                       rtol=1e-8, atol=1e-10)
+    # the two processes must agree exactly with each other
+    np.testing.assert_array_equal(results[0]["lam"], results[1]["lam"])

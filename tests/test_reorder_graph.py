@@ -120,3 +120,23 @@ def test_hgraph_nontrivial_all_modes():
     perm = reorder(tt, "hgraph")
     for m, p in enumerate(perm.perms):
         assert not np.array_equal(p, np.arange(tt.dims[m])), f"mode {m}"
+
+
+def test_hypergraph_uncut():
+    """≙ hgraph_uncut (src/graph.c:576-624): hyperedges with every pin
+    in one part, checked against a brute-force loop."""
+    from splatt_tpu.graph import hypergraph_uncut
+
+    tt = gen.fixture_tensor("med")
+    h = hypergraph_nnz(tt)
+    rng = np.random.default_rng(3)
+    parts = rng.integers(0, 4, size=h.nvtxs)
+    got = hypergraph_uncut(h, parts)
+    expect = [e for e in range(h.nhedges)
+              if len(set(parts[h.eind[h.eptr[e]:h.eptr[e + 1]]])) <= 1]
+    assert list(got) == expect
+    # one part -> nothing is cut
+    assert len(hypergraph_uncut(h, np.zeros(h.nvtxs, dtype=int))) == h.nhedges
+    # negative part ids (unassigned sentinels) work the same
+    got_neg = hypergraph_uncut(h, parts - 5)
+    assert list(got_neg) == expect

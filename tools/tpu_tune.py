@@ -55,7 +55,10 @@ def main() -> None:
                             dtype=str(np.dtype(dtype)), block=None,
                             sec=round(t, 4)))
         print(results[-1], flush=True)
-        for block in (1024, 4096, 16384):
+        # one-hot HBM traffic is nnz × seg_width and seg_width grows
+        # with the block size, so the sweep leans small (512-4096);
+        # 16384+ only ever paid for the VMEM-resident fused plans
+        for block in (512, 1024, 2048, 4096):
             lay = build_layout(tt, 0, block=block, val_dtype=dtype)
             for path, engines in (("sorted_onehot", ("xla", "pallas")),
                                   ("sorted_scatter", ("xla",))):
