@@ -167,11 +167,17 @@ def main() -> None:
 
     def run(X):
         # auto: phased per-phase jits on TPU (a whole-sweep program at
-        # NELL scale wedges the tunneled remote-compile service), the
-        # fully fused sweep elsewhere.
+        # NELL scale wedges the tunneled remote-compile service) and
+        # whenever the native host MTTKRP engine runs (host calls can't
+        # live inside a whole-sweep trace); the fully fused sweep
+        # elsewhere.
+        from splatt_tpu.ops.mttkrp import choose_impl
+
+        native = (isinstance(X, BlockedSparse)
+                  and choose_impl(X.opts) == "native")
         phased = (jit_mode == "phased"
                   or (jit_mode == "auto"
-                      and jax.default_backend() == "tpu"))
+                      and (jax.default_backend() == "tpu" or native)))
         sweep = (_make_phased_sweep if phased
                  else _make_sweep)(X, tt.nmodes, 0.0)
         # warmup / compile

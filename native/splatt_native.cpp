@@ -457,3 +457,114 @@ int sort_perm(const int64_t *inds, int64_t nnz, int nmodes,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native MTTKRP — the host fallback engine (≙ the reference's
+// register-blocked fiber loops, src/mttkrp.c:427-463, re-designed for
+// the blocked layout: a flat pass over mode-sorted nonzeros with a
+// rank-length register accumulator flushed on output-row change; no
+// tree, no locks — one core, contiguous rank-length rows, f32 or f64).
+//
+//   inds:    (nmodes, nnz_pad) int32 row-major (the layout's indices)
+//   vals:    (nnz_pad,) T
+//   factors: nmodes pointers, factors[k] = (dims[k], rank) T row-major
+//            (factors[mode] is never read)
+//   out:     (dims[mode], rank) T, caller-zeroed
+//   sorted:  nonzeros are sorted by `mode` (enables run accumulation);
+//            0 => direct scatter accumulation (generic modes)
+
+namespace {
+
+template <typename T>
+void mttkrp_impl(const int32_t *inds, const T *vals, int64_t nnz,
+                 int64_t nnz_pad, int nmodes, int mode,
+                 const T *const *factors, const int64_t *dims, int rank,
+                 T *out, int sorted) {
+  const int32_t *orow = inds + static_cast<int64_t>(mode) * nnz_pad;
+  const int64_t odim = dims[mode];
+  std::vector<T> accbuf(rank, T(0));
+  std::vector<T> prodbuf(rank);
+  T *acc = accbuf.data();
+  T *prod = prodbuf.data();
+  int64_t cur = -1;
+
+  // gather the non-output mode index streams once
+  const int32_t *oinds[8];
+  const T *ofac[8];
+  int nother = 0;
+  for (int k = 0; k < nmodes; ++k) {
+    if (k == mode) continue;
+    oinds[nother] = inds + static_cast<int64_t>(k) * nnz_pad;
+    ofac[nother] = factors[k];
+    ++nother;
+  }
+
+  for (int64_t n = 0; n < nnz; ++n) {
+    const int64_t row = orow[n];
+    const T v = vals[n];
+    if (nother == 2) {
+      const T *a = ofac[0] + static_cast<int64_t>(oinds[0][n]) * rank;
+      const T *b = ofac[1] + static_cast<int64_t>(oinds[1][n]) * rank;
+      if (sorted) {
+        if (row != cur) {
+          if (cur >= 0 && cur < odim) {
+            T *o = out + cur * rank;
+            for (int r = 0; r < rank; ++r) o[r] += acc[r];
+          }
+          for (int r = 0; r < rank; ++r) acc[r] = T(0);
+          cur = row;
+        }
+        for (int r = 0; r < rank; ++r) acc[r] += v * a[r] * b[r];
+      } else if (row >= 0 && row < odim) {
+        T *o = out + row * rank;
+        for (int r = 0; r < rank; ++r) o[r] += v * a[r] * b[r];
+      }
+    } else {
+      for (int r = 0; r < rank; ++r) prod[r] = v;
+      for (int j = 0; j < nother; ++j) {
+        const T *u = ofac[j] + static_cast<int64_t>(oinds[j][n]) * rank;
+        for (int r = 0; r < rank; ++r) prod[r] *= u[r];
+      }
+      if (sorted) {
+        if (row != cur) {
+          if (cur >= 0 && cur < odim) {
+            T *o = out + cur * rank;
+            for (int r = 0; r < rank; ++r) o[r] += acc[r];
+          }
+          for (int r = 0; r < rank; ++r) acc[r] = T(0);
+          cur = row;
+        }
+        for (int r = 0; r < rank; ++r) acc[r] += prod[r];
+      } else if (row >= 0 && row < odim) {
+        T *o = out + row * rank;
+        for (int r = 0; r < rank; ++r) o[r] += prod[r];
+      }
+    }
+  }
+  if (sorted && cur >= 0 && cur < odim) {
+    T *o = out + cur * rank;
+    for (int r = 0; r < rank; ++r) o[r] += acc[r];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void mttkrp_f32(const int32_t *inds, const float *vals, int64_t nnz,
+                int64_t nnz_pad, int nmodes, int mode,
+                const float *const *factors, const int64_t *dims, int rank,
+                float *out, int sorted) {
+  mttkrp_impl<float>(inds, vals, nnz, nnz_pad, nmodes, mode, factors, dims,
+                     rank, out, sorted);
+}
+
+void mttkrp_f64(const int32_t *inds, const double *vals, int64_t nnz,
+                int64_t nnz_pad, int nmodes, int mode,
+                const double *const *factors, const int64_t *dims, int rank,
+                double *out, int sorted) {
+  mttkrp_impl<double>(inds, vals, nnz, nnz_pad, nmodes, mode, factors, dims,
+                      rank, out, sorted);
+}
+
+}  // extern "C"

@@ -24,7 +24,8 @@ from splatt_tpu.cpd import init_factors
 from splatt_tpu.ops.mttkrp import (choose_impl, mttkrp_blocked,
                                    mttkrp_stream, mttkrp_ttbox)
 
-ALGS = ("stream", "blocked", "blocked_pallas", "scatter", "ttbox")
+ALGS = ("stream", "blocked", "blocked_pallas", "scatter", "ttbox",
+        "native")
 
 
 def _alg_plan(alg: str, layout, mode: int, dim: int, opts: Options):
@@ -81,6 +82,14 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
             elif alg == "ttbox":
                 fn = lambda: mttkrp_ttbox(inds, vals, factors, mode,
                                           tt.dims[mode])
+            elif alg == "native":
+                from splatt_tpu.ops.mttkrp import _mttkrp_native
+
+                layout = bs.layout_for(mode)
+                if _mttkrp_native(layout, factors, mode, None) is None:
+                    times.append(float("nan"))
+                    continue
+                fn = lambda: _mttkrp_native(layout, factors, mode, None)
             else:
                 layout = bs.layout_for(mode)
                 plan = _alg_plan(alg, layout, mode, tt.dims[mode], opts)
@@ -123,6 +132,14 @@ def crosscheck_mttkrp(tt: SparseTensor, rank: int = 16,
             if alg == "ttbox":
                 out = mttkrp_ttbox(inds, vals, factors, mode,
                                    tt.dims[mode])
+            elif alg == "native":
+                from splatt_tpu.ops.mttkrp import _mttkrp_native
+
+                out = _mttkrp_native(bs.layout_for(mode), factors, mode,
+                                     None)
+                if out is None:
+                    skipped += 1
+                    continue
             else:
                 layout = bs.layout_for(mode)
                 plan = _alg_plan(alg, layout, mode, tt.dims[mode], opts)

@@ -276,7 +276,13 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     if profiled:
         sweep = _make_profiled_sweep(X, nmodes, opts.regularization)
     else:
-        phased = jax.default_backend() == "tpu"
+        from splatt_tpu.ops.mttkrp import choose_impl
+
+        # phased also when the native C++ MTTKRP engine will run: it
+        # executes on host and cannot live inside a whole-sweep trace
+        phased = (jax.default_backend() == "tpu"
+                  or (isinstance(X, BlockedSparse)
+                      and choose_impl(opts) == "native"))
         sweep = (_make_phased_sweep if phased
                  else _make_sweep)(X, nmodes, opts.regularization)
     if profiled:

@@ -28,14 +28,18 @@ _load_failed = False
 
 
 def _build() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-             "-o", str(_SO_PATH), str(_SRC_PATH)],
-            check=True, capture_output=True, timeout=300)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", str(_SO_PATH), str(_SRC_PATH)]
+    # -march=native vectorizes the MTTKRP rank loops; retry without it
+    # for toolchains that reject the flag
+    for flags in (base[:2] + ["-march=native"] + base[2:], base):
+        try:
+            subprocess.run(flags, check=True, capture_output=True,
+                           timeout=300)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return False
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -71,6 +75,13 @@ def _load() -> Optional[ctypes.CDLL]:
                               ctypes.c_void_p]
     lib.tns_stream_to_bin.restype = ctypes.c_int
     lib.tns_stream_to_bin.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    for name in ("mttkrp_f32", "mttkrp_f64"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                       ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                       ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+                       ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
     _lib = lib
     return lib
 
@@ -153,3 +164,45 @@ def sort_perm(inds: np.ndarray, dims: Sequence[int],
     if rc != 0:
         return None
     return perm
+
+
+def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
+           dims: Sequence[int], sorted_by_mode: bool) -> Optional[np.ndarray]:
+    """Native single-core MTTKRP over a blocked layout's arrays
+    (≙ the reference's register-blocked fiber loops, src/mttkrp.c:427-463
+    — re-designed as a flat pass with run accumulation).
+
+    inds: (nmodes, nnz_pad) int32; vals: (nnz_pad,) f32/f64 (padding is
+    zero-valued, only the first `len(vals)` entries — all of them — are
+    read); factors: per-mode (dims[k], rank) arrays matching vals'
+    dtype.  None → caller should fall back to the XLA engines.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals)
+    dtype = vals.dtype
+    if dtype == np.float32:
+        fn = lib.mttkrp_f32
+    elif dtype == np.float64:
+        fn = lib.mttkrp_f64
+    else:
+        return None
+    inds = np.ascontiguousarray(inds, dtype=np.int32)
+    nmodes, nnz_pad = inds.shape
+    if nmodes > 8:
+        return None
+    facs = [np.ascontiguousarray(f, dtype=dtype) for f in factors]
+    rank = facs[0].shape[1]
+    fac_ptrs = (ctypes.c_void_p * nmodes)(
+        *[f.ctypes.data_as(ctypes.c_void_p).value for f in facs])
+    dims_arr = np.asarray(dims, dtype=np.int64)
+    out = np.zeros((dims[mode], rank), dtype=dtype)
+    fn(inds.ctypes.data_as(ctypes.c_void_p),
+       vals.ctypes.data_as(ctypes.c_void_p),
+       ctypes.c_int64(nnz_pad), ctypes.c_int64(nnz_pad),
+       ctypes.c_int(nmodes), ctypes.c_int(mode),
+       fac_ptrs, dims_arr.ctypes.data_as(ctypes.c_void_p),
+       ctypes.c_int(rank), out.ctypes.data_as(ctypes.c_void_p),
+       ctypes.c_int(1 if sorted_by_mode else 0))
+    return out

@@ -31,6 +31,7 @@ from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from splatt_tpu.blocked import BlockedSparse, ModeLayout
 from splatt_tpu.config import Options
@@ -385,12 +386,24 @@ def _choose_path_bs(bs: BlockedSparse, mode: int) -> str:
     return choose_path(layout, mode, bs.opts)
 
 
+def native_available() -> bool:
+    """Whether the native C++ MTTKRP engine can run here."""
+    from splatt_tpu import native
+
+    return native.available()
+
+
 def choose_impl(opts: Options) -> str:
-    """Pick the one-hot reduction engine: Pallas on TPU (or when forced),
-    scanned-XLA elsewhere; forcing Pallas off-TPU uses interpret mode."""
+    """Pick the MTTKRP engine: Pallas on TPU (or when forced), the
+    native C++ host kernel on CPU when the library is available,
+    scanned-XLA otherwise; forcing Pallas off-TPU uses interpret mode.
+    ``use_pallas=False`` forces pure-XLA (the differential tests' way to
+    pin the jit engines)."""
     backend = jax.default_backend()
     if opts.use_pallas is None:
-        return "pallas" if backend == "tpu" else "xla"
+        if backend == "tpu":
+            return "pallas"
+        return "native" if native_available() else "xla"
     if not opts.use_pallas:
         return "xla"
     return "pallas" if backend == "tpu" else "pallas_interpret"
@@ -414,8 +427,36 @@ def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
         vals = jnp.asarray(X.vals)
         return mttkrp_stream(inds, vals, factors, mode, X.dims[mode])
     layout = X.layout_for(mode)
-    if path is None:
-        path = _choose_path_bs(X, mode)
     if impl is None:
         impl = choose_impl(X.opts)
+    if impl == "native":
+        out = _mttkrp_native(layout, factors, mode, path)
+        if out is not None:
+            return out
+        impl = "xla"  # tracer inputs / unsupported dtype / lib missing
+    if path is None:
+        path = _choose_path_bs(X, mode)
     return mttkrp_blocked(layout, factors, mode, path=path, impl=impl)
+
+
+def _mttkrp_native(layout: ModeLayout, factors: List[jax.Array], mode: int,
+                   path: Optional[str]) -> Optional[jax.Array]:
+    """Run the native C++ host engine, or None to fall back to the jit
+    engines (inside a jit trace, non-f32/f64 dtypes, missing library,
+    or a forced path that pins a specific jit algorithm)."""
+    from splatt_tpu import native
+
+    if path is not None:
+        return None  # explicit path = the caller wants that jit engine
+    if any(isinstance(U, jax.core.Tracer) for U in factors):
+        return None  # inside a jit trace (e.g. the fused sweep)
+    if factors[0].dtype not in (jnp.float32, jnp.float64):
+        return None
+    dims = [int(f.shape[0]) for f in factors]
+    out = native.mttkrp(
+        np.asarray(layout.inds), np.asarray(layout.vals),
+        [np.asarray(U) for U in factors], mode, dims,
+        sorted_by_mode=(mode == layout.mode))
+    if out is None:
+        return None
+    return jnp.asarray(out)

@@ -190,8 +190,11 @@ def test_phased_sweep_matches_fused():
     dims = (14, 11, 9)
     ind = np.stack([rng.integers(0, d, size=300) for d in dims])
     tt = SparseTensor(ind, rng.random(300), dims)
+    # pin the XLA engine: the un-jitted phased sweep would otherwise
+    # dispatch to the native C++ engine, whose summation order differs
     bs = BlockedSparse.from_coo(tt, _opts(nnz_block=128,
-                                          block_alloc=BlockAlloc.ALLMODE))
+                                          block_alloc=BlockAlloc.ALLMODE,
+                                          use_pallas=False))
     outs = []
     for builder in (_make_sweep, _make_phased_sweep):
         factors = init_factors(tt.dims, 6, 3, dtype=jnp.float64)

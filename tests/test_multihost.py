@@ -65,7 +65,7 @@ def _ground_truth(decomp: str):
     return distributed_cpd_als(tt, rank=4, opts=opts)
 
 
-@pytest.mark.parametrize("decomp", ["medium", "fine"])
+@pytest.mark.parametrize("decomp", ["medium", "fine", "coarse"])
 def test_two_process_matches_single(decomp, tmp_path):
     results = _run_pair(decomp, tmp_path)
     ref = _ground_truth(decomp)
