@@ -125,6 +125,9 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     ≙ csf_find_mode_order).
     """
     nmodes, nnz = tt.nmodes, tt.nnz
+    from splatt_tpu.utils.env import check_int32_dims
+
+    check_int32_dims(tt.dims)
     others = secondary_order(tt.dims, mode, mode_order, mode_order_custom)
     order = [mode] + others
     perm = tt.sort_order(order)

@@ -268,6 +268,14 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         factors = init_factors(dims, rank, opts.seed(), dtype=dtype)
     grams = [gram(U) for U in factors]
 
+    if opts.verbosity >= Verbosity.LOW:
+        if isinstance(X, BlockedSparse):
+            from splatt_tpu.ops.mttkrp import describe_plan
+
+            print(f"  {describe_plan(X, factors)}")
+        else:
+            print("  engine plan: impl=xla mode*=stream (COO oracle)")
+
     # -v -v: split-jit profiled sweep with real per-phase attribution.
     # On TPU the default is the phased sweep: one whole-sweep XLA
     # program at NELL scale wedges the tunneled remote-compile service

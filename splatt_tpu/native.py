@@ -167,15 +167,18 @@ def sort_perm(inds: np.ndarray, dims: Sequence[int],
 
 
 def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
-           dims: Sequence[int], sorted_by_mode: bool) -> Optional[np.ndarray]:
+           dims: Sequence[int], sorted_by_mode: bool,
+           nnz: Optional[int] = None) -> Optional[np.ndarray]:
     """Native single-core MTTKRP over a blocked layout's arrays
     (≙ the reference's register-blocked fiber loops, src/mttkrp.c:427-463
     — re-designed as a flat pass with run accumulation).
 
-    inds: (nmodes, nnz_pad) int32; vals: (nnz_pad,) f32/f64 (padding is
-    zero-valued, only the first `len(vals)` entries — all of them — are
-    read); factors: per-mode (dims[k], rank) arrays matching vals'
-    dtype.  None → caller should fall back to the XLA engines.
+    inds: (nmodes, nnz_pad) int32; vals: (nnz_pad,) f32/f64; factors:
+    per-mode (dims[k], rank) arrays matching vals' dtype.  `nnz` is the
+    true nonzero count — padding entries trail the sort and carry a
+    sentinel index equal to `dim` on the sort-mode row, which is out of
+    range for the factor gather, so the kernel must never touch them.
+    None → caller should fall back to the XLA engines.
     """
     lib = _load()
     if lib is None:
@@ -188,10 +191,14 @@ def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
         fn = lib.mttkrp_f64
     else:
         return None
+    if any(np.asarray(f).dtype != dtype for f in factors):
+        return None  # mixed dtypes: let the XLA paths apply promotion
     inds = np.ascontiguousarray(inds, dtype=np.int32)
     nmodes, nnz_pad = inds.shape
     if nmodes > 8:
         return None
+    if nnz is None:
+        nnz = nnz_pad
     facs = [np.ascontiguousarray(f, dtype=dtype) for f in factors]
     rank = facs[0].shape[1]
     fac_ptrs = (ctypes.c_void_p * nmodes)(
@@ -200,7 +207,7 @@ def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
     out = np.zeros((dims[mode], rank), dtype=dtype)
     fn(inds.ctypes.data_as(ctypes.c_void_p),
        vals.ctypes.data_as(ctypes.c_void_p),
-       ctypes.c_int64(nnz_pad), ctypes.c_int64(nnz_pad),
+       ctypes.c_int64(min(nnz, nnz_pad)), ctypes.c_int64(nnz_pad),
        ctypes.c_int(nmodes), ctypes.c_int(mode),
        fac_ptrs, dims_arr.ctypes.data_as(ctypes.c_void_p),
        ctypes.c_int(rank), out.ctypes.data_as(ctypes.c_void_p),

@@ -344,6 +344,41 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     return "xla_scan"
 
 
+def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
+    """One-line human-readable dispatch plan for a CPD run over `X` —
+    which impl (native/pallas/xla) and, per mode, which path/engine
+    mttkrp() will actually execute.  Dispatch falls back silently (VMEM
+    gates, Mosaic capability probes), so the CLI prints this at
+    Verbosity.LOW to make the chosen engine observable
+    (≙ the reference's CSF/tile report lines, src/stats.c:226-296).
+    """
+    impl = choose_impl(X.opts)
+    # mirror every runtime fallback of _mttkrp_native/native.mttkrp so
+    # the printed plan is what will actually execute
+    native_runs = (impl == "native" and native_available()
+                   and X.nmodes <= 8
+                   and factors[0].dtype in (jnp.float32, jnp.float64)
+                   and factors[0].dtype == X.layouts[0].vals.dtype)
+    parts = []
+    for m in range(X.nmodes):
+        path = _choose_path_bs(X, m)
+        if native_runs:
+            eng = "native"
+        elif impl == "native":
+            eng = engine_plan(X.layout_for(m), factors, m, path=path,
+                              impl="xla")
+        else:
+            eng = engine_plan(X.layout_for(m), factors, m, path=path,
+                              impl=impl)
+        parts.append(f"mode{m}={path}/{eng}")
+    note = ""
+    from splatt_tpu.ops.pallas_kernels import PROBE_STATES
+
+    if PROBE_STATES.get("fused_t") == "timeout":
+        note = " [fused_t probe timed out: unproven, not rejected]"
+    return f"engine plan: impl={impl} " + " ".join(parts) + note
+
+
 def _unfused_hbm_ok(layout: ModeLayout, R: int, itemsize: int,
                     budget_bytes: int = 6 << 30) -> bool:
     """Whether the unfused Pallas plan's (nnz_pad, R) HBM partial-product
@@ -456,7 +491,7 @@ def _mttkrp_native(layout: ModeLayout, factors: List[jax.Array], mode: int,
     out = native.mttkrp(
         np.asarray(layout.inds), np.asarray(layout.vals),
         [np.asarray(U) for U in factors], mode, dims,
-        sorted_by_mode=(mode == layout.mode))
+        sorted_by_mode=(mode == layout.mode), nnz=layout.nnz)
     if out is None:
         return None
     return jnp.asarray(out)

@@ -357,7 +357,15 @@ def fused_mttkrp_t(layout, factors, mode: int, width: int,
     return jnp.swapaxes(out, 1, 2)[:, :, :R]
 
 
-def _probe_compiles(kernel_fn) -> bool:
+#: outcome of each capability probe, keyed by kernel name — "ok",
+#: "compile_failed", "timeout", or absent if never probed.  "timeout"
+#: means the verdict is *unproven* (a transiently slow remote-compile
+#: service, not a rejected kernel) and that an orphaned daemon thread
+#: may still be using the chip; engine_plan/CLI surface this.
+PROBE_STATES: dict = {}
+
+
+def _probe_compiles(kernel_fn, name: str) -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
     interpret)` COMPILES for this backend at a *representative* shape.
     Lowering alone is not enough: Mosaic layout inference (e.g. the
@@ -367,6 +375,7 @@ def _probe_compiles(kernel_fn) -> bool:
     crashes the Mosaic compiler subprocess (tools/fused_bisect.py), so
     the probe uses a production-like block and dims."""
     if jax.default_backend() != "tpu":
+        PROBE_STATES[name] = "not_tpu"
         return False
 
     def compile_case():
@@ -407,7 +416,23 @@ def _probe_compiles(kernel_fn) -> bool:
     t = threading.Thread(target=runner, daemon=True)
     t.start()
     t.join(timeout=240)
-    return bool(result and result[0])
+    if not result:
+        # Deadline hit, not a compile rejection: the verdict is unproven
+        # and the orphaned thread may still occupy the (single-lease)
+        # chip.  Cache it anyway — re-probing would stall every dispatch
+        # by another 240 s — but say so loudly and record the distinct
+        # state so engine_plan/CLI can report "unproven", not "rejected".
+        PROBE_STATES[name] = "timeout"
+        import sys
+
+        print(f"splatt-tpu: WARNING: {name} capability probe timed out "
+              f"after 240 s (remote compile slow/wedged, NOT a kernel "
+              f"rejection); treating as unsupported this session — an "
+              f"orphaned compile thread may briefly contend for the chip",
+              file=sys.stderr, flush=True)
+        return False
+    PROBE_STATES[name] = "ok" if result[0] else "compile_failed"
+    return bool(result[0])
 
 
 @functools.cache
@@ -415,7 +440,7 @@ def fused_t_supported() -> bool:
     """Whether the transposed-table fused kernel compiles here (its
     lane-wise same-shape take_along_axis gather is the form Mosaic
     supports on jax 0.9.0)."""
-    return _probe_compiles(fused_mttkrp_t)
+    return _probe_compiles(fused_mttkrp_t, "fused_t")
 
 
 @functools.cache
@@ -425,7 +450,7 @@ def fused_gather_supported() -> bool:
     same-shaped take_along_axis is), so this is False on current
     hardware — kept for future jax versions; interpret mode covers it
     in tests."""
-    return _probe_compiles(fused_mttkrp)
+    return _probe_compiles(fused_mttkrp, "fused_gather")
 
 
 def fused_vmem_ok(factors, mode: int, width: int, block: int,

@@ -43,6 +43,10 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
                 np.zeros(nbuckets, dtype=np.int64))
     if owner.min() < 0 or owner.max() >= nbuckets:
         raise ValueError(f"owner ids must lie in [0, {nbuckets})")
+    if int(inds.max()) >= 2**31 - 1:
+        from splatt_tpu.utils.env import check_int32_dims
+
+        check_int32_dims([int(inds.max()) + 1])  # loud, shared message
     counts = np.bincount(owner, minlength=nbuckets)
     C = max(int(counts.max()), 1)
     order = np.argsort(owner, kind="stable")
@@ -154,6 +158,10 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
         offs = np.zeros(nbuckets + 1, dtype=np.int64)
         np.cumsum(ccounts, out=offs[1:])
         slot = cursor[own_s] + (np.arange(own_s.size) - offs[own_s])
+        if ichunk.size and int(ichunk.max()) >= 2**31 - 1:
+            from splatt_tpu.utils.env import check_int32_dims
+
+            check_int32_dims([int(ichunk.max()) + 1])
         placed = ichunk[:, order].astype(np.int32)
         if postprocess is not None:
             placed = postprocess(placed)

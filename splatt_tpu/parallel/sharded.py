@@ -68,6 +68,9 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
     to the largest.  Pad entries point at row 0 with value 0 — harmless
     to every kernel.
     """
+    from splatt_tpu.utils.env import check_int32_dims
+
+    check_int32_dims(tt.dims)
     ndev = mesh.shape[axis]
     if partition is None:
         nnz_pad = max(ndev, _pad_to(tt.nnz, ndev))
@@ -284,12 +287,14 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
                else "all2all")
     if opts.verbosity >= Verbosity.HIGH:
-        # ≙ mpi_rank_stats + mpi_send_recv_stats; equal contiguous
-        # chunks unless a FINE partition reshuffled the nonzeros
+        # ≙ mpi_rank_stats + mpi_send_recv_stats.  Measured occupancy,
+        # not the equal-chunk assumption: padding trails, so the last
+        # chunk(s) hold the shortfall.
         if partition is not None:
             counts = np.bincount(np.asarray(partition), minlength=ndev)
         else:
-            counts = np.full(ndev, tt.nnz // max(ndev, 1))
+            chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
+            counts = np.clip(tt.nnz - chunk * np.arange(ndev), 0, chunk)
         print(imbalance_report(counts, "shard"))
         for line in comm_volume_report(dims_pad, rank,
                                        np.dtype(dtype).itemsize, ndev=ndev):

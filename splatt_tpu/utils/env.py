@@ -10,19 +10,36 @@ def ceil_to(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
 
+def check_int32_dims(dims) -> None:
+    """Device indices are int32 (≙ the reference's compile-time
+    splatt_idx_t choice, include/splatt/types_config.h:38-43), and the
+    blocked layouts use `dim` itself as the padding sentinel — so every
+    dim must fit strictly below INT32_MAX.  Called by each path that
+    casts host int64 coordinates down (layout build, nnz sharding,
+    bucket scatter) so overflow fails loudly instead of wrapping.
+    """
+    limit = 2**31 - 1
+    if max(dims, default=0) >= limit:
+        raise ValueError(
+            f"dims {tuple(dims)} exceed the int32 device index width "
+            f"(max dim must be < {limit}); relabel/split the mode first")
+
+
 def host_fence(x):
     """Force true device completion of `x` and everything it depends on.
 
     block_until_ready alone is not enough on tunneled/relayed devices
     (e.g. the axon TPU relay), which can ack readiness before execution
     finishes — a one-element host fetch is a true data-dependency fence.
-    Returns `x` for chaining.
+    Every leaf is fetched: under the phased sweep the leaves are produced
+    by separate device programs, so fencing only the first would leave
+    the later phases un-covered.  Returns `x` for chaining.
     """
     import jax
 
-    leaf = jax.tree_util.tree_leaves(x)[0]
     jax.block_until_ready(x)
-    jax.device_get(leaf.ravel()[0])
+    for leaf in jax.tree_util.tree_leaves(x):
+        jax.device_get(leaf.ravel()[0])
     return x
 
 

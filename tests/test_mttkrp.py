@@ -175,3 +175,18 @@ def test_float32_tolerance(any_tensor):
         got = np.asarray(mttkrp(bs, factors32, mode))
         want = np_mttkrp(tt, factors32, mode)
         np.testing.assert_allclose(got, want, rtol=9e-3, atol=9e-3)
+
+
+def test_layout_rejects_dims_beyond_int32():
+    """Device indices are int32 (the sentinel is `dim` itself); layouts
+    must fail loudly instead of wrapping in the cast (VERDICT r2 #9)."""
+    import pytest
+
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.coo import SparseTensor
+
+    big = 2**31 - 1
+    tt = SparseTensor(inds=np.array([[0], [1], [2]], dtype=np.int64),
+                      vals=np.ones(1), dims=(4, 5, big))
+    with pytest.raises(ValueError, match="int32"):
+        build_layout(tt, 0, block=128)

@@ -208,3 +208,60 @@ def test_native_mttkrp_inside_trace_falls_back():
     gold = np.asarray(mttkrp_stream(jnp.asarray(tt.inds),
                                     jnp.asarray(tt.vals), fac, 0, dims[0]))
     np.testing.assert_allclose(np.asarray(traced(fac)), gold, atol=1e-10)
+
+
+def test_native_mttkrp_never_reads_padding():
+    """Regression for the round-2 nondeterministic NaN failures: the
+    kernel must never touch padded entries.  Padding carries a sentinel
+    index equal to `dim` on the sort-mode row — an out-of-bounds factor
+    gather (UB; 0*garbage injected NaN depending on heap state).  Here
+    the padding is poisoned with huge values and in-bounds indices so a
+    loop bound that includes it fails deterministically."""
+    import jax.numpy as jnp
+
+    from splatt_tpu.ops.mttkrp import mttkrp_stream
+
+    rng = np.random.default_rng(5)
+    dims = (11, 7, 9)
+    nnz, rank = 100, 6
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    vals = rng.random(nnz)
+    fac = [jnp.asarray(rng.random((d, rank))) for d in dims]
+
+    nnz_pad = 256  # pretend block padding
+    pinds = np.zeros((3, nnz_pad), dtype=np.int32)
+    pinds[:, :nnz] = inds
+    pinds[:, nnz:] = 1          # in-bounds poison rows
+    pvals = np.full(nnz_pad, 1e30)
+    pvals[:nnz] = vals
+
+    for mode in range(3):
+        gold = np.asarray(mttkrp_stream(
+            jnp.asarray(inds), jnp.asarray(vals), fac, mode, dims[mode]))
+        for sorted_by_mode in (False, True):
+            if sorted_by_mode:
+                order = np.argsort(pinds[mode, :nnz], kind="stable")
+                sinds, svals = pinds.copy(), pvals.copy()
+                sinds[:, :nnz] = pinds[:, :nnz][:, order]
+                svals[:nnz] = pvals[:nnz][order]
+            else:
+                sinds, svals = pinds, pvals
+            out = native.mttkrp(sinds, svals,
+                                [np.asarray(f) for f in fac], mode, dims,
+                                sorted_by_mode=sorted_by_mode, nnz=nnz)
+            assert out is not None
+            np.testing.assert_allclose(
+                out, gold, atol=1e-10,
+                err_msg=f"mode={mode} sorted={sorted_by_mode}")
+
+
+def test_native_mttkrp_dtype_mismatch_falls_back():
+    """f64 factors with an f32 layout must return None (the XLA paths
+    own the promotion semantics), not silently compute in f32."""
+    rng = np.random.default_rng(6)
+    dims = (5, 4, 3)
+    inds = np.stack([rng.integers(0, d, 50) for d in dims]).astype(np.int32)
+    vals = rng.random(50).astype(np.float32)
+    fac64 = [rng.random((d, 4)) for d in dims]
+    assert native.mttkrp(inds, vals, fac64, 0, dims,
+                         sorted_by_mode=False, nnz=50) is None
