@@ -83,13 +83,14 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
                 fn = lambda: mttkrp_ttbox(inds, vals, factors, mode,
                                           tt.dims[mode])
             elif alg == "native":
-                from splatt_tpu.ops.mttkrp import _mttkrp_native
+                from splatt_tpu.ops.mttkrp import _run_native, plan_mttkrp
 
                 layout = bs.layout_for(mode)
-                if _mttkrp_native(layout, factors, mode, None) is None:
+                if plan_mttkrp(bs, factors, mode,
+                               impl="native").engine != "native":
                     times.append(float("nan"))
                     continue
-                fn = lambda: _mttkrp_native(layout, factors, mode, None)
+                fn = lambda: _run_native(layout, factors, mode)
             else:
                 layout = bs.layout_for(mode)
                 plan = _alg_plan(alg, layout, mode, tt.dims[mode], opts)
@@ -133,10 +134,13 @@ def crosscheck_mttkrp(tt: SparseTensor, rank: int = 16,
                 out = mttkrp_ttbox(inds, vals, factors, mode,
                                    tt.dims[mode])
             elif alg == "native":
-                from splatt_tpu.ops.mttkrp import _mttkrp_native
+                from splatt_tpu.ops.mttkrp import _run_native, plan_mttkrp
 
-                out = _mttkrp_native(bs.layout_for(mode), factors, mode,
-                                     None)
+                layout = bs.layout_for(mode)
+                out = (_run_native(layout, factors, mode)
+                       if plan_mttkrp(bs, factors, mode,
+                                      impl="native").engine == "native"
+                       else None)
                 if out is None:
                     skipped += 1
                     continue

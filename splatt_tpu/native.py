@@ -168,16 +168,18 @@ def sort_perm(inds: np.ndarray, dims: Sequence[int],
 
 def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
            dims: Sequence[int], sorted_by_mode: bool,
-           nnz: Optional[int] = None) -> Optional[np.ndarray]:
+           nnz: int) -> Optional[np.ndarray]:
     """Native single-core MTTKRP over a blocked layout's arrays
     (≙ the reference's register-blocked fiber loops, src/mttkrp.c:427-463
     — re-designed as a flat pass with run accumulation).
 
     inds: (nmodes, nnz_pad) int32; vals: (nnz_pad,) f32/f64; factors:
     per-mode (dims[k], rank) arrays matching vals' dtype.  `nnz` is the
-    true nonzero count — padding entries trail the sort and carry a
-    sentinel index equal to `dim` on the sort-mode row, which is out of
-    range for the factor gather, so the kernel must never touch them.
+    true nonzero count and is REQUIRED: padding entries trail the sort
+    and carry a sentinel index equal to `dim` on the sort-mode row —
+    out of range for the factor gather — so a loop bound that includes
+    them is undefined behavior (the round-2 nondeterminism bug).  Pass
+    nnz == inds.shape[1] only for genuinely unpadded arrays.
     None → caller should fall back to the XLA engines.
     """
     lib = _load()
@@ -197,8 +199,6 @@ def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
     nmodes, nnz_pad = inds.shape
     if nmodes > 8:
         return None
-    if nnz is None:
-        nnz = nnz_pad
     facs = [np.ascontiguousarray(f, dtype=dtype) for f in factors]
     rank = facs[0].shape[1]
     fac_ptrs = (ctypes.c_void_p * nmodes)(

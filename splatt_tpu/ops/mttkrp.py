@@ -27,7 +27,7 @@ p_is_privatized :221-236) is static at trace time.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Sequence, Union
+from typing import List, NamedTuple, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -344,6 +344,70 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     return "xla_scan"
 
 
+class Plan(NamedTuple):
+    """One MTTKRP dispatch decision: the resolved engine family
+    (`impl`), the algorithm (`path`), and the reduction engine that
+    will actually execute (`engine`).  :func:`plan_mttkrp` is the single
+    source of this truth — :func:`mttkrp` executes the plan it returns
+    and :func:`describe_plan`/benches/tests print the same object, so
+    the reported plan cannot desynchronize from what runs."""
+
+    impl: str    # "native" | "pallas" | "pallas_interpret" | "xla"
+    path: str    # one of PATHS
+    engine: str  # "native" | "fused_t" | "fused" | "unfused_pallas" | "xla_scan" | "xla"
+
+
+def _native_runnable(layout: ModeLayout, factors: Sequence[jax.Array],
+                     path: Optional[str]) -> bool:
+    """Exactly the conditions under which the native C++ engine runs —
+    each mirrors a bailout inside :func:`native.mttkrp` or the trace
+    check in dispatch, so `plan.engine == "native"` iff it executes."""
+    if path is not None:
+        return False  # explicit path = the caller wants that jit engine
+    if any(isinstance(U, jax.core.Tracer) for U in factors):
+        return False  # inside a jit trace (e.g. the fused sweep)
+    vdt = layout.vals.dtype
+    if vdt not in (jnp.float32, jnp.float64):
+        return False
+    if any(f.dtype != vdt for f in factors):
+        return False  # mixed dtypes: the XLA paths own promotion
+    if layout.nmodes > 8:
+        return False
+    return native_available()
+
+
+def _resolve_dispatch(X: "BlockedSparse", factors: Sequence[jax.Array],
+                      mode: int, path: Optional[str],
+                      impl: Optional[str]) -> tuple:
+    """Resolve (impl, path) — the part of the dispatch decision
+    :func:`mttkrp` needs to execute.  The engine-within-impl choice is
+    made by engine_plan inside mttkrp_blocked; plan_mttkrp surfaces it
+    for reporting without making the hot path compute it twice."""
+    if impl is None:
+        impl = choose_impl(X.opts)
+    if impl == "native":
+        if _native_runnable(X.layout_for(mode), factors, path):
+            return "native", path or _choose_path_bs(X, mode)
+        impl = "xla"
+    if path is None:
+        path = _choose_path_bs(X, mode)
+    return impl, path
+
+
+def plan_mttkrp(X: "BlockedSparse", factors: Sequence[jax.Array], mode: int,
+                path: Optional[str] = None,
+                impl: Optional[str] = None) -> Plan:
+    """Compute the dispatch decision :func:`mttkrp` will execute for
+    this call (≙ mttkrp_csf dispatch, src/mttkrp.c:1287-1341 — but
+    reified as a value so benches/CLI/tests can consume the same
+    decision instead of hand-mirroring the conditions)."""
+    impl, path = _resolve_dispatch(X, factors, mode, path, impl)
+    if impl == "native":
+        return Plan("native", path, "native")
+    return Plan(impl, path,
+                engine_plan(X.layout_for(mode), factors, mode, path, impl))
+
+
 def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
     """One-line human-readable dispatch plan for a CPD run over `X` —
     which impl (native/pallas/xla) and, per mode, which path/engine
@@ -351,26 +415,13 @@ def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
     gates, Mosaic capability probes), so the CLI prints this at
     Verbosity.LOW to make the chosen engine observable
     (≙ the reference's CSF/tile report lines, src/stats.c:226-296).
+    Built from the same :func:`plan_mttkrp` objects dispatch executes.
     """
     impl = choose_impl(X.opts)
-    # mirror every runtime fallback of _mttkrp_native/native.mttkrp so
-    # the printed plan is what will actually execute
-    native_runs = (impl == "native" and native_available()
-                   and X.nmodes <= 8
-                   and factors[0].dtype in (jnp.float32, jnp.float64)
-                   and factors[0].dtype == X.layouts[0].vals.dtype)
     parts = []
     for m in range(X.nmodes):
-        path = _choose_path_bs(X, m)
-        if native_runs:
-            eng = "native"
-        elif impl == "native":
-            eng = engine_plan(X.layout_for(m), factors, m, path=path,
-                              impl="xla")
-        else:
-            eng = engine_plan(X.layout_for(m), factors, m, path=path,
-                              impl=impl)
-        parts.append(f"mode{m}={path}/{eng}")
+        plan = plan_mttkrp(X, factors, m)
+        parts.append(f"mode{m}={plan.path}/{plan.engine}")
     note = ""
     from splatt_tpu.ops.pallas_kernels import PROBE_STATES
 
@@ -461,32 +512,25 @@ def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
         inds = jnp.asarray(X.inds)
         vals = jnp.asarray(X.vals)
         return mttkrp_stream(inds, vals, factors, mode, X.dims[mode])
+    rimpl, rpath = _resolve_dispatch(X, factors, mode, path, impl)
     layout = X.layout_for(mode)
-    if impl is None:
-        impl = choose_impl(X.opts)
-    if impl == "native":
-        out = _mttkrp_native(layout, factors, mode, path)
+    if rimpl == "native":
+        out = _run_native(layout, factors, mode)
         if out is not None:
             return out
-        impl = "xla"  # tracer inputs / unsupported dtype / lib missing
-    if path is None:
-        path = _choose_path_bs(X, mode)
-    return mttkrp_blocked(layout, factors, mode, path=path, impl=impl)
+        # the shared library failed at call time (not a planned
+        # condition — e.g. deleted mid-session); degrade to XLA
+        rimpl = "xla"
+    return mttkrp_blocked(layout, factors, mode, path=rpath, impl=rimpl)
 
 
-def _mttkrp_native(layout: ModeLayout, factors: List[jax.Array], mode: int,
-                   path: Optional[str]) -> Optional[jax.Array]:
-    """Run the native C++ host engine, or None to fall back to the jit
-    engines (inside a jit trace, non-f32/f64 dtypes, missing library,
-    or a forced path that pins a specific jit algorithm)."""
+def _run_native(layout: ModeLayout, factors: List[jax.Array],
+                mode: int) -> Optional[jax.Array]:
+    """Execute the native C++ host engine for a planned "native" call.
+    Runnability was decided by :func:`_native_runnable`; native.mttkrp
+    still re-validates defensively and returns None on surprise."""
     from splatt_tpu import native
 
-    if path is not None:
-        return None  # explicit path = the caller wants that jit engine
-    if any(isinstance(U, jax.core.Tracer) for U in factors):
-        return None  # inside a jit trace (e.g. the fused sweep)
-    if factors[0].dtype not in (jnp.float32, jnp.float64):
-        return None
     dims = [int(f.shape[0]) for f in factors]
     out = native.mttkrp(
         np.asarray(layout.inds), np.asarray(layout.vals),

@@ -417,6 +417,11 @@ def _probe_compiles(kernel_fn, name: str) -> bool:
     t.start()
     t.join(timeout=240)
     if not result:
+        # close the race where the probe completed between the join
+        # deadline expiring and this check: one short grace re-join,
+        # then a final read, before declaring a timeout
+        t.join(timeout=2.0)
+    if not result:
         # Deadline hit, not a compile rejection: the verdict is unproven
         # and the orphaned thread may still occupy the (single-lease)
         # chip.  Cache it anyway — re-probing would stall every dispatch

@@ -39,6 +39,8 @@ def host_fence(x):
 
     jax.block_until_ready(x)
     for leaf in jax.tree_util.tree_leaves(x):
+        if not hasattr(leaf, "ravel") or getattr(leaf, "size", 0) == 0:
+            continue
         jax.device_get(leaf.ravel()[0])
     return x
 

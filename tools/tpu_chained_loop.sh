@@ -1,0 +1,51 @@
+#!/bin/bash
+# Chained claim-probe -> full TPU session loop.
+#
+# Round-3 verdict: three rounds of BENCH artifacts were burned on
+# "accelerator unavailable" because the probe loop and the work session
+# were never connected.  This loop probes for a claim window and, the
+# moment one opens, immediately runs the full ordered work session
+# (bench.py first — the flagship number — then kernel bisects/tuning).
+#
+# Discipline:
+#   * single client at a time (the axon relay serializes claims; a killed
+#     client can wedge the lease) — claims are never interrupted mid-flight;
+#   * a hard wall-clock deadline so the loop NEVER overlaps the driver's
+#     own round-end bench run;
+#   * tools/STOP_PROBE stops the loop between attempts.
+#
+# Run: nohup bash tools/tpu_chained_loop.sh > tools/tpu_chained_loop.out 2>&1 &
+cd "$(dirname "$0")/.."
+rm -f tools/STOP_PROBE
+DEADLINE=$(( $(date +%s) + ${TPU_LOOP_BUDGET_S:-34200} ))  # default 9.5 h
+SESSION_DONE=0
+for i in $(seq 1 200); do
+  [ -e tools/STOP_PROBE ] && { echo "loop: stopped by sentinel"; exit 0; }
+  now=$(date +%s)
+  if [ "$now" -ge "$DEADLINE" ]; then
+    echo "loop: wall-clock deadline reached after $i attempts"; exit 0
+  fi
+  echo "=== probe attempt $i $(date -u +%H:%M:%S) ==="
+  # Cap a single claim below the time to the deadline so we never hold a
+  # claim attempt into the driver's round-end window.
+  remain=$(( DEADLINE - now ))
+  TPU_PROBE_TIMEOUT=$(( remain < 2700 ? remain : 2700 )) python tools/tpu_probe.py
+  rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "=== claim OK on attempt $i; launching work session ==="
+    bash tools/tpu_session.sh
+    src=$?
+    echo "=== session rc=$src ==="
+    # Success means stage B produced a TPU-platform bench artifact.
+    if grep -q '"tpu"' BENCH_TPU_CAND.json 2>/dev/null; then
+      echo "loop: TPU bench captured; done"
+      SESSION_DONE=1
+      exit 0
+    fi
+    echo "loop: session ran but no TPU bench artifact; continuing to probe"
+  fi
+  [ -e tools/STOP_PROBE ] && { echo "loop: stopped by sentinel"; exit 0; }
+  sleep 240
+done
+echo "loop: exhausted attempts (session_done=$SESSION_DONE)"
+exit 1
