@@ -229,6 +229,7 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     - "pallas_interpret": kernel semantics on CPU, for tests.
     """
     from splatt_tpu.ops.pallas_kernels import (fused_mttkrp, fused_mttkrp_t,
+                                               fused_mttkrp_tg,
                                                onehot_reduce_full,
                                                onehot_reduce_sorted,
                                                vmem_chunk)
@@ -264,6 +265,10 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             return fused_mttkrp_t(layout, factors, mode, width,
                                   accumulate=True,
                                   interpret=interpret)[:dim]
+        if plan == "fused_tg":
+            return fused_mttkrp_tg(layout, factors, mode, width,
+                                   accumulate=True,
+                                   interpret=interpret)[:dim]
         if plan == "fused":
             return fused_mttkrp(layout, factors, mode, width,
                                 accumulate=True,
@@ -286,6 +291,9 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
         if plan == "fused_t":
             parts = fused_mttkrp_t(layout, factors, mode, S,
                                    accumulate=False, interpret=interpret)
+        elif plan == "fused_tg":
+            parts = fused_mttkrp_tg(layout, factors, mode, S,
+                                    accumulate=False, interpret=interpret)
         elif plan == "fused":
             parts = fused_mttkrp(layout, factors, mode, S,
                                  accumulate=False, interpret=interpret)
@@ -318,6 +326,8 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
                                                fused_t_supported,
                                                fused_t_vmem_ok,
+                                               fused_tg_supported,
+                                               fused_tg_vmem_ok,
                                                fused_vmem_ok, vmem_chunk)
 
     dim = int(factors[mode].shape[0])
@@ -333,9 +343,12 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     else:
         width = layout.seg_width
     fused_t_ok = pallas and (interpret or fused_t_supported())
+    fused_tg_ok = pallas and (interpret or fused_tg_supported())
     fused_ok = pallas and (interpret or fused_gather_supported())
     if fused_t_ok and fused_t_vmem_ok(factors, mode, width, B):
         return "fused_t"
+    if fused_tg_ok and fused_tg_vmem_ok(factors, mode, width, B):
+        return "fused_tg"
     if fused_ok and fused_vmem_ok(factors, mode, width, B):
         return "fused"
     if (pallas and vmem_chunk(width, B, R, itemsize) >= 1

@@ -278,6 +278,50 @@ def fused_t_vmem_ok(factors, mode: int, width: int, block: int,
     return fac + work <= budget_bytes
 
 
+def _prep_t_operands(layout, factors, mode: int, accumulate: bool):
+    """Shared operand prep for the transposed-table fused kernels:
+    (local, vals, uts, gidxs) with the sentinel-clamp and lane-chunk
+    padding contract in ONE place.
+
+    local/vals: (nb, 1, B).  uts[j]: the (R8, d_pad) transposed,
+    zero-padded factor table for the j-th non-target mode.  gidxs[j]:
+    (nb, ck, 8, d_pad) gather requests — the per-block index vector
+    clamped to d-1 (padding entries carry the out-of-range sentinel
+    `dim`; their values are zero so the clamped row is harmless),
+    padded to whole d_pad lane chunks, replicated across 8 sublanes
+    (the same-shaped take_along_axis form Mosaic lowers).
+    """
+    nb, B = layout.nblocks, layout.block
+    R = int(factors[0].shape[1])
+    R8 = ceil_to(R, _SUBLANE)
+    dtype = factors[0].dtype
+    others = [k for k in range(layout.nmodes) if k != mode]
+
+    seg = layout.inds[mode]
+    if accumulate:
+        local = seg.reshape(nb, B)
+    else:
+        local = seg.reshape(nb, B) - layout.row_start[:, None]
+    vals = layout.vals.reshape(nb, B).astype(dtype)
+    local = local[:, None, :]
+    vals = vals[:, None, :]
+
+    uts = []
+    gidxs = []
+    for k in others:
+        d = int(factors[k].shape[0])
+        d_pad = ceil_to(d, 128)
+        u_t = factors[k].T
+        uts.append(jnp.pad(u_t, ((0, R8 - R), (0, d_pad - d))))
+        ck = -(-B // d_pad)
+        idx = jnp.minimum(layout.inds[k], d - 1).reshape(nb, B)
+        if ck * d_pad != B:
+            idx = jnp.pad(idx, ((0, 0), (0, ck * d_pad - B)))
+        gidxs.append(jnp.broadcast_to(idx.reshape(nb, ck, 1, d_pad),
+                                      (nb, ck, _SUBLANE, d_pad)))
+    return local, vals, uts, gidxs
+
+
 @functools.partial(jax.jit, static_argnames=("mode", "width", "accumulate",
                                              "interpret"))
 def fused_mttkrp_t(layout, factors, mode: int, width: int,
@@ -288,45 +332,18 @@ def fused_mttkrp_t(layout, factors, mode: int, width: int,
     (width, R) totals when `accumulate` (privatized short modes) —
     same contract as :func:`fused_mttkrp`.
     """
-    nmodes = layout.nmodes
     nb, B = layout.nblocks, layout.block
     R = int(factors[0].shape[1])
     R8 = ceil_to(R, _SUBLANE)
     dtype = factors[0].dtype
-    others = [k for k in range(nmodes) if k != mode]
-
-    seg = layout.inds[mode]
-    if accumulate:
-        local = seg.reshape(nb, B)
-    else:
-        local = seg.reshape(nb, B) - layout.row_start[:, None]
-    vals = layout.vals.reshape(nb, B).astype(dtype)
-    local = local[:, None, :]
-    vals = vals[:, None, :]
+    others = [k for k in range(layout.nmodes) if k != mode]
     grid = (nb,)
 
-    # per-factor: (R8, D128) transposed tables + (nb, ck, 8, D128)
-    # pre-chunked/replicated request tiles (see _tile_gather)
-    uts = []
-    gidxs = []
-    ut_specs = []
-    gidx_specs = []
-    for k in others:
-        d = int(factors[k].shape[0])
-        d_pad = ceil_to(d, 128)
-        u_t = factors[k].T
-        u_t = jnp.pad(u_t, ((0, R8 - R), (0, d_pad - d)))
-        uts.append(u_t)
-        ut_specs.append(pl.BlockSpec((R8, d_pad), lambda i: (0, 0)))
-        ck = -(-B // d_pad)
-        idx = jnp.minimum(layout.inds[k], d - 1).reshape(nb, B)
-        if ck * d_pad != B:
-            idx = jnp.pad(idx, ((0, 0), (0, ck * d_pad - B)))
-        gidx = jnp.broadcast_to(idx.reshape(nb, ck, 1, d_pad),
-                                (nb, ck, _SUBLANE, d_pad))
-        gidxs.append(gidx)
-        gidx_specs.append(pl.BlockSpec((1, ck, _SUBLANE, d_pad),
-                                       lambda i: (i, 0, 0, 0)))
+    local, vals, uts, gidxs = _prep_t_operands(layout, factors, mode,
+                                               accumulate)
+    ut_specs = [pl.BlockSpec(u.shape, lambda i: (0, 0)) for u in uts]
+    gidx_specs = [pl.BlockSpec((1,) + g.shape[1:], lambda i: (i, 0, 0, 0))
+                  for g in gidxs]
 
     acc = _acc_dtype(dtype)
     if accumulate:
@@ -348,6 +365,154 @@ def fused_mttkrp_t(layout, factors, mode: int, width: int,
         ],
         out_specs=out_spec,
         out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(local, vals, *gidxs, *uts)
+    # back to the (…, width, R) contract of the untransposed kernels
+    if accumulate:
+        return out.T[:, :R]
+    return jnp.swapaxes(out, 1, 2)[:, :, :R]
+
+
+# -- sublane-tiled fused kernel (inner grid over rank tiles) ----------------
+#
+# Structurally different fallback for the Mosaic compiler crashes that
+# kill fused_mttkrp_t at production block sizes (tools/fused_bisect.json:
+# every block>=4096 case dies with an HTTP 500 subprocess crash while
+# block-128 compiles; prime suspects are the Python-unrolled ck×(R8/8)
+# take_along_axis fan-out and the large lane/sublane concatenates).
+# This variant:
+#   * grid (R8/8, nb) — each instance computes ONE 8-sublane rank tile,
+#     so the kernel body holds one take_along_axis per (factor, lane
+#     chunk) and no concatenates at all;
+#   * only an (8, D) slice of each transposed table is resident per
+#     step; the table block index depends only on the rank-tile
+#     coordinate, and nb is the fastest grid dimension, so Pallas
+#     re-fetches each slice once per rank tile (~R8/8 · ΣD · 32 B per
+#     MTTKRP — noise), not once per block;
+#   * chunk products accumulate into a VMEM scratch at static
+#     128-aligned lane offsets instead of concatenating tiles.
+# The VMEM envelope is tiny and independent of dim×rank, so this engine
+# also covers configs fused_t's whole-table residency gate rejects
+# (rank 200, the Amazon-scale mode dims).
+
+def _fused_tg_kernel(local_ref, vals_ref, *refs,
+                     width: int, accumulate: bool, nother: int):
+    gidx_refs = refs[:nother]
+    ut_refs = refs[nother:2 * nother]
+    out_ref = refs[2 * nother]
+    prod_ref = refs[2 * nother + 1]          # VMEM scratch (8, B)
+    local = local_ref[0, :, :]               # (1, B) int32
+    vals = vals_ref[0, :, :]                 # (1, B)
+    B = local.shape[1]
+    dtype = vals.dtype
+    prod_ref[...] = jnp.broadcast_to(vals, (_SUBLANE, B))
+    for j in range(nother):
+        u_t = ut_refs[j][...]                # (8, D_j) slice of the table
+        gidx = gidx_refs[j][0]               # (ck_j, 8, D_j)
+        ck, _, D = gidx.shape
+        for c in range(ck):
+            w = min(B - c * D, D)
+            if w <= 0:
+                break
+            tile = jnp.take_along_axis(u_t, gidx[c], axis=1)   # (8, D_j)
+            if w == B and ck == 1:
+                prod_ref[...] = prod_ref[...] * tile[:, :B]
+            else:
+                prod_ref[:, c * D:c * D + w] = (
+                    prod_ref[:, c * D:c * D + w] * tile[:, :w])
+    iota = jax.lax.broadcasted_iota(jnp.int32, (width, B), 0)
+    onehot = (jnp.broadcast_to(local, (width, B)) == iota).astype(dtype)
+    # (8, B) · (S, B)ᵀ on the MXU → (8, S) transposed partials tile
+    part = jax.lax.dot_general(
+        prod_ref[...], onehot,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=out_ref.dtype,
+        precision=onehot_precision(dtype, "rhs"))
+    if not accumulate:
+        out_ref[...] = part[None]
+        return
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = part
+
+    @pl.when(pl.program_id(1) != 0)
+    def _accum():
+        out_ref[...] += part
+
+
+def fused_tg_vmem_ok(factors, mode: int, width: int, block: int,
+                     budget_bytes: int = None) -> bool:
+    """VMEM plan of the sublane-tiled kernel — per-step only: (8, D)
+    table slices, the replicated index tiles, the (8, B) product
+    scratch, one-hot and partials.  ×2 on streamed operands for double
+    buffering.  Independent of rank and of whole-table footprints."""
+    if budget_bytes is None:
+        budget_bytes = _vmem_budget()
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
+    b_pad = ceil_to(block, 128)
+    work = 0
+    for k, f in enumerate(factors):
+        if k != mode:
+            d = ceil_to(int(f.shape[0]), 128)
+            ck = -(-b_pad // d)
+            work += 2 * _SUBLANE * d * itemsize        # table slice (dbuf)
+            work += 2 * ck * _SUBLANE * d * 4          # replicated idx tiles
+    work += (_SUBLANE * b_pad * itemsize               # prod scratch
+             + ceil_to(width, _SUBLANE) * b_pad * itemsize   # one-hot
+             + _SUBLANE * ceil_to(width, 128) * 4            # partials tile
+             + 4 * b_pad * 4)                                # local + vals
+    return work <= budget_bytes
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "width", "accumulate",
+                                             "interpret"))
+def fused_mttkrp_tg(layout, factors, mode: int, width: int,
+                    accumulate: bool, interpret: bool = False) -> jax.Array:
+    """Sublane-tiled fused MTTKRP (grid over rank tiles × blocks).
+
+    Same contract as :func:`fused_mttkrp_t`: (nb, width, R) block
+    partials, or (width, R) totals when `accumulate`.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, B = layout.nblocks, layout.block
+    R = int(factors[0].shape[1])
+    R8 = ceil_to(R, _SUBLANE)
+    n_rtiles = R8 // _SUBLANE
+    dtype = factors[0].dtype
+    others = [k for k in range(layout.nmodes) if k != mode]
+    grid = (n_rtiles, nb)     # nb fastest: table slices fetched per r-tile
+
+    local, vals, uts, gidxs = _prep_t_operands(layout, factors, mode,
+                                               accumulate)
+    ut_specs = [pl.BlockSpec((_SUBLANE, u.shape[1]), lambda r, i: (r, 0))
+                for u in uts]
+    gidx_specs = [pl.BlockSpec((1,) + g.shape[1:],
+                               lambda r, i: (i, 0, 0, 0)) for g in gidxs]
+
+    acc = _acc_dtype(dtype)
+    if accumulate:
+        out_spec = pl.BlockSpec((_SUBLANE, width), lambda r, i: (r, 0))
+        out_shape = jax.ShapeDtypeStruct((R8, width), acc)
+    else:
+        out_spec = pl.BlockSpec((1, _SUBLANE, width), lambda r, i: (i, r, 0))
+        out_shape = jax.ShapeDtypeStruct((nb, R8, width), acc)
+
+    out = pl.pallas_call(
+        functools.partial(_fused_tg_kernel, width=width,
+                          accumulate=accumulate, nother=len(others)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, B), lambda r, i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, B), lambda r, i: (i, 0, 0)),
+            *gidx_specs,
+            *ut_specs,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((_SUBLANE, B), dtype)],
         interpret=interpret,
         compiler_params=_compiler_params(),
     )(local, vals, *gidxs, *uts)
@@ -446,6 +611,14 @@ def fused_t_supported() -> bool:
     lane-wise same-shape take_along_axis gather is the form Mosaic
     supports on jax 0.9.0)."""
     return _probe_compiles(fused_mttkrp_t, "fused_t")
+
+
+@functools.cache
+def fused_tg_supported() -> bool:
+    """Whether the sublane-tiled fused kernel compiles here (one
+    take_along_axis per factor×chunk, no concatenates, scratch-store
+    accumulation — the shape Mosaic is most likely to accept)."""
+    return _probe_compiles(fused_mttkrp_tg, "fused_tg")
 
 
 @functools.cache

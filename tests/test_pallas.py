@@ -114,6 +114,82 @@ def test_fused_mttkrp_kernel_direct():
                                    err_msg=f"fused privatized mode={mode}")
 
 
+def test_fused_tg_kernel_direct():
+    """Sublane-tiled fused kernel (grid over rank tiles × blocks) vs the
+    numpy brute force — covering multi-chunk lane gathers (block larger
+    than a padded mode dim), multiple rank tiles, and both output
+    contracts."""
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.ops.pallas_kernels import fused_mttkrp_tg
+
+    for name, block, rank in (("med", 128, 8),      # single tile
+                              ("med", 512, 20),     # ck>1, 3 rank tiles
+                              ("med4", 256, 12)):   # 4-mode
+        tt = gen.fixture_tensor(name)
+        factors = make_factors(tt.dims, rank=rank)
+        for mode in range(tt.nmodes):
+            lay = build_layout(tt, mode, block=block, val_dtype=np.float64)
+            want = np_mttkrp(tt, factors, mode)
+            S = lay.seg_width
+            parts = fused_mttkrp_tg(lay, factors, mode, S, accumulate=False,
+                                    interpret=True)
+            idx = (np.asarray(lay.row_start)[:, None]
+                   + np.arange(S)).reshape(-1)
+            out = np.zeros((tt.dims[mode] + S + 1, rank))
+            np.add.at(out, idx, np.asarray(parts).reshape(-1, rank))
+            np.testing.assert_allclose(
+                out[:tt.dims[mode]], want, atol=TOL,
+                err_msg=f"fused_tg sorted {name} block={block} mode={mode}")
+            W = -(-(tt.dims[mode] + 1) // 8) * 8
+            tot = fused_mttkrp_tg(lay, factors, mode, W, accumulate=True,
+                                  interpret=True)
+            np.testing.assert_allclose(
+                np.asarray(tot)[:tt.dims[mode]], want, atol=TOL,
+                err_msg=f"fused_tg priv {name} block={block} mode={mode}")
+
+
+def test_fused_tg_dispatch_when_tables_too_big(monkeypatch):
+    """When whole-table residency (fused_t) is gated out, dispatch picks
+    the sublane-tiled kernel — whose VMEM plan is rank/dim independent —
+    and the answer still matches."""
+    import splatt_tpu.ops.pallas_kernels as pk
+    from splatt_tpu.ops.mttkrp import engine_plan
+
+    tt = gen.fixture_tensor("med")
+    opts = Options(block_alloc=BlockAlloc.ALLMODE, nnz_block=128,
+                   val_dtype=np.float64)
+    bs = BlockedSparse.from_coo(tt, opts)
+    factors = make_factors(tt.dims)
+    monkeypatch.setattr(pk, "fused_t_vmem_ok", lambda *a, **k: False)
+    mttkrp_blocked.clear_cache()
+    for mode in range(tt.nmodes):
+        lay = bs.layout_for(mode)
+        assert engine_plan(lay, factors, mode, "sorted_onehot",
+                           "pallas_interpret") == "fused_tg"
+        want = np_mttkrp(tt, factors, mode)
+        got = mttkrp_blocked(lay, factors, mode,
+                             path="sorted_onehot", impl="pallas_interpret")
+        np.testing.assert_allclose(np.asarray(got), want, atol=TOL,
+                                   err_msg=f"fused_tg dispatch mode={mode}")
+
+
+def test_fused_tg_bf16_accumulates_f32():
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.ops.pallas_kernels import fused_mttkrp_tg
+
+    tt = gen.fixture_tensor("med")
+    factors = [jnp.asarray(np.asarray(f), dtype=jnp.bfloat16)
+               for f in make_factors(tt.dims)]
+    lay = build_layout(tt, 0, block=128, val_dtype=jnp.bfloat16)
+    W = -(-(tt.dims[0] + 1) // 8) * 8
+    tot = fused_mttkrp_tg(lay, factors, 0, W, accumulate=True,
+                          interpret=True)
+    assert tot.dtype == jnp.float32
+    want = np_mttkrp(tt, [np.asarray(f, np.float64) for f in factors], 0)
+    np.testing.assert_allclose(np.asarray(tot)[:tt.dims[0]], want, atol=0.6,
+                               rtol=0.1)
+
+
 def test_fused_vmem_gate():
     from splatt_tpu.ops.pallas_kernels import fused_vmem_ok
 
@@ -136,6 +212,7 @@ def test_pallas_unfused_fallback_matches(monkeypatch):
     factors = make_factors(tt.dims)
     monkeypatch.setattr(pk, "fused_vmem_ok", lambda *a, **k: False)
     monkeypatch.setattr(pk, "fused_t_vmem_ok", lambda *a, **k: False)
+    monkeypatch.setattr(pk, "fused_tg_vmem_ok", lambda *a, **k: False)
     # identical statics/avals were traced earlier in this file with the
     # fused branch; drop the cache so the monkeypatch is consulted
     mttkrp_blocked.clear_cache()

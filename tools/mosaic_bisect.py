@@ -9,9 +9,19 @@ hard timeout.  Variants strip the fused_t kernel down op by op:
   k_dot      — one-hot build + MXU dot only (no gather)
   k_gather1  — a single (8, D) take_along_axis gather, no dot
   k_gatherN  — the full _tile_gather loop (R8/8 tiles), no dot
+  k_concat   — _tile_gather minus take_along_axis: the sublane/lane
+               concatenates alone (gathered tiles replaced by slices)
   k_full     — the real fused_t kernel
+  k_tg       — the sublane-tiled fused_mttkrp_tg kernel (r4 variant:
+               one gather per factor×chunk, scratch stores, no concat)
   u_sorted   — onehot_reduce_sorted (unfused) at block 4096
   u_full     — onehot_reduce_full (unfused, privatized width)
+
+Cases with a `_nell` suffix run at NELL-2-like dims (12092, 9184,
+28818) instead of the (512, 384, 1024) probe dims — the two regimes
+differ in lane-chunk count (ck≈15 vs ck=1) and gather width (≤1024 vs
+28928), which separates "too many unrolled gathers" from "gather too
+wide" as crash causes.
 
 Writes tools/mosaic_bisect.json.
 """
@@ -41,8 +51,13 @@ def build(case: str):
     from splatt_tpu.ops.mttkrp import mxu_precision
 
     rng = np.random.default_rng(0)
-    dims = (512, 384, 1024)
-    nnz = 8192
+    if case.endswith("_nell"):
+        case = case[:-len("_nell")]
+        dims = (12092, 9184, 28818)
+        nnz = 500_000
+    else:
+        dims = (512, 384, 1024)
+        nnz = 8192
     B = 4096
     R = 48
     R8 = 48
@@ -56,6 +71,12 @@ def build(case: str):
     if case == "k_full":
         out = pk.fused_mttkrp_t(lay, fac, mode=0, width=width,
                                 accumulate=False, interpret=False)
+        out.block_until_ready()
+        return dict(shape=list(out.shape))
+
+    if case == "k_tg":
+        out = pk.fused_mttkrp_tg(lay, fac, mode=0, width=width,
+                                 accumulate=False, interpret=False)
         out.block_until_ready()
         return dict(shape=list(out.shape))
 
@@ -78,24 +99,10 @@ def build(case: str):
         out.block_until_ready()
         return dict(shape=list(out.shape))
 
-    # hand-stripped kernel variants at the same shapes as fused_t
-    others = [1, 2]
-    d_pads = [((dims[k] + 127) // 128) * 128 for k in others]
-    local = lay.inds[0].reshape(nb, B) - lay.row_start[:, None]
-    local = local[:, None, :]
-    vals = lay.vals.reshape(nb, B)[:, None, :]
-    uts = []
-    gidxs = []
-    for k, d_pad in zip(others, d_pads):
-        d = dims[k]
-        u_t = jnp.pad(fac[k].T, ((0, 0), (0, d_pad - d)))
-        uts.append(u_t)
-        ck = -(-B // d_pad)
-        idx = jnp.minimum(lay.inds[k], d - 1).reshape(nb, B)
-        if ck * d_pad != B:
-            idx = jnp.pad(idx, ((0, 0), (0, ck * d_pad - B)))
-        gidxs.append(jnp.broadcast_to(
-            idx.reshape(nb, ck, 1, d_pad), (nb, ck, 8, d_pad)).astype(jnp.int32))
+    # hand-stripped kernel variants with the real kernels' operands
+    local, vals, uts, gidxs = pk._prep_t_operands(lay, fac, 0,
+                                                  accumulate=False)
+    d_pads = [u.shape[1] for u in uts]
 
     if case == "k_dot":
         def kern(local_ref, vals_ref, out_ref):
@@ -146,10 +153,40 @@ def build(case: str):
         out.block_until_ready()
         return dict(shape=list(out.shape))
 
+    if case == "k_concat":
+        # the concatenates of _tile_gather without any gather: same
+        # tile shapes, tiles produced by aligned slices of the table
+        d_pad = d_pads[0]
+        ck = gidxs[0].shape[1]
+
+        def kern(ut_ref, out_ref):
+            u_t = ut_ref[...]
+            pieces = []
+            for c in range(ck):
+                tiles = [u_t[r0:r0 + 8, :] * (c + 1.0)
+                         for r0 in range(0, R8, 8)]
+                pieces.append(tiles[0] if len(tiles) == 1
+                              else jnp.concatenate(tiles, axis=0))
+            rows = (pieces[0] if ck == 1
+                    else jnp.concatenate(pieces, axis=1))[:, :B]
+            out_ref[...] = jnp.sum(rows).reshape(1, 1)
+
+        out = pl.pallas_call(
+            kern, grid=(nb,),
+            in_specs=[pl.BlockSpec((R8, d_pad), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            compiler_params=pk._compiler_params(),
+        )(uts[0])
+        out.block_until_ready()
+        return dict(shape=list(out.shape))
+
     raise ValueError(case)
 
 
-CASES = ["k_dot", "k_gather1", "k_gatherN", "k_full", "u_sorted", "u_full"]
+CASES = ["k_dot", "k_gather1", "k_gatherN", "k_concat", "k_full", "k_tg",
+         "u_sorted", "u_full",
+         "k_gather1_nell", "k_full_nell", "k_tg_nell", "u_sorted_nell"]
 
 
 def main():

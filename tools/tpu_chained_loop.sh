@@ -17,6 +17,9 @@
 # Run: nohup bash tools/tpu_chained_loop.sh > tools/tpu_chained_loop.out 2>&1 &
 cd "$(dirname "$0")/.."
 rm -f tools/STOP_PROBE
+# a stale artifact from a previous session must not satisfy the
+# success check below
+rm -f BENCH_TPU_CAND.json
 DEADLINE=$(( $(date +%s) + ${TPU_LOOP_BUDGET_S:-34200} ))  # default 9.5 h
 SESSION_DONE=0
 for i in $(seq 1 200); do

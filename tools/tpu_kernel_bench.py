@@ -93,6 +93,35 @@ def main() -> None:
                     rec(path=path, engine=engine, plan=plan, dtype=dname,
                         block=block,
                         error=f"{type(e).__name__}: {e}"[:140])
+            # force each fused kernel variant directly (dispatch stops
+            # at the first engine whose probe+gate passes, so a head-to-
+            # head needs explicit calls); scatter-combine cost included
+            # for a fair sec/MTTKRP
+            from splatt_tpu.ops import pallas_kernels as pk
+
+            S = lay.seg_width
+            idx = (lay.row_start[:, None]
+                   + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
+            dim0pad = tt.dims[mode] + S + 1
+
+            def run_variant(kern, f):
+                parts = kern(lay, f, mode, S, accumulate=False,
+                             interpret=False)
+                out = jnp.zeros((dim0pad, parts.shape[-1]), parts.dtype)
+                return out.at[idx].add(parts.reshape(-1, parts.shape[-1]))
+
+            for vname, kern in (("fused_t", pk.fused_mttkrp_t),
+                                ("fused_tg", pk.fused_mttkrp_tg)):
+                try:
+                    t = chain_time(
+                        lambda f, k=kern: run_variant(k, f), factors)
+                    rec(path="sorted_onehot", engine="pallas_forced",
+                        plan=vname, dtype=dname, block=block,
+                        seg_width=S, sec=round(t, 5))
+                except Exception as e:
+                    rec(path="sorted_onehot", engine="pallas_forced",
+                        plan=vname, dtype=dname, block=block,
+                        error=f"{type(e).__name__}: {e}"[:140])
             del lay
 
     with open("tools/kernel_bench.json", "w") as f:

@@ -25,6 +25,10 @@ note "stage C: mosaic op-level bisect + unfused HW validation"
 timeout 2400 python -u tools/mosaic_bisect.py
 echo "stage C rc=$?"
 
+note "stage C2: kernel head-to-head (stream/blocked/fused_t/fused_tg)"
+timeout 2400 python -u tools/tpu_kernel_bench.py
+echo "stage C2 rc=$?"
+
 note "stage D: tuning sweep (paths x engines x dtypes x blocks)"
 timeout 3600 python -u tools/tpu_tune.py
 echo "stage D rc=$?"
