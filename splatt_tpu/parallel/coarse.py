@@ -32,7 +32,9 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (bucket_scatter, fit_tail,
+from splatt_tpu.parallel.common import (blocked_buckets,
+                                        blocked_local_mttkrp, bucket_engine,
+                                        bucket_scatter, fit_tail,
                                         mode_update_tail,
                                         run_distributed_als)
 from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
@@ -43,22 +45,29 @@ def _bucket_by_mode(tt: SparseTensor, mode: int, ndev: int, val_dtype):
     """Bucket nonzeros by the equal row fences of `mode`.
 
     Returns (inds (nmodes, ndev, C) int32 with mode-m indices local to
-    the fence, vals (ndev, C), block_rows).
+    the fence, vals (ndev, C), block_rows, counts).
     """
     dim_pad = ceil_to(max(tt.dims[mode], ndev), ndev)
     block = dim_pad // ndev
     owner = tt.inds[mode] // block
-    binds, bvals, _, _ = bucket_scatter(tt.inds, tt.vals, owner, ndev,
-                                        val_dtype)
+    binds, bvals, _, counts = bucket_scatter(tt.inds, tt.vals, owner, ndev,
+                                             val_dtype)
     binds[mode] %= block  # localize to the fence (pad slots stay 0)
-    return binds, bvals, block
+    return binds, bvals, block, counts
 
 
 def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                    opts: Optional[Options] = None,
                    init: Optional[List[jax.Array]] = None,
-                   axis: str = "d") -> KruskalTensor:
-    """Distributed CPD-ALS, coarse-grained owner-computes."""
+                   axis: str = "d",
+                   local_engine: str = "blocked") -> KruskalTensor:
+    """Distributed CPD-ALS, coarse-grained owner-computes.
+
+    `local_engine`: "blocked" (default) sorts each per-mode bucket and
+    runs the single-chip blocked MTTKRP engine inside the sweep
+    (≙ mttkrp_csf over each rank's per-mode tensor copy); "stream"
+    keeps the naive formulation (the differential oracle).
+    """
     opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
     mesh = mesh or make_mesh(axis_names=(axis,))
@@ -66,15 +75,37 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     nmodes = tt.nmodes
     xnormsq = tt.normsq()
     dtype = resolve_dtype(opts, tt.vals.dtype)
+    if local_engine not in ("blocked", "stream"):
+        raise ValueError(f"unknown local_engine {local_engine!r}")
+    blocked = local_engine == "blocked"
 
     # one sorted+bucketed copy per mode (≙ per-mode tensors + ALLMODE)
     per_mode = [_bucket_by_mode(tt, m, ndev, dtype) for m in range(nmodes)]
-    blocks = tuple(b for (_, _, b) in per_mode)
+    blocks = tuple(b for (_, _, b, _) in per_mode)
     dims_pad = tuple(b * ndev for b in blocks)
     nnz_sharding = NamedSharding(mesh, P(None, axis, None))
     val_sharding = NamedSharding(mesh, P(axis, None))
-    inds_dev = [jax.device_put(i, nnz_sharding) for (i, _, _) in per_mode]
-    vals_dev = [jax.device_put(v, val_sharding) for (_, v, _) in per_mode]
+    if blocked:
+        cells = []
+        inds_dev = []
+        vals_dev = []
+        rs_dev = []
+        for m, (bi, bv, blk_rows, counts) in enumerate(per_mode):
+            i, v, rs, blkk, S = blocked_buckets(bi, bv, counts, m,
+                                                blk_rows, opts.nnz_block)
+            path, impl = bucket_engine(S, opts)
+            cells.append(dict(block=blkk, seg_width=S, path=path,
+                              impl=impl))
+            inds_dev.append(jax.device_put(i, nnz_sharding))
+            vals_dev.append(jax.device_put(v, val_sharding))
+            rs_dev.append(jax.device_put(rs, val_sharding))
+    else:
+        cells = None
+        inds_dev = [jax.device_put(i, nnz_sharding)
+                    for (i, _, _, _) in per_mode]
+        vals_dev = [jax.device_put(v, val_sharding)
+                    for (_, v, _, _) in per_mode]
+        rs_dev = []
 
     factors_host = (init if init is not None
                     else init_factors(tt.dims, rank, opts.seed(),
@@ -95,14 +126,15 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     gram_specs = tuple([P()] * nmodes)
     inds_specs = tuple([P(None, axis, None)] * nmodes)
     vals_specs = tuple([P(axis, None)] * nmodes)
+    rs_specs = (tuple([P(axis, None)] * nmodes) if blocked else ())
     reg = opts.regularization
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(inds_specs, vals_specs, factor_specs, gram_specs,
-                       P()),
+             in_specs=(inds_specs, vals_specs, rs_specs, factor_specs,
+                       gram_specs, P()),
              out_specs=(factor_specs, gram_specs, P(), P(), P()),
              check_vma=False)
-    def sweep(inds_l, vals_l, factors_l, grams_l, first_flag):
+    def sweep(inds_l, vals_l, rs_l, factors_l, grams_l, first_flag):
         factors_l = list(factors_l)
         grams_l = list(grams_l)
         lam = None
@@ -110,18 +142,35 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         for m in range(nmodes):
             ic = inds_l[m].reshape(nmodes, -1)
             vc = vals_l[m].reshape(-1)
-            prod = vc[:, None].astype(factors_l[0].dtype)
-            for k in range(nmodes):
-                if k != m:
-                    # ≙ mpi_update_rows: fetch the other factors
-                    U = jax.lax.all_gather(factors_l[k], axis, axis=0,
-                                           tiled=True)
-                    prod = prod * jnp.take(U, ic[k], axis=0, mode="clip")
-            # owner-computes: all nonzeros for my rows are local,
-            # so the MTTKRP block needs NO reduction
-            M_l = jax.ops.segment_sum(
-                prod.astype(acc_dtype(prod.dtype)), ic[m],
-                num_segments=blocks[m])
+            if blocked:
+                # ≙ mpi_update_rows, then the rank-local optimized
+                # MTTKRP over this mode's sorted copy — owner-computes:
+                # NO output reduction
+                R = factors_l[0].shape[1]
+                fac_full = [
+                    jax.lax.all_gather(factors_l[k], axis, axis=0,
+                                       tiled=True) if k != m
+                    else factors_l[m]  # local fence IS the row space
+                    for k in range(nmodes)]
+                M_l = blocked_local_mttkrp(
+                    ic, vc, rs_l[m].reshape(-1), fac_full, m,
+                    dim=blocks[m], block=cells[m]["block"],
+                    seg_width=cells[m]["seg_width"],
+                    path=cells[m]["path"], impl=cells[m]["impl"])
+            else:
+                prod = vc[:, None].astype(factors_l[0].dtype)
+                for k in range(nmodes):
+                    if k != m:
+                        # ≙ mpi_update_rows: fetch the other factors
+                        U = jax.lax.all_gather(factors_l[k], axis, axis=0,
+                                               tiled=True)
+                        prod = prod * jnp.take(U, ic[k], axis=0,
+                                               mode="clip")
+                # owner-computes: all nonzeros for my rows are local,
+                # so the MTTKRP block needs NO reduction
+                M_l = jax.ops.segment_sum(
+                    prod.astype(acc_dtype(prod.dtype)), ic[m],
+                    num_segments=blocks[m])
             U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
                                               first_flag, axis,
                                               store_dtype=dtype)
@@ -134,7 +183,8 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     sweep = jax.jit(sweep)
 
     def step(factors, grams, flag):
-        return sweep(tuple(inds_dev), tuple(vals_dev), factors, grams, flag)
+        return sweep(tuple(inds_dev), tuple(vals_dev), tuple(rs_dev),
+                     factors, grams, flag)
 
     return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                                tt.dims, dtype)

@@ -63,6 +63,90 @@ def bucket_scatter(inds: np.ndarray, vals: np.ndarray, owner: np.ndarray,
             C, counts)
 
 
+def blocked_buckets(binds: np.ndarray, bvals: np.ndarray,
+                    counts: np.ndarray, mode: int, local_dim: int,
+                    block: int
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int, int]:
+    """Per-bucket sorted+blocked layout arrays for output `mode` — the
+    distributed analog of :func:`splatt_tpu.blocked.build_layout`, with
+    uniform shapes across buckets so one bucket lands on each device
+    (≙ each MPI rank building its own CSF over its local nonzeros,
+    which mpi_cpd.c:714 then feeds to the same mttkrp_csf the
+    single-rank path uses).
+
+    binds: (nmodes, nbuckets, C) int32 with the mode-`mode` row in
+    [0, local_dim); bvals: (nbuckets, C); counts: true occupancy per
+    bucket (pad slots hold index 0 / value 0 and may sit anywhere a
+    bucket_scatter left them — they are re-marked with the sentinel
+    here so they trail the sort, exactly the single-chip padding
+    contract).
+
+    Returns (inds (nmodes, nbuckets, nnz_pad), vals (nbuckets, nnz_pad),
+    row_start (nbuckets, nb), block, seg_width).
+    """
+    from splatt_tpu.utils.env import ceil_to
+
+    nmodes, nbuckets, C = binds.shape
+    block = max(128, min(block, ceil_to(max(C, 1), 128)))
+    nnz_pad = max(block, ceil_to(C, block))
+    nb = nnz_pad // block
+    out_i = np.zeros((nmodes, nbuckets, nnz_pad), dtype=np.int32)
+    out_v = np.zeros((nbuckets, nnz_pad), dtype=bvals.dtype)
+    for b in range(nbuckets):
+        n = int(counts[b])
+        order = np.argsort(binds[mode, b, :n], kind="stable")
+        out_i[:, b, :n] = binds[:, b, :n][:, order]
+        out_v[b, :n] = bvals[b, :n][order]
+        out_i[mode, b, n:] = local_dim        # sentinel-padded tail
+    rows = out_i[mode].reshape(nbuckets, nb, block)
+    row_start = np.ascontiguousarray(rows[:, :, 0]).astype(np.int32)
+    if nbuckets > 0 and counts.size and int(counts.max()) > 0:
+        span = int((rows[:, :, -1] - rows[:, :, 0]).max()) + 1
+    else:
+        span = 1
+    # sentinel tails inflate the last real block's span; clamp like
+    # build_layout (the one-hot never matches those lanes)
+    seg_width = ceil_to(min(span, local_dim if local_dim > 0 else 1), 8)
+    return out_i, out_v, row_start, block, seg_width
+
+
+def blocked_local_mttkrp(inds_b, vals_b, row_start_b, factors, mode: int,
+                         dim: int, block: int, seg_width: int,
+                         path: str, impl: str):
+    """Run the single-chip blocked MTTKRP engine on one device's bucket
+    inside a shard_mapped sweep (≙ each rank calling the optimized
+    mttkrp_csf locally, src/mpi/mpi_cpd.c:714) — the same dispatch and
+    kernels (one-hot MXU contraction, Pallas engines on TPU) as the
+    single-device path, over the bucket's sorted arrays.
+
+    `factors[mode]` is only the output row-space shape carrier; its
+    values are unused by the sorted paths.
+    """
+    from splatt_tpu.blocked import ModeLayout
+    from splatt_tpu.ops.mttkrp import mttkrp_blocked
+
+    lay = ModeLayout(inds=inds_b, vals=vals_b, row_start=row_start_b,
+                     mode=mode, dim=dim, block=block,
+                     seg_width=seg_width, nnz=0)
+    return mttkrp_blocked(lay, list(factors), mode, path=path, impl=impl)
+
+
+def bucket_engine(seg_width: int, opts: Options) -> Tuple[str, str]:
+    """(path, impl) for the in-sweep blocked engine — the same
+    heuristics as the single-chip dispatch (choose_path/_onehot_pays/
+    choose_impl), minus the host-only native engine (the sweep body is
+    a jit trace)."""
+    from splatt_tpu.ops.mttkrp import _onehot_pays, choose_impl
+
+    path = ("sorted_onehot"
+            if seg_width <= opts.onehot_cap and _onehot_pays(opts)
+            else "sorted_scatter")
+    impl = choose_impl(opts)
+    if impl == "native":
+        impl = "xla"
+    return path, impl
+
+
 def is_memmapped(arr) -> bool:
     """Whether an array is (a view of) an np.memmap — SparseTensor's
     ascontiguousarray normalization strips the subclass but keeps the

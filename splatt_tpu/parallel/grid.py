@@ -49,9 +49,11 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (balanced_relabel, bucket_scatter,
-                                        comm_volume_report, fit_tail,
-                                        imbalance_report, mode_update_tail,
+from splatt_tpu.parallel.common import (balanced_relabel, blocked_buckets,
+                                        blocked_local_mttkrp, bucket_engine,
+                                        bucket_scatter, comm_volume_report,
+                                        fit_tail, imbalance_report,
+                                        mode_update_tail,
                                         run_distributed_als,
                                         streamed_bucket_scatter)
 from splatt_tpu.parallel.mesh import auto_grid
@@ -299,21 +301,80 @@ class GridDecomp:
         padded factor (for run_distributed_als)."""
         return None if self.relabels is None else list(self.relabels)
 
+    def build_cell_layouts(self, opts: Options) -> "CellLayouts":
+        """Per-cell, per-mode sorted blocked layouts so the sweep runs
+        the single-chip blocked MTTKRP engine inside every cell
+        (≙ each rank building CSF over its local nonzeros and calling
+        the same optimized mttkrp_csf, src/mpi/mpi_cpd.c:714).  Index
+        memory is nmodes× the stream sweep's — the distributed ALLMODE
+        trade the reference makes too (types_config.h:179-190).
+        """
+        nmodes = self.nmodes
+        ncells = int(np.prod(self.grid))
+        binds = np.asarray(self.inds_local).reshape(nmodes, ncells, -1)
+        bvals = np.asarray(self.vals).reshape(ncells, -1)
+        per_mode = []
+        for m in range(nmodes):
+            i, v, rs, blk, S = blocked_buckets(
+                binds, bvals, self.cell_counts, m, self.block_rows[m],
+                opts.nnz_block)
+            path, impl = bucket_engine(S, opts)
+            per_mode.append(dict(
+                inds=i.reshape((nmodes, *self.grid, -1)),
+                vals=v.reshape((*self.grid, -1)),
+                row_start=rs.reshape((*self.grid, -1)),
+                block=blk, seg_width=S, path=path, impl=impl))
+        return CellLayouts(per_mode=per_mode)
 
-def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float):
-    """One jitted shard_mapped ALS sweep over the n-D grid."""
+
+@dataclasses.dataclass
+class CellLayouts:
+    """Per-mode sorted+blocked cell arrays for the grid sweep (see
+    GridDecomp.build_cell_layouts)."""
+
+    per_mode: List[dict]
+
+    def device_put(self, mesh: Mesh, nmodes: int):
+        axes = [_axis(m) for m in range(nmodes)]
+        out = []
+        for pm in self.per_mode:
+            out.append(dict(
+                inds=jax.device_put(pm["inds"],
+                                    NamedSharding(mesh, P(None, *axes, None))),
+                vals=jax.device_put(pm["vals"],
+                                    NamedSharding(mesh, P(*axes, None))),
+                row_start=jax.device_put(
+                    pm["row_start"], NamedSharding(mesh, P(*axes, None))),
+                block=pm["block"], seg_width=pm["seg_width"],
+                path=pm["path"], impl=pm["impl"]))
+        return out
+
+
+def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float,
+                    cells: Optional[List[dict]] = None):
+    """One jitted shard_mapped ALS sweep over the n-D grid.
+
+    With `cells` (device-put CellLayouts.per_mode): the local MTTKRP
+    runs the single-chip blocked engine over each cell's sorted arrays
+    (≙ mpi ranks reusing the optimized mttkrp_csf, mpi_cpd.c:714);
+    without, the naive stream formulation (kept as the differential
+    oracle for the blocked sweep).
+    """
     nmodes = decomp.nmodes
     axes = [_axis(m) for m in range(nmodes)]
     factor_specs = tuple(P(_axis(m), None) for m in range(nmodes))
     gram_specs = tuple([P()] * nmodes)
     block_rows = decomp.block_rows
+    cell_specs = tuple(
+        (P(None, *axes, None), P(*axes, None), P(*axes, None))
+        for _ in range(nmodes)) if cells is not None else ()
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(None, *axes, None), P(*axes, None),
-                       factor_specs, gram_specs, P()),
+                       factor_specs, gram_specs, P(), cell_specs),
              out_specs=(factor_specs, gram_specs, P(), P(), P()),
              check_vma=False)
-    def sweep(inds_l, vals_l, factors_l, grams_l, first_flag):
+    def sweep(inds_l, vals_l, factors_l, grams_l, first_flag, cells_l):
         factors_l = list(factors_l)
         grams_l = list(grams_l)
         dtype = factors_l[0].dtype
@@ -325,14 +386,23 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float):
         for m in range(nmodes):
             # inputs are cell-local: no communication (the medium-grain
             # payoff — ≙ only layer rows ever being touched)
-            prod = vals_c[:, None].astype(dtype)
-            for k in range(nmodes):
-                if k != m:
-                    prod = prod * jnp.take(factors_l[k], inds_c[k], axis=0,
-                                           mode="clip")
-            partial_out = jax.ops.segment_sum(
-                prod.astype(acc_dtype(prod.dtype)), inds_c[m],
-                num_segments=block_rows[m])
+            if cells is not None:
+                ci, cv, crs = cells_l[m]
+                partial_out = blocked_local_mttkrp(
+                    ci.reshape(nmodes, -1), cv.reshape(-1),
+                    crs.reshape(-1), factors_l, m,
+                    dim=block_rows[m], block=cells[m]["block"],
+                    seg_width=cells[m]["seg_width"],
+                    path=cells[m]["path"], impl=cells[m]["impl"])
+            else:
+                prod = vals_c[:, None].astype(dtype)
+                for k in range(nmodes):
+                    if k != m:
+                        prod = prod * jnp.take(factors_l[k], inds_c[k],
+                                               axis=0, mode="clip")
+                partial_out = jax.ops.segment_sum(
+                    prod.astype(acc_dtype(prod.dtype)), inds_c[m],
+                    num_segments=block_rows[m])
             # layer reduce (≙ mpi_reduce_rows + mpi_update_rows): after
             # this, every device in the mode-m layer holds the block
             other_axes = tuple(axes[k] for k in range(nmodes) if k != m)
@@ -357,8 +427,15 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                  mesh: Optional[Mesh] = None,
                  opts: Optional[Options] = None,
                  init: Optional[List[jax.Array]] = None,
-                 relabel: Optional[str] = None) -> KruskalTensor:
+                 relabel: Optional[str] = None,
+                 local_engine: str = "blocked") -> KruskalTensor:
     """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition).
+
+    `local_engine`: "blocked" (default) runs the single-chip blocked
+    MTTKRP engine inside every cell over per-cell sorted layouts
+    (≙ mttkrp_csf per rank, mpi_cpd.c:714); "stream" keeps the naive
+    gather+segment_sum formulation (the differential oracle, and the
+    lower-memory choice — blocked cells store nmodes sorted copies).
 
     `relabel` picks the fence-balancing strategy:
 
@@ -422,7 +499,27 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                 np.dtype(dtype).itemsize, grid=decomp.grid):
             print(line)
 
-    inds, vals = decomp.device_put(mesh)
+    cells_dev = ()
+    cells_host = None
+    if local_engine == "blocked":
+        cells_host = decomp.build_cell_layouts(opts).device_put(
+            mesh, tt.nmodes)
+    elif local_engine != "stream":
+        raise ValueError(f"unknown local_engine {local_engine!r}")
+    if cells_host is not None:
+        cells_dev = tuple((c["inds"], c["vals"], c["row_start"])
+                          for c in cells_host)
+        # the blocked sweep never reads the stream COO arrays — put
+        # 1-entry dummies instead of keeping a dead O(nnz) copy in HBM
+        axes_p = [_axis(m) for m in range(tt.nmodes)]
+        inds = jax.device_put(
+            np.zeros((tt.nmodes, *decomp.grid, 1), np.int32),
+            NamedSharding(mesh, P(None, *axes_p, None)))
+        vals = jax.device_put(
+            np.zeros((*decomp.grid, 1), dtype),
+            NamedSharding(mesh, P(*axes_p, None)))
+    else:
+        inds, vals = decomp.device_put(mesh)
     factors_host = (init if init is not None
                     else init_factors(tt.dims, rank, opts.seed(),
                                       dtype=dtype))
@@ -433,10 +530,11 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     gram_sharding = NamedSharding(mesh, P())
     grams = tuple(jax.device_put(gram(U), gram_sharding) for U in factors)
 
-    sweep = make_grid_sweep(mesh, decomp, opts.regularization)
+    sweep = make_grid_sweep(mesh, decomp, opts.regularization,
+                            cells=cells_host)
 
     def step(factors, grams, flag):
-        return sweep(inds, vals, factors, grams, flag)
+        return sweep(inds, vals, factors, grams, flag, cells_dev)
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                               tt.dims, dtype,

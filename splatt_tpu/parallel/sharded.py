@@ -45,7 +45,9 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (bucket_scatter, comm_volume_report,
+from splatt_tpu.parallel.common import (blocked_buckets,
+                                        blocked_local_mttkrp, bucket_engine,
+                                        bucket_scatter, comm_volume_report,
                                         fit_tail, imbalance_report,
                                         mode_update_tail,
                                         run_distributed_als)
@@ -87,6 +89,42 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
     inds_s = jax.device_put(inds, NamedSharding(mesh, P(None, axis)))
     vals_s = jax.device_put(vals, NamedSharding(mesh, P(axis)))
     return inds_s, vals_s
+
+
+def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
+                          dims_pad: Tuple[int, ...], axis: str = "nnz",
+                          val_dtype=np.float32,
+                          partition: Optional[np.ndarray] = None):
+    """Per-shard, per-mode sorted blocked layouts so the sweep runs the
+    single-chip blocked MTTKRP engine inside every shard (≙ each MPI
+    rank building CSF over its local nonzeros, mpi_cpd.c:714).  The
+    mode-m row space stays GLOBAL (the psum_scatter reduce owns the
+    fence split), so local_dim = dims_pad[m].
+
+    Returns (host_meta, device_arrays): host_meta[m] holds the statics
+    (block, seg_width, path, impl); device_arrays[m] the device-put
+    (inds, vals, row_start) triple.
+    """
+    ndev = mesh.shape[axis]
+    if partition is None:
+        chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
+        owner = np.arange(tt.nnz, dtype=np.int64) // chunk
+    else:
+        owner = np.asarray(partition, dtype=np.int64)
+    binds, bvals, _, counts = bucket_scatter(tt.inds, tt.vals, owner, ndev,
+                                             val_dtype)
+    meta = []
+    arrays = []
+    for m in range(tt.nmodes):
+        i, v, rs, blk, S = blocked_buckets(binds, bvals, counts, m,
+                                           dims_pad[m], opts.nnz_block)
+        path, impl = bucket_engine(S, opts)
+        meta.append(dict(block=blk, seg_width=S, path=path, impl=impl))
+        arrays.append((
+            jax.device_put(i, NamedSharding(mesh, P(None, axis, None))),
+            jax.device_put(v, NamedSharding(mesh, P(axis, None))),
+            jax.device_put(rs, NamedSharding(mesh, P(axis, None)))))
+    return meta, tuple(arrays)
 
 
 def shard_factors(factors: List[jax.Array], dims: Tuple[int, ...],
@@ -146,7 +184,8 @@ def sharded_mttkrp(inds: jax.Array, vals: jax.Array, factors: List[jax.Array],
 
 def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
                        dims_pad: Tuple[int, ...], axis: str = "nnz",
-                       variant: str = "all2all"):
+                       variant: str = "all2all",
+                       cells: Optional[List[dict]] = None):
     """Build the jitted, shard_mapped one-iteration ALS sweep.
 
     `first_flag` is a replicated scalar array selecting 2-norm (iteration
@@ -155,10 +194,20 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
     primitives for the two row-exchange phases (≙ SPLATT_OPTION_COMM):
     "all2all" = all_gather + psum_scatter, "ring" = ppermute ring
     (splatt_tpu.parallel.ring) with O(dim/ndev) peak factor memory.
+
+    `cells` (shard_blocked_layouts meta; all2all only): the local
+    MTTKRP runs the single-chip blocked engine over each shard's
+    sorted arrays instead of the stream formulation.
     """
     ndev = mesh.shape[axis]
     factor_specs = tuple([P(axis, None)] * nmodes)
     gram_specs = tuple([P(None, None)] * nmodes)
+    if cells is not None and variant != "all2all":
+        raise ValueError("blocked local engine requires the all2all "
+                         "variant (the ring reduce is blockwise)")
+    cell_specs = tuple(
+        (P(None, axis, None), P(axis, None), P(axis, None))
+        for _ in range(nmodes)) if cells is not None else ()
 
     if variant == "ring":
         from splatt_tpu.parallel.ring import (blockwise_reduce_rows,
@@ -190,21 +239,43 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(None, axis), P(axis), factor_specs, gram_specs,
-                       P()),
+                       P(), cell_specs),
              out_specs=(factor_specs, gram_specs, P(), P(), P()),
              check_vma=False)
-    def sweep(inds_l, vals_l, factors_l, grams_l, first_flag):
+    def sweep(inds_l, vals_l, factors_l, grams_l, first_flag, cells_l):
         factors_l = list(factors_l)
         grams_l = list(grams_l)
         dtype = factors_l[0].dtype
         lam = None
         M_l = None
         for m in range(nmodes):
-            prod = vals_l[:, None].astype(dtype)
-            for k in range(nmodes):
-                if k != m:
-                    prod = prod * gather_rows(factors_l[k], inds_l[k])
-            M_l = reduce_rows(prod, inds_l[m], m)
+            if cells is not None:
+                # ≙ mpi_update_rows then the rank-local optimized
+                # MTTKRP (mttkrp_csf, mpi_cpd.c:714) over the shard's
+                # sorted blocked arrays, then mpi_reduce_rows
+                ci, cv, crs = cells_l[m]
+                R = factors_l[0].shape[1]
+                fac_full = [
+                    jax.lax.all_gather(factors_l[k], axis, axis=0,
+                                       tiled=True) if k != m
+                    # shape carrier for the output row space (values
+                    # unused by the sorted paths; DCE'd)
+                    else jnp.zeros((dims_pad[m], R), dtype)
+                    for k in range(nmodes)]
+                partial_out = blocked_local_mttkrp(
+                    ci.reshape(nmodes, -1), cv.reshape(-1),
+                    crs.reshape(-1), fac_full, m,
+                    dim=dims_pad[m], block=cells[m]["block"],
+                    seg_width=cells[m]["seg_width"],
+                    path=cells[m]["path"], impl=cells[m]["impl"])
+                M_l = jax.lax.psum_scatter(partial_out, axis,
+                                           scatter_dimension=0, tiled=True)
+            else:
+                prod = vals_l[:, None].astype(dtype)
+                for k in range(nmodes):
+                    if k != m:
+                        prod = prod * gather_rows(factors_l[k], inds_l[k])
+                M_l = reduce_rows(prod, inds_l[m], m)
             U_l, gram, lam = mode_update_tail(M_l, grams_l, m, reg,
                                               first_flag, axis,
                                               store_dtype=dtype)
@@ -222,7 +293,8 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     init: Optional[List[jax.Array]] = None,
                     axis: str = "nnz",
                     partition: Optional[np.ndarray] = None,
-                    row_distribute: Optional[str] = None) -> KruskalTensor:
+                    row_distribute: Optional[str] = None,
+                    local_engine: str = "blocked") -> KruskalTensor:
     """Distributed CPD-ALS over a device mesh (≙ the mpirun cpd path,
     src/cmds/mpi_cmd_cpd.c:175-338).
 
@@ -235,6 +307,12 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     each shard's touched rows are greedily claimed into its own fence
     (≙ p_greedy_mat_distribution, src/mpi/mpi_mat_distribute.c:436-548)
     — before fences are cut; original row order is restored on gather.
+
+    `local_engine`: "blocked" (default; all2all variant only) runs the
+    single-chip blocked MTTKRP engine over per-shard sorted layouts
+    inside the sweep (≙ mttkrp_csf per rank, mpi_cpd.c:714); "stream"
+    keeps the naive formulation (the differential oracle; always used
+    by the ring variant, whose reduce is blockwise).
     """
     opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
@@ -267,8 +345,25 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     elif row_distribute is not None:
         raise ValueError(f"unknown row_distribute {row_distribute!r}")
 
-    inds, vals = shard_nnz(tt, mesh, axis=axis, val_dtype=dtype,
-                           partition=partition)
+    variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
+               else "all2all")
+    cells_meta = None
+    cells_dev = ()
+    if local_engine == "blocked" and variant == "all2all":
+        cells_meta, cells_dev = shard_blocked_layouts(
+            tt, mesh, opts, dims_pad, axis=axis, val_dtype=dtype,
+            partition=partition)
+        # the blocked sweep never reads the stream shard arrays — put
+        # 1-entry-per-device dummies instead of a dead O(nnz) HBM copy
+        inds = jax.device_put(np.zeros((nmodes, ndev), np.int32),
+                              NamedSharding(mesh, P(None, axis)))
+        vals = jax.device_put(np.zeros(ndev, dtype),
+                              NamedSharding(mesh, P(axis)))
+    elif local_engine not in ("blocked", "stream"):
+        raise ValueError(f"unknown local_engine {local_engine!r}")
+    else:
+        inds, vals = shard_nnz(tt, mesh, axis=axis, val_dtype=dtype,
+                               partition=partition)
     # init in the ORIGINAL row space (rank-count/distribution
     # invariance, ≙ mpi_mat_rand); relabels only affect placement
     factors_host = (init if init is not None
@@ -284,8 +379,6 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         jax.device_put(gram(U), gram_sharding) for U in factors
     )
 
-    variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
-               else "all2all")
     if opts.verbosity >= Verbosity.HIGH:
         # ≙ mpi_rank_stats + mpi_send_recv_stats.  Measured occupancy,
         # not the equal-chunk assumption: padding trails, so the last
@@ -300,10 +393,11 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                                        np.dtype(dtype).itemsize, ndev=ndev):
             print(line)
     sweep = make_sharded_sweep(mesh, nmodes, opts.regularization,
-                               dims_pad, axis=axis, variant=variant)
+                               dims_pad, axis=axis, variant=variant,
+                               cells=cells_meta)
 
     def step(factors, grams, flag):
-        return sweep(inds, vals, factors, grams, flag)
+        return sweep(inds, vals, factors, grams, flag, cells_dev)
 
     return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                                orig_dims, dtype, row_select=relabels)

@@ -37,7 +37,7 @@ def _lower_sweep(variant, tt, rank, mesh, axis="nnz"):
     sweep = make_sharded_sweep(mesh, tt.nmodes, 0.0, dims_pad, axis=axis,
                                variant=variant)
     flag = jnp.asarray(0.0, jnp.float32)
-    return sweep.lower(inds, vals, factors, grams, flag).compile()
+    return sweep.lower(inds, vals, factors, grams, flag, ()).compile()
 
 
 def test_ring_peak_memory_fraction_of_all2all():
