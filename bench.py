@@ -80,6 +80,101 @@ def _ref_sec_per_iter(measured: dict, shape: str, nnz: int, rank: int):
     return None
 
 
+def _scaling_child(n: int) -> None:
+    """One scaling-sweep measurement at `n` virtual CPU devices (the
+    parent set XLA_FLAGS/JAX_PLATFORMS before this interpreter
+    started).  Prints one ``SCALING {json}`` line.
+
+    sec/iter is the median of the per-iteration wall clocks the
+    distributed driver prints (each iteration is host-synced by the fit
+    fetch at fit_check_every=1), skipping the first two iterations —
+    they carry compile time.
+    """
+    import contextlib
+    import io
+    import re
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from splatt_tpu.config import Options, Verbosity
+    from splatt_tpu.parallel.sharded import sharded_cpd_als
+
+    nnz = int(os.environ.get("SPLATT_BENCH_NNZ", 2_000_000))
+    rank = int(os.environ.get("SPLATT_BENCH_RANK", 16))
+    iters = int(os.environ.get("SPLATT_BENCH_ITERS", 3))
+    shape = os.environ.get("SPLATT_BENCH_SHAPE", "nell2")
+    tt = synthetic_tensor(SHAPES.get(shape, SHAPES["nell2"]), nnz,
+                          seed=1 if shape == "enron4" else 0)
+
+    opts = Options(random_seed=7, verbosity=Verbosity.LOW,
+                   val_dtype=np.float32, max_iterations=2 + iters,
+                   tolerance=0.0, fit_check_every=1)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        sharded_cpd_als(tt, rank, opts=opts)
+    times = [float(s) for s in
+             re.findall(r"its =\s*\d+ \(([0-9.]+)s\)", buf.getvalue())]
+    steady = sorted(times[2:]) or sorted(times)
+    sec = steady[len(steady) // 2] if steady else None
+    print("SCALING " + json.dumps(
+        dict(n_devices=n,
+             sec_per_iter=round(sec, 5) if sec is not None else None,
+             nnz=nnz, rank=rank)), flush=True)
+
+
+def _run_scaling(devices) -> None:
+    """Worker-count scaling sweep over virtual CPU devices (≙ the
+    thread-scaling loop of the reference's bench verb,
+    src/bench.c:84-117,95-101 — the TPU analog scales devices, since
+    XLA owns the chip's cores).  One subprocess per device count (the
+    virtual device count is fixed at interpreter start), reporting
+    sec/iter and parallel efficiency vs the smallest count."""
+    import subprocess
+
+    results = {}
+    for n in devices:
+        env = dict(os.environ)
+        env["SPLATT_SCALING_CHILD"] = str(n)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n}"])
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=1800)
+            line = [l for l in p.stdout.splitlines()
+                    if l.startswith("SCALING ")]
+            results[n] = (json.loads(line[0][8:]) if line
+                          else dict(error=p.stderr[-200:]))
+        except subprocess.SubprocessError as e:
+            results[n] = dict(error=str(e)[:200])
+        print(f"bench: scaling n={n}: {results[n]}", file=sys.stderr,
+              flush=True)
+    n0 = devices[0]
+    base = results.get(n0, {}).get("sec_per_iter")
+    rows = []
+    for n in devices:
+        sec = results.get(n, {}).get("sec_per_iter")
+        # a 0.0 measurement (iteration under the print resolution) is a
+        # valid result, just unusable as a ratio denominator
+        eff = (round(base * n0 / (n * sec), 3)
+               if base and sec else (1.0 if n == n0 and base is not None
+                                     else None))
+        rows.append(dict(n_devices=n, sec_per_iter=sec, efficiency=eff))
+    ok = [r for r in rows if r["sec_per_iter"] is not None]
+    best = min(ok, key=lambda r: r["sec_per_iter"]) if ok else {}
+    print(json.dumps(dict(
+        metric=f"CPD-ALS device-scaling sweep (fine decomposition, "
+               f"virtual CPU devices {list(devices)})",
+        value=best.get("sec_per_iter", 0.0),
+        unit="sec/iter",
+        vs_baseline=1.0,
+        scaling=rows), allow_nan=False), flush=True)
+
+
 def _device_precheck(timeout_sec: int = 180) -> None:
     """Probe device availability in a subprocess so a wedged accelerator
     lease cannot hang the benchmark; fall back to CPU on failure.
@@ -112,6 +207,21 @@ def _device_precheck(timeout_sec: int = 180) -> None:
 
 
 def main() -> None:
+    child = os.environ.get("SPLATT_SCALING_CHILD")
+    if child:
+        _scaling_child(int(child))
+        return
+    devices = os.environ.get("SPLATT_BENCH_DEVICES")
+    if devices:
+        try:
+            devs = [int(x) for x in devices.split(",") if x.strip()]
+            assert devs and all(d >= 1 for d in devs)
+        except (ValueError, AssertionError):
+            print(f"bench: bad SPLATT_BENCH_DEVICES {devices!r}; "
+                  f"expected e.g. 1,2,4,8", file=sys.stderr, flush=True)
+            raise SystemExit(2)
+        _run_scaling(devs)
+        return
     _device_precheck()
     import jax
     import jax.numpy as jnp

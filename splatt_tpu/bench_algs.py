@@ -58,8 +58,10 @@ def _time_call(fn, warmup: int = 1, reps: int = 3) -> float:
 def bench_mttkrp(tt: SparseTensor, rank: int = 16,
                  algs: Sequence[str] = ALGS,
                  opts: Optional[Options] = None,
-                 reps: int = 3) -> Dict[str, List[float]]:
-    """Per-mode wall clock for each algorithm; returns alg -> [sec/mode].
+                 reps: int = 3, return_layouts: bool = False):
+    """Per-mode wall clock for each algorithm; returns alg -> [sec/mode]
+    (with `return_layouts`, also the per-mode ModeLayouts for the
+    roofline model).
 
     ≙ the per-mode timing loop of src/bench.c:84-117.
     """
@@ -102,6 +104,10 @@ def bench_mttkrp(tt: SparseTensor, rank: int = 16,
                                             path=path, impl=impl)
             times.append(_time_call(fn, reps=reps))
         results[alg] = times
+    if return_layouts:
+        layouts = ([bs.layout_for(m) for m in range(tt.nmodes)]
+                   if bs is not None else None)
+        return results, layouts
     return results
 
 
@@ -170,3 +176,93 @@ def format_bench(results: Dict[str, List[float]]) -> str:
         total = np.nansum(times)
         lines.append(f"  {alg:<16s} {cols}  total: {total:0.5f}s")
     return "\n".join(lines)
+
+
+# -- roofline model ---------------------------------------------------------
+
+#: HBM peak bandwidth by device-kind prefix (GB/s).  Sources: public
+#: TPU spec sheets (v4 1228, v5e 819, v5p 2765, v6e "Trillium" 1640).
+HBM_PEAK_GBS = (("TPU v6", 1640.0), ("TPU v5p", 2765.0),
+                ("TPU v5", 819.0), ("TPU v4", 1228.0), ("TPU v3", 900.0),
+                ("TPU v2", 700.0))
+
+
+def hbm_peak_gbs() -> Optional[float]:
+    """Peak HBM bandwidth of device 0, or None off-TPU."""
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    for prefix, gbs in HBM_PEAK_GBS:
+        if kind.startswith(prefix):
+            return gbs
+    return None
+
+
+def mttkrp_bytes(alg: str, tt: SparseTensor, rank: int, mode: int,
+                 itemsize: int, layout=None) -> float:
+    """First-order HBM bytes moved by one MTTKRP (the roofline model
+    the blocked format was designed against; ≙ the hand arithmetic of
+    the reference's perf analysis).  Counts logical traffic: index +
+    value streams, one factor-row fetch per nonzero per input mode
+    (gathers on sparse coordinates miss), and the output — plus each
+    algorithm's own intermediates:
+
+    - stream/scatter: gather+Hadamard fuse into the segment/scatter
+      sum, no intermediate;
+    - blocked (one-hot, xla_scan engine): block partials (nb, S, R)
+      written then scatter-combined (read+write);
+    - blocked_pallas fused engines: the factor TABLES stream once
+      (VMEM-resident) instead of once per nonzero — the design's
+      whole premise — plus the same partials;
+    - ttbox: one full index+value pass per rank column.
+    """
+    nnz = tt.nnz
+    nmodes = tt.nmodes
+    acc = 4  # f32 accumulator width
+    out = tt.dims[mode] * rank * acc
+    idx_val = nnz * (nmodes * 4 + itemsize)
+    rows = (nmodes - 1) * nnz * rank * itemsize
+    if alg == "stream":
+        return idx_val + rows + out
+    if alg == "ttbox":
+        return rank * (idx_val + (nmodes - 1) * nnz * itemsize) + out
+    if alg == "scatter":
+        return idx_val + rows + out
+    if alg in ("blocked", "blocked_pallas"):
+        nb = layout.nblocks if layout is not None else 1
+        S = layout.seg_width if layout is not None else 8
+        partials = 2 * nb * S * rank * acc
+        if alg == "blocked_pallas":
+            tables = sum(d * rank * itemsize
+                         for k, d in enumerate(tt.dims) if k != mode)
+            return idx_val + tables + partials + out
+        return idx_val + rows + partials + out
+    if alg == "native":
+        return idx_val + rows + out
+    raise ValueError(f"unknown algorithm {alg!r}")
+
+
+def roofline_report(tt: SparseTensor, results: Dict[str, List[float]],
+                    rank: int, itemsize: int,
+                    layouts=None) -> List[str]:
+    """Per-alg/mode effective bandwidth lines: model GB/s and, on TPU,
+    % of the HBM peak (≙ src/bench.c printing per-algorithm times —
+    extended with the bytes model so a reader sees headroom, not just
+    seconds)."""
+    peak = hbm_peak_gbs()
+    lines = []
+    for alg, times in results.items():
+        cells = []
+        for m, t in enumerate(times):
+            if np.isnan(t) or t <= 0:
+                cells.append(f"mode{m}:    --  ")
+                continue
+            lay = layouts[m] if layouts is not None else None
+            gbs = mttkrp_bytes(alg, tt, rank, m, itemsize, lay) / t / 1e9
+            pct = f" ({100 * gbs / peak:3.0f}%)" if peak else ""
+            cells.append(f"mode{m}: {gbs:6.1f}{pct}")
+        label = f"  {alg:<16s}"
+        lines.append(label + "  ".join(cells)
+                     + ("  GB/s of HBM peak" if peak else "  GB/s (model)"))
+    return lines

@@ -23,6 +23,13 @@ from splatt_tpu.utils.env import apply_env_platform
 apply_env_platform()
 
 
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return v
+
+
 def _common_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("tensor", help="coordinate tensor file (.tns/.bin)")
     p.add_argument("-v", "--verbose", action="count", default=0,
@@ -114,13 +121,18 @@ def cmd_cpd(args) -> int:
               + (f" grid={args.grid}" if args.grid else ""))
         out = distributed_cpd_als(tt, rank=args.rank, opts=opts, grid=grid,
                                   partition=partition,
-                                  row_distribute=args.rowdist)
+                                  row_distribute=args.rowdist,
+                                  checkpoint_path=args.checkpoint,
+                                  checkpoint_every=args.checkpoint_every,
+                                  local_engine=args.local_engine)
         bs = None
     else:
         with timers.time("blocked_build"):
             bs = BlockedSparse.from_coo(tt, opts)
         print(cpd_stats_text(bs, args.rank, opts))
-        out = cpd_als(bs, rank=args.rank, opts=opts)
+        out = cpd_als(bs, rank=args.rank, opts=opts,
+                      checkpoint_path=args.checkpoint,
+                      checkpoint_every=args.checkpoint_every)
     print(f"Final fit: {float(out.fit):0.5f}")
     if bs is not None and opts.verbosity >= Verbosity.HIGH:
         # per-mode MTTKRP profile (≙ the per-mode times of `cpd -v -v`,
@@ -165,10 +177,19 @@ def cmd_bench(args) -> int:
         tt = perm.apply(tt)
         print(f"  (reordered: {args.permute})")
     algs = args.alg or list(ALGS)
-    results = bench_mttkrp(tt, rank=args.rank, algs=algs, opts=opts,
-                           reps=args.reps)
+    results, layouts = bench_mttkrp(tt, rank=args.rank, algs=algs,
+                                    opts=opts, reps=args.reps,
+                                    return_layouts=True)
     print(f"Benchmarking MTTKRP, rank {args.rank}, {args.reps} reps")
     print(format_bench(results))
+    from splatt_tpu.bench_algs import roofline_report
+    from splatt_tpu.config import resolve_dtype as _rd
+
+    print("Effective bandwidth (first-order bytes model):")
+    for line in roofline_report(
+            tt, results, args.rank,
+            np.dtype(_rd(opts, tt.vals.dtype)).itemsize, layouts):
+        print(line)
     if args.check:
         from splatt_tpu.bench_algs import crosscheck_mttkrp
         from splatt_tpu.config import resolve_dtype
@@ -320,6 +341,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comm-minimizing factor-row distribution for "
                         "--decomp fine (greedy row claiming, reference "
                         "mpi_mat_distribute semantics)")
+    p.add_argument("--local-engine", choices=["blocked", "stream"],
+                   dest="local_engine",
+                   help="per-device MTTKRP engine for distributed runs "
+                        "(default auto: blocked sorted layouts, except "
+                        "streamed out-of-core builds which keep the "
+                        "memory-lean stream form)")
+    p.add_argument("--checkpoint", metavar="FILE",
+                   help="write an atomic .npz checkpoint every "
+                        "--checkpoint-every iterations and resume from "
+                        "it when present (single-device and "
+                        "distributed; checkpoints are device-count-"
+                        "independent)")
+    p.add_argument("--checkpoint-every", type=_positive_int, default=10,
+                   metavar="N", help="iterations between checkpoints")
     p.set_defaults(fn=cmd_cpd)
 
     p = sub.add_parser("bench", help="benchmark MTTKRP algorithms")

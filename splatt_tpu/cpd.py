@@ -244,6 +244,9 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     start_it = 0
     ck_lam = None
     ck_fit = 0.0
+    if checkpoint_path is not None and checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if checkpoint_path is not None and resume:
         import os
 

@@ -17,7 +17,11 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
                         grid: Optional[Tuple[int, ...]] = None,
                         partition: Optional[np.ndarray] = None,
                         mesh=None,
-                        row_distribute: Optional[str] = None) -> KruskalTensor:
+                        row_distribute: Optional[str] = None,
+                        checkpoint_path: Optional[str] = None,
+                        checkpoint_every: int = 10,
+                        resume: bool = True,
+                        local_engine: Optional[str] = None) -> KruskalTensor:
     """Distributed CPD-ALS, dispatching on ``opts.decomposition``
     (≙ SPLATT_OPTION_DECOMP, types_config.h:179-190):
 
@@ -30,21 +34,26 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
       psum_scatter outputs (:func:`sharded_cpd_als`)
     """
     opts = (opts or default_opts()).validate()
+    ck = dict(checkpoint_path=checkpoint_path,
+              checkpoint_every=checkpoint_every, resume=resume)
+    eng = local_engine if local_engine is not None else "blocked"
     if opts.decomposition is Decomposition.MEDIUM and partition is None:
         if row_distribute is not None:
             raise ValueError("row_distribute applies to the FINE "
                              "decomposition (the medium grid's layer "
                              "fences already localize inputs)")
         return grid_cpd_als(tt, rank, grid=grid, mesh=mesh, opts=opts,
-                            init=init)
+                            init=init, local_engine=local_engine, **ck)
     if opts.decomposition is Decomposition.COARSE:
         if row_distribute is not None:
             raise ValueError("row_distribute applies to the FINE "
                              "decomposition, not COARSE")
-        return coarse_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init)
+        return coarse_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
+                              local_engine=eng, **ck)
     return sharded_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
                            partition=partition,
-                           row_distribute=row_distribute)
+                           row_distribute=row_distribute,
+                           local_engine=eng, **ck)
 
 
 __all__ = [

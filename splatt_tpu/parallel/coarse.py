@@ -60,7 +60,10 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                    opts: Optional[Options] = None,
                    init: Optional[List[jax.Array]] = None,
                    axis: str = "d",
-                   local_engine: str = "blocked") -> KruskalTensor:
+                   local_engine: str = "blocked",
+                   checkpoint_path: Optional[str] = None,
+                   checkpoint_every: int = 10,
+                   resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS, coarse-grained owner-computes.
 
     `local_engine`: "blocked" (default) sorts each per-mode bucket and
@@ -146,7 +149,6 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                 # ≙ mpi_update_rows, then the rank-local optimized
                 # MTTKRP over this mode's sorted copy — owner-computes:
                 # NO output reduction
-                R = factors_l[0].shape[1]
                 fac_full = [
                     jax.lax.all_gather(factors_l[k], axis, axis=0,
                                        tiled=True) if k != m
@@ -187,4 +189,7 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                      factors, grams, flag)
 
     return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                               tt.dims, dtype)
+                               tt.dims, dtype,
+                               checkpoint_path=checkpoint_path,
+                               checkpoint_every=checkpoint_every,
+                               resume=resume)

@@ -405,10 +405,38 @@ def fit_tail(lam, grams_l, M_l, U_last, inner_axis):
     return znormsq, inner
 
 
+def _gather_original(factors, dims, row_select):
+    """Gather sharded factors to host and restore original row order /
+    strip row padding — shared by post-processing and checkpointing."""
+    out = []
+    for m, U in enumerate(factors):
+        g = np.asarray(_gather_global(U))
+        sel = row_select[m] if row_select is not None else None
+        out.append(g[:dims[m]] if sel is None else g[np.asarray(sel)])
+    return out
+
+
+def _place_original(U, cur, sel):
+    """Inverse of :func:`_gather_original` for one factor: pad/permute
+    an original-row-space array back into the placement row space of
+    the currently sharded factor `cur`, preserving its sharding."""
+    dim_pad, R = int(cur.shape[0]), int(cur.shape[1])
+    U = np.asarray(U)
+    U_pad = np.zeros((dim_pad, R), dtype=cur.dtype)
+    if sel is None:
+        U_pad[:U.shape[0]] = U
+    else:
+        U_pad[np.asarray(sel)] = U
+    return jax.device_put(jnp.asarray(U_pad, dtype=cur.dtype), cur.sharding)
+
+
 def run_distributed_als(step: Callable, factors, grams, rank: int,
                         opts: Options, xnormsq: float,
                         dims: Sequence[int], dtype,
-                        row_select=None) -> KruskalTensor:
+                        row_select=None,
+                        checkpoint_path: str = None,
+                        checkpoint_every: int = 10,
+                        resume: bool = True) -> KruskalTensor:
     """Host convergence loop + post-processing for a distributed sweep.
 
     `step(factors, grams, first_flag) -> (factors, grams, lam, znormsq,
@@ -417,21 +445,70 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     `row_select[m]`, when given, is a (dim_m,) index array mapping the
     gathered padded factor back to original row order (the inverse of a
     balanced-fence relabeling).
+
+    Checkpoint/resume (exceeds the reference, whose mpi_write_mats only
+    writes terminal outputs): with `checkpoint_path`, the factors are
+    gathered to the ORIGINAL row space and written atomically every
+    `checkpoint_every` iterations — the same .npz format as the
+    single-device driver, so checkpoints are decomposition- and
+    device-count-independent.  An existing checkpoint is resumed from
+    (re-placed into the current run's shardings, Grams recomputed);
+    pass resume=False to overwrite.
     """
+    import os
+
+    from splatt_tpu.cpd import _save_checkpoint, load_checkpoint
+    from splatt_tpu.ops.linalg import gram as gram_fn
+
+    if checkpoint_path and checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}")
     fit_prev = 0.0
+    start_it = 0
     lam = jnp.ones((rank,), dtype=dtype)
+    if checkpoint_path and resume and os.path.exists(checkpoint_path):
+        fs, lam_ck, start_it, fit_ck = load_checkpoint(checkpoint_path)
+        if (len(fs) != len(factors)
+                or any(int(np.asarray(f).shape[0]) != d
+                       or int(np.asarray(f).shape[1]) != rank
+                       for f, d in zip(fs, dims))):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} does not match this run "
+                f"(dims {dims}, rank {rank}); pass resume=False to "
+                f"overwrite")
+        factors = tuple(
+            _place_original(U, cur,
+                            row_select[m] if row_select is not None
+                            else None)
+            for m, (U, cur) in enumerate(zip(fs, factors)))
+        grams = tuple(
+            jax.device_put(gram_fn(f).astype(g.dtype), g.sharding)
+            for f, g in zip(factors, grams))
+        lam = jnp.asarray(lam_ck, dtype=dtype)
+        fit_prev = fit_ck
+        if opts.verbosity >= Verbosity.LOW:
+            print(f"  resumed from {checkpoint_path} at iteration "
+                  f"{start_it} (fit {fit_ck:0.5f})")
     k = opts.fit_check_every
-    for it in range(opts.max_iterations):
+    for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
         factors, grams, lam, znormsq, inner = step(factors, grams, flag)
+        save_now = (checkpoint_path
+                    and (it + 1) % checkpoint_every == 0
+                    and it + 1 != opts.max_iterations)
         # same sync batching as cpd_als: fetch the fit only at check
         # iterations (each float() is a host round trip)
-        if (it + 1) % k != 0 and it + 1 != opts.max_iterations:
+        if ((it + 1) % k != 0 and it + 1 != opts.max_iterations
+                and not save_now):
             if opts.verbosity >= Verbosity.HIGH:
                 print(f"  its = {it + 1:3d} (deferred fit check)")
             continue
         fitval = float(_fit(xnormsq, znormsq, inner))
+        if save_now:
+            _save_checkpoint(checkpoint_path,
+                             _gather_original(factors, dims, row_select),
+                             lam, it + 1, fitval)
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({time.perf_counter() - t0:.3f}s)"
                   f"  fit = {fitval:0.5f}  delta = {fitval - fit_prev:+0.4e}")
@@ -440,11 +517,8 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             break
         fit_prev = fitval
 
-    gathered = [_gather_global(U) for U in factors]
-    if row_select is not None:
-        gathered = [U if sel is None else jnp.asarray(np.asarray(U)[sel])
-                    for U, sel in zip(gathered, row_select)]
-    return post_process(gathered, lam,
+    gathered = _gather_original(factors, dims, row_select)
+    return post_process([jnp.asarray(U) for U in gathered], lam,
                         jnp.asarray(fit_prev, dtype=dtype), dims=dims)
 
 

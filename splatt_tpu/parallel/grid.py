@@ -428,14 +428,20 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                  opts: Optional[Options] = None,
                  init: Optional[List[jax.Array]] = None,
                  relabel: Optional[str] = None,
-                 local_engine: str = "blocked") -> KruskalTensor:
+                 local_engine: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 checkpoint_every: int = 10,
+                 resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition).
 
-    `local_engine`: "blocked" (default) runs the single-chip blocked
-    MTTKRP engine inside every cell over per-cell sorted layouts
-    (≙ mttkrp_csf per rank, mpi_cpd.c:714); "stream" keeps the naive
+    `local_engine`: "blocked" runs the single-chip blocked MTTKRP
+    engine inside every cell over per-cell sorted layouts (≙
+    mttkrp_csf per rank, mpi_cpd.c:714); "stream" keeps the naive
     gather+segment_sum formulation (the differential oracle, and the
-    lower-memory choice — blocked cells store nmodes sorted copies).
+    lower-memory choice — blocked cells store nmodes sorted copies in
+    host+device memory).  None (default) = auto: blocked, except for
+    streamed/memmapped decompositions, whose bounded-RSS guarantee the
+    in-RAM sorted copies would destroy.
 
     `relabel` picks the fence-balancing strategy:
 
@@ -459,6 +465,17 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
         balance = False  # explicit relabeling supersedes fence balancing
     perm = None
     if relabel is not None:
+        if checkpoint_path is not None:
+            # a PERM_TYPES relabel permutes the index space BEFORE the
+            # decomposition, so checkpoints would be written in the
+            # permuted row space — indistinguishable by shape from an
+            # original-space checkpoint on resume.  Refuse loudly
+            # rather than silently resume wrong rows.
+            raise ValueError(
+                "checkpoint_path cannot be combined with a PERM_TYPES "
+                "relabel (checkpoints would be in the permuted row "
+                "space); use relabel='balanced' or checkpoint without "
+                "relabeling")
         from splatt_tpu.reorder import reorder
 
         perm = reorder(tt, relabel, seed=opts.seed())
@@ -501,6 +518,14 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
 
     cells_dev = ()
     cells_host = None
+    if local_engine is None:
+        # auto: the blocked cells materialize nmodes sorted copies in
+        # host RAM — exactly what a streamed (bounded-RSS) build exists
+        # to avoid
+        from splatt_tpu.parallel.common import is_memmapped
+
+        local_engine = ("stream" if is_memmapped(decomp.inds_local)
+                        else "blocked")
     if local_engine == "blocked":
         cells_host = decomp.build_cell_layouts(opts).device_put(
             mesh, tt.nmodes)
@@ -538,7 +563,10 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                               tt.dims, dtype,
-                              row_select=decomp.row_select())
+                              row_select=decomp.row_select(),
+                              checkpoint_path=checkpoint_path,
+                              checkpoint_every=checkpoint_every,
+                              resume=resume)
     if perm is not None:
         out = KruskalTensor(
             factors=[jnp.asarray(perm.apply_to_factor(np.asarray(U), m))

@@ -294,7 +294,10 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     axis: str = "nnz",
                     partition: Optional[np.ndarray] = None,
                     row_distribute: Optional[str] = None,
-                    local_engine: str = "blocked") -> KruskalTensor:
+                    local_engine: str = "blocked",
+                    checkpoint_path: Optional[str] = None,
+                    checkpoint_every: int = 10,
+                    resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS over a device mesh (≙ the mpirun cpd path,
     src/cmds/mpi_cmd_cpd.c:175-338).
 
@@ -400,4 +403,7 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         return sweep(inds, vals, factors, grams, flag, cells_dev)
 
     return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                               orig_dims, dtype, row_select=relabels)
+                               orig_dims, dtype, row_select=relabels,
+                               checkpoint_path=checkpoint_path,
+                               checkpoint_every=checkpoint_every,
+                               resume=resume)
