@@ -166,13 +166,19 @@ def _run_scaling(devices) -> None:
         rows.append(dict(n_devices=n, sec_per_iter=sec, efficiency=eff))
     ok = [r for r in rows if r["sec_per_iter"] is not None]
     best = min(ok, key=lambda r: r["sec_per_iter"]) if ok else {}
-    print(json.dumps(dict(
+    rec = dict(
         metric=f"CPD-ALS device-scaling sweep (fine decomposition, "
                f"virtual CPU devices {list(devices)})",
         value=best.get("sec_per_iter", 0.0),
         unit="sec/iter",
         vs_baseline=1.0,
-        scaling=rows), allow_nan=False), flush=True)
+        scaling=rows)
+    if not ok:
+        # a 0.0 "measurement" must not masquerade as a fast run
+        rec["error"] = "all device counts failed; see stderr"
+    print(json.dumps(rec, allow_nan=False), flush=True)
+    if not ok:
+        raise SystemExit(1)
 
 
 def _device_precheck(timeout_sec: int = 180) -> None:

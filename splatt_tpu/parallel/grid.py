@@ -422,6 +422,116 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float,
     return jax.jit(sweep)
 
 
+def make_grid_profiled_sweep(mesh: Mesh, decomp: GridDecomp, reg: float,
+                             store_dtype, cells: Optional[List[dict]] = None):
+    """Split-jit profiled grid sweep: each phase (local MTTKRP, layer
+    reduce, solve/normalize/gram update, fit) is its own shard_mapped
+    program bracketed by blocking timers, so the mttkrp-vs-collective-
+    vs-solve split is MEASURED (≙ mpi_time_stats reporting per-phase
+    avg/max across ranks, src/mpi/mpi_cpd.c:893-939 — SPMD phases are
+    barrier-synchronized, so wall clock IS the across-device max).
+    Costs cross-phase fusion; the fused :func:`make_grid_sweep` is the
+    production path.
+    """
+    nmodes = decomp.nmodes
+    axes = [_axis(m) for m in range(nmodes)]
+    factor_specs = tuple(P(_axis(m), None) for m in range(nmodes))
+    gram_specs = tuple([P()] * nmodes)
+    block_rows = decomp.block_rows
+    cell_spec = (P(None, *axes, None), P(*axes, None), P(*axes, None))
+
+    def make_local(m):
+        in_specs = ((P(None, *axes, None), P(*axes, None), factor_specs)
+                    + ((cell_spec,) if cells is not None else ()))
+
+        @partial(shard_map, mesh=mesh, in_specs=in_specs,
+                 out_specs=P(*axes, None, None), check_vma=False)
+        def local_m(inds_l, vals_l, factors_l, *cell_m):
+            if cells is not None:
+                ci, cv, crs = cell_m[0]
+                part = blocked_local_mttkrp(
+                    ci.reshape(nmodes, -1), cv.reshape(-1),
+                    crs.reshape(-1), list(factors_l), m,
+                    dim=block_rows[m], block=cells[m]["block"],
+                    seg_width=cells[m]["seg_width"],
+                    path=cells[m]["path"], impl=cells[m]["impl"])
+            else:
+                inds_c = inds_l.reshape(nmodes, -1)
+                vals_c = vals_l.reshape(-1)
+                prod = vals_c[:, None].astype(factors_l[0].dtype)
+                for k in range(nmodes):
+                    if k != m:
+                        prod = prod * jnp.take(factors_l[k], inds_c[k],
+                                               axis=0, mode="clip")
+                part = jax.ops.segment_sum(
+                    prod.astype(acc_dtype(prod.dtype)), inds_c[m],
+                    num_segments=block_rows[m])
+            return part.reshape((1,) * nmodes + part.shape)
+
+        return jax.jit(local_m)
+
+    def make_reduce(m):
+        other_axes = tuple(axes[k] for k in range(nmodes) if k != m)
+
+        @partial(shard_map, mesh=mesh, in_specs=(P(*axes, None, None),),
+                 out_specs=P(_axis(m), None), check_vma=False)
+        def reduce_m(parts_l):
+            p = parts_l.reshape(parts_l.shape[-2:])
+            return jax.lax.psum(p, other_axes) if other_axes else p
+
+        return jax.jit(reduce_m)
+
+    def make_update(m):
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(_axis(m), None), gram_specs, P()),
+                 out_specs=(P(_axis(m), None), P(), P()),
+                 check_vma=False)
+        def update_m(M_l, grams_l, flag):
+            return mode_update_tail(M_l, list(grams_l), m, reg, flag,
+                                    axes[m], store_dtype=store_dtype)
+
+        return jax.jit(update_m)
+
+    last = nmodes - 1
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), gram_specs, P(_axis(last), None),
+                       P(_axis(last), None)),
+             out_specs=(P(), P()), check_vma=False)
+    def fit_fn(lam, grams_l, M_l, U_l):
+        return fit_tail(lam, list(grams_l), M_l, U_l, axes[last])
+
+    locals_ = [make_local(m) for m in range(nmodes)]
+    reduces = [make_reduce(m) for m in range(nmodes)]
+    updates = [make_update(m) for m in range(nmodes)]
+    fit_jit = jax.jit(fit_fn)
+
+    from splatt_tpu.utils.env import host_fence as sync
+    from splatt_tpu.utils.timers import timers
+
+    def sweep(inds, vals, factors, grams, flag, cells_dev=()):
+        factors = list(factors)
+        grams = list(grams)
+        lam = None
+        M = None
+        for m in range(nmodes):
+            extra = (cells_dev[m],) if cells is not None else ()
+            with timers.time("dist_mttkrp"):
+                parts = sync(locals_[m](inds, vals, tuple(factors),
+                                        *extra))
+            with timers.time("dist_comm"):
+                M = sync(reduces[m](parts))
+            with timers.time("dist_update"):
+                factors[m], grams[m], lam = sync(
+                    updates[m](M, tuple(grams), flag))
+        with timers.time("dist_fit"):
+            znormsq, inner = sync(fit_jit(lam, tuple(grams), M,
+                                          factors[last]))
+        return tuple(factors), tuple(grams), lam, znormsq, inner
+
+    return sweep
+
+
 def grid_cpd_als(tt: SparseTensor, rank: int,
                  grid: Optional[Tuple[int, ...]] = None,
                  mesh: Optional[Mesh] = None,
@@ -555,11 +665,29 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     gram_sharding = NamedSharding(mesh, P())
     grams = tuple(jax.device_put(gram(U), gram_sharding) for U in factors)
 
-    sweep = make_grid_sweep(mesh, decomp, opts.regularization,
-                            cells=cells_host)
+    profiled = opts.verbosity >= Verbosity.HIGH
+    if profiled:
+        # split-jit phases with blocking timers: measured per-phase
+        # attribution (≙ mpi_time_stats) at the cost of fusion
+        sweep = make_grid_profiled_sweep(mesh, decomp,
+                                         opts.regularization, dtype,
+                                         cells=cells_host)
+    else:
+        sweep = make_grid_sweep(mesh, decomp, opts.regularization,
+                                cells=cells_host)
+
+    ncalls = [0]
 
     def step(factors, grams, flag):
-        return sweep(inds, vals, factors, grams, flag, cells_dev)
+        out = sweep(inds, vals, factors, grams, flag, cells_dev)
+        ncalls[0] += 1
+        if profiled and ncalls[0] == 1:
+            # drop the trace+compile-laden first iteration from the
+            # attribution (warm-then-reset, like the single-device path)
+            from splatt_tpu.parallel.common import reset_dist_timers
+
+            reset_dist_timers()
+        return out
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                               tt.dims, dtype,
@@ -567,6 +695,11 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                               checkpoint_path=checkpoint_path,
                               checkpoint_every=checkpoint_every,
                               resume=resume)
+    if profiled:
+        from splatt_tpu.parallel.common import dist_phase_report
+
+        for line in dist_phase_report():
+            print(line)
     if perm is not None:
         out = KruskalTensor(
             factors=[jnp.asarray(perm.apply_to_factor(np.asarray(U), m))

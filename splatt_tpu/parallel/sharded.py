@@ -288,6 +288,128 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
     return jax.jit(sweep)
 
 
+def make_sharded_profiled_sweep(mesh: Mesh, nmodes: int, reg: float,
+                                dims_pad: Tuple[int, ...], store_dtype,
+                                axis: str = "nnz",
+                                cells: Optional[List[dict]] = None):
+    """Split-jit profiled sharded sweep (all2all variant only): gather,
+    local MTTKRP, reduce, update, and fit each run as their own
+    shard_mapped program bracketed by blocking timers — the measured
+    mttkrp/collective/solve attribution of ≙ mpi_time_stats
+    (src/mpi/mpi_cpd.c:893-939).  Costs cross-phase fusion and
+    materializes the gathered factors between phases; the fused
+    :func:`make_sharded_sweep` is the production path.
+    """
+    factor_specs = tuple([P(axis, None)] * nmodes)
+    gram_specs = tuple([P(None, None)] * nmodes)
+    cell_spec = (P(None, axis, None), P(axis, None), P(axis, None))
+
+    def make_gather(m):
+        others = [k for k in range(nmodes) if k != m]
+
+        @partial(shard_map, mesh=mesh, in_specs=(factor_specs,),
+                 out_specs=tuple(P(None, None) for _ in others),
+                 check_vma=False)
+        def gather_m(factors_l):
+            # ≙ mpi_update_rows: fetch the other factors whole
+            return tuple(jax.lax.all_gather(factors_l[k], axis, axis=0,
+                                            tiled=True) for k in others)
+
+        return jax.jit(gather_m)
+
+    def make_local(m):
+        others = [k for k in range(nmodes) if k != m]
+        gathered_specs = tuple(P(None, None) for _ in others)
+        in_specs = ((P(None, axis), P(axis), gathered_specs)
+                    + ((cell_spec,) if cells is not None else ()))
+
+        @partial(shard_map, mesh=mesh, in_specs=in_specs,
+                 out_specs=P(axis, None), check_vma=False)
+        def local_m(inds_l, vals_l, gathered, *cell_m):
+            if cells is not None:
+                ci, cv, crs = cell_m[0]
+                R = gathered[0].shape[1]
+                fac_full = []
+                gi = iter(gathered)
+                for k in range(nmodes):
+                    fac_full.append(
+                        jnp.zeros((dims_pad[m], R), gathered[0].dtype)
+                        if k == m else next(gi))
+                return blocked_local_mttkrp(
+                    ci.reshape(nmodes, -1), cv.reshape(-1),
+                    crs.reshape(-1), fac_full, m,
+                    dim=dims_pad[m], block=cells[m]["block"],
+                    seg_width=cells[m]["seg_width"],
+                    path=cells[m]["path"], impl=cells[m]["impl"])
+            prod = vals_l[:, None].astype(gathered[0].dtype)
+            for j, k in enumerate(others):
+                prod = prod * jnp.take(gathered[j], inds_l[k], axis=0,
+                                       mode="clip")
+            return jax.ops.segment_sum(
+                prod.astype(acc_dtype(prod.dtype)), inds_l[m],
+                num_segments=dims_pad[m])
+
+        return jax.jit(local_m)
+
+    def make_reduce(m):
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis, None),),
+                 out_specs=P(axis, None), check_vma=False)
+        def reduce_m(part_l):
+            # ≙ mpi_reduce_rows: keep the summed rows I own
+            return jax.lax.psum_scatter(part_l, axis,
+                                        scatter_dimension=0, tiled=True)
+
+        return jax.jit(reduce_m)
+
+    def make_update(m):
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P(axis, None), gram_specs, P()),
+                 out_specs=(P(axis, None), P(), P()), check_vma=False)
+        def update_m(M_l, grams_l, flag):
+            return mode_update_tail(M_l, list(grams_l), m, reg, flag,
+                                    axis, store_dtype=store_dtype)
+
+        return jax.jit(update_m)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(), gram_specs, P(axis, None), P(axis, None)),
+             out_specs=(P(), P()), check_vma=False)
+    def fit_fn(lam, grams_l, M_l, U_l):
+        return fit_tail(lam, list(grams_l), M_l, U_l, axis)
+
+    gathers = [make_gather(m) for m in range(nmodes)]
+    locals_ = [make_local(m) for m in range(nmodes)]
+    reduces = [make_reduce(m) for m in range(nmodes)]
+    updates = [make_update(m) for m in range(nmodes)]
+    fit_jit = jax.jit(fit_fn)
+
+    from splatt_tpu.utils.env import host_fence as sync
+    from splatt_tpu.utils.timers import timers
+
+    def sweep(inds, vals, factors, grams, flag, cells_dev=()):
+        factors = list(factors)
+        grams = list(grams)
+        lam = None
+        M = None
+        for m in range(nmodes):
+            with timers.time("dist_gather"):
+                gathered = sync(gathers[m](tuple(factors)))
+            extra = (cells_dev[m],) if cells is not None else ()
+            with timers.time("dist_mttkrp"):
+                part = sync(locals_[m](inds, vals, gathered, *extra))
+            with timers.time("dist_comm"):
+                M = sync(reduces[m](part))
+            with timers.time("dist_update"):
+                factors[m], grams[m], lam = sync(
+                    updates[m](M, tuple(grams), flag))
+        with timers.time("dist_fit"):
+            znormsq, inner = sync(fit_jit(lam, tuple(grams), M,
+                                          factors[nmodes - 1]))
+        return tuple(factors), tuple(grams), lam, znormsq, inner
+
+    return sweep
+
+
 def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     opts: Optional[Options] = None,
                     init: Optional[List[jax.Array]] = None,
@@ -395,15 +517,40 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         for line in comm_volume_report(dims_pad, rank,
                                        np.dtype(dtype).itemsize, ndev=ndev):
             print(line)
-    sweep = make_sharded_sweep(mesh, nmodes, opts.regularization,
-                               dims_pad, axis=axis, variant=variant,
-                               cells=cells_meta)
+    profiled = (opts.verbosity >= Verbosity.HIGH and variant == "all2all")
+    if profiled:
+        # split-jit phases with blocking timers: measured gather/mttkrp/
+        # reduce/solve attribution (≙ mpi_time_stats)
+        sweep = make_sharded_profiled_sweep(mesh, nmodes,
+                                            opts.regularization, dims_pad,
+                                            dtype, axis=axis,
+                                            cells=cells_meta)
+    else:
+        sweep = make_sharded_sweep(mesh, nmodes, opts.regularization,
+                                   dims_pad, axis=axis, variant=variant,
+                                   cells=cells_meta)
+
+    ncalls = [0]
 
     def step(factors, grams, flag):
-        return sweep(inds, vals, factors, grams, flag, cells_dev)
+        out = sweep(inds, vals, factors, grams, flag, cells_dev)
+        ncalls[0] += 1
+        if profiled and ncalls[0] == 1:
+            # drop the trace+compile-laden first iteration from the
+            # attribution (warm-then-reset, like the single-device path)
+            from splatt_tpu.parallel.common import reset_dist_timers
 
-    return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                               orig_dims, dtype, row_select=relabels,
-                               checkpoint_path=checkpoint_path,
-                               checkpoint_every=checkpoint_every,
-                               resume=resume)
+            reset_dist_timers()
+        return out
+
+    out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
+                              orig_dims, dtype, row_select=relabels,
+                              checkpoint_path=checkpoint_path,
+                              checkpoint_every=checkpoint_every,
+                              resume=resume)
+    if profiled:
+        from splatt_tpu.parallel.common import dist_phase_report
+
+        for line in dist_phase_report():
+            print(line)
+    return out

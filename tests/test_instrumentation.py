@@ -202,3 +202,38 @@ def test_plan_is_what_executes(monkeypatch):
 
     traced(fac64)
     assert not calls
+
+
+def test_distributed_profiled_sweep_attribution(capsys):
+    """At HIGH verbosity the grid/sharded drivers run the split-jit
+    profiled sweep: per-phase totals (gather/mttkrp/collective/solve/
+    fit) are MEASURED and printed (≙ mpi_time_stats,
+    src/mpi/mpi_cpd.c:893-939), and the profiled math is identical to
+    the fused sweep's."""
+    from splatt_tpu.parallel.grid import grid_cpd_als
+    from splatt_tpu.parallel.sharded import sharded_cpd_als
+
+    tt = _small_tensor(9, nnz=500)
+    base_opts = default_opts()
+    base_opts.random_seed = 4
+    base_opts.max_iterations = 3
+    base_opts.verbosity = Verbosity.NONE
+
+    for name, fn in (("grid", grid_cpd_als), ("sharded", sharded_cpd_als)):
+        timers.reset()
+        base = fn(tt, 3, opts=base_opts)
+        hi = default_opts()
+        hi.random_seed = 4
+        hi.max_iterations = 3
+        hi.verbosity = Verbosity.HIGH
+        prof = fn(tt, 3, opts=hi)
+        out = capsys.readouterr().out
+        assert "distributed phase times" in out, name
+        assert "local mttkrp" in out and "reduce collective" in out, name
+        if name == "sharded":
+            assert "gather rows" in out
+        assert float(prof.fit) == pytest.approx(float(base.fit),
+                                                abs=1e-9), name
+        for a, b in zip(base.factors, prof.factors):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-8, err_msg=name)
