@@ -41,14 +41,37 @@ from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
 from splatt_tpu.utils.env import ceil_to
 
 
-def _bucket_by_mode(tt: SparseTensor, mode: int, ndev: int, val_dtype):
+def _bucket_by_mode(tt: SparseTensor, mode: int, ndev: int, val_dtype,
+                    streamed: Optional[bool] = None,
+                    out_dir: Optional[str] = None,
+                    chunk: int = 1 << 22):
     """Bucket nonzeros by the equal row fences of `mode`.
 
     Returns (inds (nmodes, ndev, C) int32 with mode-m indices local to
     the fence, vals (ndev, C), block_rows, counts).
+
+    `streamed` (auto: when tt holds memmapped indices) runs the
+    bucketing in chunked passes — host RSS O(chunk + bucket metadata)
+    — with optionally disk-backed outputs under `out_dir`, so a
+    beyond-RAM tensor builds its per-mode copies end-to-end.
     """
+    from splatt_tpu.parallel.common import (is_memmapped,
+                                            streamed_bucket_scatter)
+
     dim_pad = ceil_to(max(tt.dims[mode], ndev), ndev)
     block = dim_pad // ndev
+    if streamed is None:
+        streamed = is_memmapped(tt.inds)
+    if streamed:
+        def postprocess(placed):
+            placed[mode] %= block
+            return placed
+
+        binds, bvals, _, counts = streamed_bucket_scatter(
+            tt.inds, tt.vals, lambda ic, s: ic[mode] // block, ndev,
+            val_dtype, chunk=chunk, out_dir=out_dir,
+            postprocess=postprocess)
+        return binds, bvals, block, counts
     owner = tt.inds[mode] // block
     binds, bvals, _, counts = bucket_scatter(tt.inds, tt.vals, owner, ndev,
                                              val_dtype)
@@ -60,17 +83,23 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                    opts: Optional[Options] = None,
                    init: Optional[List[jax.Array]] = None,
                    axis: str = "d",
-                   local_engine: str = "blocked",
+                   local_engine: Optional[str] = None,
+                   out_dir: Optional[str] = None,
                    checkpoint_path: Optional[str] = None,
                    checkpoint_every: int = 10,
                    resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS, coarse-grained owner-computes.
 
-    `local_engine`: "blocked" (default) sorts each per-mode bucket and
-    runs the single-chip blocked MTTKRP engine inside the sweep
-    (≙ mttkrp_csf over each rank's per-mode tensor copy); "stream"
-    keeps the naive formulation (the differential oracle).
+    `local_engine`: "blocked" sorts each per-mode bucket and runs the
+    single-chip blocked MTTKRP engine inside the sweep (≙ mttkrp_csf
+    over each rank's per-mode tensor copy); "stream" keeps the naive
+    formulation (the differential oracle).  None (default) = auto:
+    blocked, except for memmapped (out-of-core) tensors, which bucket
+    via the streamed chunked passes (optionally disk-backed under
+    `out_dir`) and keep the memory-lean stream engine.
     """
+    import os
+
     opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
     mesh = mesh or make_mesh(axis_names=(axis,))
@@ -78,12 +107,21 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     nmodes = tt.nmodes
     xnormsq = tt.normsq()
     dtype = resolve_dtype(opts, tt.vals.dtype)
+    if local_engine is None:
+        from splatt_tpu.parallel.common import is_memmapped
+
+        local_engine = ("stream" if is_memmapped(tt.inds) else "blocked")
     if local_engine not in ("blocked", "stream"):
         raise ValueError(f"unknown local_engine {local_engine!r}")
     blocked = local_engine == "blocked"
 
-    # one sorted+bucketed copy per mode (≙ per-mode tensors + ALLMODE)
-    per_mode = [_bucket_by_mode(tt, m, ndev, dtype) for m in range(nmodes)]
+    # one sorted+bucketed copy per mode (≙ per-mode tensors + ALLMODE);
+    # per-mode out_dir subdirs: the memmap file names inside are fixed
+    per_mode = [_bucket_by_mode(
+        tt, m, ndev, dtype,
+        out_dir=(os.path.join(out_dir, f"mode{m}")
+                 if out_dir is not None else None))
+        for m in range(nmodes)]
     blocks = tuple(b for (_, _, b, _) in per_mode)
     dims_pad = tuple(b * ndev for b in blocks)
     nnz_sharding = NamedSharding(mesh, P(None, axis, None))

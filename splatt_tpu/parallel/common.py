@@ -163,6 +163,23 @@ def reset_dist_timers() -> None:
         t.seconds = 0.0
 
 
+def wrap_profiled_step(step: Callable) -> Callable:
+    """Wrap a driver's step closure for profiled runs: after the first
+    call (trace+compile-laden), zero the distributed phase timers so
+    the attribution covers warm iterations only — the single-device
+    profiled path's warm-then-reset discipline."""
+    ncalls = [0]
+
+    def wrapped(*args):
+        out = step(*args)
+        ncalls[0] += 1
+        if ncalls[0] == 1:
+            reset_dist_timers()
+        return out
+
+    return wrapped
+
+
 def dist_phase_report() -> List[str]:
     """Measured per-phase totals of a profiled distributed run
     (≙ mpi_time_stats' per-phase avg/max table, mpi_cpd.c:893-939;
@@ -225,8 +242,10 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
     (≙ the reference streaming equal-nnz chunks from the root rank,
     mpi_simple_distribute, src/mpi/mpi_io.c:587-648).
 
-    `owner_fn(inds_chunk) -> (n,) bucket ids` is evaluated per chunk
-    (twice — recomputing beats materializing an O(nnz) owner array).
+    `owner_fn(inds_chunk, start) -> (n,) bucket ids` is evaluated per
+    chunk (twice — recomputing beats materializing an O(nnz) owner
+    array); `start` is the chunk's global nonzero offset, for owners
+    that depend on position (equal-nnz fences, partition files).
     `postprocess(binds_chunk) -> binds_chunk`, if given, is applied to
     each chunk's indices before placement (e.g. cell-localization).
     With `out_dir`, the bucketed arrays are numpy memmaps under it —
@@ -242,7 +261,7 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
         counts = np.zeros(nbuckets, dtype=np.int64)
         for s in range(0, nnz, chunk):
             e = min(nnz, s + chunk)
-            own = np.asarray(owner_fn(np.asarray(inds[:, s:e])),
+            own = np.asarray(owner_fn(np.asarray(inds[:, s:e]), s),
                              dtype=np.int64)
             if own.min(initial=0) < 0 or own.max(initial=0) >= nbuckets:
                 raise ValueError(f"owner ids must lie in [0, {nbuckets})")
@@ -268,7 +287,7 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
     for s in range(0, nnz, chunk):
         e = min(nnz, s + chunk)
         ichunk = np.asarray(inds[:, s:e])
-        own = np.asarray(owner_fn(ichunk), dtype=np.int64)
+        own = np.asarray(owner_fn(ichunk, s), dtype=np.int64)
         order = np.argsort(own, kind="stable")
         own_s = own[order]
         ccounts = np.bincount(own_s, minlength=nbuckets)
@@ -540,13 +559,15 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
                 print(f"  its = {it + 1:3d} (deferred fit check)")
             continue
         fitval = float(_fit(xnormsq, znormsq, inner))
-        if save_now and jax.process_index() == 0:
-            # one writer: in a multi-controller run every process holds
-            # the gathered factors, but racing np.savez on the same
-            # path would corrupt it
-            _save_checkpoint(checkpoint_path,
-                             _gather_original(factors, dims, row_select),
-                             lam, it + 1, fitval)
+        if save_now:
+            # the gather is a COLLECTIVE in multi-controller runs
+            # (process_allgather) — every process must enter it; only
+            # the WRITE is single-writer (racing np.savez on one path
+            # corrupts the file)
+            gathered = _gather_original(factors, dims, row_select)
+            if jax.process_index() == 0:
+                _save_checkpoint(checkpoint_path, gathered, lam, it + 1,
+                                 fitval)
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({time.perf_counter() - t0:.3f}s)"
                   f"  fit = {fitval:0.5f}  delta = {fitval - fit_prev:+0.4e}")

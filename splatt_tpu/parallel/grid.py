@@ -248,7 +248,7 @@ class GridDecomp:
         # needs only one more pass over the tensor
         binds, bvals, cell_nnz, counts = streamed_bucket_scatter(
             tt.inds, tt.vals,
-            lambda ic: cells_of_chunk(ic, relabels),
+            lambda ic, s: cells_of_chunk(ic, relabels),
             ncells, val_dtype, chunk=chunk, out_dir=out_dir,
             postprocess=postprocess, counts=counts)
 
@@ -676,18 +676,13 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
         sweep = make_grid_sweep(mesh, decomp, opts.regularization,
                                 cells=cells_host)
 
-    ncalls = [0]
-
     def step(factors, grams, flag):
-        out = sweep(inds, vals, factors, grams, flag, cells_dev)
-        ncalls[0] += 1
-        if profiled and ncalls[0] == 1:
-            # drop the trace+compile-laden first iteration from the
-            # attribution (warm-then-reset, like the single-device path)
-            from splatt_tpu.parallel.common import reset_dist_timers
+        return sweep(inds, vals, factors, grams, flag, cells_dev)
 
-            reset_dist_timers()
-        return out
+    if profiled:
+        from splatt_tpu.parallel.common import wrap_profiled_step
+
+        step = wrap_profiled_step(step)
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                               tt.dims, dtype,

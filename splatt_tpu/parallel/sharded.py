@@ -55,9 +55,64 @@ from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
 from splatt_tpu.utils.env import ceil_to as _pad_to
 
 
+def shard_nnz_host(tt: SparseTensor, ndev: int, val_dtype=np.float32,
+                   partition: Optional[np.ndarray] = None,
+                   streamed: Optional[bool] = None,
+                   out_dir: Optional[str] = None,
+                   chunk: int = 1 << 22
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host side of :func:`shard_nnz`: the padded (nmodes, nnz_pad)
+    arrays, without the device_put.
+
+    `streamed` (auto: when tt holds memmapped indices) runs the
+    bucketing in chunked passes so host RSS stays O(chunk + bucket
+    metadata); with `out_dir` the outputs are disk-backed memmaps —
+    a beyond-RAM tensor shards end-to-end (≙ the reference streaming
+    equal-nnz chunks from the root rank, src/mpi/mpi_io.c:587-648).
+    """
+    from splatt_tpu.parallel.common import (is_memmapped,
+                                            streamed_bucket_scatter)
+    from splatt_tpu.utils.env import check_int32_dims
+
+    check_int32_dims(tt.dims)
+    if streamed is None:
+        streamed = is_memmapped(tt.inds)
+    if streamed:
+        if partition is None:
+            csize = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
+
+            def owner_fn(ic, s):
+                return np.minimum(
+                    (s + np.arange(ic.shape[1], dtype=np.int64)) // csize,
+                    ndev - 1)
+        else:
+            part = partition  # may itself be a memmap
+
+            def owner_fn(ic, s):
+                return np.asarray(part[s:s + ic.shape[1]], dtype=np.int64)
+
+        binds, bvals, _, _ = streamed_bucket_scatter(
+            tt.inds, tt.vals, owner_fn, ndev, val_dtype, chunk=chunk,
+            out_dir=out_dir)
+        return binds.reshape(tt.nmodes, -1), bvals.reshape(-1)
+    if partition is None:
+        nnz_pad = max(ndev, _pad_to(tt.nnz, ndev))
+        inds = np.zeros((tt.nmodes, nnz_pad), dtype=np.int32)
+        inds[:, :tt.nnz] = tt.inds
+        vals = np.zeros(nnz_pad, dtype=val_dtype)
+        vals[:tt.nnz] = tt.vals
+        return inds, vals
+    binds, bvals, _, _ = bucket_scatter(tt.inds, tt.vals,
+                                        np.asarray(partition), ndev,
+                                        val_dtype)
+    return binds.reshape(tt.nmodes, -1), bvals.reshape(-1)
+
+
 def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
               val_dtype=np.float32,
-              partition: Optional[np.ndarray] = None
+              partition: Optional[np.ndarray] = None,
+              streamed: Optional[bool] = None,
+              out_dir: Optional[str] = None
               ) -> Tuple[jax.Array, jax.Array]:
     """Pad nonzeros to the device count and shard them over `axis`.
 
@@ -68,24 +123,12 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
     — the FINE decomposition's user-supplied nonzero-level partition
     (≙ p_rearrange_fine, src/mpi/mpi_io.c:486-499), with buckets padded
     to the largest.  Pad entries point at row 0 with value 0 — harmless
-    to every kernel.
+    to every kernel.  See :func:`shard_nnz_host` for the streamed
+    (bounded-RSS / disk-backed) build knobs.
     """
-    from splatt_tpu.utils.env import check_int32_dims
-
-    check_int32_dims(tt.dims)
-    ndev = mesh.shape[axis]
-    if partition is None:
-        nnz_pad = max(ndev, _pad_to(tt.nnz, ndev))
-        inds = np.zeros((tt.nmodes, nnz_pad), dtype=np.int32)
-        inds[:, :tt.nnz] = tt.inds
-        vals = np.zeros(nnz_pad, dtype=val_dtype)
-        vals[:tt.nnz] = tt.vals
-    else:
-        binds, bvals, _, _ = bucket_scatter(tt.inds, tt.vals,
-                                            np.asarray(partition), ndev,
-                                            val_dtype)
-        inds = binds.reshape(tt.nmodes, -1)
-        vals = bvals.reshape(-1)
+    inds, vals = shard_nnz_host(tt, mesh.shape[axis], val_dtype,
+                                partition=partition, streamed=streamed,
+                                out_dir=out_dir)
     inds_s = jax.device_put(inds, NamedSharding(mesh, P(None, axis)))
     vals_s = jax.device_put(vals, NamedSharding(mesh, P(axis)))
     return inds_s, vals_s
@@ -416,7 +459,8 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     axis: str = "nnz",
                     partition: Optional[np.ndarray] = None,
                     row_distribute: Optional[str] = None,
-                    local_engine: str = "blocked",
+                    local_engine: Optional[str] = None,
+                    out_dir: Optional[str] = None,
                     checkpoint_path: Optional[str] = None,
                     checkpoint_every: int = 10,
                     resume: bool = True) -> KruskalTensor:
@@ -433,11 +477,15 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     (≙ p_greedy_mat_distribution, src/mpi/mpi_mat_distribute.c:436-548)
     — before fences are cut; original row order is restored on gather.
 
-    `local_engine`: "blocked" (default; all2all variant only) runs the
+    `local_engine`: "blocked" (all2all variant only) runs the
     single-chip blocked MTTKRP engine over per-shard sorted layouts
     inside the sweep (≙ mttkrp_csf per rank, mpi_cpd.c:714); "stream"
     keeps the naive formulation (the differential oracle; always used
-    by the ring variant, whose reduce is blockwise).
+    by the ring variant, whose reduce is blockwise).  None (default) =
+    auto: blocked, except for memmapped (out-of-core) tensors, whose
+    bounded-RSS shard build the in-RAM sorted copies would destroy —
+    those shard via the streamed bucketing (optionally disk-backed
+    with `out_dir`) and keep the stream engine.
     """
     opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
@@ -472,6 +520,10 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
 
     variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
                else "all2all")
+    if local_engine is None:
+        from splatt_tpu.parallel.common import is_memmapped
+
+        local_engine = ("stream" if is_memmapped(tt.inds) else "blocked")
     cells_meta = None
     cells_dev = ()
     if local_engine == "blocked" and variant == "all2all":
@@ -488,7 +540,7 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         raise ValueError(f"unknown local_engine {local_engine!r}")
     else:
         inds, vals = shard_nnz(tt, mesh, axis=axis, val_dtype=dtype,
-                               partition=partition)
+                               partition=partition, out_dir=out_dir)
     # init in the ORIGINAL row space (rank-count/distribution
     # invariance, ≙ mpi_mat_rand); relabels only affect placement
     factors_host = (init if init is not None
@@ -530,18 +582,13 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                                    dims_pad, axis=axis, variant=variant,
                                    cells=cells_meta)
 
-    ncalls = [0]
-
     def step(factors, grams, flag):
-        out = sweep(inds, vals, factors, grams, flag, cells_dev)
-        ncalls[0] += 1
-        if profiled and ncalls[0] == 1:
-            # drop the trace+compile-laden first iteration from the
-            # attribution (warm-then-reset, like the single-device path)
-            from splatt_tpu.parallel.common import reset_dist_timers
+        return sweep(inds, vals, factors, grams, flag, cells_dev)
 
-            reset_dist_timers()
-        return out
+    if profiled:
+        from splatt_tpu.parallel.common import wrap_profiled_step
+
+        step = wrap_profiled_step(step)
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                               orig_dims, dtype, row_select=relabels,

@@ -51,7 +51,13 @@ def main():
                    max_iterations=8, tolerance=0.0,
                    val_dtype=np.float64,
                    decomposition=Decomposition(decomp))
-    out = distributed_cpd_als(tt, rank=4, opts=opts)
+    # checkpoint every 3 its: exercises the multi-controller save path
+    # (the gather is a collective every process must enter; only
+    # process 0 writes) — a wrong guard deadlocks at iteration 3
+    ck = os.path.join(os.path.dirname(out_path), "mh_ck.npz")
+    out = distributed_cpd_als(tt, rank=4, opts=opts,
+                              checkpoint_path=ck, checkpoint_every=3,
+                              resume=False)
     np.savez(out_path,
              fit=float(out.fit),
              lam=np.asarray(out.lam, dtype=np.float64),

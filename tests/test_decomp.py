@@ -249,3 +249,48 @@ def test_distributed_checkpoint_resume(tmp_path, driver):
     with pytest.raises(ValueError, match="does not match"):
         opts = _opts(max_iterations=2)
         gridals(tt, 3, opts=opts, checkpoint_path=ck)
+
+
+def test_streamed_shard_and_coarse_builds_match(tmp_path):
+    """The streamed (bounded-RSS, optionally disk-backed) FINE shard
+    build and COARSE per-mode bucketing produce bit-identical arrays to
+    the in-RAM builds, and a memmapped tensor runs the full distributed
+    drivers end-to-end with the same fit (VERDICT r3 #4)."""
+    from splatt_tpu import io as tio
+    from splatt_tpu.io import load_memmap
+    from splatt_tpu.parallel.coarse import _bucket_by_mode, coarse_cpd_als
+    from splatt_tpu.parallel.sharded import shard_nnz_host, sharded_cpd_als
+
+    tt = gen.fixture_tensor("med")
+    path = str(tmp_path / "m.bin")
+    tio.save(tt, path)
+    mm = load_memmap(path)
+
+    rng = np.random.default_rng(2)
+    part = rng.integers(0, 8, tt.nnz)
+    for p in (None, part):
+        a_i, a_v = shard_nnz_host(tt, 8, np.float64, partition=p,
+                                  streamed=False)
+        for out_dir in (None, str(tmp_path / f"f{p is None}")):
+            b_i, b_v = shard_nnz_host(mm, 8, np.float64, partition=p,
+                                      streamed=True, out_dir=out_dir,
+                                      chunk=97)  # awkward chunk size
+            np.testing.assert_array_equal(a_i, np.asarray(b_i))
+            np.testing.assert_array_equal(a_v, np.asarray(b_v))
+
+    for m in range(tt.nmodes):
+        a = _bucket_by_mode(tt, m, 8, np.float64, streamed=False)
+        b = _bucket_by_mode(mm, m, 8, np.float64, streamed=True,
+                            out_dir=str(tmp_path / f"c{m}"), chunk=61)
+        np.testing.assert_array_equal(a[0], np.asarray(b[0]))
+        np.testing.assert_array_equal(a[1], np.asarray(b[1]))
+        assert a[2] == b[2]
+        np.testing.assert_array_equal(a[3], b[3])
+
+    # end-to-end: memmapped input auto-selects the streamed build +
+    # stream engine and matches the in-RAM run exactly
+    opts = _opts(max_iterations=3)
+    for fn in (sharded_cpd_als, coarse_cpd_als):
+        ram = fn(tt, 3, opts=opts)
+        ooc = fn(mm, 3, opts=opts)
+        assert float(ram.fit) == pytest.approx(float(ooc.fit), abs=1e-12)

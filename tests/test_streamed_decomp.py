@@ -26,7 +26,7 @@ def test_streamed_bucket_scatter_matches_dense():
     owner = (tt.inds[0] * 7 + tt.inds[1]) % 6
     b0, v0, c0, n0 = bucket_scatter(tt.inds, tt.vals, owner, 6, np.float32)
     b1, v1, c1, n1 = streamed_bucket_scatter(
-        tt.inds, tt.vals, lambda ic: (ic[0] * 7 + ic[1]) % 6, 6,
+        tt.inds, tt.vals, lambda ic, s: (ic[0] * 7 + ic[1]) % 6, 6,
         np.float32, chunk=701)
     assert c0 == c1
     np.testing.assert_array_equal(n0, n1)
@@ -39,7 +39,7 @@ def test_streamed_bucket_scatter_memmap_out(tmp_path):
     owner = tt.inds[2] % 4
     b0, v0, c0, n0 = bucket_scatter(tt.inds, tt.vals, owner, 4, np.float64)
     b1, v1, c1, n1 = streamed_bucket_scatter(
-        tt.inds, tt.vals, lambda ic: ic[2] % 4, 4, np.float64,
+        tt.inds, tt.vals, lambda ic, s: ic[2] % 4, 4, np.float64,
         chunk=997, out_dir=str(tmp_path / "bk"))
     assert isinstance(b1, np.memmap) and isinstance(v1, np.memmap)
     assert c0 == c1
