@@ -386,7 +386,7 @@ def main() -> None:
 
     names = {"nell2": "NELL-2-shaped", "enron4": "Enron-shaped"}
     platform = jax.devices()[0].platform
-    print(json.dumps({
+    rec = {
         "metric": f"CPD-ALS sec/iteration, synthetic {names[shape]} "
                   f"({tt.nmodes}-mode, {nnz} nnz, rank {rank}, "
                   f"{jnp.dtype(factors[0].dtype).name}) on {platform}; "
@@ -394,7 +394,35 @@ def main() -> None:
         "value": round(sec_per_iter, 4),
         "unit": "sec/iter",
         "vs_baseline": round(vs, 3),
-    }))
+    }
+    try:
+        # first-order roofline: one iteration = nmodes MTTKRPs' logical
+        # HBM traffic (lower bound; layout partials omitted) against
+        # the measured sec/iter — shows headroom next to the seconds
+        from splatt_tpu.bench_algs import hbm_peak_gbs, mttkrp_bytes
+
+        if best.startswith("blocked"):
+            # the winning blocked run used Pallas fused engines when
+            # forced or on TPU (choose_impl semantics) — those stream
+            # the factor TABLES once, a different traffic model
+            pallas_ran = (use_pallas is True
+                          or (use_pallas is None
+                              and jax.default_backend() == "tpu"))
+            alg = "blocked_pallas" if pallas_ran else "blocked"
+        else:
+            alg = "stream"
+        itemsize = jnp.dtype(bench_dtype).itemsize
+        gb = sum(mttkrp_bytes(alg, tt, rank, m, itemsize)
+                 for m in range(tt.nmodes)) / 1e9
+        rec["model_gb_per_iter"] = round(gb, 2)
+        rec["eff_gbs"] = round(gb / sec_per_iter, 1)
+        peak = hbm_peak_gbs()
+        if peak:
+            rec["hbm_peak_pct"] = round(100 * gb / sec_per_iter / peak, 1)
+    except Exception as e:  # the headline number must never be lost
+        print(f"bench: roofline model skipped ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

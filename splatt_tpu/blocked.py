@@ -202,15 +202,11 @@ class BlockedSparse:
         """
         opts = (opts or default_opts()).validate()
         nmodes = tt.nmodes
-        by_size = sorted(range(nmodes), key=lambda m: (tt.dims[m], m))
-        if opts.block_alloc is BlockAlloc.ONEMODE:
-            build_modes = [by_size[0]]
-        elif opts.block_alloc is BlockAlloc.TWOMODE:
-            build_modes = [by_size[0]]
-            if nmodes > 1 and by_size[-1] != by_size[0]:
-                build_modes.append(by_size[-1])
-        else:
-            build_modes = list(range(nmodes))
+        # one selection rule shared with the distributed cell/shard
+        # layout builders — they must never desynchronize
+        from splatt_tpu.parallel.common import alloc_build_modes
+
+        build_modes = alloc_build_modes(tt.dims, opts)
 
         layouts = [build_layout(tt, m, block=opts.nnz_block,
                                 val_dtype=resolve_dtype(opts, tt.vals.dtype),

@@ -12,7 +12,7 @@
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -112,22 +112,27 @@ def blocked_buckets(binds: np.ndarray, bvals: np.ndarray,
 
 def blocked_local_mttkrp(inds_b, vals_b, row_start_b, factors, mode: int,
                          dim: int, block: int, seg_width: int,
-                         path: str, impl: str):
+                         path: str, impl: str,
+                         sort_mode: Optional[int] = None):
     """Run the single-chip blocked MTTKRP engine on one device's bucket
     inside a shard_mapped sweep (≙ each rank calling the optimized
     mttkrp_csf locally, src/mpi/mpi_cpd.c:714) — the same dispatch and
     kernels (one-hot MXU contraction, Pallas engines on TPU) as the
     single-device path, over the bucket's sorted arrays.
 
-    `factors[mode]` is only the output row-space shape carrier; its
-    values are unused by the sorted paths.
+    `sort_mode`/`dim` describe the layout (which mode its nonzeros are
+    sorted by, and that mode's local row count — the sentinel value);
+    `mode` is the OUTPUT mode.  When they differ, `path` must be the
+    generic "scatter" (≙ a CSF traversal rooted at another mode).
+    `factors[mode]` is only the output row-space shape carrier for the
+    sorted paths; its values are unused.
     """
     from splatt_tpu.blocked import ModeLayout
     from splatt_tpu.ops.mttkrp import mttkrp_blocked
 
     lay = ModeLayout(inds=inds_b, vals=vals_b, row_start=row_start_b,
-                     mode=mode, dim=dim, block=block,
-                     seg_width=seg_width, nnz=0)
+                     mode=mode if sort_mode is None else sort_mode,
+                     dim=dim, block=block, seg_width=seg_width, nnz=0)
     return mttkrp_blocked(lay, list(factors), mode, path=path, impl=impl)
 
 
@@ -145,6 +150,26 @@ def bucket_engine(seg_width: int, opts: Options) -> Tuple[str, str]:
     if impl == "native":
         impl = "xla"
     return path, impl
+
+
+def alloc_build_modes(dims: Sequence[int], opts: Options) -> List[int]:
+    """Which modes get their own sorted layout under the alloc policy —
+    the same rule as BlockedSparse.from_coo (≙ splatt_csf_alloc,
+    src/csf.c:770-814): ONEMODE = smallest mode; TWOMODE = smallest +
+    largest; ALLMODE = every mode.  Other modes run the generic scatter
+    path on the first layout."""
+    from splatt_tpu.config import BlockAlloc
+
+    nmodes = len(dims)
+    by_size = sorted(range(nmodes), key=lambda m: (dims[m], m))
+    if opts.block_alloc is BlockAlloc.ONEMODE:
+        return [by_size[0]]
+    if opts.block_alloc is BlockAlloc.TWOMODE:
+        modes = [by_size[0]]
+        if nmodes > 1 and by_size[-1] != by_size[0]:
+            modes.append(by_size[-1])
+        return modes
+    return list(range(nmodes))
 
 
 DIST_TIMER_NAMES = ("dist_gather", "dist_mttkrp", "dist_comm",

@@ -302,51 +302,74 @@ class GridDecomp:
         return None if self.relabels is None else list(self.relabels)
 
     def build_cell_layouts(self, opts: Options) -> "CellLayouts":
-        """Per-cell, per-mode sorted blocked layouts so the sweep runs
-        the single-chip blocked MTTKRP engine inside every cell
-        (≙ each rank building CSF over its local nonzeros and calling
-        the same optimized mttkrp_csf, src/mpi/mpi_cpd.c:714).  Index
-        memory is nmodes× the stream sweep's — the distributed ALLMODE
-        trade the reference makes too (types_config.h:179-190).
+        """Per-cell sorted blocked layouts so the sweep runs the
+        single-chip blocked MTTKRP engine inside every cell (≙ each
+        rank building CSF over its local nonzeros and calling the same
+        optimized mttkrp_csf, src/mpi/mpi_cpd.c:714).
+
+        `opts.block_alloc` governs the layout count exactly like the
+        single-chip compiler (≙ splatt_csf_alloc): ONEMODE/TWOMODE
+        build 1–2 sorted copies and the remaining modes run the
+        generic scatter path on the first; ALLMODE builds one per mode.
         """
+        from splatt_tpu.parallel.common import alloc_build_modes
+
         nmodes = self.nmodes
         ncells = int(np.prod(self.grid))
         binds = np.asarray(self.inds_local).reshape(nmodes, ncells, -1)
         bvals = np.asarray(self.vals).reshape(ncells, -1)
-        per_mode = []
-        for m in range(nmodes):
+        build_modes = alloc_build_modes(
+            [self.block_rows[m] for m in range(nmodes)], opts)
+        layouts = []
+        for m in build_modes:
             i, v, rs, blk, S = blocked_buckets(
                 binds, bvals, self.cell_counts, m, self.block_rows[m],
                 opts.nnz_block)
             path, impl = bucket_engine(S, opts)
-            per_mode.append(dict(
+            layouts.append(dict(
                 inds=i.reshape((nmodes, *self.grid, -1)),
                 vals=v.reshape((*self.grid, -1)),
                 row_start=rs.reshape((*self.grid, -1)),
-                block=blk, seg_width=S, path=path, impl=impl))
-        return CellLayouts(per_mode=per_mode)
+                block=blk, seg_width=S, path=path, impl=impl,
+                sort_mode=m, sort_dim=self.block_rows[m]))
+        mode_map = {m: (build_modes.index(m) if m in build_modes else 0)
+                    for m in range(nmodes)}
+        return CellLayouts(layouts=layouts, mode_map=mode_map)
 
 
 @dataclasses.dataclass
 class CellLayouts:
-    """Per-mode sorted+blocked cell arrays for the grid sweep (see
+    """Sorted+blocked cell arrays for the grid sweep, one entry per
+    built layout plus a mode→layout map (see
     GridDecomp.build_cell_layouts)."""
 
-    per_mode: List[dict]
+    layouts: List[dict]
+    mode_map: dict
 
     def device_put(self, mesh: Mesh, nmodes: int):
+        """Per-MODE cell dicts for the sweep; layouts device_put once
+        and shared by reference across the modes that map to them.
+        A mode whose layout is sorted for another mode runs the
+        generic scatter path (≙ an internal/leaf CSF traversal)."""
         axes = [_axis(m) for m in range(nmodes)]
-        out = []
-        for pm in self.per_mode:
-            out.append(dict(
-                inds=jax.device_put(pm["inds"],
+        placed = []
+        for lay in self.layouts:
+            placed.append(dict(
+                inds=jax.device_put(lay["inds"],
                                     NamedSharding(mesh, P(None, *axes, None))),
-                vals=jax.device_put(pm["vals"],
+                vals=jax.device_put(lay["vals"],
                                     NamedSharding(mesh, P(*axes, None))),
                 row_start=jax.device_put(
-                    pm["row_start"], NamedSharding(mesh, P(*axes, None))),
-                block=pm["block"], seg_width=pm["seg_width"],
-                path=pm["path"], impl=pm["impl"]))
+                    lay["row_start"], NamedSharding(mesh, P(*axes, None))),
+                block=lay["block"], seg_width=lay["seg_width"],
+                path=lay["path"], impl=lay["impl"],
+                sort_mode=lay["sort_mode"], sort_dim=lay["sort_dim"]))
+        out = []
+        for m in range(nmodes):
+            lay = dict(placed[self.mode_map[m]])
+            if lay["sort_mode"] != m:
+                lay["path"] = "scatter"
+            out.append(lay)
         return out
 
 
@@ -354,11 +377,11 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float,
                     cells: Optional[List[dict]] = None):
     """One jitted shard_mapped ALS sweep over the n-D grid.
 
-    With `cells` (device-put CellLayouts.per_mode): the local MTTKRP
-    runs the single-chip blocked engine over each cell's sorted arrays
-    (≙ mpi ranks reusing the optimized mttkrp_csf, mpi_cpd.c:714);
-    without, the naive stream formulation (kept as the differential
-    oracle for the blocked sweep).
+    With `cells` (the per-mode dicts from CellLayouts.device_put): the
+    local MTTKRP runs the single-chip blocked engine over each cell's
+    sorted arrays (≙ mpi ranks reusing the optimized mttkrp_csf,
+    mpi_cpd.c:714); without, the naive stream formulation (kept as the
+    differential oracle for the blocked sweep).
     """
     nmodes = decomp.nmodes
     axes = [_axis(m) for m in range(nmodes)]
@@ -391,9 +414,10 @@ def make_grid_sweep(mesh: Mesh, decomp: GridDecomp, reg: float,
                 partial_out = blocked_local_mttkrp(
                     ci.reshape(nmodes, -1), cv.reshape(-1),
                     crs.reshape(-1), factors_l, m,
-                    dim=block_rows[m], block=cells[m]["block"],
+                    dim=cells[m]["sort_dim"], block=cells[m]["block"],
                     seg_width=cells[m]["seg_width"],
-                    path=cells[m]["path"], impl=cells[m]["impl"])
+                    path=cells[m]["path"], impl=cells[m]["impl"],
+                    sort_mode=cells[m]["sort_mode"])
             else:
                 prod = vals_c[:, None].astype(dtype)
                 for k in range(nmodes):
@@ -452,9 +476,10 @@ def make_grid_profiled_sweep(mesh: Mesh, decomp: GridDecomp, reg: float,
                 part = blocked_local_mttkrp(
                     ci.reshape(nmodes, -1), cv.reshape(-1),
                     crs.reshape(-1), list(factors_l), m,
-                    dim=block_rows[m], block=cells[m]["block"],
+                    dim=cells[m]["sort_dim"], block=cells[m]["block"],
                     seg_width=cells[m]["seg_width"],
-                    path=cells[m]["path"], impl=cells[m]["impl"])
+                    path=cells[m]["path"], impl=cells[m]["impl"],
+                    sort_mode=cells[m]["sort_mode"])
             else:
                 inds_c = inds_l.reshape(nmodes, -1)
                 vals_c = vals_l.reshape(-1)

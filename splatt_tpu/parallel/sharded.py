@@ -138,16 +138,24 @@ def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
                           dims_pad: Tuple[int, ...], axis: str = "nnz",
                           val_dtype=np.float32,
                           partition: Optional[np.ndarray] = None):
-    """Per-shard, per-mode sorted blocked layouts so the sweep runs the
+    """Per-shard sorted blocked layouts so the sweep runs the
     single-chip blocked MTTKRP engine inside every shard (≙ each MPI
     rank building CSF over its local nonzeros, mpi_cpd.c:714).  The
     mode-m row space stays GLOBAL (the psum_scatter reduce owns the
-    fence split), so local_dim = dims_pad[m].
+    fence split), so the sentinel dim is dims_pad[sort_mode].
+
+    `opts.block_alloc` governs the layout count exactly like the
+    single-chip compiler (≙ splatt_csf_alloc): ONEMODE/TWOMODE build
+    1–2 sorted copies (shared by reference across modes, the
+    non-sorted ones running the generic scatter path); ALLMODE builds
+    one per mode.
 
     Returns (host_meta, device_arrays): host_meta[m] holds the statics
-    (block, seg_width, path, impl); device_arrays[m] the device-put
-    (inds, vals, row_start) triple.
+    (block, seg_width, path, impl, sort_mode, sort_dim);
+    device_arrays[m] the device-put (inds, vals, row_start) triple.
     """
+    from splatt_tpu.parallel.common import alloc_build_modes
+
     ndev = mesh.shape[axis]
     if partition is None:
         chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
@@ -156,17 +164,29 @@ def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
         owner = np.asarray(partition, dtype=np.int64)
     binds, bvals, _, counts = bucket_scatter(tt.inds, tt.vals, owner, ndev,
                                              val_dtype)
-    meta = []
-    arrays = []
-    for m in range(tt.nmodes):
+    build_modes = alloc_build_modes(dims_pad, opts)
+    built_meta = []
+    built_arr = []
+    for m in build_modes:
         i, v, rs, blk, S = blocked_buckets(binds, bvals, counts, m,
                                            dims_pad[m], opts.nnz_block)
         path, impl = bucket_engine(S, opts)
-        meta.append(dict(block=blk, seg_width=S, path=path, impl=impl))
-        arrays.append((
+        built_meta.append(dict(block=blk, seg_width=S, path=path,
+                               impl=impl, sort_mode=m,
+                               sort_dim=dims_pad[m]))
+        built_arr.append((
             jax.device_put(i, NamedSharding(mesh, P(None, axis, None))),
             jax.device_put(v, NamedSharding(mesh, P(axis, None))),
             jax.device_put(rs, NamedSharding(mesh, P(axis, None)))))
+    meta = []
+    arrays = []
+    for m in range(tt.nmodes):
+        j = build_modes.index(m) if m in build_modes else 0
+        mm = dict(built_meta[j])
+        if mm["sort_mode"] != m:
+            mm["path"] = "scatter"
+        meta.append(mm)
+        arrays.append(built_arr[j])
     return meta, tuple(arrays)
 
 
@@ -308,9 +328,10 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
                 partial_out = blocked_local_mttkrp(
                     ci.reshape(nmodes, -1), cv.reshape(-1),
                     crs.reshape(-1), fac_full, m,
-                    dim=dims_pad[m], block=cells[m]["block"],
+                    dim=cells[m]["sort_dim"], block=cells[m]["block"],
                     seg_width=cells[m]["seg_width"],
-                    path=cells[m]["path"], impl=cells[m]["impl"])
+                    path=cells[m]["path"], impl=cells[m]["impl"],
+                    sort_mode=cells[m]["sort_mode"])
                 M_l = jax.lax.psum_scatter(partial_out, axis,
                                            scatter_dimension=0, tiled=True)
             else:
@@ -381,9 +402,10 @@ def make_sharded_profiled_sweep(mesh: Mesh, nmodes: int, reg: float,
                 return blocked_local_mttkrp(
                     ci.reshape(nmodes, -1), cv.reshape(-1),
                     crs.reshape(-1), fac_full, m,
-                    dim=dims_pad[m], block=cells[m]["block"],
+                    dim=cells[m]["sort_dim"], block=cells[m]["block"],
                     seg_width=cells[m]["seg_width"],
-                    path=cells[m]["path"], impl=cells[m]["impl"])
+                    path=cells[m]["path"], impl=cells[m]["impl"],
+                    sort_mode=cells[m]["sort_mode"])
             prod = vals_l[:, None].astype(gathered[0].dtype)
             for j, k in enumerate(others):
                 prod = prod * jnp.take(gathered[j], inds_l[k], axis=0,

@@ -183,6 +183,27 @@ def test_blocked_local_engine_matches_stream(name):
                                        atol=1e-8, err_msg=label)
 
 
+@pytest.mark.parametrize("alloc", ["onemode", "twomode", "allmode"])
+def test_blocked_engine_alloc_policies(alloc):
+    """The distributed cell/shard layouts honor the alloc policy like
+    the single-chip compiler (≙ splatt_csf_alloc): shared layouts run
+    non-sorted modes through the generic scatter path, with identical
+    results."""
+    from splatt_tpu.config import BlockAlloc
+    from splatt_tpu.parallel.grid import grid_cpd_als as gridals
+    from splatt_tpu.parallel.sharded import sharded_cpd_als as sharded
+
+    tt = gen.fixture_tensor("med")
+    opts = _opts(max_iterations=4, block_alloc=BlockAlloc(alloc))
+    for label, fn in (("grid", gridals), ("sharded", sharded)):
+        a = fn(tt, 4, opts=opts, local_engine="stream")
+        b = fn(tt, 4, opts=opts, local_engine="blocked")
+        for ua, ub in zip(a.factors, b.factors):
+            np.testing.assert_allclose(np.asarray(ua), np.asarray(ub),
+                                       atol=1e-8,
+                                       err_msg=f"{label}/{alloc}")
+
+
 def test_blocked_buckets_contract():
     """Sentinel-padded tails, per-bucket sort, uniform shapes."""
     from splatt_tpu.parallel.common import blocked_buckets, bucket_scatter
