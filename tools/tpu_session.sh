@@ -44,4 +44,9 @@ SPLATT_BENCH_SHAPE=enron4 SPLATT_BENCH_NNZ=5000000 SPLATT_BENCH_RANK=25 \
 echo "stage F rc=$?"
 cat BENCH_TPU_ENRON4.json
 
+note "stage G: bf16 bench row (bf16 storage, f32 accumulation)"
+SPLATT_BENCH_DTYPE=bfloat16 timeout 2400 python -u bench.py > BENCH_TPU_BF16.json
+echo "stage G rc=$?"
+cat BENCH_TPU_BF16.json
+
 note "session done"
