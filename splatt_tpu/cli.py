@@ -357,7 +357,13 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N", help="iterations between checkpoints")
     p.set_defaults(fn=cmd_cpd)
 
-    p = sub.add_parser("bench", help="benchmark MTTKRP algorithms")
+    p = sub.add_parser(
+        "bench", help="benchmark MTTKRP algorithms",
+        epilog="Per-path effective-bandwidth (roofline) lines are "
+               "printed with the timings.  For a device-count scaling "
+               "sweep (≙ the reference's thread scaling) run the "
+               "repo-root bench driver: SPLATT_BENCH_DEVICES=1,2,4,8 "
+               "python bench.py")
     _common_opts(p)
     p.add_argument("-r", "--rank", type=int, default=16)
     p.add_argument("-a", "--alg", action="append",

@@ -26,6 +26,7 @@ p_is_privatized :221-236) is static at trace time.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import List, NamedTuple, Optional, Sequence, Union
 
@@ -138,9 +139,25 @@ def mttkrp_ttbox(inds: jax.Array, vals: jax.Array,
 
 # -- blocked paths ---------------------------------------------------------
 
+#: elements of one-hot materialized per scan step of the XLA engine —
+#: the fallback's main tuning knob (more = fewer, bigger fused steps).
+#: Env-overridable so the hardware tuning sweep (tools/tpu_tune.py) can
+#: measure it; the default matches the round-2/3 measured configs.
+try:
+    _SCAN_TARGET = int(os.environ.get("SPLATT_SCAN_TARGET_ELEMS", 1 << 23))
+except ValueError:
+    import sys as _sys
+
+    print("splatt-tpu: bad SPLATT_SCAN_TARGET_ELEMS (want an int); "
+          "using the default", file=_sys.stderr)
+    _SCAN_TARGET = 1 << 23
+
+
 def _block_chunks(nblocks: int, elems_per_block: int,
-                  target_elems: int = 1 << 23) -> int:
+                  target_elems: Optional[int] = None) -> int:
     """Blocks per scan step, sized to bound one-hot materialization."""
+    if target_elems is None:
+        target_elems = _SCAN_TARGET
     c = max(1, target_elems // max(elems_per_block, 1))
     return min(c, nblocks)
 

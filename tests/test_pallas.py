@@ -272,3 +272,60 @@ def test_pallas_bf16_accumulates_f32():
                               S + 8).sum(axis=0)
     np.testing.assert_allclose(np.asarray(got2, dtype=np.float64), want2,
                                atol=3e-2)
+
+
+def test_fused_tg_production_dims_interpret():
+    """fused_tg index math at real NELL-2 production dims — block 4096,
+    28928-lane padded gathers, rank 50 (the shapes whose Mosaic
+    compiles crash for fused_t) — stays exact in interpret mode."""
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.ops.mttkrp import mttkrp_stream
+    from splatt_tpu.ops.pallas_kernels import fused_mttkrp_tg
+
+    rng = np.random.default_rng(0)
+    dims = (12092, 9184, 28818)
+    nnz, rank = 4096, 50
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    from splatt_tpu.coo import SparseTensor
+
+    tt = SparseTensor(inds=inds, vals=rng.standard_normal(nnz), dims=dims)
+    fac = [jnp.asarray(rng.standard_normal((d, rank)).astype(np.float32))
+           for d in dims]
+    lay = build_layout(tt, 0, block=4096, val_dtype=np.float32)
+    S = lay.seg_width
+    parts = fused_mttkrp_tg(lay, fac, 0, S, accumulate=False,
+                            interpret=True)
+    idx = (np.asarray(lay.row_start)[:, None] + np.arange(S)).reshape(-1)
+    out = np.zeros((dims[0] + S + 1, rank), np.float32)
+    np.add.at(out, idx, np.asarray(parts).reshape(-1, rank))
+    gold = np.asarray(mttkrp_stream(jnp.asarray(tt.inds),
+                                    jnp.asarray(tt.vals), fac, 0, dims[0]))
+    err = (np.abs(out[:dims[0]] - gold).max()
+           / max(np.abs(gold).max(), 1e-9))
+    assert err < 5e-5, err
+
+
+def test_scan_target_knob_changes_chunking_not_results():
+    """SPLATT_SCAN_TARGET_ELEMS tunes the XLA engine's scan granularity
+    (hardware sweep knob) without changing the computed MTTKRP."""
+    import importlib
+
+    mk = importlib.import_module("splatt_tpu.ops.mttkrp")
+    from splatt_tpu.blocked import build_layout
+
+    tt = gen.fixture_tensor("med")
+    factors = make_factors(tt.dims)
+    lay = build_layout(tt, 0, block=128, val_dtype=np.float64)
+    want = np_mttkrp(tt, factors, 0)
+    old = mk._SCAN_TARGET
+    try:
+        for target in (1 << 10, 1 << 16, 1 << 24):
+            mk._SCAN_TARGET = target
+            mttkrp_blocked.clear_cache()
+            got = mttkrp_blocked(lay, factors, 0, path="sorted_onehot",
+                                 impl="xla")
+            np.testing.assert_allclose(np.asarray(got), want, atol=TOL,
+                                       err_msg=str(target))
+    finally:
+        mk._SCAN_TARGET = old
+        mttkrp_blocked.clear_cache()
