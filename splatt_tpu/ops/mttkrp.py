@@ -163,7 +163,8 @@ def _block_chunks(nblocks: int, elems_per_block: int,
 
 
 def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
-                width: int, accumulate: bool) -> jax.Array:
+                width: int, accumulate: bool,
+                target_elems: Optional[int] = None) -> jax.Array:
     """Fused gather + Hadamard + one-hot reduce as a scan over block
     chunks (the XLA engine of the fused MTTKRP).
 
@@ -178,7 +179,7 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
     R = int(factors[0].shape[1])
     dtype = factors[0].dtype
     nmodes = layout.nmodes
-    C = _block_chunks(nb, width * B)
+    C = _block_chunks(nb, width * B, target_elems)
     nsteps = -(-nb // C)
     nb_pad = nsteps * C
 
@@ -227,10 +228,10 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
     return parts.reshape(nb_pad, width, R)[:nb]
 
 
-@partial(jax.jit, static_argnames=("mode", "path", "impl"))
 def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
                    path: str = "sorted_onehot",
-                   impl: str = "xla") -> jax.Array:
+                   impl: str = "xla",
+                   scan_target: Optional[int] = None) -> jax.Array:
     """Blocked MTTKRP over one :class:`ModeLayout`.
 
     `path` picks the algorithm (static dispatch); `impl` picks the
@@ -244,7 +245,22 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
       VMEM; HBM traffic ≈ inds + vals + touched factor rows + output),
       else the unfused kernel on a precomputed prod;
     - "pallas_interpret": kernel semantics on CPU, for tests.
+
+    `scan_target` tunes how much one-hot the XLA engine's scan step
+    materializes (default: SPLATT_SCAN_TARGET_ELEMS).  Resolved here —
+    outside the jit — so it is part of the cache key and changing it
+    always takes effect.
     """
+    if scan_target is None:
+        scan_target = _SCAN_TARGET
+    return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
+                               scan_target)
+
+
+@partial(jax.jit, static_argnames=("mode", "path", "impl", "scan_target"))
+def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
+                        mode: int, path: str, impl: str,
+                        scan_target: int) -> jax.Array:
     from splatt_tpu.ops.pallas_kernels import (fused_mttkrp, fused_mttkrp_t,
                                                fused_mttkrp_tg,
                                                onehot_reduce_full,
@@ -299,7 +315,7 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
                                       chunk=vmem_chunk(width, B, int(R),
                                                        itemsize))[:dim]
         return _scan_fused(layout, factors, mode, width,
-                           accumulate=True)[:dim]
+                           accumulate=True, target_elems=scan_target)[:dim]
 
     if path == "sorted_onehot":
         if mode != layout.mode:
@@ -324,13 +340,17 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
                                                           itemsize))
         else:
             parts = _scan_fused(layout, factors, mode, S,
-                                accumulate=False)    # (nb, S, R)
+                                accumulate=False,
+                                target_elems=scan_target)    # (nb, S, R)
         idx = (layout.row_start[:, None] + jnp.arange(S, dtype=jnp.int32)).reshape(-1)
         out = jnp.zeros((dim + S + 1, R), dtype=parts.dtype)
         out = out.at[idx].add(parts.reshape(-1, R))
         return out[:dim]
 
     raise ValueError(f"unknown path {path!r}")
+
+
+mttkrp_blocked.clear_cache = _mttkrp_blocked_jit.clear_cache
 
 
 def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
@@ -345,7 +365,8 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
                                                fused_t_vmem_ok,
                                                fused_tg_supported,
                                                fused_tg_vmem_ok,
-                                               fused_vmem_ok, vmem_chunk)
+                                               fused_vmem_ok, probe_regime,
+                                               vmem_chunk)
 
     dim = int(factors[mode].shape[0])
     R = int(factors[0].shape[1])
@@ -359,9 +380,17 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
         width = -(-(dim + 1) // 8) * 8
     else:
         width = layout.seg_width
-    fused_t_ok = pallas and (interpret or fused_t_supported())
-    fused_tg_ok = pallas and (interpret or fused_tg_supported())
-    fused_ok = pallas and (interpret or fused_gather_supported())
+    # capability probes are per lane-chunk regime: a Mosaic crash in
+    # the many-chunk (small-dims) regime must not veto the flagship
+    # single-chunk production shapes, and vice versa.  Only the
+    # GATHERED (non-target) factors are lane-chunked, so the target
+    # mode's dim does not enter the classification.
+    regime = probe_regime([int(f.shape[0])
+                           for k, f in enumerate(factors) if k != mode],
+                          B)
+    fused_t_ok = pallas and (interpret or fused_t_supported(regime))
+    fused_tg_ok = pallas and (interpret or fused_tg_supported(regime))
+    fused_ok = pallas and (interpret or fused_gather_supported(regime))
     if fused_t_ok and fused_t_vmem_ok(factors, mode, width, B):
         return "fused_t"
     if fused_tg_ok and fused_tg_vmem_ok(factors, mode, width, B):
@@ -455,8 +484,10 @@ def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
     note = ""
     from splatt_tpu.ops.pallas_kernels import PROBE_STATES
 
-    if PROBE_STATES.get("fused_t") == "timeout":
-        note = " [fused_t probe timed out: unproven, not rejected]"
+    timed_out = [k for k, v in PROBE_STATES.items() if v == "timeout"]
+    if timed_out:
+        note = (f" [{','.join(sorted(timed_out))} probe timed out: "
+                f"unproven, not rejected]")
     return f"engine plan: impl={impl} " + " ".join(parts) + note
 
 

@@ -530,17 +530,39 @@ def fused_mttkrp_tg(layout, factors, mode: int, width: int,
 PROBE_STATES: dict = {}
 
 
-def _probe_compiles(kernel_fn, name: str) -> bool:
+#: representative probe shapes per lane-chunk regime.  "ck1": the
+#: flagship NELL-like production regime — mode dims in the thousands,
+#: a single lane chunk per factor (d_pad >= block), wide gathers, a
+#: realistic seg_width (mode-0 indices laid out so each 4096-block
+#: spans ~8 rows, like a 20M-nnz tensor's density).  "multick": small
+#: mode dims against the same block, so the kernels unroll many lane
+#: chunks per factor (ck up to 11) — a regime that can crash Mosaic
+#: independently of the ck1 shape.  Probing per regime keeps a crash
+#: in one from vetoing the other.
+_PROBE_DIMS = {"ck1": (12092, 9184, 28818), "multick": (512, 384, 1024)}
+
+
+def probe_regime(dims, block: int) -> str:
+    """Which probe regime a (dims, block) config falls in: "multick"
+    when any factor needs more than one padded lane chunk per block."""
+    return ("multick"
+            if any(block > ceil_to(int(d), 128) for d in dims)
+            else "ck1")
+
+
+def _probe_compiles(kernel_fn, name: str, regime: str = "ck1") -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
-    interpret)` COMPILES for this backend at a *representative* shape.
-    Lowering alone is not enough: Mosaic layout inference (e.g. the
-    "Invalid input layout" broadcast restriction) only runs at compile
-    time.  And a toy shape is not enough either — measured on a v5e, a
-    (16,24,32)/block-128 probe compiles while every block-4096 case
-    crashes the Mosaic compiler subprocess (tools/fused_bisect.py), so
-    the probe uses a production-like block and dims."""
+    interpret)` COMPILES for this backend at a shape representative of
+    `regime`.  Lowering alone is not enough: Mosaic layout inference
+    (e.g. the "Invalid input layout" broadcast restriction) only runs
+    at compile time.  And a toy shape is not enough either — measured
+    on a v5e, a (16,24,32)/block-128 probe compiles while every
+    block-4096 case crashes the Mosaic compiler subprocess
+    (tools/fused_bisect.py), so each regime probes a production-like
+    block and dims."""
+    state_key = f"{name}:{regime}"
     if jax.default_backend() != "tpu":
-        PROBE_STATES[name] = "not_tpu"
+        PROBE_STATES[state_key] = "not_tpu"
         return False
 
     def compile_case():
@@ -550,10 +572,22 @@ def _probe_compiles(kernel_fn, name: str) -> bool:
         from splatt_tpu.coo import SparseTensor
 
         rng = np.random.default_rng(0)
-        dims = (512, 384, 1024)
-        inds = np.stack([rng.integers(0, d, 8192) for d in dims])
+        dims = _PROBE_DIMS[regime]
+        nnz = 8192
+        if regime == "ck1":
+            # NELL-like density: each 4096-block spans ~8 output rows,
+            # giving the production seg_width (~8-16)
+            i0 = np.minimum((np.arange(nnz, dtype=np.int64) * 8) // 4096,
+                            dims[0] - 1)
+        else:
+            # small dims: random rows give the regime's natural wide
+            # seg_width (~dims[0]) — the width real multick kernels
+            # compile at
+            i0 = rng.integers(0, dims[0], nnz)
+        inds = np.stack([i0] + [rng.integers(0, d, nnz)
+                                for d in dims[1:]])
         tt = SparseTensor(inds=inds.astype(np.int64),
-                          vals=np.ones(8192), dims=dims)
+                          vals=np.ones(nnz), dims=dims)
         lay = build_layout(tt, 0, block=4096, val_dtype=np.float32)
         fac = [jnp.zeros((d, 48), jnp.float32) for d in dims]
         kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
@@ -592,43 +626,45 @@ def _probe_compiles(kernel_fn, name: str) -> bool:
         # chip.  Cache it anyway — re-probing would stall every dispatch
         # by another 240 s — but say so loudly and record the distinct
         # state so engine_plan/CLI can report "unproven", not "rejected".
-        PROBE_STATES[name] = "timeout"
+        PROBE_STATES[state_key] = "timeout"
         import sys
 
-        print(f"splatt-tpu: WARNING: {name} capability probe timed out "
+        print(f"splatt-tpu: WARNING: {state_key} capability probe timed out "
               f"after 240 s (remote compile slow/wedged, NOT a kernel "
               f"rejection); treating as unsupported this session — an "
               f"orphaned compile thread may briefly contend for the chip",
               file=sys.stderr, flush=True)
         return False
-    PROBE_STATES[name] = "ok" if result[0] else "compile_failed"
+    PROBE_STATES[state_key] = ("ok" if result[0]
+                               else "compile_failed")
     return bool(result[0])
 
 
 @functools.cache
-def fused_t_supported() -> bool:
+def fused_t_supported(regime: str = "ck1") -> bool:
     """Whether the transposed-table fused kernel compiles here (its
     lane-wise same-shape take_along_axis gather is the form Mosaic
-    supports on jax 0.9.0)."""
-    return _probe_compiles(fused_mttkrp_t, "fused_t")
+    supports on jax 0.9.0), probed per lane-chunk regime."""
+    return _probe_compiles(fused_mttkrp_t, "fused_t", regime)
 
 
 @functools.cache
-def fused_tg_supported() -> bool:
+def fused_tg_supported(regime: str = "ck1") -> bool:
     """Whether the sublane-tiled fused kernel compiles here (one
     take_along_axis per factor×chunk, no concatenates, scratch-store
-    accumulation — the shape Mosaic is most likely to accept)."""
-    return _probe_compiles(fused_mttkrp_tg, "fused_tg")
+    accumulation — the shape Mosaic is most likely to accept), probed
+    per lane-chunk regime."""
+    return _probe_compiles(fused_mttkrp_tg, "fused_tg", regime)
 
 
 @functools.cache
-def fused_gather_supported() -> bool:
+def fused_gather_supported(regime: str = "ck1") -> bool:
     """Whether the row-major fused kernel compiles here.  Its arbitrary
     ``u[idx]`` row gather is NOT a form jax 0.9.0's Mosaic lowers (only
     same-shaped take_along_axis is), so this is False on current
     hardware — kept for future jax versions; interpret mode covers it
     in tests."""
-    return _probe_compiles(fused_mttkrp, "fused_gather")
+    return _probe_compiles(fused_mttkrp, "fused_gather", regime)
 
 
 def fused_vmem_ok(factors, mode: int, width: int, block: int,

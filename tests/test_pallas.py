@@ -306,26 +306,18 @@ def test_fused_tg_production_dims_interpret():
 
 
 def test_scan_target_knob_changes_chunking_not_results():
-    """SPLATT_SCAN_TARGET_ELEMS tunes the XLA engine's scan granularity
-    (hardware sweep knob) without changing the computed MTTKRP."""
-    import importlib
-
-    mk = importlib.import_module("splatt_tpu.ops.mttkrp")
+    """scan_target tunes the XLA engine's scan granularity (the
+    hardware sweep knob; default from SPLATT_SCAN_TARGET_ELEMS) as a
+    static jit argument — distinct values re-trace — without changing
+    the computed MTTKRP."""
     from splatt_tpu.blocked import build_layout
 
     tt = gen.fixture_tensor("med")
     factors = make_factors(tt.dims)
     lay = build_layout(tt, 0, block=128, val_dtype=np.float64)
     want = np_mttkrp(tt, factors, 0)
-    old = mk._SCAN_TARGET
-    try:
-        for target in (1 << 10, 1 << 16, 1 << 24):
-            mk._SCAN_TARGET = target
-            mttkrp_blocked.clear_cache()
-            got = mttkrp_blocked(lay, factors, 0, path="sorted_onehot",
-                                 impl="xla")
-            np.testing.assert_allclose(np.asarray(got), want, atol=TOL,
-                                       err_msg=str(target))
-    finally:
-        mk._SCAN_TARGET = old
-        mttkrp_blocked.clear_cache()
+    for target in (1 << 10, 1 << 16, 1 << 24):
+        got = mttkrp_blocked(lay, factors, 0, path="sorted_onehot",
+                             impl="xla", scan_target=target)
+        np.testing.assert_allclose(np.asarray(got), want, atol=TOL,
+                                   err_msg=str(target))

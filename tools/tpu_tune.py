@@ -82,22 +82,15 @@ def main() -> None:
 
     # scan-chunk sweep for the XLA engine (the proven-on-chip engine:
     # tune how much one-hot each lax.scan step materializes)
-    import importlib
-
-    # `from splatt_tpu.ops import mttkrp` resolves to the re-exported
-    # *function*; load the module itself to mutate the knob
-    mk = importlib.import_module("splatt_tpu.ops.mttkrp")
     lay = build_layout(tt, 0, block=4096, val_dtype=jnp.float32)
     factors = [jnp.asarray(np.random.default_rng(0).random((d, rank)),
                            jnp.float32) for d in tt.dims]
-    default_target = mk._SCAN_TARGET
     for target in (1 << 21, 1 << 22, 1 << 23, 1 << 24, 1 << 25):
-        mk._SCAN_TARGET = target
-        mttkrp_blocked.clear_cache()  # chunking is trace-time static
         try:
             t = timeit(lambda: mttkrp_blocked(lay, factors, 0,
                                               path="sorted_onehot",
-                                              impl="xla"))
+                                              impl="xla",
+                                              scan_target=target))
             rec = dict(path="sorted_onehot", engine="xla",
                        scan_target_elems=target, block=4096,
                        sec=round(t, 5))
@@ -107,8 +100,6 @@ def main() -> None:
                        error=f"{type(e).__name__}: {e}"[:120])
         results.append(rec)
         print(rec, flush=True)
-    mk._SCAN_TARGET = default_target
-    mttkrp_blocked.clear_cache()
 
     out = dict(platform=platform, nnz=nnz, rank=rank, dims=tt.dims,
                results=results)
