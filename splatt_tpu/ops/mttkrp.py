@@ -388,14 +388,18 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     regime = probe_regime([int(f.shape[0])
                            for k, f in enumerate(factors) if k != mode],
                           B)
-    fused_t_ok = pallas and (interpret or fused_t_supported(regime))
-    fused_tg_ok = pallas and (interpret or fused_tg_supported(regime))
-    fused_ok = pallas and (interpret or fused_gather_supported(regime))
-    if fused_t_ok and fused_t_vmem_ok(factors, mode, width, B):
+    # LAZY probing, cheap VMEM gate first: each capability probe costs
+    # a remote compile attempt on the tunneled TPU service (~35 s, or
+    # 240 s on a wedged compile) — a kernel gated out by VMEM, or never
+    # reached because an earlier engine won, must not be probed at all.
+    if pallas and fused_t_vmem_ok(factors, mode, width, B) \
+            and (interpret or fused_t_supported(regime, B)):
         return "fused_t"
-    if fused_tg_ok and fused_tg_vmem_ok(factors, mode, width, B):
+    if pallas and fused_tg_vmem_ok(factors, mode, width, B) \
+            and (interpret or fused_tg_supported(regime, B)):
         return "fused_tg"
-    if fused_ok and fused_vmem_ok(factors, mode, width, B):
+    if pallas and fused_vmem_ok(factors, mode, width, B) \
+            and (interpret or fused_gather_supported(regime, B)):
         return "fused"
     if (pallas and vmem_chunk(width, B, R, itemsize) >= 1
             and _unfused_hbm_ok(layout, R, itemsize)):

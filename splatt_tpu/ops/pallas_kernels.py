@@ -550,17 +550,19 @@ def probe_regime(dims, block: int) -> str:
             else "ck1")
 
 
-def _probe_compiles(kernel_fn, name: str, regime: str = "ck1") -> bool:
+def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
+                    block: int = 4096) -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
     interpret)` COMPILES for this backend at a shape representative of
-    `regime`.  Lowering alone is not enough: Mosaic layout inference
-    (e.g. the "Invalid input layout" broadcast restriction) only runs
-    at compile time.  And a toy shape is not enough either — measured
-    on a v5e, a (16,24,32)/block-128 probe compiles while every
-    block-4096 case crashes the Mosaic compiler subprocess
-    (tools/fused_bisect.py), so each regime probes a production-like
-    block and dims."""
-    state_key = f"{name}:{regime}"
+    `regime` at the CALLER's block size.  Lowering alone is not
+    enough: Mosaic layout inference (e.g. the "Invalid input layout"
+    broadcast restriction) only runs at compile time.  And a toy shape
+    is not enough either — measured on a v5e, a (16,24,32)/block-128
+    probe compiles while every block-4096 case crashes the Mosaic
+    compiler subprocess (tools/fused_bisect.py); the block size is the
+    variable that bisect data most implicates, so it is part of the
+    probe key rather than fixed."""
+    state_key = f"{name}:{regime}:b{block}"
     if jax.default_backend() != "tpu":
         PROBE_STATES[state_key] = "not_tpu"
         return False
@@ -573,11 +575,15 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1") -> bool:
 
         rng = np.random.default_rng(0)
         dims = _PROBE_DIMS[regime]
-        nnz = 8192
+        nnz = max(8192, 2 * block)
+        # scale the probe's rank to the device's VMEM so a capacity
+        # rejection on small-VMEM parts (v2/v3: 16 MiB) is never cached
+        # as a capability rejection for the whole regime
+        rank = 48 if _vmem_limit() >= (32 << 20) else 16
         if regime == "ck1":
-            # NELL-like density: each 4096-block spans ~8 output rows,
+            # NELL-like density: each block spans ~8 output rows,
             # giving the production seg_width (~8-16)
-            i0 = np.minimum((np.arange(nnz, dtype=np.int64) * 8) // 4096,
+            i0 = np.minimum((np.arange(nnz, dtype=np.int64) * 8) // block,
                             dims[0] - 1)
         else:
             # small dims: random rows give the regime's natural wide
@@ -588,8 +594,8 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1") -> bool:
                                 for d in dims[1:]])
         tt = SparseTensor(inds=inds.astype(np.int64),
                           vals=np.ones(nnz), dims=dims)
-        lay = build_layout(tt, 0, block=4096, val_dtype=np.float32)
-        fac = [jnp.zeros((d, 48), jnp.float32) for d in dims]
+        lay = build_layout(tt, 0, block=block, val_dtype=np.float32)
+        fac = [jnp.zeros((d, rank), jnp.float32) for d in dims]
         kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
                         accumulate=False, interpret=False).compile()
         return True
@@ -641,30 +647,31 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1") -> bool:
 
 
 @functools.cache
-def fused_t_supported(regime: str = "ck1") -> bool:
+def fused_t_supported(regime: str = "ck1", block: int = 4096) -> bool:
     """Whether the transposed-table fused kernel compiles here (its
     lane-wise same-shape take_along_axis gather is the form Mosaic
-    supports on jax 0.9.0), probed per lane-chunk regime."""
-    return _probe_compiles(fused_mttkrp_t, "fused_t", regime)
+    supports on jax 0.9.0), probed per (lane-chunk regime, block)."""
+    return _probe_compiles(fused_mttkrp_t, "fused_t", regime, block)
 
 
 @functools.cache
-def fused_tg_supported(regime: str = "ck1") -> bool:
+def fused_tg_supported(regime: str = "ck1", block: int = 4096) -> bool:
     """Whether the sublane-tiled fused kernel compiles here (one
     take_along_axis per factor×chunk, no concatenates, scratch-store
     accumulation — the shape Mosaic is most likely to accept), probed
-    per lane-chunk regime."""
-    return _probe_compiles(fused_mttkrp_tg, "fused_tg", regime)
+    per (lane-chunk regime, block)."""
+    return _probe_compiles(fused_mttkrp_tg, "fused_tg", regime, block)
 
 
 @functools.cache
-def fused_gather_supported(regime: str = "ck1") -> bool:
+def fused_gather_supported(regime: str = "ck1",
+                           block: int = 4096) -> bool:
     """Whether the row-major fused kernel compiles here.  Its arbitrary
     ``u[idx]`` row gather is NOT a form jax 0.9.0's Mosaic lowers (only
     same-shaped take_along_axis is), so this is False on current
     hardware — kept for future jax versions; interpret mode covers it
     in tests."""
-    return _probe_compiles(fused_mttkrp, "fused_gather", regime)
+    return _probe_compiles(fused_mttkrp, "fused_gather", regime, block)
 
 
 def fused_vmem_ok(factors, mode: int, width: int, block: int,

@@ -114,11 +114,16 @@ try:
         fac = [jnp.asarray(rng.standard_normal((d, 32)).astype(np.float32))
                for d in dims]
         lay = build_layout(tt, 0, block=512, val_dtype=np.float32)
-        from splatt_tpu.ops.pallas_kernels import fused_gather_supported
+        from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
+                                                   probe_regime)
 
         # Record whether the fused kernel itself can lower on this jax/
-        # Mosaic, or whether dispatch fell back to the unfused kernels.
-        info["fused_gather_supported"] = fused_gather_supported()
+        # Mosaic, or whether dispatch fell back to the unfused kernels —
+        # probed at THIS config's regime/block so the recorded verdict
+        # is the one the dispatch below actually consults.
+        regime = probe_regime(dims[1:], lay.block)
+        info["fused_gather_supported"] = fused_gather_supported(
+            regime, lay.block)
         got = mk.mttkrp_blocked(lay, fac, 0, path="sorted_onehot",
                                 impl="pallas")
         got.block_until_ready()
