@@ -287,13 +287,23 @@ def main() -> None:
         # whenever the native host MTTKRP engine runs (host calls can't
         # live inside a whole-sweep trace); the fully fused sweep
         # elsewhere.
-        from splatt_tpu.ops.mttkrp import choose_impl
+        from splatt_tpu.ops.mttkrp import choose_impl, describe_plan
 
         native = (isinstance(X, BlockedSparse)
                   and choose_impl(X.opts) == "native")
         phased = (jit_mode == "phased"
                   or (jit_mode == "auto"
                       and (jax.default_backend() == "tpu" or native)))
+        if isinstance(X, BlockedSparse):
+            # name the dispatch plan in the log: the TPU number is only
+            # interpretable knowing which engine (fused_t/fused_tg/
+            # xla_scan/native) actually ran.  Inside a FUSED whole-sweep
+            # trace the host-only native engine cannot run (tracer
+            # inputs) — say so rather than mislabel the measurement.
+            plan = describe_plan(X, factors)
+            if not phased and "native" in plan:
+                plan += " [fused whole-sweep jit: native falls back to xla]"
+            note(plan)
         sweep = (_make_phased_sweep if phased
                  else _make_sweep)(X, tt.nmodes, 0.0)
         # warmup / compile
