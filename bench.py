@@ -198,10 +198,31 @@ def _device_precheck(timeout_sec: int = 180) -> None:
              "if p:\n"
              "    jax.config.update('jax_platforms', p)\n"
              "jax.devices()\n")
+    ok = False
     try:
-        subprocess.run([sys.executable, "-c", probe],
-                       timeout=timeout_sec, check=True, capture_output=True)
-    except (subprocess.SubprocessError, OSError):
+        p = subprocess.Popen([sys.executable, "-c", probe],
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        try:
+            ok = p.wait(timeout=timeout_sec) == 0
+        except subprocess.TimeoutExpired:
+            # a hung claim means the relay is down; SIGTERM (never
+            # SIGKILL — a killed mid-claim client wedges the lease) and
+            # give it a grace period.  If it ignores SIGTERM, orphan it:
+            # the doomed claim expires on its own and only the CPU
+            # fallback follows anyway.
+            p.terminate()
+            try:
+                # short grace only — a pending claim in the child can't
+                # block the parent's CPU fallback, so don't delay it
+                p.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                print("bench: probe ignoring SIGTERM; orphaning it "
+                      "(claim will expire server-side)",
+                      file=sys.stderr, flush=True)
+    except OSError:
+        pass
+    if not ok:
         print("bench: accelerator unavailable, falling back to CPU",
               file=sys.stderr, flush=True)
         import jax
