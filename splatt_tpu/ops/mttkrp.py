@@ -488,10 +488,12 @@ def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
     note = ""
     from splatt_tpu.ops.pallas_kernels import PROBE_STATES
 
-    timed_out = [k for k, v in PROBE_STATES.items() if v == "timeout"]
-    if timed_out:
-        note = (f" [{','.join(sorted(timed_out))} probe timed out: "
-                f"unproven, not rejected]")
+    unproven = {k: v for k, v in PROBE_STATES.items()
+                if v in ("timeout", "infra_error")}
+    if unproven:
+        labels = [f"{k} {'timed out' if v == 'timeout' else 'service error'}"
+                  for k, v in sorted(unproven.items())]
+        note = f" [probe {'; '.join(labels)}: unproven, not rejected]"
     return f"engine plan: impl={impl} " + " ".join(parts) + note
 
 

@@ -530,6 +530,128 @@ def fused_mttkrp_tg(layout, factors, mode: int, width: int,
 PROBE_STATES: dict = {}
 
 
+# -- persistent capability cache --------------------------------------------
+#
+# A capability probe costs a remote compile (~35 s healthy, 240 s on a
+# wedged service) and its verdict depends only on (jax version, device
+# kind, kernel, regime, block) — none of which change between the
+# processes of one environment.  Every stage of tools/tpu_session.sh is
+# its own process, so without persistence a precious chip window spends
+# its first minutes re-proving verdicts the previous stage already paid
+# for.  This cache stores proven verdicts ("ok"/"compile_failed") on
+# disk; "timeout" is stored for reporting but NEVER short-circuits a
+# later process — an unproven verdict is retried, not inherited (a
+# transiently wedged compile service must not demote the flagship
+# engine for every future session).
+
+_CACHE_ENV = "SPLATT_PROBE_CACHE"
+
+
+def _cache_path():
+    import os
+    import pathlib
+
+    p = os.environ.get(_CACHE_ENV)
+    if p:
+        return pathlib.Path(p)
+    root = pathlib.Path(__file__).resolve().parents[2]
+    # a real repo-checkout marker — the bare existence of a sibling
+    # "tools" dir would misfire inside site-packages
+    if (root / "pyproject.toml").exists() and (root / "tools").is_dir():
+        return root / "tools" / "probe_cache.json"
+    return pathlib.Path.home() / ".cache" / "splatt_tpu" / "probe_cache.json"
+
+
+@functools.cache
+def _kernel_src_hash() -> str:
+    """Hash of the sources a probe verdict depends on — this module
+    plus the layout/tensor builders the probe compiles through
+    (blocked.py, coo.py): editing any of them invalidates every cached
+    verdict, so a fixed Mosaic crash is re-probed instead of staying
+    disabled behind a stale "compile_failed"."""
+    import hashlib
+    import pathlib
+
+    h = hashlib.sha256()
+    pkg = pathlib.Path(__file__).resolve().parents[1]
+    try:
+        for src in (pathlib.Path(__file__), pkg / "blocked.py",
+                    pkg / "coo.py"):
+            h.update(src.read_bytes())
+        return h.hexdigest()[:12]
+    except Exception:
+        return "nosrc"
+
+
+def _cache_env_key() -> str:
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:
+        jl = "?"
+    return f"{jax.__version__}|jaxlib{jl}|{kind}|{_kernel_src_hash()}"
+
+
+def probe_cache_load(state_key: str):
+    """Cached verdict for `state_key` in this environment, or None.
+    Returns whatever was stored ("ok"/"compile_failed"/"timeout") —
+    the CALLER decides which states are authoritative."""
+    import json
+
+    try:
+        with open(_cache_path()) as f:
+            data = json.load(f)
+        entry = data.get(_cache_env_key(), {}).get(state_key)
+        return entry["state"] if entry else None
+    except Exception:
+        return None
+
+
+def probe_cache_store(state_key: str, state: str) -> None:
+    """Record a probe verdict on disk (atomic replace; best-effort —
+    cache IO must never break dispatch).  Timestamps let a TPU session
+    commit the file as evidence of when each verdict was proven."""
+    import json
+    import os
+    import tempfile
+    import time
+
+    try:
+        path = _cache_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        # serialize concurrent read-modify-writes (two processes proving
+        # different kernels must not drop each other's verdicts)
+        import fcntl
+
+        with open(str(path) + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except Exception:
+                data = {}
+            env = data.setdefault(_cache_env_key(), {})
+            env[state_key] = {"state": state, "ts": time.time()}
+            fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(data, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            except Exception:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+    except Exception:
+        pass
+
+
 #: representative probe shapes per lane-chunk regime.  "ck1": the
 #: flagship NELL-like production regime — mode dims in the thousands,
 #: a single lane chunk per factor (d_pad >= block), wide gathers, a
@@ -550,6 +672,42 @@ def probe_regime(dims, block: int) -> str:
             else "ck1")
 
 
+def _probe_case(kernel_fn, regime: str, block: int) -> bool:
+    """The probe compile itself — module-level so tests can substitute
+    it without touching the thread/deadline/cache machinery around it."""
+    import numpy as np
+
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.coo import SparseTensor
+
+    rng = np.random.default_rng(0)
+    dims = _PROBE_DIMS[regime]
+    nnz = max(8192, 2 * block)
+    # scale the probe's rank to the device's VMEM so a capacity
+    # rejection on small-VMEM parts (v2/v3: 16 MiB) is never cached
+    # as a capability rejection for the whole regime
+    rank = 48 if _vmem_limit() >= (32 << 20) else 16
+    if regime == "ck1":
+        # NELL-like density: each block spans ~8 output rows,
+        # giving the production seg_width (~8-16)
+        i0 = np.minimum((np.arange(nnz, dtype=np.int64) * 8) // block,
+                        dims[0] - 1)
+    else:
+        # small dims: random rows give the regime's natural wide
+        # seg_width (~dims[0]) — the width real multick kernels
+        # compile at
+        i0 = rng.integers(0, dims[0], nnz)
+    inds = np.stack([i0] + [rng.integers(0, d, nnz)
+                            for d in dims[1:]])
+    tt = SparseTensor(inds=inds.astype(np.int64),
+                      vals=np.ones(nnz), dims=dims)
+    lay = build_layout(tt, 0, block=block, val_dtype=np.float32)
+    fac = [jnp.zeros((d, rank), jnp.float32) for d in dims]
+    kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
+                    accumulate=False, interpret=False).compile()
+    return True
+
+
 def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
                     block: int = 4096) -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
@@ -567,38 +725,15 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         PROBE_STATES[state_key] = "not_tpu"
         return False
 
+    # Proven verdicts persist across processes; "timeout" does not
+    # short-circuit (unproven — retry it now that we have the chip).
+    cached = probe_cache_load(state_key)
+    if cached in ("ok", "compile_failed"):
+        PROBE_STATES[state_key] = cached
+        return cached == "ok"
+
     def compile_case():
-        import numpy as np
-
-        from splatt_tpu.blocked import build_layout
-        from splatt_tpu.coo import SparseTensor
-
-        rng = np.random.default_rng(0)
-        dims = _PROBE_DIMS[regime]
-        nnz = max(8192, 2 * block)
-        # scale the probe's rank to the device's VMEM so a capacity
-        # rejection on small-VMEM parts (v2/v3: 16 MiB) is never cached
-        # as a capability rejection for the whole regime
-        rank = 48 if _vmem_limit() >= (32 << 20) else 16
-        if regime == "ck1":
-            # NELL-like density: each block spans ~8 output rows,
-            # giving the production seg_width (~8-16)
-            i0 = np.minimum((np.arange(nnz, dtype=np.int64) * 8) // block,
-                            dims[0] - 1)
-        else:
-            # small dims: random rows give the regime's natural wide
-            # seg_width (~dims[0]) — the width real multick kernels
-            # compile at
-            i0 = rng.integers(0, dims[0], nnz)
-        inds = np.stack([i0] + [rng.integers(0, d, nnz)
-                                for d in dims[1:]])
-        tt = SparseTensor(inds=inds.astype(np.int64),
-                          vals=np.ones(nnz), dims=dims)
-        lay = build_layout(tt, 0, block=block, val_dtype=np.float32)
-        fac = [jnp.zeros((d, rank), jnp.float32) for d in dims]
-        kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
-                        accumulate=False, interpret=False).compile()
-        return True
+        return _probe_case(kernel_fn, regime, block)
 
     # The compile runs on a daemon thread with a deadline: a wedged
     # remote-compile service (observed: >40 min hangs) must degrade to
@@ -612,11 +747,23 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
 
     result = []
 
+    # Transient service failures (the tunneled chip lease dropping,
+    # relay restarts) must not be mistaken for kernel rejections: the
+    # axon relay routinely raises UNAVAILABLE rather than hanging.  A
+    # Mosaic crash, by contrast, is deterministic for the shape — those
+    # ARE rejections, even when reported as an HTTP 500 from the remote
+    # compile service.
+    _INFRA_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Socket closed",
+                      "GOAWAY", "failed to connect",
+                      "Unable to initialize backend")
+
     def runner():
         try:
             result.append(compile_case())
-        except Exception:
-            result.append(False)
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            result.append("infra" if any(m in msg for m in _INFRA_MARKERS)
+                          else False)
 
     t = threading.Thread(target=runner, daemon=True)
     t.start()
@@ -633,16 +780,31 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         # by another 240 s — but say so loudly and record the distinct
         # state so engine_plan/CLI can report "unproven", not "rejected".
         PROBE_STATES[state_key] = "timeout"
+        probe_cache_store(state_key, "timeout")
         import sys
 
         print(f"splatt-tpu: WARNING: {state_key} capability probe timed out "
               f"after 240 s (remote compile slow/wedged, NOT a kernel "
               f"rejection); treating as unsupported this session — an "
-              f"orphaned compile thread may briefly contend for the chip",
+              f"orphaned compile thread may briefly contend for the chip "
+              f"(recorded as unproven; the next process will re-probe)",
               file=sys.stderr, flush=True)
         return False
-    PROBE_STATES[state_key] = ("ok" if result[0]
-                               else "compile_failed")
+    if result[0] == "infra":
+        # unproven, like timeout: recorded for reporting, retried by the
+        # next process rather than inherited as a rejection
+        PROBE_STATES[state_key] = "infra_error"
+        probe_cache_store(state_key, "infra_error")
+        import sys
+
+        print(f"splatt-tpu: WARNING: {state_key} capability probe hit a "
+              f"transient service error (NOT a kernel rejection); treating "
+              f"as unsupported this session — the next process will re-probe",
+              file=sys.stderr, flush=True)
+        return False
+    state = "ok" if result[0] else "compile_failed"
+    PROBE_STATES[state_key] = state
+    probe_cache_store(state_key, state)
     return bool(result[0])
 
 

@@ -1,0 +1,148 @@
+"""Persistent capability-probe cache.
+
+A probe's verdict depends only on (jax version, device kind, kernel,
+regime, block), so it is cached on disk and reused by later processes —
+a chip window is spent measuring, not re-proving what the previous
+session stage already paid a remote compile for.  The contract under
+test: proven verdicts ("ok"/"compile_failed") short-circuit the probe,
+"timeout" is recorded but always retried, and cache IO failures never
+break dispatch.
+"""
+
+import json
+
+import jax
+import pytest
+
+import splatt_tpu.ops.pallas_kernels as pk
+
+
+@pytest.fixture()
+def cache_file(tmp_path, monkeypatch):
+    path = tmp_path / "probe_cache.json"
+    monkeypatch.setenv(pk._CACHE_ENV, str(path))
+    return path
+
+
+@pytest.fixture()
+def fake_tpu(monkeypatch):
+    """Pretend the backend is TPU so _probe_compiles reaches the cache
+    and probe machinery; the probe body itself is substituted per-test."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+def _states(snapshot):
+    """Context to keep PROBE_STATES isolated per test."""
+    pk.PROBE_STATES.clear()
+    pk.PROBE_STATES.update(snapshot)
+
+
+def test_store_load_roundtrip(cache_file):
+    pk.probe_cache_store("fused_t:ck1:b4096", "ok")
+    assert pk.probe_cache_load("fused_t:ck1:b4096") == "ok"
+    assert pk.probe_cache_load("fused_t:ck1:b128") is None
+    # the file is keyed by environment (jax version | device kind)
+    data = json.loads(cache_file.read_text())
+    (env_key,) = data.keys()
+    assert jax.__version__ in env_key
+
+
+def test_cache_hit_skips_probe(cache_file, fake_tpu, monkeypatch):
+    _states({})
+    pk.probe_cache_store("testk:ck1:b4096", "compile_failed")
+
+    def boom(*a, **k):
+        raise AssertionError("probe must not run on a cache hit")
+
+    monkeypatch.setattr(pk, "_probe_case", boom)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.PROBE_STATES["testk:ck1:b4096"] == "compile_failed"
+
+    pk.probe_cache_store("testk2:ck1:b4096", "ok")
+    assert pk._probe_compiles(None, "testk2", "ck1", 4096) is True
+    assert pk.PROBE_STATES["testk2:ck1:b4096"] == "ok"
+
+
+def test_cache_miss_runs_probe_and_stores(cache_file, fake_tpu, monkeypatch):
+    _states({})
+    calls = []
+    monkeypatch.setattr(pk, "_probe_case",
+                        lambda fn, regime, block: calls.append(1) or True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+    assert calls == [1]
+    assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+    # a second PROCESS (simulated: fresh PROBE_STATES) hits the cache
+    _states({})
+    monkeypatch.setattr(pk, "_probe_case",
+                        lambda fn, regime, block: calls.append(2) or True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+    assert calls == [1], "second process must not re-probe"
+
+
+def test_timeout_is_retried_not_inherited(cache_file, fake_tpu, monkeypatch):
+    _states({})
+    pk.probe_cache_store("testk:ck1:b4096", "timeout")
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    # an unproven verdict must NOT short-circuit: the probe runs and
+    # upgrades the cached state to the proven one
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+    assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+
+
+def test_infra_error_is_retried_not_inherited(cache_file, fake_tpu,
+                                              monkeypatch):
+    _states({})
+
+    def flaky(fn, regime, block):
+        raise RuntimeError("UNAVAILABLE: TPU backend setup error")
+
+    monkeypatch.setattr(pk, "_probe_case", flaky)
+    # a transient service failure is NOT a kernel rejection
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.PROBE_STATES["testk:ck1:b4096"] == "infra_error"
+    assert pk.probe_cache_load("testk:ck1:b4096") == "infra_error"
+    # the next process re-probes and can prove the kernel fine
+    _states({})
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+    assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+
+
+def test_kernel_edit_invalidates_cache(cache_file, fake_tpu, monkeypatch):
+    _states({})
+    pk.probe_cache_store("testk:ck1:b4096", "compile_failed")
+    # simulate a kernel fix: the module source hash changes, so the old
+    # environment's verdicts no longer apply and the probe re-runs
+    monkeypatch.setattr(pk, "_kernel_src_hash", lambda: "newhash12345")
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+
+
+def test_compile_failure_is_stored(cache_file, fake_tpu, monkeypatch):
+    _states({})
+
+    def fail(fn, regime, block):
+        raise RuntimeError("Mosaic crash")
+
+    monkeypatch.setattr(pk, "_probe_case", fail)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.probe_cache_load("testk:ck1:b4096") == "compile_failed"
+    assert pk.PROBE_STATES["testk:ck1:b4096"] == "compile_failed"
+
+
+def test_not_tpu_short_circuits_without_cache(cache_file):
+    _states({})
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.PROBE_STATES["testk:ck1:b4096"] == "not_tpu"
+    assert not cache_file.exists()
+
+
+def test_cache_io_failure_is_harmless(fake_tpu, monkeypatch, tmp_path):
+    _states({})
+    # a path whose parent is a regular file: mkdir/open both fail
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    monkeypatch.setenv(pk._CACHE_ENV, str(blocker / "sub" / "cache.json"))
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    # store/load both raise internally; dispatch still gets its verdict
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
