@@ -392,9 +392,14 @@ def fused_mttkrp_t(layout, factors, mode: int, width: int,
 #     MTTKRP — noise), not once per block;
 #   * chunk products accumulate into a VMEM scratch at static
 #     128-aligned lane offsets instead of concatenating tiles.
-# The VMEM envelope is tiny and independent of dim×rank, so this engine
-# also covers configs fused_t's whole-table residency gate rejects
-# (rank 200, the Amazon-scale mode dims).
+# The VMEM envelope is RANK-independent (only one 8-sublane rank tile
+# is live per step) but DIM-linear: the per-step (8, d_pad) table slice
+# and index tiles scale with the padded mode dim, so rank-200 configs
+# fused_t's whole-table residency gate rejects are covered, while
+# mode dims beyond a few hundred thousand still reject (a 10M-row mode
+# ⇒ ~960 MB/step) and dispatch falls back to xla_scan.  What rescues
+# the Amazon-scale configs is the multi-chip grid: each device sees
+# only its grid-LOCAL dims, which shrink by the axis width.
 
 def _fused_tg_kernel(local_ref, vals_ref, *refs,
                      width: int, accumulate: bool, nother: int):
@@ -447,7 +452,10 @@ def fused_tg_vmem_ok(factors, mode: int, width: int, block: int,
     """VMEM plan of the sublane-tiled kernel — per-step only: (8, D)
     table slices, the replicated index tiles, the (8, B) product
     scratch, one-hot and partials.  ×2 on streamed operands for double
-    buffering.  Independent of rank and of whole-table footprints."""
+    buffering.  RANK-independent (no whole-table footprint), but
+    DIM-linear: the slice/index terms grow with each padded mode dim,
+    so very large local dims (≳ a few hundred thousand rows at
+    block 4096) correctly reject here and dispatch falls back."""
     if budget_bytes is None:
         budget_bytes = _vmem_budget()
     itemsize = jnp.dtype(factors[0].dtype).itemsize
@@ -747,23 +755,27 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
 
     result = []
 
-    # Transient service failures (the tunneled chip lease dropping,
-    # relay restarts) must not be mistaken for kernel rejections: the
-    # axon relay routinely raises UNAVAILABLE rather than hanging.  A
-    # Mosaic crash, by contrast, is deterministic for the shape — those
-    # ARE rejections, even when reported as an HTTP 500 from the remote
-    # compile service.
-    _INFRA_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Socket closed",
-                      "GOAWAY", "failed to connect",
-                      "Unable to initialize backend")
+    # Only a recognized DETERMINISTIC rejection may be persisted as
+    # "compile_failed" — the cache makes any misclassification
+    # permanent for the whole environment, so the persisted-negative
+    # set is a whitelist (Mosaic compiler crash/rejection signatures),
+    # not a transient-error blocklist.  Everything else — the tunneled
+    # relay dropping (UNAVAILABLE etc.), or any unrecognized exception
+    # — is treated as unproven: rejected for THIS session, re-probed
+    # by the next process (worst case one ~35 s probe per process,
+    # bounded; a wrongly-persisted rejection would be unbounded).
+    _REJECT_MARKERS = ("Mosaic", "mosaic", "Internal TPU kernel compiler",
+                       "Invalid input layout", "Unsupported lowering",
+                       "not implemented", "NotImplementedError",
+                       "INTERNAL: ", "HTTP code 500")
 
     def runner():
         try:
             result.append(compile_case())
         except Exception as e:
             msg = f"{type(e).__name__}: {e}"
-            result.append("infra" if any(m in msg for m in _INFRA_MARKERS)
-                          else False)
+            result.append(False if any(m in msg for m in _REJECT_MARKERS)
+                          else "infra")
 
     t = threading.Thread(target=runner, daemon=True)
     t.start()
@@ -797,9 +809,10 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         probe_cache_store(state_key, "infra_error")
         import sys
 
-        print(f"splatt-tpu: WARNING: {state_key} capability probe hit a "
-              f"transient service error (NOT a kernel rejection); treating "
-              f"as unsupported this session — the next process will re-probe",
+        print(f"splatt-tpu: WARNING: {state_key} capability probe failed "
+              f"with an unrecognized/transient error (NOT a proven kernel "
+              f"rejection); treating as unsupported this session — the "
+              f"next process will re-probe",
               file=sys.stderr, flush=True)
         return False
     state = "ok" if result[0] else "compile_failed"

@@ -36,7 +36,10 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
     opts = (opts or default_opts()).validate()
     ck = dict(checkpoint_path=checkpoint_path,
               checkpoint_every=checkpoint_every, resume=resume)
-    eng = local_engine if local_engine is not None else "blocked"
+    # local_engine=None flows through unchanged: each driver's own
+    # auto-detection picks "stream" for memmapped (beyond-RAM) tensors
+    # and "blocked" otherwise — forcing "blocked" here would materialize
+    # O(nnz) in-RAM sorted copies for exactly the inputs that can't.
     if opts.decomposition is Decomposition.MEDIUM and partition is None:
         if row_distribute is not None:
             raise ValueError("row_distribute applies to the FINE "
@@ -49,11 +52,11 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
             raise ValueError("row_distribute applies to the FINE "
                              "decomposition, not COARSE")
         return coarse_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
-                              local_engine=eng, **ck)
+                              local_engine=local_engine, **ck)
     return sharded_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
                            partition=partition,
                            row_distribute=row_distribute,
-                           local_engine=eng, **ck)
+                           local_engine=local_engine, **ck)
 
 
 __all__ = [

@@ -569,6 +569,7 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
                   f"{start_it} (fit {fit_ck:0.5f})")
     k = opts.fit_check_every
     last_check_it = start_it
+    done_it = start_it
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
@@ -601,12 +602,19 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
         # so enabling checkpoints cannot change convergence behavior
         window = (it + 1) - last_check_it
         last_check_it = it + 1
+        done_it = it + 1
         if it > 0 and abs(fitval - fit_prev) < opts.tolerance * window:
             fit_prev = fitval
             break
         fit_prev = fitval
 
     gathered = _gather_original(factors, dims, row_select)
+    # final checkpoint, like cpd_als's last-iteration save: a completed
+    # (or converged) run must not leave the checkpoint several
+    # iterations stale — a later resume with a higher max_iterations
+    # would redo work this result already contained
+    if checkpoint_path and done_it > start_it and jax.process_index() == 0:
+        _save_checkpoint(checkpoint_path, gathered, lam, done_it, fit_prev)
     return post_process([jnp.asarray(U) for U in gathered], lam,
                         jnp.asarray(fit_prev, dtype=dtype), dims=dims)
 

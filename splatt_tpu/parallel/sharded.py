@@ -545,7 +545,14 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     if local_engine is None:
         from splatt_tpu.parallel.common import is_memmapped
 
-        local_engine = ("stream" if is_memmapped(tt.inds) else "blocked")
+        local_engine = ("stream" if is_memmapped(tt.inds)
+                        or variant == "ring" else "blocked")
+    elif local_engine == "blocked" and variant == "ring":
+        # never silently ignore an explicit engine request (the ring
+        # sweep is stream-only; make_sharded_sweep has the same guard)
+        raise ValueError("local_engine='blocked' is not supported with "
+                         "the POINT2POINT (ring) comm pattern; use "
+                         "ALL2ALL or local_engine='stream'")
     cells_meta = None
     cells_dev = ()
     if local_engine == "blocked" and variant == "all2all":

@@ -272,6 +272,68 @@ def test_distributed_checkpoint_resume(tmp_path, driver):
         gridals(tt, 3, opts=opts, checkpoint_path=ck)
 
 
+def test_distributed_final_checkpoint_is_current(tmp_path):
+    """A completed (or converged) distributed run leaves the checkpoint
+    at its LAST iteration, like the single-device driver — a later
+    resume with a higher max_iterations must not redo work the result
+    already contained (ADVICE r4)."""
+    from splatt_tpu.cpd import load_checkpoint
+    from splatt_tpu.parallel.grid import grid_cpd_als as gridals
+
+    tt = gen.fixture_tensor("med")
+    ck = str(tmp_path / "g.npz")
+    opts = _opts(max_iterations=5, tolerance=0.0)
+    res = gridals(tt, 4, opts=opts, checkpoint_path=ck, checkpoint_every=2)
+    _, _, it, fit = load_checkpoint(ck)
+    assert it == 5
+    assert fit == pytest.approx(float(res.fit), abs=1e-12)
+    # resuming with the same budget is a no-op that returns the same fit
+    resumed = gridals(tt, 4, opts=opts, checkpoint_path=ck,
+                      checkpoint_every=2)
+    assert float(resumed.fit) == pytest.approx(float(res.fit), abs=1e-12)
+
+
+def test_explicit_blocked_with_ring_rejected():
+    """An explicit local_engine='blocked' under the POINT2POINT ring
+    variant raises instead of silently downgrading to stream
+    (ADVICE r4); auto-selection (None) quietly resolves to stream."""
+    from splatt_tpu.config import CommPattern
+    from splatt_tpu.parallel.sharded import sharded_cpd_als
+
+    tt = gen.fixture_tensor("med")
+    opts = _opts(max_iterations=2, comm_pattern=CommPattern.POINT2POINT)
+    with pytest.raises(ValueError, match="ring"):
+        sharded_cpd_als(tt, 4, opts=opts, local_engine="blocked")
+    res = sharded_cpd_als(tt, 4, opts=opts)       # auto → stream, runs
+    assert np.isfinite(float(res.fit))
+
+
+def test_wrapper_passes_local_engine_through(tmp_path):
+    """distributed_cpd_als must hand local_engine=None through to every
+    driver so their memmapped auto-detection runs (ADVICE r4): a
+    memmapped tensor through the public wrapper picks the streamed
+    path for COARSE/FINE rather than an in-RAM blocked build."""
+    from unittest import mock
+
+    from splatt_tpu import io as tio
+    from splatt_tpu.config import Decomposition
+    from splatt_tpu.io import load_memmap
+    from splatt_tpu.parallel import distributed_cpd_als
+
+    tt = gen.fixture_tensor("med")
+    path = str(tmp_path / "m.bin")
+    tio.save(tt, path)
+    mm = load_memmap(path)
+    for dec, target in ((Decomposition.COARSE,
+                         "splatt_tpu.parallel.coarse_cpd_als"),
+                        (Decomposition.FINE,
+                         "splatt_tpu.parallel.sharded_cpd_als")):
+        opts = _opts(max_iterations=2, decomposition=dec)
+        with mock.patch(target) as drv:
+            distributed_cpd_als(mm, 4, opts=opts)
+        assert drv.call_args.kwargs["local_engine"] is None, dec
+
+
 def test_streamed_shard_and_coarse_builds_match(tmp_path):
     """The streamed (bounded-RSS, optionally disk-backed) FINE shard
     build and COARSE per-mode bucketing produce bit-identical arrays to

@@ -237,3 +237,73 @@ def test_distributed_profiled_sweep_attribution(capsys):
         for a, b in zip(base.factors, prof.factors):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-8, err_msg=name)
+
+
+def test_fused_tg_gate_truthful_at_amazon_dims():
+    """fused_tg's VMEM envelope is rank-independent but DIM-linear
+    (VERDICT r4 weak #3): at Amazon-like single-chip mode dims the gate
+    must reject and dispatch must truthfully report xla_scan — not
+    oversell coverage the kernel cannot compile."""
+    import importlib
+    from types import SimpleNamespace
+
+    import jax
+
+    from splatt_tpu.ops.pallas_kernels import (fused_t_vmem_ok,
+                                               fused_tg_vmem_ok)
+
+    mk = importlib.import_module("splatt_tpu.ops.mttkrp")
+
+    amazon = (10_000_000, 5_000_000, 2_000_000)
+    facs = [jax.ShapeDtypeStruct((d, 50), jnp.float32) for d in amazon]
+    assert not fused_t_vmem_ok(facs, 0, 16, 4096)
+    assert not fused_tg_vmem_ok(facs, 0, 16, 4096)
+    # Amazon nnz: the unfused path's HBM intermediate rejects too
+    lay = SimpleNamespace(block=4096, seg_width=16, nnz_pad=1_700_000_000)
+    plan = mk.engine_plan(lay, facs, 0, path="sorted_onehot",
+                          impl="pallas_interpret")
+    assert plan == "xla_scan"
+    # rank-independence is real: rank 200 at moderate dims still fits tg
+    moderate = [jax.ShapeDtypeStruct((d, 200), jnp.float32)
+                for d in (2000, 3000, 4000)]
+    assert fused_tg_vmem_ok(moderate, 0, 16, 4096)
+    # and dim-linearity has the documented threshold: a few hundred
+    # thousand local rows pass, a few million reject
+    mid = [jax.ShapeDtypeStruct((d, 50), jnp.float32)
+           for d in (200_000, 100_000, 150_000)]
+    big = [jax.ShapeDtypeStruct((d, 50), jnp.float32)
+           for d in (2_000_000, 1_000_000, 1_500_000)]
+    assert fused_tg_vmem_ok(mid, 0, 16, 4096)
+    assert not fused_tg_vmem_ok(big, 0, 16, 4096)
+
+
+def test_retired_fused_kernel_out_of_dispatch(monkeypatch):
+    """The row-major fused kernel is known-unlowerable on current
+    jax/Mosaic (VERDICT r4 weak #5): even when its own VMEM gate
+    passes, default dispatch must skip it — order is fused_t →
+    fused_tg → unfused → xla_scan — unless SPLATT_EXPERIMENTAL_FUSED=1
+    explicitly re-enables it."""
+    import importlib
+    from types import SimpleNamespace
+
+    import jax
+
+    mk = importlib.import_module("splatt_tpu.ops.mttkrp")
+    pk = importlib.import_module("splatt_tpu.ops.pallas_kernels")
+
+    monkeypatch.setattr(pk, "fused_t_vmem_ok", lambda *a, **k: False)
+    monkeypatch.setattr(pk, "fused_tg_vmem_ok", lambda *a, **k: False)
+    monkeypatch.setattr(pk, "fused_vmem_ok", lambda *a, **k: True)
+    facs = [jax.ShapeDtypeStruct((d, 8), jnp.float32)
+            for d in (64, 48, 80)]
+    lay = SimpleNamespace(block=128, seg_width=8, nnz_pad=1024)
+
+    monkeypatch.delenv("SPLATT_EXPERIMENTAL_FUSED", raising=False)
+    plan = mk.engine_plan(lay, facs, 0, path="sorted_onehot",
+                          impl="pallas_interpret")
+    assert plan != "fused"
+
+    monkeypatch.setenv("SPLATT_EXPERIMENTAL_FUSED", "1")
+    plan = mk.engine_plan(lay, facs, 0, path="sorted_onehot",
+                          impl="pallas_interpret")
+    assert plan == "fused"

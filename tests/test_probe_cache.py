@@ -122,12 +122,31 @@ def test_compile_failure_is_stored(cache_file, fake_tpu, monkeypatch):
     _states({})
 
     def fail(fn, regime, block):
-        raise RuntimeError("Mosaic crash")
+        raise RuntimeError("Mosaic failed to compile the kernel")
 
     monkeypatch.setattr(pk, "_probe_case", fail)
     assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
     assert pk.probe_cache_load("testk:ck1:b4096") == "compile_failed"
     assert pk.PROBE_STATES["testk:ck1:b4096"] == "compile_failed"
+
+
+def test_unrecognized_error_is_not_persisted_as_rejection(cache_file,
+                                                         fake_tpu,
+                                                         monkeypatch):
+    """Only whitelisted deterministic signatures may persist as
+    compile_failed — the cache makes misclassification permanent, so an
+    unknown exception is unproven and the next process re-probes."""
+    _states({})
+
+    def weird(fn, regime, block):
+        raise OSError("Connection reset by peer")
+
+    monkeypatch.setattr(pk, "_probe_case", weird)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.probe_cache_load("testk:ck1:b4096") == "infra_error"
+    _states({})
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
 
 
 def test_not_tpu_short_circuits_without_cache(cache_file):

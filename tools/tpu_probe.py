@@ -114,16 +114,25 @@ try:
         fac = [jnp.asarray(rng.standard_normal((d, 32)).astype(np.float32))
                for d in dims]
         lay = build_layout(tt, 0, block=512, val_dtype=np.float32)
-        from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
+        from splatt_tpu.ops.pallas_kernels import (fused_t_supported,
+                                                   fused_tg_supported,
                                                    probe_regime)
 
-        # Record whether the fused kernel itself can lower on this jax/
-        # Mosaic, or whether dispatch fell back to the unfused kernels —
-        # probed at THIS config's regime/block so the recorded verdict
-        # is the one the dispatch below actually consults.
+        # Record whether the LIVE fused kernels (fused_t, then the
+        # sublane-tiled fused_tg fallback) can lower on this jax/Mosaic,
+        # or whether dispatch fell back to the unfused kernels — probed
+        # at THIS config's regime/block so the recorded verdict is the
+        # one the dispatch below actually consults.  (The dead row-major
+        # fused kernel lost its probe slot: VERDICT r4 weak #5.)
         regime = probe_regime(dims[1:], lay.block)
-        info["fused_gather_supported"] = fused_gather_supported(
-            regime, lay.block)
+        info["fused_t_supported"] = fused_t_supported(regime, lay.block)
+        # lazy, like dispatch: the fallback kernel is only probed when
+        # the flagship lost — each probe is a remote compile (~35 s) of
+        # scarce claim-window time, and the kernel head-to-head stage
+        # probes (and persists) fused_tg itself when it runs
+        if not info["fused_t_supported"]:
+            info["fused_tg_supported"] = fused_tg_supported(regime,
+                                                            lay.block)
         got = mk.mttkrp_blocked(lay, fac, 0, path="sorted_onehot",
                                 impl="pallas")
         got.block_until_ready()
