@@ -78,7 +78,12 @@ def cmd_cpd(args) -> int:
         opts.comm_pattern = CommPattern(args.comm)
     timers.start("total")
     with timers.time("io"):
-        tt = load(args.tensor)
+        if getattr(args, "mmap", False):
+            from splatt_tpu.io import load_memmap
+
+            tt = load_memmap(args.tensor)
+        else:
+            tt = load(args.tensor)
     print(tensor_stats(tt, args.tensor))
 
     distributed = (args.decomp is not None or args.grid is not None
@@ -124,9 +129,16 @@ def cmd_cpd(args) -> int:
                                   row_distribute=args.rowdist,
                                   checkpoint_path=args.checkpoint,
                                   checkpoint_every=args.checkpoint_every,
-                                  local_engine=args.local_engine)
+                                  local_engine=args.local_engine,
+                                  out_dir=args.scratch_dir)
         bs = None
     else:
+        if args.scratch_dir:
+            # never silently ignore an explicit out-of-core request
+            raise ValueError(
+                "--scratch-dir applies to distributed runs (--decomp/"
+                "--grid/...); the single-chip blocked build "
+                "materializes its layouts in RAM")
         with timers.time("blocked_build"):
             bs = BlockedSparse.from_coo(tt, opts)
         print(cpd_stats_text(bs, args.rank, opts))
@@ -344,9 +356,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--local-engine", choices=["blocked", "stream"],
                    dest="local_engine",
                    help="per-device MTTKRP engine for distributed runs "
-                        "(default auto: blocked sorted layouts, except "
-                        "streamed out-of-core builds which keep the "
-                        "memory-lean stream form)")
+                        "(default auto: blocked sorted layouts; "
+                        "memmapped tensors build them via streamed "
+                        "chunked passes)")
+    p.add_argument("--scratch-dir", dest="scratch_dir", metavar="DIR",
+                   help="disk-backed scratch for distributed "
+                        "decomposition arrays: with a memmapped tensor "
+                        "the whole build is out-of-core (bounded host "
+                        "RSS at any scale)")
+    p.add_argument("--mmap", action="store_true",
+                   help="memory-map a binary tensor instead of loading "
+                        "it (O(1) host RAM for the LOAD; pair with a "
+                        "distributed --decomp and --scratch-dir for a "
+                        "fully out-of-core build — the single-chip "
+                        "blocked build still materializes its layouts)")
     p.add_argument("--checkpoint", metavar="FILE",
                    help="write an atomic .npz checkpoint every "
                         "--checkpoint-every iterations and resume from "

@@ -21,7 +21,8 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
                         checkpoint_path: Optional[str] = None,
                         checkpoint_every: int = 10,
                         resume: bool = True,
-                        local_engine: Optional[str] = None) -> KruskalTensor:
+                        local_engine: Optional[str] = None,
+                        out_dir: Optional[str] = None) -> KruskalTensor:
     """Distributed CPD-ALS, dispatching on ``opts.decomposition``
     (≙ SPLATT_OPTION_DECOMP, types_config.h:179-190):
 
@@ -32,10 +33,16 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
     - FINE: arbitrary nonzero placement (equal chunks, or a
       user-supplied per-nonzero `partition`), all_gather inputs +
       psum_scatter outputs (:func:`sharded_cpd_als`)
+
+    `out_dir`: scratch directory for disk-backed decomposition arrays —
+    with a memmapped tensor this makes the whole build out-of-core
+    (streamed buckets + chunked counting-sort layouts), host RSS
+    bounded at any scale.
     """
     opts = (opts or default_opts()).validate()
     ck = dict(checkpoint_path=checkpoint_path,
-              checkpoint_every=checkpoint_every, resume=resume)
+              checkpoint_every=checkpoint_every, resume=resume,
+              out_dir=out_dir)
     # local_engine=None flows through unchanged: each driver's own
     # auto-detection picks "stream" for memmapped (beyond-RAM) tensors
     # and "blocked" otherwise — forcing "blocked" here would materialize

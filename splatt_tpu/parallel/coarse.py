@@ -90,13 +90,16 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                    resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS, coarse-grained owner-computes.
 
-    `local_engine`: "blocked" sorts each per-mode bucket and runs the
-    single-chip blocked MTTKRP engine inside the sweep (≙ mttkrp_csf
-    over each rank's per-mode tensor copy); "stream" keeps the naive
-    formulation (the differential oracle).  None (default) = auto:
-    blocked, except for memmapped (out-of-core) tensors, which bucket
-    via the streamed chunked passes (optionally disk-backed under
-    `out_dir`) and keep the memory-lean stream engine.
+    `local_engine`: "blocked" (the default) sorts each per-mode bucket
+    and runs the single-chip blocked MTTKRP engine inside the sweep
+    (≙ mttkrp_csf over each rank's per-mode tensor copy); "stream"
+    keeps the naive formulation (the differential oracle).  Memmapped
+    (out-of-core) tensors keep the blocked engine: the buckets build
+    via streamed chunked passes and the sorted layouts via the chunked
+    counting sort (streamed_blocked_buckets) — both disk-backed under
+    `out_dir` when given, so host RSS stays bounded at any scale
+    (≙ every rank running the optimized mttkrp_csf regardless of
+    tensor size, src/mpi/mpi_cpd.c:714).
     """
     import os
 
@@ -108,9 +111,14 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     xnormsq = tt.normsq()
     dtype = resolve_dtype(opts, tt.vals.dtype)
     if local_engine is None:
+        # auto: blocked, except memmapped WITHOUT out_dir — there the
+        # sorted copies would be a second O(nnz) in-RAM allocation on a
+        # beyond-RAM input; with out_dir the whole build is disk-backed
         from splatt_tpu.parallel.common import is_memmapped
 
-        local_engine = ("stream" if is_memmapped(tt.inds) else "blocked")
+        local_engine = ("stream"
+                        if is_memmapped(tt.inds) and out_dir is None
+                        else "blocked")
     if local_engine not in ("blocked", "stream"):
         raise ValueError(f"unknown local_engine {local_engine!r}")
     blocked = local_engine == "blocked"
@@ -127,13 +135,24 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     nnz_sharding = NamedSharding(mesh, P(None, axis, None))
     val_sharding = NamedSharding(mesh, P(axis, None))
     if blocked:
+        from splatt_tpu.parallel.common import (is_memmapped,
+                                                streamed_blocked_buckets)
+
         cells = []
         inds_dev = []
         vals_dev = []
         rs_dev = []
         for m, (bi, bv, blk_rows, counts) in enumerate(per_mode):
-            i, v, rs, blkk, S = blocked_buckets(bi, bv, counts, m,
-                                                blk_rows, opts.nnz_block)
+            if is_memmapped(bi):
+                # disk-backed buckets (bi is memmapped iff out_dir was
+                # given): sort them chunked, layouts land beside them
+                i, v, rs, blkk, S = streamed_blocked_buckets(
+                    bi, bv, counts, m, blk_rows, opts.nnz_block,
+                    out_dir=os.path.join(out_dir, f"mode{m}", "blocked"))
+            else:
+                i, v, rs, blkk, S = blocked_buckets(bi, bv, counts, m,
+                                                    blk_rows,
+                                                    opts.nnz_block)
             path, impl = bucket_engine(S, opts)
             cells.append(dict(block=blkk, seg_width=S, path=path,
                               impl=impl))

@@ -110,6 +110,149 @@ def blocked_buckets(binds: np.ndarray, bvals: np.ndarray,
     return out_i, out_v, row_start, block, seg_width
 
 
+class _FlushWindow:
+    """Dirty-byte-budgeted flush policy for chunked writers into
+    disk-backed memmaps: flushing (msync + MADV_DONTNEED) after every
+    chunk is a measured writeback storm — each flush covers the whole
+    file — while never flushing leaves ru_maxrss looking unbounded.
+    One shared policy so the budget and accounting can't diverge
+    between the scatter and the counting-sort builds.
+
+    The byte accounting is exact for clustered writes (the scatter's
+    per-bucket cursors, the sort's ascending positions) and an
+    UNDERCOUNT for writes spread thinly across many pages; in that
+    regime steady-state RSS is bounded by the kernel's own dirty-page
+    writeback/reclaim rather than this window — memmap pages are
+    always evictable, so the build degrades to page-cache thrash, not
+    OOM.
+    """
+
+    def __init__(self, *arrays, budget: int = 256 << 20) -> None:
+        self.arrays = arrays
+        self.budget = budget
+        self.dirty = 0
+
+    def wrote(self, nbytes: int) -> None:
+        self.dirty += nbytes
+        if self.dirty >= self.budget:
+            self.flush()
+
+    def flush(self) -> None:
+        if self.dirty > 0:
+            _drop_pages(*self.arrays)
+            self.dirty = 0
+
+
+def _memmap_dir(arr) -> Optional[str]:
+    """Directory of the file backing a memmapped array (via .base
+    chains), or None — used to place derived layout memmaps next to
+    their source buckets when the caller gave no explicit out_dir."""
+    import os
+
+    a = arr
+    while a is not None and not isinstance(a, np.memmap):
+        a = getattr(a, "base", None)
+    fn = getattr(a, "filename", None)
+    return os.path.dirname(str(fn)) if fn else None
+
+
+def streamed_blocked_buckets(binds: np.ndarray, bvals: np.ndarray,
+                             counts: np.ndarray, mode: int, local_dim: int,
+                             block: int, out_dir: Optional[str] = None,
+                             chunk: int = 1 << 22
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                        int, int]:
+    """:func:`blocked_buckets` in bounded host RSS, for (possibly
+    memmapped) bucket arrays too large to argsort in RAM — the piece
+    that keeps the optimized blocked engine available for out-of-core
+    tensors (the reference runs mttkrp_csf per rank regardless of
+    scale, src/mpi/mpi_cpd.c:714 at src/mpi/mpi_io.c:756-844 sizes).
+
+    Per bucket, a two-pass counting sort keyed on the mode row (keys
+    lie in [0, local_dim)): pass 1 histograms the keys in chunks; pass
+    2 scatters each chunk to its final position — stable, so the
+    permutation is bit-identical to blocked_buckets' stable argsort.
+    Allocations are O(chunk + local_dim) per bucket; with `out_dir`
+    the outputs are disk-backed memmaps (w+ creates sparse zero-filled
+    files).  Input pages are advised away after every chunk (clean —
+    msync is free); OUTPUT pages flush through a :class:`_FlushWindow`
+    (per-chunk whole-file msync was a measured writeback storm).
+    Resident output pages stay near the flush window when writes
+    cluster, and degrade to kernel-managed page cache (evictable, so
+    never OOM) when a chunk's writes spread across many pages.  Write
+    positions are ascending within each chunk (offsets grow with the
+    sorted keys), so the scatter walks the output forward.
+
+    Same contract as :func:`blocked_buckets`: returns (inds (nmodes,
+    nbuckets, nnz_pad), vals (nbuckets, nnz_pad), row_start
+    (nbuckets, nb), block, seg_width), sentinel-padded tails included.
+    """
+    import os
+
+    from splatt_tpu.utils.env import ceil_to
+
+    nmodes, nbuckets, C = binds.shape
+    block = max(128, min(block, ceil_to(max(C, 1), 128)))
+    nnz_pad = max(block, ceil_to(C, block))
+    nb = nnz_pad // block
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+        out_i = np.lib.format.open_memmap(
+            os.path.join(out_dir, "linds.npy"), mode="w+",
+            dtype=np.int32, shape=(nmodes, nbuckets, nnz_pad))
+        out_v = np.lib.format.open_memmap(
+            os.path.join(out_dir, "lvals.npy"), mode="w+",
+            dtype=bvals.dtype, shape=(nbuckets, nnz_pad))
+    else:
+        out_i = np.zeros((nmodes, nbuckets, nnz_pad), dtype=np.int32)
+        out_v = np.zeros((nbuckets, nnz_pad), dtype=bvals.dtype)
+    row_start = np.zeros((nbuckets, nb), dtype=np.int32)
+    span = 0
+    win = _FlushWindow(out_i, out_v)
+    row_bytes = nmodes * 4 + out_v.dtype.itemsize
+    for b in range(nbuckets):
+        n = int(counts[b])
+        hist = np.zeros(local_dim, dtype=np.int64)
+        for s in range(0, n, chunk):
+            e = min(n, s + chunk)
+            hist += np.bincount(np.asarray(binds[mode, b, s:e]),
+                                minlength=local_dim)
+            _drop_pages(binds)           # clean input pages: msync free
+        offs = np.zeros(local_dim + 1, dtype=np.int64)
+        np.cumsum(hist, out=offs[1:])
+        cursor = np.zeros(local_dim, dtype=np.int64)
+        for s in range(0, n, chunk):
+            e = min(n, s + chunk)
+            keys = np.asarray(binds[mode, b, s:e])
+            order = np.argsort(keys, kind="stable")
+            ks = keys[order]
+            ccounts = np.bincount(ks, minlength=local_dim)
+            coffs = np.zeros(local_dim + 1, dtype=np.int64)
+            np.cumsum(ccounts, out=coffs[1:])
+            # stable rank: global key offset + earlier-chunk occupancy
+            # + within-chunk rank among equal keys
+            pos = offs[ks] + cursor[ks] + (np.arange(ks.size) - coffs[ks])
+            for m in range(nmodes):
+                out_i[m, b, pos] = np.asarray(binds[m, b, s:e])[order]
+            out_v[b, pos] = np.asarray(bvals[b, s:e])[order]
+            cursor += ccounts
+            _drop_pages(binds, bvals)
+            win.wrote((e - s) * row_bytes)
+        for s in range(n, nnz_pad, chunk):       # sentinel tail
+            e = min(nnz_pad, s + chunk)
+            out_i[mode, b, s:e] = local_dim
+            win.wrote((e - s) * 4)
+        firsts = np.asarray(out_i[mode, b, 0::block])
+        lasts = np.asarray(out_i[mode, b, block - 1::block])
+        row_start[b] = firsts.astype(np.int32)
+        span = max(span, int((lasts - firsts).max(initial=0)) + 1)
+        win.flush()
+    if not (nbuckets > 0 and counts.size and int(counts.max()) > 0):
+        span = 1
+    seg_width = ceil_to(min(span, local_dim if local_dim > 0 else 1), 8)
+    return out_i, out_v, row_start, block, seg_width
+
+
 def blocked_local_mttkrp(inds_b, vals_b, row_start_b, factors, mode: int,
                          dim: int, block: int, seg_width: int,
                          path: str, impl: str,
@@ -309,6 +452,7 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
         bvals = np.zeros((nbuckets, C), dtype=val_dtype)
 
     cursor = np.zeros(nbuckets, dtype=np.int64)
+    win = _FlushWindow(binds, bvals)     # see _FlushWindow for why
     for s in range(0, nnz, chunk):
         e = min(nnz, s + chunk)
         ichunk = np.asarray(inds[:, s:e])
@@ -331,10 +475,10 @@ def streamed_bucket_scatter(inds, vals, owner_fn, nbuckets: int, val_dtype,
         bvals[own_s, slot] = np.asarray(vals[s:e])[order]
         cursor += ccounts
         if out_dir is not None:
-            # bounded RSS is the whole point of disk-backed outputs:
-            # writeback+drop after every chunk caps dirty pages at one
-            # chunk's scatter footprint
-            _drop_pages(binds, bvals, inds, vals)
+            _drop_pages(inds, vals)      # clean input pages: msync free
+            win.wrote((e - s) * (nmodes * 4 + bvals.dtype.itemsize))
+    if out_dir is not None:
+        win.flush()
     return binds, bvals, C, counts
 
 

@@ -35,6 +35,7 @@ the permutation bookkeeping restores factor row order afterwards.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import List, Optional, Tuple
 
@@ -301,7 +302,9 @@ class GridDecomp:
         padded factor (for run_distributed_als)."""
         return None if self.relabels is None else list(self.relabels)
 
-    def build_cell_layouts(self, opts: Options) -> "CellLayouts":
+    def build_cell_layouts(self, opts: Options,
+                           out_dir: Optional[str] = None,
+                           chunk: int = 1 << 22) -> "CellLayouts":
         """Per-cell sorted blocked layouts so the sweep runs the
         single-chip blocked MTTKRP engine inside every cell (≙ each
         rank building CSF over its local nonzeros and calling the same
@@ -311,20 +314,38 @@ class GridDecomp:
         single-chip compiler (≙ splatt_csf_alloc): ONEMODE/TWOMODE
         build 1–2 sorted copies and the remaining modes run the
         generic scatter path on the first; ALLMODE builds one per mode.
+
+        Memmapped (disk-backed streamed) decompositions sort via the
+        chunked counting-sort build, with the layout memmaps under
+        `out_dir` (default: beside the decomposition's own files) —
+        the blocked engine survives out-of-core scale.
         """
-        from splatt_tpu.parallel.common import alloc_build_modes
+        from splatt_tpu.parallel.common import (_memmap_dir,
+                                                alloc_build_modes,
+                                                is_memmapped,
+                                                streamed_blocked_buckets)
 
         nmodes = self.nmodes
         ncells = int(np.prod(self.grid))
-        binds = np.asarray(self.inds_local).reshape(nmodes, ncells, -1)
-        bvals = np.asarray(self.vals).reshape(ncells, -1)
+        binds = self.inds_local.reshape(nmodes, ncells, -1)
+        bvals = self.vals.reshape(ncells, -1)
+        streamed = is_memmapped(binds)
+        if streamed and out_dir is None:
+            out_dir = _memmap_dir(binds)
         build_modes = alloc_build_modes(
             [self.block_rows[m] for m in range(nmodes)], opts)
         layouts = []
         for m in build_modes:
-            i, v, rs, blk, S = blocked_buckets(
-                binds, bvals, self.cell_counts, m, self.block_rows[m],
-                opts.nnz_block)
+            if streamed:
+                i, v, rs, blk, S = streamed_blocked_buckets(
+                    binds, bvals, self.cell_counts, m, self.block_rows[m],
+                    opts.nnz_block, chunk=chunk,
+                    out_dir=(os.path.join(out_dir, f"cells_m{m}")
+                             if out_dir is not None else None))
+            else:
+                i, v, rs, blk, S = blocked_buckets(
+                    binds, bvals, self.cell_counts, m, self.block_rows[m],
+                    opts.nnz_block)
             path, impl = bucket_engine(S, opts)
             layouts.append(dict(
                 inds=i.reshape((nmodes, *self.grid, -1)),
@@ -564,19 +585,20 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
                  init: Optional[List[jax.Array]] = None,
                  relabel: Optional[str] = None,
                  local_engine: Optional[str] = None,
+                 out_dir: Optional[str] = None,
                  checkpoint_path: Optional[str] = None,
                  checkpoint_every: int = 10,
                  resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS over an n-D grid mesh (MEDIUM decomposition).
 
-    `local_engine`: "blocked" runs the single-chip blocked MTTKRP
-    engine inside every cell over per-cell sorted layouts (≙
-    mttkrp_csf per rank, mpi_cpd.c:714); "stream" keeps the naive
-    gather+segment_sum formulation (the differential oracle, and the
-    lower-memory choice — blocked cells store nmodes sorted copies in
-    host+device memory).  None (default) = auto: blocked, except for
-    streamed/memmapped decompositions, whose bounded-RSS guarantee the
-    in-RAM sorted copies would destroy.
+    `local_engine`: "blocked" (the default) runs the single-chip
+    blocked MTTKRP engine inside every cell over per-cell sorted
+    layouts (≙ mttkrp_csf per rank, mpi_cpd.c:714); "stream" keeps the
+    naive gather+segment_sum formulation (the differential oracle).
+    Memmapped (out-of-core) tensors keep the blocked engine: the
+    decomposition builds via streamed chunked passes and the cell
+    layouts via the chunked counting sort, disk-backed under `out_dir`
+    when given — bounded host RSS at any scale.
 
     `relabel` picks the fence-balancing strategy:
 
@@ -636,7 +658,9 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
 
     decomp = GridDecomp.build(tt, grid=grid,
                               n_devices=len(devices) if devices else None,
-                              val_dtype=dtype, balance=balance)
+                              val_dtype=dtype, balance=balance,
+                              out_dir=(os.path.join(out_dir, "scatter")
+                                       if out_dir is not None else None))
     mesh = mesh or decomp.make_mesh(devices=devices)
     xnormsq = tt.normsq()
 
@@ -654,16 +678,17 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     cells_dev = ()
     cells_host = None
     if local_engine is None:
-        # auto: the blocked cells materialize nmodes sorted copies in
-        # host RAM — exactly what a streamed (bounded-RSS) build exists
-        # to avoid
+        # auto: blocked, except memmapped WITHOUT out_dir — there the
+        # sorted cell copies would be a second O(nnz) in-RAM allocation
+        # on a beyond-RAM input; with out_dir the chunked counting sort
+        # keeps the whole build disk-backed and RSS bounded
         from splatt_tpu.parallel.common import is_memmapped
 
-        local_engine = ("stream" if is_memmapped(decomp.inds_local)
-                        else "blocked")
+        lean = is_memmapped(tt.inds) and out_dir is None
+        local_engine = "stream" if lean else "blocked"
     if local_engine == "blocked":
-        cells_host = decomp.build_cell_layouts(opts).device_put(
-            mesh, tt.nmodes)
+        cells_host = decomp.build_cell_layouts(
+            opts, out_dir=out_dir).device_put(mesh, tt.nmodes)
     elif local_engine != "stream":
         raise ValueError(f"unknown local_engine {local_engine!r}")
     if cells_host is not None:

@@ -137,7 +137,9 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
 def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
                           dims_pad: Tuple[int, ...], axis: str = "nnz",
                           val_dtype=np.float32,
-                          partition: Optional[np.ndarray] = None):
+                          partition: Optional[np.ndarray] = None,
+                          out_dir: Optional[str] = None,
+                          chunk: int = 1 << 22):
     """Per-shard sorted blocked layouts so the sweep runs the
     single-chip blocked MTTKRP engine inside every shard (≙ each MPI
     rank building CSF over its local nonzeros, mpi_cpd.c:714).  The
@@ -153,23 +155,56 @@ def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
     Returns (host_meta, device_arrays): host_meta[m] holds the statics
     (block, seg_width, path, impl, sort_mode, sort_dim);
     device_arrays[m] the device-put (inds, vals, row_start) triple.
+
+    Memmapped (out-of-core) tensors build via the streamed chunked
+    passes — bucket scatter and the per-bucket counting sort both
+    disk-backed under `out_dir` when given — so the optimized engine
+    survives beyond-RAM scale (≙ mttkrp_csf per rank regardless of
+    size, src/mpi/mpi_cpd.c:714).
     """
-    from splatt_tpu.parallel.common import alloc_build_modes
+    import os
+
+    from splatt_tpu.parallel.common import (alloc_build_modes, is_memmapped,
+                                            streamed_blocked_buckets,
+                                            streamed_bucket_scatter)
 
     ndev = mesh.shape[axis]
-    if partition is None:
-        chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
-        owner = np.arange(tt.nnz, dtype=np.int64) // chunk
+    streamed = is_memmapped(tt.inds)
+    fence = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
+    if streamed:
+        if partition is None:
+            def owner_fn(ic, s):
+                return np.arange(s, s + ic.shape[1], dtype=np.int64) // fence
+        else:
+            part = np.asarray(partition, dtype=np.int64)
+
+            def owner_fn(ic, s):
+                return part[s:s + ic.shape[1]]
+
+        binds, bvals, _, counts = streamed_bucket_scatter(
+            tt.inds, tt.vals, owner_fn, ndev, val_dtype, chunk=chunk,
+            out_dir=(os.path.join(out_dir, "shards")
+                     if out_dir is not None else None))
     else:
-        owner = np.asarray(partition, dtype=np.int64)
-    binds, bvals, _, counts = bucket_scatter(tt.inds, tt.vals, owner, ndev,
-                                             val_dtype)
+        if partition is None:
+            owner = np.arange(tt.nnz, dtype=np.int64) // fence
+        else:
+            owner = np.asarray(partition, dtype=np.int64)
+        binds, bvals, _, counts = bucket_scatter(tt.inds, tt.vals, owner,
+                                                 ndev, val_dtype)
     build_modes = alloc_build_modes(dims_pad, opts)
     built_meta = []
     built_arr = []
     for m in build_modes:
-        i, v, rs, blk, S = blocked_buckets(binds, bvals, counts, m,
-                                           dims_pad[m], opts.nnz_block)
+        if is_memmapped(binds):
+            i, v, rs, blk, S = streamed_blocked_buckets(
+                binds, bvals, counts, m, dims_pad[m], opts.nnz_block,
+                chunk=chunk,
+                out_dir=(os.path.join(out_dir, f"blocked_m{m}")
+                         if out_dir is not None else None))
+        else:
+            i, v, rs, blk, S = blocked_buckets(binds, bvals, counts, m,
+                                               dims_pad[m], opts.nnz_block)
         path, impl = bucket_engine(S, opts)
         built_meta.append(dict(block=blk, seg_width=S, path=path,
                                impl=impl, sort_mode=m,
@@ -499,15 +534,15 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     (≙ p_greedy_mat_distribution, src/mpi/mpi_mat_distribute.c:436-548)
     — before fences are cut; original row order is restored on gather.
 
-    `local_engine`: "blocked" (all2all variant only) runs the
-    single-chip blocked MTTKRP engine over per-shard sorted layouts
+    `local_engine`: "blocked" (all2all variant only; the default) runs
+    the single-chip blocked MTTKRP engine over per-shard sorted layouts
     inside the sweep (≙ mttkrp_csf per rank, mpi_cpd.c:714); "stream"
     keeps the naive formulation (the differential oracle; always used
-    by the ring variant, whose reduce is blockwise).  None (default) =
-    auto: blocked, except for memmapped (out-of-core) tensors, whose
-    bounded-RSS shard build the in-RAM sorted copies would destroy —
-    those shard via the streamed bucketing (optionally disk-backed
-    with `out_dir`) and keep the stream engine.
+    by the ring variant, whose reduce is blockwise).  Memmapped
+    (out-of-core) tensors keep the blocked engine: the shard build and
+    the per-shard sorts run as streamed chunked passes (disk-backed
+    under `out_dir` when given), so host RSS stays bounded at any
+    scale.
     """
     opts = (opts or default_opts()).validate()
     mesh, axis = single_axis_of(mesh, axis)
@@ -543,10 +578,17 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
                else "all2all")
     if local_engine is None:
+        # auto: the optimized engine wherever the variant supports it.
+        # Memmapped tensors keep blocked too via the streamed chunked
+        # counting sort — but only when out_dir makes the build
+        # disk-backed; without it the sorted copies would be a second
+        # O(nnz) in-RAM allocation on exactly the inputs that can't
+        # afford the first (beyond-RAM tensors), so those stay stream.
         from splatt_tpu.parallel.common import is_memmapped
 
-        local_engine = ("stream" if is_memmapped(tt.inds)
-                        or variant == "ring" else "blocked")
+        lean = is_memmapped(tt.inds) and out_dir is None
+        local_engine = ("stream" if variant == "ring" or lean
+                        else "blocked")
     elif local_engine == "blocked" and variant == "ring":
         # never silently ignore an explicit engine request (the ring
         # sweep is stream-only; make_sharded_sweep has the same guard)
@@ -558,7 +600,7 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     if local_engine == "blocked" and variant == "all2all":
         cells_meta, cells_dev = shard_blocked_layouts(
             tt, mesh, opts, dims_pad, axis=axis, val_dtype=dtype,
-            partition=partition)
+            partition=partition, out_dir=out_dir)
         # the blocked sweep never reads the stream shard arrays — put
         # 1-entry-per-device dummies instead of a dead O(nnz) HBM copy
         inds = jax.device_put(np.zeros((nmodes, ndev), np.int32),

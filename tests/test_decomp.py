@@ -377,3 +377,36 @@ def test_streamed_shard_and_coarse_builds_match(tmp_path):
         ram = fn(tt, 3, opts=opts)
         ooc = fn(mm, 3, opts=opts)
         assert float(ram.fit) == pytest.approx(float(ooc.fit), abs=1e-12)
+
+
+@pytest.mark.parametrize("chunk", [7, 64, 10**6])
+@pytest.mark.parametrize("disk", [False, True])
+def test_streamed_blocked_buckets_bit_identical(tmp_path, chunk, disk):
+    """The chunked counting-sort build (bounded RSS, optionally
+    disk-backed) is BIT-identical to the in-RAM argsort build — same
+    arrays, row_start, block, seg_width — across chunk sizes smaller
+    and larger than any bucket, including empty buckets."""
+    from splatt_tpu.parallel.common import (blocked_buckets, bucket_scatter,
+                                            streamed_blocked_buckets)
+
+    rng = np.random.default_rng(3)
+    dims = (16, 12, 20)
+    nnz = 500
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims]).astype(np.int64)
+    vals = rng.random(nnz)
+    owner = rng.integers(0, 4, nnz)
+    owner[owner == 2] = 1                 # bucket 2 left empty
+    binds, bvals, C, counts = bucket_scatter(inds, vals, owner, 4,
+                                             np.float64)
+    for mode in range(3):
+        ref = blocked_buckets(binds, bvals, counts, mode, dims[mode], 128)
+        out_dir = str(tmp_path / f"m{mode}c{chunk}") if disk else None
+        got = streamed_blocked_buckets(binds, bvals, counts, mode,
+                                       dims[mode], 128, out_dir=out_dir,
+                                       chunk=chunk)
+        np.testing.assert_array_equal(np.asarray(got[0]), ref[0])
+        np.testing.assert_array_equal(np.asarray(got[1]), ref[1])
+        np.testing.assert_array_equal(got[2], ref[2])
+        assert got[3] == ref[3] and got[4] == ref[4]
+        if disk:
+            assert isinstance(got[0], np.memmap)

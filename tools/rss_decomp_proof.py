@@ -68,7 +68,10 @@ def _measure(path, nnz):
     codes = dict(
         grid=f'''
 import json, os, resource, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from splatt_tpu.io import load_memmap
 from splatt_tpu.parallel.grid import GridDecomp
@@ -88,7 +91,10 @@ print(json.dumps(dict(rss_after_load_mb=round(r0, 1),
 ''',
         fine=f'''
 import json, os, resource, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from splatt_tpu.io import load_memmap
 from splatt_tpu.parallel.sharded import shard_nnz_host
@@ -106,7 +112,10 @@ print(json.dumps(dict(rss_after_load_mb=round(r0, 1),
 ''',
         coarse=f'''
 import json, os, resource, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 from splatt_tpu.io import load_memmap
 from splatt_tpu.parallel.coarse import _bucket_by_mode
@@ -124,6 +133,67 @@ binds, bvals, block, counts = _bucket_by_mode(
 print(json.dumps(dict(rss_after_load_mb=round(r0, 1),
                       rss_peak_mb=round(rss_mb(), 1),
                       bucket_nnz=int(binds.shape[2]))))
+''',
+        # the round-5 additions: the OPTIMIZED blocked engine's sorted
+        # layouts built from the memmapped decomposition by the chunked
+        # counting sort (streamed_blocked_buckets) — host RSS must stay
+        # bounded here too, or out-of-core loses the fast engine
+        # (VERDICT r4 missing #3)
+        grid_blocked=f'''
+import json, os, resource, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from splatt_tpu.io import load_memmap
+from splatt_tpu.parallel.grid import GridDecomp
+from splatt_tpu.parallel.common import is_memmapped
+from splatt_tpu.config import BlockAlloc, default_opts
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+tt = load_memmap({path!r})
+r0 = rss_mb()
+d = GridDecomp.build(tt, grid=(2, 2, 2), val_dtype=np.float32,
+                     streamed=True, out_dir={work!r} + "/bk",
+                     chunk=1 << 21)
+opts = default_opts()
+opts.block_alloc = BlockAlloc.ONEMODE
+cells = d.build_cell_layouts(opts, chunk=1 << 21)
+lay = cells.layouts[0]
+print(json.dumps(dict(rss_after_load_mb=round(r0, 1),
+                      rss_peak_mb=round(rss_mb(), 1),
+                      memmapped=bool(is_memmapped(lay["inds"])),
+                      seg_width=lay["seg_width"], block=lay["block"])))
+''',
+        coarse_blocked=f'''
+import json, os, resource, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from splatt_tpu.io import load_memmap
+from splatt_tpu.parallel.coarse import _bucket_by_mode
+from splatt_tpu.parallel.common import streamed_blocked_buckets
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+tt = load_memmap({path!r})
+r0 = rss_mb()
+binds, bvals, block, counts = _bucket_by_mode(
+    tt, 0, 8, np.float32, streamed=True,
+    out_dir={work!r} + "/coarse0", chunk=1 << 21)
+i, v, rs, blk, S = streamed_blocked_buckets(
+    binds, bvals, counts, 0, block, 4096,
+    out_dir={work!r} + "/coarse0/blocked", chunk=1 << 21)
+print(json.dumps(dict(rss_after_load_mb=round(r0, 1),
+                      rss_peak_mb=round(rss_mb(), 1),
+                      memmapped=isinstance(i, np.memmap),
+                      seg_width=S, block=blk)))
 ''')
     import subprocess
     rec = dict(tensor_gb=round(size_gb, 2), nnz_requested=nnz)
