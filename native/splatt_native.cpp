@@ -499,6 +499,16 @@ void mttkrp_impl(const int32_t *inds, const T *vals, int64_t nnz,
     ++nother;
   }
 
+  // NOTE on further tuning (measured, round 5 — tools/cpu_profile.json):
+  // this loop is at its single-core floor for the flagship config
+  // (20M nnz, rank 50, f32, NELL-2 dims): ~22-24 ns/nonzero sorted,
+  // matching an isolated microbench of the same loop (~19-20 ns on
+  // uniform-random indices).  Software-prefetching the factor rows
+  // PF_DIST ahead wins 16-22% on uniform-random gathers but is a wash
+  // to slightly negative on the real power-law tensors (hot rows are
+  // already cache-resident, so the extra prefetch instructions buy
+  // nothing), and compile-time rank specialization measured within
+  // noise of this runtime-rank loop — both were tried and reverted.
   for (int64_t n = 0; n < nnz; ++n) {
     const int64_t row = orow[n];
     const T v = vals[n];

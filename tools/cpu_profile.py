@@ -13,6 +13,11 @@ import os
 import sys
 import time
 
+# BEFORE jax import: the axon site plugin reads the env at interpreter
+# start, and an unforced run claims the real chip — contending with the
+# probe loop (one TPU client at a time)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
