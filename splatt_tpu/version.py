@@ -1,7 +1,7 @@
 """Version queries (≙ reference include/splatt/api_version.h:47-61)."""
 
 version_major = 0
-version_minor = 1
+version_minor = 5
 version_patch = 0
 
 __version__ = f"{version_major}.{version_minor}.{version_patch}"
