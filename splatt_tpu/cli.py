@@ -78,7 +78,7 @@ def cmd_cpd(args) -> int:
         opts.comm_pattern = CommPattern(args.comm)
     timers.start("total")
     with timers.time("io"):
-        if getattr(args, "mmap", False):
+        if args.mmap:
             from splatt_tpu.io import load_memmap
 
             tt = load_memmap(args.tensor)

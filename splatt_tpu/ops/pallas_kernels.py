@@ -740,9 +740,6 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         PROBE_STATES[state_key] = cached
         return cached == "ok"
 
-    def compile_case():
-        return _probe_case(kernel_fn, regime, block)
-
     # The compile runs on a daemon thread with a deadline: a wedged
     # remote-compile service (observed: >40 min hangs) must degrade to
     # "unsupported" — blocking dispatch here would wedge the whole
@@ -771,7 +768,7 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
 
     def runner():
         try:
-            result.append(compile_case())
+            result.append(_probe_case(kernel_fn, regime, block))
         except Exception as e:
             msg = f"{type(e).__name__}: {e}"
             result.append(False if any(m in msg for m in _REJECT_MARKERS)

@@ -32,8 +32,7 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (blocked_buckets,
-                                        blocked_local_mttkrp, bucket_engine,
+from splatt_tpu.parallel.common import (blocked_local_mttkrp, bucket_engine,
                                         bucket_scatter, fit_tail,
                                         mode_update_tail,
                                         run_distributed_als)
@@ -111,14 +110,9 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     xnormsq = tt.normsq()
     dtype = resolve_dtype(opts, tt.vals.dtype)
     if local_engine is None:
-        # auto: blocked, except memmapped WITHOUT out_dir — there the
-        # sorted copies would be a second O(nnz) in-RAM allocation on a
-        # beyond-RAM input; with out_dir the whole build is disk-backed
-        from splatt_tpu.parallel.common import is_memmapped
+        from splatt_tpu.parallel.common import auto_local_engine
 
-        local_engine = ("stream"
-                        if is_memmapped(tt.inds) and out_dir is None
-                        else "blocked")
+        local_engine = auto_local_engine(tt, out_dir)
     if local_engine not in ("blocked", "stream"):
         raise ValueError(f"unknown local_engine {local_engine!r}")
     blocked = local_engine == "blocked"
@@ -135,24 +129,17 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     nnz_sharding = NamedSharding(mesh, P(None, axis, None))
     val_sharding = NamedSharding(mesh, P(axis, None))
     if blocked:
-        from splatt_tpu.parallel.common import (is_memmapped,
-                                                streamed_blocked_buckets)
+        from splatt_tpu.parallel.common import build_bucket_layout
 
         cells = []
         inds_dev = []
         vals_dev = []
         rs_dev = []
         for m, (bi, bv, blk_rows, counts) in enumerate(per_mode):
-            if is_memmapped(bi):
-                # disk-backed buckets (bi is memmapped iff out_dir was
-                # given): sort them chunked, layouts land beside them
-                i, v, rs, blkk, S = streamed_blocked_buckets(
-                    bi, bv, counts, m, blk_rows, opts.nnz_block,
-                    out_dir=os.path.join(out_dir, f"mode{m}", "blocked"))
-            else:
-                i, v, rs, blkk, S = blocked_buckets(bi, bv, counts, m,
-                                                    blk_rows,
-                                                    opts.nnz_block)
+            i, v, rs, blkk, S = build_bucket_layout(
+                bi, bv, counts, m, blk_rows, opts.nnz_block,
+                out_dir=(os.path.join(out_dir, f"mode{m}", "blocked")
+                         if out_dir is not None else None))
             path, impl = bucket_engine(S, opts)
             cells.append(dict(block=blkk, seg_width=S, path=path,
                               impl=impl))

@@ -253,6 +253,34 @@ def streamed_blocked_buckets(binds: np.ndarray, bvals: np.ndarray,
     return out_i, out_v, row_start, block, seg_width
 
 
+def auto_local_engine(tt, out_dir: Optional[str]) -> str:
+    """The auto `local_engine` policy shared by all three distributed
+    drivers: the optimized blocked engine everywhere, except memmapped
+    tensors WITHOUT a scratch dir — there the sorted copies would be a
+    second O(nnz) in-RAM allocation on exactly the inputs that cannot
+    afford the first (beyond-RAM tensors), so those stay on the lean
+    stream oracle.  (The FINE ring variant is stream-only; its caller
+    layers that condition on top.)"""
+    return ("stream" if is_memmapped(tt.inds) and out_dir is None
+            else "blocked")
+
+
+def build_bucket_layout(binds: np.ndarray, bvals: np.ndarray,
+                        counts: np.ndarray, mode: int, local_dim: int,
+                        block: int, out_dir: Optional[str] = None,
+                        chunk: int = 1 << 22):
+    """:func:`blocked_buckets` or its streamed chunked-counting-sort
+    variant, chosen by whether the buckets are memmapped — the ONE
+    dispatch point, so every driver treats disk-backed buckets
+    identically (disk-backed buckets exist iff the scatter ran with an
+    out_dir, in which case the layouts are disk-backed too)."""
+    if is_memmapped(binds):
+        return streamed_blocked_buckets(binds, bvals, counts, mode,
+                                        local_dim, block,
+                                        out_dir=out_dir, chunk=chunk)
+    return blocked_buckets(binds, bvals, counts, mode, local_dim, block)
+
+
 def blocked_local_mttkrp(inds_b, vals_b, row_start_b, factors, mode: int,
                          dim: int, block: int, seg_width: int,
                          path: str, impl: str,

@@ -50,7 +50,7 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (balanced_relabel, blocked_buckets,
+from splatt_tpu.parallel.common import (balanced_relabel,
                                         blocked_local_mttkrp, bucket_engine,
                                         bucket_scatter, comm_volume_report,
                                         fit_tail, imbalance_report,
@@ -322,30 +322,24 @@ class GridDecomp:
         """
         from splatt_tpu.parallel.common import (_memmap_dir,
                                                 alloc_build_modes,
-                                                is_memmapped,
-                                                streamed_blocked_buckets)
+                                                build_bucket_layout,
+                                                is_memmapped)
 
         nmodes = self.nmodes
         ncells = int(np.prod(self.grid))
         binds = self.inds_local.reshape(nmodes, ncells, -1)
         bvals = self.vals.reshape(ncells, -1)
-        streamed = is_memmapped(binds)
-        if streamed and out_dir is None:
+        if is_memmapped(binds) and out_dir is None:
             out_dir = _memmap_dir(binds)
         build_modes = alloc_build_modes(
             [self.block_rows[m] for m in range(nmodes)], opts)
         layouts = []
         for m in build_modes:
-            if streamed:
-                i, v, rs, blk, S = streamed_blocked_buckets(
-                    binds, bvals, self.cell_counts, m, self.block_rows[m],
-                    opts.nnz_block, chunk=chunk,
-                    out_dir=(os.path.join(out_dir, f"cells_m{m}")
-                             if out_dir is not None else None))
-            else:
-                i, v, rs, blk, S = blocked_buckets(
-                    binds, bvals, self.cell_counts, m, self.block_rows[m],
-                    opts.nnz_block)
+            i, v, rs, blk, S = build_bucket_layout(
+                binds, bvals, self.cell_counts, m, self.block_rows[m],
+                opts.nnz_block, chunk=chunk,
+                out_dir=(os.path.join(out_dir, f"cells_m{m}")
+                         if out_dir is not None else None))
             path, impl = bucket_engine(S, opts)
             layouts.append(dict(
                 inds=i.reshape((nmodes, *self.grid, -1)),
@@ -678,14 +672,9 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     cells_dev = ()
     cells_host = None
     if local_engine is None:
-        # auto: blocked, except memmapped WITHOUT out_dir — there the
-        # sorted cell copies would be a second O(nnz) in-RAM allocation
-        # on a beyond-RAM input; with out_dir the chunked counting sort
-        # keeps the whole build disk-backed and RSS bounded
-        from splatt_tpu.parallel.common import is_memmapped
+        from splatt_tpu.parallel.common import auto_local_engine
 
-        lean = is_memmapped(tt.inds) and out_dir is None
-        local_engine = "stream" if lean else "blocked"
+        local_engine = auto_local_engine(tt, out_dir)
     if local_engine == "blocked":
         cells_host = decomp.build_cell_layouts(
             opts, out_dir=out_dir).device_put(mesh, tt.nmodes)

@@ -45,8 +45,7 @@ from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
 from splatt_tpu.ops.mttkrp import acc_dtype
-from splatt_tpu.parallel.common import (blocked_buckets,
-                                        blocked_local_mttkrp, bucket_engine,
+from splatt_tpu.parallel.common import (blocked_local_mttkrp, bucket_engine,
                                         bucket_scatter, comm_volume_report,
                                         fit_tail, imbalance_report,
                                         mode_update_tail,
@@ -164,8 +163,9 @@ def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
     """
     import os
 
-    from splatt_tpu.parallel.common import (alloc_build_modes, is_memmapped,
-                                            streamed_blocked_buckets,
+    from splatt_tpu.parallel.common import (alloc_build_modes,
+                                            build_bucket_layout,
+                                            is_memmapped,
                                             streamed_bucket_scatter)
 
     ndev = mesh.shape[axis]
@@ -196,15 +196,11 @@ def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
     built_meta = []
     built_arr = []
     for m in build_modes:
-        if is_memmapped(binds):
-            i, v, rs, blk, S = streamed_blocked_buckets(
-                binds, bvals, counts, m, dims_pad[m], opts.nnz_block,
-                chunk=chunk,
-                out_dir=(os.path.join(out_dir, f"blocked_m{m}")
-                         if out_dir is not None else None))
-        else:
-            i, v, rs, blk, S = blocked_buckets(binds, bvals, counts, m,
-                                               dims_pad[m], opts.nnz_block)
+        i, v, rs, blk, S = build_bucket_layout(
+            binds, bvals, counts, m, dims_pad[m], opts.nnz_block,
+            chunk=chunk,
+            out_dir=(os.path.join(out_dir, f"blocked_m{m}")
+                     if out_dir is not None else None))
         path, impl = bucket_engine(S, opts)
         built_meta.append(dict(block=blk, seg_width=S, path=path,
                                impl=impl, sort_mode=m,
@@ -578,17 +574,12 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
                else "all2all")
     if local_engine is None:
-        # auto: the optimized engine wherever the variant supports it.
-        # Memmapped tensors keep blocked too via the streamed chunked
-        # counting sort — but only when out_dir makes the build
-        # disk-backed; without it the sorted copies would be a second
-        # O(nnz) in-RAM allocation on exactly the inputs that can't
-        # afford the first (beyond-RAM tensors), so those stay stream.
-        from splatt_tpu.parallel.common import is_memmapped
+        # shared auto policy, plus the FINE-only condition: the ring
+        # variant's blockwise reduce is stream-only
+        from splatt_tpu.parallel.common import auto_local_engine
 
-        lean = is_memmapped(tt.inds) and out_dir is None
-        local_engine = ("stream" if variant == "ring" or lean
-                        else "blocked")
+        local_engine = ("stream" if variant == "ring"
+                        else auto_local_engine(tt, out_dir))
     elif local_engine == "blocked" and variant == "ring":
         # never silently ignore an explicit engine request (the ring
         # sweep is stream-only; make_sharded_sweep has the same guard)
