@@ -19,7 +19,9 @@ for the reference's memory-lean policies), SPLATT_BENCH_JIT
 (auto|fused|phased — whole-sweep jit vs. per-phase jits; auto picks
 phased on TPU where the fused program wedges the remote compiler),
 SPLATT_BENCH_SHAPE (nell2 default | enron4 — the 4-mode Enron-shaped
-workload of BASELINE.md row 2).
+workload of BASELINE.md row 2), SPLATT_BENCH_PATHS ("blocked,stream"
+default — which tensor representations to measure; "blocked" alone
+skips the slow stream oracle on long-rank configs / scarce chip time).
 """
 
 from __future__ import annotations
@@ -364,6 +366,19 @@ def main() -> None:
         jax.clear_caches()
 
     results = {}
+    raw_paths = [p.strip() for p in
+                 os.environ.get("SPLATT_BENCH_PATHS",
+                                "blocked,stream").split(",") if p.strip()]
+    paths = [p for p in raw_paths if p in ("blocked", "stream")]
+    if paths != raw_paths:
+        # keep the valid subset rather than silently re-enabling the
+        # slow paths the caller asked to skip — inside a hard-timeout
+        # chip window that would kill the run before any JSON prints
+        print(f"bench: ignoring unknown SPLATT_BENCH_PATHS entries in "
+              f"{raw_paths!r}; running {paths or ['blocked', 'stream']}",
+              file=sys.stderr, flush=True)
+    if not paths:
+        paths = ["blocked", "stream"]
     engine = os.environ.get("SPLATT_BENCH_ENGINE", "auto").lower()
     if engine not in ("auto", "pallas", "xla"):
         print(f"bench: bad SPLATT_BENCH_ENGINE {engine!r}; using auto",
@@ -380,14 +395,15 @@ def main() -> None:
                    val_dtype=bench_dtype, use_pallas=use_pallas,
                    block_alloc=alloc)
     blocked_failed = False
-    try:
-        note("building blocked layouts")
-        results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
-    except Exception as e:
-        print(f"bench: blocked path failed ({type(e).__name__}: {e})",
-              file=sys.stderr, flush=True)
-        blocked_failed = True
-    release()  # outside any handler: no live traceback pinning buffers
+    if "blocked" in paths:
+        try:
+            note("building blocked layouts")
+            results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
+        except Exception as e:
+            print(f"bench: blocked path failed ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
+            blocked_failed = True
+        release()  # outside any handler: no traceback pinning buffers
     if blocked_failed:
         try:
             note("retrying blocked with the XLA engine")
@@ -399,12 +415,13 @@ def main() -> None:
             print(f"bench: blocked XLA engine failed too "
                   f"({type(e2).__name__})", file=sys.stderr, flush=True)
         release()
-    try:
-        note("stream path")
-        results["stream"] = run(tt)
-    except Exception as e:
-        print(f"bench: stream path failed ({type(e).__name__})",
-              file=sys.stderr, flush=True)
+    if "stream" in paths:
+        try:
+            note("stream path")
+            results["stream"] = run(tt)
+        except Exception as e:
+            print(f"bench: stream path failed ({type(e).__name__})",
+                  file=sys.stderr, flush=True)
     if not results:
         raise RuntimeError("all benchmark paths failed")
     best = min(results, key=results.get)

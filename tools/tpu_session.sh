@@ -33,8 +33,11 @@ note "stage D: tuning sweep (paths x engines x dtypes x blocks)"
 timeout 3600 python -u tools/tpu_tune.py
 echo "stage D rc=$?"
 
-note "stage E: rank-200 bench row"
-SPLATT_BENCH_RANK=200 SPLATT_BENCH_ITERS=2 timeout 2400 python -u bench.py > BENCH_TPU_R200.json
+# blocked only: the stream oracle at rank 200 costs ~20 min of window
+# for a 30x-slower number
+note "stage E: rank-200 bench row (blocked only)"
+SPLATT_BENCH_RANK=200 SPLATT_BENCH_ITERS=2 SPLATT_BENCH_PATHS=blocked \
+  timeout 2400 python -u bench.py > BENCH_TPU_R200.json
 echo "stage E rc=$?"
 cat BENCH_TPU_R200.json
 
@@ -45,7 +48,8 @@ echo "stage F rc=$?"
 cat BENCH_TPU_ENRON4.json
 
 note "stage G: bf16 bench row (bf16 storage, f32 accumulation)"
-SPLATT_BENCH_DTYPE=bfloat16 timeout 2400 python -u bench.py > BENCH_TPU_BF16.json
+SPLATT_BENCH_DTYPE=bfloat16 SPLATT_BENCH_PATHS=blocked \
+  timeout 2400 python -u bench.py > BENCH_TPU_BF16.json
 echo "stage G rc=$?"
 cat BENCH_TPU_BF16.json
 
