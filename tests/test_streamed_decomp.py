@@ -199,3 +199,44 @@ def test_memmapped_without_scratch_stays_lean(tmp_path):
         d = str(tmp_path / f"{label}_s")
         res = run(mm, d)        # disk-backed: blocked engine
         assert np.isfinite(float(res.fit)), label
+
+
+def test_auto_local_engine_policy(tmp_path):
+    """The shared policy table: blocked for in-RAM tensors regardless
+    of scratch dir; memmapped tensors need the scratch dir to upgrade."""
+    from splatt_tpu.io import load_memmap, save
+    from splatt_tpu.parallel.common import auto_local_engine
+
+    tt = _tensor(1, nnz=300, dims=(8, 6, 10))
+    path = str(tmp_path / "t.bin")
+    save(tt, path, binary=True)
+    mm = load_memmap(path)
+    assert auto_local_engine(tt, None) == "blocked"
+    assert auto_local_engine(tt, "/scratch") == "blocked"
+    assert auto_local_engine(mm, None) == "stream"
+    assert auto_local_engine(mm, "/scratch") == "blocked"
+
+
+def test_build_bucket_layout_dispatch(tmp_path):
+    """ONE dispatch point: memmapped buckets take the streamed counting
+    sort (disk-backed outputs), in-RAM buckets the argsort build — same
+    results either way."""
+    from splatt_tpu.parallel.common import (build_bucket_layout,
+                                            bucket_scatter,
+                                            streamed_bucket_scatter)
+
+    tt = _tensor(5, nnz=600, dims=(12, 10, 14))
+    owner = tt.inds[0] % 3
+    b0, v0, _, n0 = bucket_scatter(tt.inds, tt.vals, owner, 3, np.float64)
+    b1, v1, _, n1 = streamed_bucket_scatter(
+        tt.inds, tt.vals, lambda ic, s: ic[0] % 3, 3, np.float64,
+        chunk=101, out_dir=str(tmp_path / "bk"))
+    ram = build_bucket_layout(b0, v0, n0, 1, tt.dims[1], 128)
+    disk = build_bucket_layout(b1, v1, n1, 1, tt.dims[1], 128,
+                               out_dir=str(tmp_path / "lay"), chunk=97)
+    assert not isinstance(ram[0], np.memmap)
+    assert isinstance(disk[0], np.memmap)
+    np.testing.assert_array_equal(ram[0], np.asarray(disk[0]))
+    np.testing.assert_array_equal(ram[1], np.asarray(disk[1]))
+    np.testing.assert_array_equal(ram[2], disk[2])
+    assert ram[3:] == disk[3:]
