@@ -58,6 +58,8 @@ def _build_opts(args) -> "Options":
     if getattr(args, "mode_order", None):
         from splatt_tpu.config import ModeOrder
         opts.mode_order = ModeOrder(args.mode_order)
+    if getattr(args, "engine_fallback", None):
+        opts.engine_fallback = args.engine_fallback == "on"
     return opts
 
 
@@ -146,6 +148,17 @@ def cmd_cpd(args) -> int:
                       checkpoint_path=args.checkpoint,
                       checkpoint_every=args.checkpoint_every)
     print(f"Final fit: {float(out.fit):0.5f}")
+    if opts.verbosity >= Verbosity.LOW:
+        # resilience report: silent degradation (engine demotions,
+        # transient retries, checkpoint recoveries) must be observable
+        # in the run log, not only in exit codes
+        from splatt_tpu import resilience
+
+        lines = resilience.run_report().summary()
+        if lines:
+            print("Resilience events:")
+            for line in lines:
+                print(line)
     if bs is not None and opts.verbosity >= Verbosity.HIGH:
         # per-mode MTTKRP profile (≙ the per-mode times of `cpd -v -v`,
         # src/cpd.c:361-366) — at HIGH verbosity cpd_als runs the
@@ -371,13 +384,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "fully out-of-core build — the single-chip "
                         "blocked build still materializes its layouts)")
     p.add_argument("--checkpoint", metavar="FILE",
-                   help="write an atomic .npz checkpoint every "
+                   help="write an atomic .npz checkpoint (checksummed; "
+                        "previous generation kept as .bak) every "
                         "--checkpoint-every iterations and resume from "
                         "it when present (single-device and "
                         "distributed; checkpoints are device-count-"
-                        "independent)")
+                        "independent; a corrupt file degrades to the "
+                        ".bak generation instead of crashing the resume)")
     p.add_argument("--checkpoint-every", type=_positive_int, default=10,
                    metavar="N", help="iterations between checkpoints")
+    p.add_argument("--engine-fallback", choices=["on", "off"],
+                   dest="engine_fallback",
+                   help="runtime engine fallback (default on): a "
+                        "failing MTTKRP engine is demoted and the next "
+                        "engine in the chain runs instead of the "
+                        "failure killing the run; 'off' fails loudly "
+                        "(docs/resilience.md)")
     p.set_defaults(fn=cmd_cpd)
 
     p = sub.add_parser(

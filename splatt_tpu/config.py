@@ -135,6 +135,13 @@ class Options:
     # scanned-XLA einsum elsewhere); True forces Pallas (interpret mode
     # off-TPU); False forces the XLA engine.
     use_pallas: Optional[bool] = None
+    # Runtime engine fallback (splatt_tpu.resilience): a failure of the
+    # selected MTTKRP engine demotes it and the next engine in the
+    # ordered chain runs, instead of the failure killing cpd_als.
+    # None = env default (SPLATT_ENGINE_FALLBACK, on unless disabled);
+    # False = fail loudly (differential tests chasing a kernel bug want
+    # the crash, not the silent rescue).
+    engine_fallback: Optional[bool] = None
 
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM

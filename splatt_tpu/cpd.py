@@ -190,30 +190,180 @@ def _make_profiled_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     return sweep
 
 
+def _try_engine_rescue(X, opts: Options, err: Exception) -> bool:
+    """Whether a failed sweep should be rebuilt and retried: demotes
+    the engine implicated in `err` (the dispatch layer notes each
+    attempt because accelerator failures can surface asynchronously,
+    with no call-site context).  False — re-raise — when fallback is
+    off, the input has no engine chain (COO oracle), the terminal
+    engine itself failed, no NEW engine was attempted since the last
+    demotion (retrying would livelock), or the error does not LOOK like
+    an accelerator/engine failure at all (UNKNOWN class): a LinAlgError
+    from the solve or a user shape bug must surface, not burn sweep
+    recompiles demoting healthy engines one by one.  (Synchronous
+    engine failures of any class are already handled one level down,
+    inside mttkrp_blocked's chain walk.)"""
+    from splatt_tpu import resilience
+
+    if not isinstance(X, BlockedSparse):
+        return False
+    enabled = (opts.engine_fallback if opts.engine_fallback is not None
+               else resilience.fallback_enabled())
+    if not enabled:
+        return False
+    if (resilience.classify_failure(err)
+            is resilience.FailureClass.UNKNOWN):
+        return False
+    attempt = resilience.last_engine_attempt()
+    if attempt is None:
+        return False
+    engine, shape_key = attempt
+    if engine == "xla" or resilience.is_demoted(engine, shape_key):
+        return False
+    resilience.demote_engine(engine, err, shape_key=shape_key)
+    if opts.verbosity >= Verbosity.LOW:
+        print(f"  engine {engine} failed at runtime "
+              f"({type(err).__name__}); falling back to the next engine "
+              f"in the chain")
+    return True
+
+
 def _fit(xnormsq: float, znormsq: jax.Array, inner: jax.Array) -> jax.Array:
     residual = jnp.sqrt(jnp.maximum(xnormsq + znormsq - 2.0 * inner, 0.0))
     return 1.0 - residual / np.sqrt(xnormsq)
 
 
+#: checkpoint schema: v1 = the original field set (no integrity data);
+#: v2 adds `schema` and a sha256 `checksum` over every payload field,
+#: so a torn/corrupt checkpoint is DETECTED at load instead of
+#: resuming from silently wrong factors.
+_CKPT_SCHEMA = 2
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file is unreadable, truncated, or fails its
+    integrity checksum — distinct from a dims/rank MISMATCH (which is a
+    caller error and stays a ValueError)."""
+
+
+def _checkpoint_digest(payload: dict) -> str:
+    """sha256 over every payload field in canonical (sorted-key) order,
+    covering dtype + shape + bytes so a flipped bit anywhere fails."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for k in sorted(payload):
+        a = np.asarray(payload[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
 def _save_checkpoint(path: str, factors, lam, it: int, fit: float) -> None:
-    """Atomic .npz checkpoint (write + rename)."""
+    """Atomic .npz checkpoint (write + rename) with integrity data.
+
+    The previous generation is kept as `<path>.bak` before the rename:
+    if this write is torn (power loss mid-replace is atomic, but a torn
+    write through a dying NFS mount is not) the resilient loader falls
+    back one generation instead of losing the run.
+    """
     import os
 
+    from splatt_tpu.utils import faults
+
+    faults.maybe_fail("checkpoint_write")
     tmp = path + ".tmp.npz"
-    arrays = {f"factor{m}": np.asarray(U) for m, U in enumerate(factors)}
-    np.savez(tmp, nmodes=len(factors), it=it, fit=fit,
-             lam=np.asarray(lam),
-             dims=np.asarray([U.shape[0] for U in factors]),
-             rank=int(factors[0].shape[1]), **arrays)
+    payload = {f"factor{m}": np.asarray(U) for m, U in enumerate(factors)}
+    payload.update(nmodes=len(factors), it=it, fit=fit,
+                   lam=np.asarray(lam),
+                   dims=np.asarray([U.shape[0] for U in factors]),
+                   rank=int(factors[0].shape[1]))
+    digest = _checkpoint_digest(payload)
+    np.savez(tmp, schema=_CKPT_SCHEMA, checksum=digest, **payload)
+    if faults.consume("checkpoint_torn"):
+        # injected torn write: drop the tail of the bytes just written,
+        # as a crashed writer or dying mount would
+        size = os.path.getsize(tmp)
+        with open(tmp, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    if os.path.exists(path):
+        os.replace(path, path + ".bak")
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str):
-    """Load a mid-run ALS checkpoint → (factors, lam, it, fit)."""
-    with np.load(path) as z:
-        nmodes = int(z["nmodes"])
-        factors = [jnp.asarray(z[f"factor{m}"]) for m in range(nmodes)]
-        return factors, jnp.asarray(z["lam"]), int(z["it"]), float(z["fit"])
+def load_checkpoint(path: str, verify: bool = True):
+    """Load a mid-run ALS checkpoint → (factors, lam, it, fit).
+
+    Schema-v2 checkpoints are checksum-verified (`verify=False` skips);
+    v1 files (no integrity fields) still load.  Any unreadable,
+    truncated, or checksum-failing file raises :class:`CheckpointError`
+    — use :func:`load_checkpoint_resilient` on resume paths, which
+    degrades to the `.bak` generation instead of dying mid-resume.
+    """
+    try:
+        with np.load(path) as z:
+            nmodes = int(z["nmodes"])
+            factors_np = [np.asarray(z[f"factor{m}"])
+                          for m in range(nmodes)]
+            lam = np.asarray(z["lam"])
+            it = int(z["it"])
+            fit = float(z["fit"])
+            dims = np.asarray(z["dims"])
+            rank = int(z["rank"])
+            stored = str(z["checksum"]) if "checksum" in z.files else None
+        if verify and stored is not None:
+            payload = {f"factor{m}": factors_np[m] for m in range(nmodes)}
+            payload.update(nmodes=nmodes, it=it, fit=fit, lam=lam,
+                           dims=dims, rank=rank)
+            if _checkpoint_digest(payload) != stored:
+                raise CheckpointError(
+                    f"checkpoint {path} failed its integrity checksum "
+                    f"(torn write or on-disk corruption)")
+        return ([jnp.asarray(f) for f in factors_np], jnp.asarray(lam),
+                it, fit)
+    except CheckpointError:
+        raise
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint {path} is unreadable "
+            f"({type(e).__name__}: {e})") from e
+
+
+def load_checkpoint_resilient(path: str):
+    """Resume-path checkpoint load: try `path`, fall back to the
+    previous `.bak` generation on corruption, and return None (start
+    fresh) when neither is usable — a corrupt checkpoint must degrade
+    the resume, not kill it.  Recoveries are logged to stderr and
+    recorded in the resilience run report."""
+    import os
+    import sys
+
+    from splatt_tpu import resilience
+
+    try:
+        return load_checkpoint(path)
+    except CheckpointError as e:
+        first_err = str(e)
+    bak = path + ".bak"
+    if os.path.exists(bak):
+        try:
+            out = load_checkpoint(bak)
+            resilience.run_report().add(
+                "checkpoint_recovery", path=path, error=first_err,
+                action=f"resumed from previous generation {bak}")
+            print(f"splatt-tpu: WARNING: {first_err}; resumed from the "
+                  f"previous generation {bak}", file=sys.stderr, flush=True)
+            return out
+        except CheckpointError as e2:
+            first_err = f"{first_err}; .bak also unusable ({e2})"
+    resilience.run_report().add(
+        "checkpoint_recovery", path=path, error=first_err,
+        action="no usable generation; starting fresh")
+    print(f"splatt-tpu: WARNING: {first_err}; no usable checkpoint "
+          f"generation — starting from scratch", file=sys.stderr, flush=True)
+    return None
 
 
 def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
@@ -250,20 +400,29 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     if checkpoint_path is not None and resume:
         import os
 
-        if os.path.exists(checkpoint_path):
-            ck_factors, ck_lam, start_it, ck_fit = \
-                load_checkpoint(checkpoint_path)
-            ck_dims = tuple(int(U.shape[0]) for U in ck_factors)
-            ck_rank = int(ck_factors[0].shape[1])
-            if ck_dims != tuple(dims) or ck_rank != rank:
-                raise ValueError(
-                    f"checkpoint {checkpoint_path} is for dims={ck_dims} "
-                    f"rank={ck_rank}, not dims={tuple(dims)} rank={rank}; "
-                    f"pass resume=False to overwrite it")
-            init = ck_factors
-            if opts.verbosity >= Verbosity.LOW:
-                print(f"  resuming from {checkpoint_path} "
-                      f"(iteration {start_it})")
+        # .bak counts as an existing checkpoint: a crash between the
+        # writer's two renames can leave ONLY the previous generation
+        # on disk, and that progress must still be resumed
+        if (os.path.exists(checkpoint_path)
+                or os.path.exists(checkpoint_path + ".bak")):
+            # resilient load: a corrupt/truncated file degrades to the
+            # previous .bak generation, or to a fresh start — never a
+            # crash mid-resume
+            loaded = load_checkpoint_resilient(checkpoint_path)
+            if loaded is not None:
+                ck_factors, ck_lam, start_it, ck_fit = loaded
+                ck_dims = tuple(int(U.shape[0]) for U in ck_factors)
+                ck_rank = int(ck_factors[0].shape[1])
+                if ck_dims != tuple(dims) or ck_rank != rank:
+                    raise ValueError(
+                        f"checkpoint {checkpoint_path} is for "
+                        f"dims={ck_dims} rank={ck_rank}, not "
+                        f"dims={tuple(dims)} rank={rank}; "
+                        f"pass resume=False to overwrite it")
+                init = ck_factors
+                if opts.verbosity >= Verbosity.LOW:
+                    print(f"  resuming from {checkpoint_path} "
+                          f"(iteration {start_it})")
 
     if init is not None:
         factors = [jnp.asarray(f, dtype=dtype) for f in init]
@@ -284,9 +443,13 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     # program at NELL scale wedges the tunneled remote-compile service
     # (>40 min), while the per-phase programs compile in seconds each.
     profiled = opts.verbosity >= Verbosity.HIGH
-    if profiled:
-        sweep = _make_profiled_sweep(X, nmodes, opts.regularization)
-    else:
+
+    def build_sweep():
+        # a factory, not a value: after a runtime engine demotion the
+        # sweep must be REBUILT — the old jit wrapper may hold a
+        # compiled executable with the demoted engine inlined
+        if profiled:
+            return _make_profiled_sweep(X, nmodes, opts.regularization)
         from splatt_tpu.ops.mttkrp import choose_impl
 
         # phased also when the native C++ MTTKRP engine will run: it
@@ -294,8 +457,10 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         phased = (jax.default_backend() == "tpu"
                   or (isinstance(X, BlockedSparse)
                       and choose_impl(opts) == "native"))
-        sweep = (_make_phased_sweep if phased
-                 else _make_sweep)(X, nmodes, opts.regularization)
+        return (_make_phased_sweep if phased
+                else _make_sweep)(X, nmodes, opts.regularization)
+
+    sweep = build_sweep()
     if profiled:
         # warm both specializations of every split-jit phase on copies,
         # then zero the phase timers: the report shows steady-state
@@ -317,7 +482,25 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     last_check_it = start_it
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
-        factors, grams, lam, znormsq, inner = sweep(factors, grams, it == 0)
+        # runtime graceful degradation: a sweep-level failure (an engine
+        # dying at outer-jit compile time, or an async runtime failure
+        # surfacing at the next sync) demotes the implicated engine and
+        # retries THIS iteration on a rebuilt sweep — the run degrades
+        # to the next engine in the chain instead of crashing.  Failures
+        # inside mttkrp_blocked's own dispatch are already handled one
+        # level down; this catches what escapes it.
+        rescue_attempts = 0
+        while True:
+            try:
+                factors, grams, lam, znormsq, inner = sweep(
+                    factors, grams, it == 0)
+                break
+            except Exception as e:
+                rescue_attempts += 1
+                if (rescue_attempts > 6
+                        or not _try_engine_rescue(X, opts, e)):
+                    raise
+                sweep = build_sweep()
         fit = _fit(xnormsq, znormsq, inner)
         # fetch the fit to host only at check iterations: on remote/
         # tunneled devices each fetch is a costly sync, and k sweeps

@@ -231,7 +231,8 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
 def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
                    path: str = "sorted_onehot",
                    impl: str = "xla",
-                   scan_target: Optional[int] = None) -> jax.Array:
+                   scan_target: Optional[int] = None,
+                   fallback: Optional[bool] = None) -> jax.Array:
     """Blocked MTTKRP over one :class:`ModeLayout`.
 
     `path` picks the algorithm (static dispatch); `impl` picks the
@@ -250,17 +251,49 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     materializes (default: SPLATT_SCAN_TARGET_ELEMS).  Resolved here —
     outside the jit — so it is part of the cache key and changing it
     always takes effect.
+
+    Runtime graceful degradation (`fallback`, default from
+    SPLATT_ENGINE_FALLBACK / resilience.fallback_enabled): the ordered
+    engine chain from :func:`engine_chain` is walked engine by engine;
+    a failure of the selected engine demotes it in the resilience
+    registry (process-wide, or per-shape for RESOURCE failures) and the
+    next engine runs — one backend's failure degrades, not kills, the
+    run.  The terminal "xla" engine (the stream/scatter formulation)
+    has no kernel/VMEM preconditions, so the chain cannot run dry.
     """
+    from splatt_tpu import resilience
+    from splatt_tpu.utils import faults
+
     if scan_target is None:
         scan_target = _SCAN_TARGET
-    return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
-                               scan_target)
+    if fallback is None:
+        fallback = resilience.fallback_enabled()
+    chain = engine_chain(layout, factors, mode, path, impl)
+    shape_key = _engine_shape_key(layout, factors, mode)
+    interpret = impl == "pallas_interpret"
+    regime = _chain_regime(layout, factors, mode)
+    last = len(chain) - 1
+    for i, engine in enumerate(chain):
+        if i < last and not _engine_probed_ok(engine, regime, layout.block,
+                                              interpret):
+            continue
+        try:
+            resilience.note_engine_attempt(engine, shape_key)
+            faults.maybe_fail(f"engine.{engine}")
+            return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
+                                       scan_target, engine)
+        except Exception as e:
+            if not fallback or i == last:
+                raise
+            resilience.demote_engine(engine, e, shape_key=shape_key)
+    raise AssertionError("engine chain exhausted")  # pragma: no cover
 
 
-@partial(jax.jit, static_argnames=("mode", "path", "impl", "scan_target"))
+@partial(jax.jit, static_argnames=("mode", "path", "impl", "scan_target",
+                                   "engine"))
 def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
                         mode: int, path: str, impl: str,
-                        scan_target: int) -> jax.Array:
+                        scan_target: int, engine: str) -> jax.Array:
     from splatt_tpu.ops.pallas_kernels import (fused_mttkrp, fused_mttkrp_t,
                                                fused_mttkrp_tg,
                                                onehot_reduce_full,
@@ -272,25 +305,32 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
     seg = layout.inds[mode]
     interpret = impl == "pallas_interpret"
 
-    if path in ("scatter", "sorted_scatter"):
+    if path in ("scatter", "sorted_scatter") or engine == "xla":
         if path == "sorted_scatter" and mode != layout.mode:
             # indices_are_sorted=True on unsorted indices is a
             # correctness-affecting XLA hint, not just a pessimization.
             raise ValueError("sorted_scatter requires the layout's own mode")
         # XLA fuses the gather+Hadamard producers into the scatter-add,
-        # so this path has no (nnz, R) HBM intermediate either.
+        # so this path has no (nnz, R) HBM intermediate either.  As the
+        # `engine == "xla"` terminal-fallback of the blocked paths it is
+        # the stream formulation over the layout's arrays: correct for
+        # any mode, no kernel or VMEM preconditions.
+        sorted_seg = (path == "sorted_scatter"
+                      or (path not in ("scatter",) and mode == layout.mode))
         prod = _gather_prod(layout.inds, layout.vals, factors, mode)
         nseg = dim + 1 if mode == layout.mode else dim
         out = jax.ops.segment_sum(prod.astype(_acc_dtype(prod.dtype)), seg,
                                   num_segments=nseg,
-                                  indices_are_sorted=(path == "sorted_scatter"))
+                                  indices_are_sorted=sorted_seg)
         return out[:dim]
 
     nb, B = layout.nblocks, layout.block
     itemsize = jnp.dtype(factors[0].dtype).itemsize
 
-    # single source of dispatch truth, shared with benches/tests
-    plan = engine_plan(layout, factors, mode, path, impl)
+    # the resolved engine is a static arg: mttkrp_blocked walks the
+    # engine_chain outside the jit, so a runtime demotion retraces with
+    # the next engine instead of recompiling the same failing one
+    plan = engine
 
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
@@ -353,64 +393,128 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
 mttkrp_blocked.clear_cache = _mttkrp_blocked_jit.clear_cache
 
 
-def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
-                path: str = "sorted_onehot", impl: str = "xla") -> str:
-    """Which engine :func:`mttkrp_blocked` will actually run for this
-    call — "fused_t", "fused", "unfused_pallas", or "xla_scan"/"xla".
-    Dispatch falls back silently (VMEM gates, Mosaic capability), so
-    benches and tests use this to label results truthfully.
-    """
+def _chain_regime(layout: ModeLayout, factors: Sequence[jax.Array],
+                  mode: int) -> str:
+    """Probe regime of this call — per lane-chunk regime: a Mosaic
+    crash in the many-chunk (small-dims) regime must not veto the
+    flagship single-chunk production shapes, and vice versa.  Only the
+    GATHERED (non-target) factors are lane-chunked, so the target
+    mode's dim does not enter the classification."""
+    from splatt_tpu.ops.pallas_kernels import probe_regime
+
+    return probe_regime([int(f.shape[0])
+                         for k, f in enumerate(factors) if k != mode],
+                        layout.block)
+
+
+def _engine_shape_key(layout: ModeLayout, factors: Sequence[jax.Array],
+                      mode: int) -> str:
+    """Demotion scope for RESOURCE failures — the same (regime, block)
+    granularity the capability probes use, so an OOM at one shape never
+    demotes the engine for shapes that fit."""
+    return f"{_chain_regime(layout, factors, mode)}:b{layout.block}"
+
+
+def _engine_probed_ok(engine: str, regime: str, block: int,
+                      interpret: bool) -> bool:
+    """Capability gate of one chain candidate, probed LAZILY: each
+    probe costs a remote compile attempt on the tunneled TPU service
+    (~35 s, 240 s wedged) — an engine never reached because an earlier
+    one won must not be probed at all, which is why engine_chain defers
+    this check to selection/fallback time instead of resolving the
+    whole chain eagerly."""
     from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
                                                fused_t_supported,
-                                               fused_t_vmem_ok,
-                                               fused_tg_supported,
-                                               fused_tg_vmem_ok,
-                                               fused_vmem_ok, probe_regime,
-                                               vmem_chunk)
+                                               fused_tg_supported)
 
+    if interpret or engine in ("unfused_pallas", "xla_scan", "xla"):
+        return True
+    if engine == "fused_t":
+        return fused_t_supported(regime, block)
+    if engine == "fused_tg":
+        return fused_tg_supported(regime, block)
+    if engine == "fused":
+        return fused_gather_supported(regime, block)
+    return True
+
+
+def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
+                 path: str = "sorted_onehot", impl: str = "xla"
+                 ) -> List[str]:
+    """The ORDERED engine fallback chain for this call: every engine
+    whose cheap gates (VMEM plan, HBM budget, runtime demotions) pass,
+    best first — fused Pallas (fused_t → fused_tg → experimental fused)
+    → unfused Pallas → xla_scan → the terminal "xla" stream/scatter
+    formulation, which has no preconditions and cannot fail to apply.
+    Capability probes are NOT consulted here (they cost a remote
+    compile each); :func:`_engine_probed_ok` runs them lazily when an
+    engine is actually selected.  :func:`mttkrp_blocked` walks this
+    chain at dispatch and again on runtime failure, so one engine's
+    failure degrades the run to the next engine instead of killing it.
+    """
+    from splatt_tpu import resilience
+    from splatt_tpu.ops.pallas_kernels import (fused_t_vmem_ok,
+                                               fused_tg_vmem_ok,
+                                               fused_vmem_ok, vmem_chunk)
+
+    if path in ("scatter", "sorted_scatter", "stream"):
+        return ["xla"]
     dim = int(factors[mode].shape[0])
     R = int(factors[0].shape[1])
     B = layout.block
     itemsize = jnp.dtype(factors[0].dtype).itemsize
     pallas = impl in ("pallas", "pallas_interpret")
-    interpret = impl == "pallas_interpret"
-    if path in ("scatter", "sorted_scatter", "stream"):
-        return "xla"
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8
     else:
         width = layout.seg_width
-    # capability probes are per lane-chunk regime: a Mosaic crash in
-    # the many-chunk (small-dims) regime must not veto the flagship
-    # single-chunk production shapes, and vice versa.  Only the
-    # GATHERED (non-target) factors are lane-chunked, so the target
-    # mode's dim does not enter the classification.
-    regime = probe_regime([int(f.shape[0])
-                           for k, f in enumerate(factors) if k != mode],
-                          B)
-    # LAZY probing, cheap VMEM gate first: each capability probe costs
-    # a remote compile attempt on the tunneled TPU service (~35 s, or
-    # 240 s on a wedged compile) — a kernel gated out by VMEM, or never
-    # reached because an earlier engine won, must not be probed at all.
-    if pallas and fused_t_vmem_ok(factors, mode, width, B) \
-            and (interpret or fused_t_supported(regime, B)):
-        return "fused_t"
-    if pallas and fused_tg_vmem_ok(factors, mode, width, B) \
-            and (interpret or fused_tg_supported(regime, B)):
-        return "fused_tg"
+    shape_key = _engine_shape_key(layout, factors, mode)
+
+    def live(name):
+        return not resilience.is_demoted(name, shape_key)
+
+    chain = []
+    if pallas and live("fused_t") and fused_t_vmem_ok(factors, mode,
+                                                      width, B):
+        chain.append("fused_t")
+    if pallas and live("fused_tg") and fused_tg_vmem_ok(factors, mode,
+                                                        width, B):
+        chain.append("fused_tg")
     # The row-major fused kernel's arbitrary u[idx] gather is known-
     # unlowerable on current jax/Mosaic (VERDICT r4 weak #5): it is out
     # of the production dispatch order — no probe slot, no session time
     # — unless explicitly re-enabled for a future jax version.  Its
     # math stays covered by the interpret-mode tests.
     if pallas and os.environ.get("SPLATT_EXPERIMENTAL_FUSED") == "1" \
-            and fused_vmem_ok(factors, mode, width, B) \
-            and (interpret or fused_gather_supported(regime, B)):
-        return "fused"
-    if (pallas and vmem_chunk(width, B, R, itemsize) >= 1
+            and live("fused") and fused_vmem_ok(factors, mode, width, B):
+        chain.append("fused")
+    if (pallas and live("unfused_pallas")
+            and vmem_chunk(width, B, R, itemsize) >= 1
             and _unfused_hbm_ok(layout, R, itemsize)):
-        return "unfused_pallas"
-    return "xla_scan"
+        chain.append("unfused_pallas")
+    if live("xla_scan"):
+        chain.append("xla_scan")
+    # terminal engine: the stream/scatter formulation — always appended,
+    # never demotable out of the chain, so dispatch cannot run dry
+    chain.append("xla")
+    return chain
+
+
+def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
+                path: str = "sorted_onehot", impl: str = "xla") -> str:
+    """Which engine :func:`mttkrp_blocked` will actually run for this
+    call — the first :func:`engine_chain` entry whose (lazily probed)
+    capability gate passes.  Dispatch falls back silently (VMEM gates,
+    Mosaic capability, runtime demotions), so benches and tests use
+    this to label results truthfully.
+    """
+    chain = engine_chain(layout, factors, mode, path, impl)
+    regime = _chain_regime(layout, factors, mode)
+    interpret = impl == "pallas_interpret"
+    for engine in chain[:-1]:
+        if _engine_probed_ok(engine, regime, layout.block, interpret):
+            return engine
+    return chain[-1]
 
 
 class Plan(NamedTuple):
@@ -423,7 +527,8 @@ class Plan(NamedTuple):
 
     impl: str    # "native" | "pallas" | "pallas_interpret" | "xla"
     path: str    # one of PATHS
-    engine: str  # "native" | "fused_t" | "fused" | "unfused_pallas" | "xla_scan" | "xla"
+    engine: str  # "native" | "fused_t" | "fused_tg" | "fused" |
+                 # "unfused_pallas" | "xla_scan" | "xla"
 
 
 def _native_runnable(layout: ModeLayout, factors: Sequence[jax.Array],
@@ -495,11 +600,18 @@ def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
     from splatt_tpu.ops.pallas_kernels import PROBE_STATES
 
     unproven = {k: v for k, v in PROBE_STATES.items()
-                if v in ("timeout", "infra_error")}
+                if v in ("timeout", "infra")}
     if unproven:
         labels = [f"{k} {'timed out' if v == 'timeout' else 'service error'}"
                   for k, v in sorted(unproven.items())]
         note = f" [probe {'; '.join(labels)}: unproven, not rejected]"
+    from splatt_tpu import resilience
+
+    demoted = resilience.demotions()
+    if demoted:
+        labels = [d.engine + (f"@{d.shape_key}" if d.shape_key else "")
+                  for d in demoted]
+        note += f" [demoted this run: {', '.join(sorted(set(labels)))}]"
     return f"engine plan: impl={impl} " + " ".join(parts) + note
 
 
@@ -594,7 +706,8 @@ def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
         # the shared library failed at call time (not a planned
         # condition — e.g. deleted mid-session); degrade to XLA
         rimpl = "xla"
-    return mttkrp_blocked(layout, factors, mode, path=rpath, impl=rimpl)
+    return mttkrp_blocked(layout, factors, mode, path=rpath, impl=rimpl,
+                          fallback=X.opts.engine_fallback)
 
 
 def _run_native(layout: ModeLayout, factors: List[jax.Array],

@@ -63,7 +63,10 @@ def _vmem_budget() -> int:
 def _compiler_params():
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(vmem_limit_bytes=_vmem_limit())
+    # jax API drift: CompilerParams (new) was TPUCompilerParams before
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(vmem_limit_bytes=_vmem_limit())
 
 
 def vmem_chunk(width: int, block: int, rank: int,
@@ -531,10 +534,13 @@ def fused_mttkrp_tg(layout, factors, mode: int, width: int,
 
 
 #: outcome of each capability probe, keyed by kernel name — "ok",
-#: "compile_failed", "timeout", or absent if never probed.  "timeout"
-#: means the verdict is *unproven* (a transiently slow remote-compile
-#: service, not a rejected kernel) and that an orphaned daemon thread
-#: may still be using the chip; engine_plan/CLI surface this.
+#: "compile_failed", "resource", "timeout", "infra", or absent if never
+#: probed.  "timeout"/"infra" mean the verdict is *unproven* (a
+#: transiently slow/wedged remote-compile service, not a rejected
+#: kernel) — for "timeout" an orphaned daemon thread may still be using
+#: the chip; engine_plan/CLI surface these.  "resource" means the probe
+#: ran out of memory: a capacity verdict scoped to this (regime, block)
+#: shape, not a capability rejection.
 PROBE_STATES: dict = {}
 
 
@@ -546,13 +552,36 @@ PROBE_STATES: dict = {}
 # processes of one environment.  Every stage of tools/tpu_session.sh is
 # its own process, so without persistence a precious chip window spends
 # its first minutes re-proving verdicts the previous stage already paid
-# for.  This cache stores proven verdicts ("ok"/"compile_failed") on
-# disk; "timeout" is stored for reporting but NEVER short-circuits a
-# later process — an unproven verdict is retried, not inherited (a
-# transiently wedged compile service must not demote the flagship
-# engine for every future session).
+# for.  This cache stores proven verdicts ("ok"/"compile_failed", and
+# the shape-scoped "resource") on disk; "timeout"/"infra" are stored
+# for reporting but NEVER short-circuit a later process — an unproven
+# verdict is retried, not inherited (a transiently wedged compile
+# service must not demote the flagship engine for every future
+# session).  Every entry additionally expires after a TTL
+# (SPLATT_PROBE_CACHE_TTL_S, default 14 days): infrastructure drifts
+# under a fixed env key (driver updates, relay reconfigurations), so
+# even a proven verdict is re-earned occasionally.
 
 _CACHE_ENV = "SPLATT_PROBE_CACHE"
+_CACHE_TTL_ENV = "SPLATT_PROBE_CACHE_TTL_S"
+_CACHE_TTL_DEFAULT_S = 14 * 24 * 3600.0
+
+
+def probe_cache_ttl() -> float:
+    """Seconds a cached verdict stays fresh (<= 0 disables expiry)."""
+    import os
+
+    raw = os.environ.get(_CACHE_TTL_ENV)
+    if not raw:
+        return _CACHE_TTL_DEFAULT_S
+    try:
+        return float(raw)
+    except ValueError:
+        import sys
+
+        print(f"splatt-tpu: bad {_CACHE_TTL_ENV} (want seconds); using "
+              f"the default", file=sys.stderr)
+        return _CACHE_TTL_DEFAULT_S
 
 
 def _cache_path():
@@ -574,9 +603,12 @@ def _cache_path():
 def _kernel_src_hash() -> str:
     """Hash of the sources a probe verdict depends on — this module
     plus the layout/tensor builders the probe compiles through
-    (blocked.py, coo.py): editing any of them invalidates every cached
-    verdict, so a fixed Mosaic crash is re-probed instead of staying
-    disabled behind a stale "compile_failed"."""
+    (blocked.py, coo.py) and the helpers the kernels import from
+    ops/mttkrp.py (_acc_dtype, onehot_precision) and utils/env.py
+    (ceil_to): editing any of them changes what the probe compiles, so
+    it must invalidate every cached verdict — a fixed Mosaic crash is
+    re-probed instead of staying disabled behind a stale
+    "compile_failed" (and a stale "ok" cannot mask a new rejection)."""
     import hashlib
     import pathlib
 
@@ -584,7 +616,8 @@ def _kernel_src_hash() -> str:
     pkg = pathlib.Path(__file__).resolve().parents[1]
     try:
         for src in (pathlib.Path(__file__), pkg / "blocked.py",
-                    pkg / "coo.py"):
+                    pkg / "coo.py", pkg / "ops" / "mttkrp.py",
+                    pkg / "utils" / "env.py"):
             h.update(src.read_bytes())
         return h.hexdigest()[:12]
     except Exception:
@@ -607,15 +640,24 @@ def _cache_env_key() -> str:
 
 def probe_cache_load(state_key: str):
     """Cached verdict for `state_key` in this environment, or None.
-    Returns whatever was stored ("ok"/"compile_failed"/"timeout") —
-    the CALLER decides which states are authoritative."""
+    Returns whatever was stored ("ok"/"compile_failed"/"resource"/
+    "timeout"/"infra") — the CALLER decides which states are
+    authoritative.  Entries older than :func:`probe_cache_ttl` are
+    expired (returned as None) so every verdict, even a proven one, is
+    re-earned occasionally on drifting infrastructure."""
     import json
+    import time
 
     try:
         with open(_cache_path()) as f:
             data = json.load(f)
         entry = data.get(_cache_env_key(), {}).get(state_key)
-        return entry["state"] if entry else None
+        if not entry:
+            return None
+        ttl = probe_cache_ttl()
+        if ttl > 0 and time.time() - float(entry.get("ts", 0)) > ttl:
+            return None
+        return entry["state"]
     except Exception:
         return None
 
@@ -733,10 +775,12 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         PROBE_STATES[state_key] = "not_tpu"
         return False
 
-    # Proven verdicts persist across processes; "timeout" does not
-    # short-circuit (unproven — retry it now that we have the chip).
+    # Proven verdicts persist across processes ("resource" is proven
+    # too, but scoped: the state_key already carries regime+block, so a
+    # capacity rejection only gates this shape); "timeout"/"infra" do
+    # not short-circuit (unproven — retry now that we have the chip).
     cached = probe_cache_load(state_key)
-    if cached in ("ok", "compile_failed"):
+    if cached in ("ok", "compile_failed", "resource"):
         PROBE_STATES[state_key] = cached
         return cached == "ok"
 
@@ -750,29 +794,41 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
     # orphaned compile never returns; its exception is swallowed.
     import threading
 
+    from splatt_tpu import resilience
+    from splatt_tpu.utils import faults
+
     result = []
 
-    # Only a recognized DETERMINISTIC rejection may be persisted as
-    # "compile_failed" — the cache makes any misclassification
-    # permanent for the whole environment, so the persisted-negative
-    # set is a whitelist (Mosaic compiler crash/rejection signatures),
-    # not a transient-error blocklist.  Everything else — the tunneled
-    # relay dropping (UNAVAILABLE etc.), or any unrecognized exception
-    # — is treated as unproven: rejected for THIS session, re-probed
-    # by the next process (worst case one ~35 s probe per process,
-    # bounded; a wrongly-persisted rejection would be unbounded).
-    _REJECT_MARKERS = ("Mosaic", "mosaic", "Internal TPU kernel compiler",
-                       "Invalid input layout", "Unsupported lowering",
-                       "not implemented", "NotImplementedError",
-                       "INTERNAL: ", "HTTP code 500")
+    # Failure taxonomy (splatt_tpu.resilience): only a recognized
+    # DETERMINISTIC rejection may be persisted as "compile_failed" —
+    # the cache makes any misclassification permanent for the whole
+    # environment, so the persisted-negative set is a whitelist (Mosaic
+    # compiler crash/rejection signatures), not a transient-error
+    # blocklist.  TRANSIENT failures (HTTP 5xx, bare INTERNAL:, relay
+    # drops) are retried in-place with capped backoff + jitter and, if
+    # they persist, recorded as "infra": rejected for THIS session,
+    # re-probed by the next process (worst case one ~35 s probe per
+    # process, bounded; a wrongly-persisted rejection would be
+    # unbounded).  RESOURCE failures (OOM/VMEM) are proven capacity
+    # verdicts scoped to this (regime, block) shape.
+
+    def attempt():
+        faults.maybe_fail("probe_compile")
+        return _probe_case(kernel_fn, regime, block)
 
     def runner():
         try:
-            result.append(_probe_case(kernel_fn, regime, block))
+            result.append(resilience.retry_transient(attempt,
+                                                     label=state_key))
         except Exception as e:
-            msg = f"{type(e).__name__}: {e}"
-            result.append(False if any(m in msg for m in _REJECT_MARKERS)
-                          else "infra")
+            cls = resilience.classify_failure(e)
+            if cls is resilience.FailureClass.DETERMINISTIC:
+                result.append(False)
+            elif cls is resilience.FailureClass.RESOURCE:
+                result.append("resource")
+            else:
+                # transient (retries exhausted) or unknown: unproven
+                result.append("infra")
 
     t = threading.Thread(target=runner, daemon=True)
     t.start()
@@ -790,6 +846,8 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         # state so engine_plan/CLI can report "unproven", not "rejected".
         PROBE_STATES[state_key] = "timeout"
         probe_cache_store(state_key, "timeout")
+        resilience.run_report().add("probe_downgrade", state_key=state_key,
+                                    verdict="timeout")
         import sys
 
         print(f"splatt-tpu: WARNING: {state_key} capability probe timed out "
@@ -802,15 +860,23 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
     if result[0] == "infra":
         # unproven, like timeout: recorded for reporting, retried by the
         # next process rather than inherited as a rejection
-        PROBE_STATES[state_key] = "infra_error"
-        probe_cache_store(state_key, "infra_error")
+        PROBE_STATES[state_key] = "infra"
+        probe_cache_store(state_key, "infra")
+        resilience.run_report().add("probe_downgrade", state_key=state_key,
+                                    verdict="infra")
         import sys
 
         print(f"splatt-tpu: WARNING: {state_key} capability probe failed "
-              f"with an unrecognized/transient error (NOT a proven kernel "
-              f"rejection); treating as unsupported this session — the "
-              f"next process will re-probe",
+              f"with a transient/unrecognized error even after backoff "
+              f"retries (NOT a proven kernel rejection); treating as "
+              f"unsupported this session — the next process will re-probe",
               file=sys.stderr, flush=True)
+        return False
+    if result[0] == "resource":
+        # proven capacity rejection, scoped: the state_key carries
+        # (regime, block), so only this shape is demoted
+        PROBE_STATES[state_key] = "resource"
+        probe_cache_store(state_key, "resource")
         return False
     state = "ok" if result[0] else "compile_failed"
     PROBE_STATES[state_key] = state

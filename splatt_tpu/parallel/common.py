@@ -707,7 +707,7 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     """
     import os
 
-    from splatt_tpu.cpd import _save_checkpoint, load_checkpoint
+    from splatt_tpu.cpd import _save_checkpoint, load_checkpoint_resilient
     from splatt_tpu.ops.linalg import gram as gram_fn
 
     if checkpoint_path and checkpoint_every < 1:
@@ -716,29 +716,36 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     fit_prev = 0.0
     start_it = 0
     lam = jnp.ones((rank,), dtype=dtype)
-    if checkpoint_path and resume and os.path.exists(checkpoint_path):
-        fs, lam_ck, start_it, fit_ck = load_checkpoint(checkpoint_path)
-        if (len(fs) != len(factors)
-                or any(int(np.asarray(f).shape[0]) != d
-                       or int(np.asarray(f).shape[1]) != rank
-                       for f, d in zip(fs, dims))):
-            raise ValueError(
-                f"checkpoint {checkpoint_path} does not match this run "
-                f"(dims {dims}, rank {rank}); pass resume=False to "
-                f"overwrite")
-        factors = tuple(
-            _place_original(U, cur,
-                            row_select[m] if row_select is not None
-                            else None)
-            for m, (U, cur) in enumerate(zip(fs, factors)))
-        grams = tuple(
-            jax.device_put(gram_fn(f).astype(g.dtype), g.sharding)
-            for f, g in zip(factors, grams))
-        lam = jnp.asarray(lam_ck, dtype=dtype)
-        fit_prev = fit_ck
-        if opts.verbosity >= Verbosity.LOW:
-            print(f"  resumed from {checkpoint_path} at iteration "
-                  f"{start_it} (fit {fit_ck:0.5f})")
+    if checkpoint_path and resume and (
+            os.path.exists(checkpoint_path)
+            or os.path.exists(checkpoint_path + ".bak")):
+        # same hardened resume as the single-device driver: a corrupt
+        # or truncated checkpoint degrades to the .bak generation, or
+        # to a fresh start — never a crash mid-resume
+        loaded = load_checkpoint_resilient(checkpoint_path)
+        if loaded is not None:
+            fs, lam_ck, start_it, fit_ck = loaded
+            if (len(fs) != len(factors)
+                    or any(int(np.asarray(f).shape[0]) != d
+                           or int(np.asarray(f).shape[1]) != rank
+                           for f, d in zip(fs, dims))):
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} does not match this run "
+                    f"(dims {dims}, rank {rank}); pass resume=False to "
+                    f"overwrite")
+            factors = tuple(
+                _place_original(U, cur,
+                                row_select[m] if row_select is not None
+                                else None)
+                for m, (U, cur) in enumerate(zip(fs, factors)))
+            grams = tuple(
+                jax.device_put(gram_fn(f).astype(g.dtype), g.sharding)
+                for f, g in zip(factors, grams))
+            lam = jnp.asarray(lam_ck, dtype=dtype)
+            fit_prev = fit_ck
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  resumed from {checkpoint_path} at iteration "
+                      f"{start_it} (fit {fit_ck:0.5f})")
     k = opts.fit_check_every
     last_check_it = start_it
     done_it = start_it

@@ -42,7 +42,7 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from splatt_tpu.utils.env import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from splatt_tpu.config import Options, Verbosity, default_opts, resolve_dtype
@@ -333,6 +333,18 @@ class GridDecomp:
             out_dir = _memmap_dir(binds)
         build_modes = alloc_build_modes(
             [self.block_rows[m] for m in range(nmodes)], opts)
+        if out_dir is not None and opts.verbosity >= Verbosity.LOW:
+            # another full sorted copy per build mode lands on disk —
+            # say where and how big BEFORE writing, so a silently
+            # chosen directory (beside the user's decomposition files)
+            # and its space cost are observable.  These memmaps persist
+            # after the run: cleanup is the caller's job (docs/).
+            per_mode = (binds.size * binds.itemsize
+                        + bvals.size * bvals.itemsize)
+            print(f"  cell layouts: memmapped under {out_dir} "
+                  f"(cells_m<mode>/, ~{per_mode / 1e9:.2f} GB per build "
+                  f"mode x {len(build_modes)} mode(s)); not cleaned up "
+                  f"automatically")
         layouts = []
         for m in build_modes:
             i, v, rs, blk, S = build_bucket_layout(

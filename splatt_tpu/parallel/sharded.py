@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from splatt_tpu.utils.env import shard_map
 
 from splatt_tpu.config import (CommPattern, Options, Verbosity, default_opts,
                                resolve_dtype)

@@ -1,0 +1,323 @@
+"""Resilience layer: failure taxonomy, retries, demotions, run report.
+
+Four consecutive rounds of chip unavailability (VERDICT.md) showed the
+system's weakest point is failure HANDLING, not speed: one transient
+remote-compile 500 used to be persisted as a permanent "compile_failed"
+verdict, demoting the flagship Pallas engine for every future session.
+Production tensor-decomposition stacks (GenTen's performance-portable
+MTTKRP; the emerging-architectures survey) keep multiple backends live
+so one backend's failure degrades, not kills, the run.  This module is
+the single place that decides what a failure MEANS:
+
+Failure taxonomy
+    :func:`classify_failure` sorts probe/compile/runtime errors into
+
+    - ``DETERMINISTIC`` — a proven kernel-compiler rejection (Mosaic
+      signatures).  Safe to persist: the same sources on the same
+      device will always fail.
+    - ``TRANSIENT``     — the remote-compile relay or service hiccuping
+      (HTTP 5xx, bare ``INTERNAL:``, ``UNAVAILABLE``, resets,
+      timeouts).  Retried with capped exponential backoff + jitter,
+      NEVER persisted.
+    - ``RESOURCE``      — capacity, not capability (OOM / VMEM
+      exhaustion).  Demotes the engine for this shape only.
+    - ``UNKNOWN``       — anything unrecognized.  Treated like
+      transient for persistence purposes (rejected this session,
+      re-probed next process) but not retried in-place.
+
+Engine demotion registry
+    :func:`demote_engine` / :func:`is_demoted` — runtime failures of a
+    dispatch engine demote it (process-wide, or per-shape for RESOURCE
+    failures) so the ordered fallback chain in
+    :func:`splatt_tpu.ops.mttkrp.engine_chain` skips it mid-run instead
+    of crashing ``cpd_als``.
+
+Run report
+    :func:`run_report` — an append-only event log (demotions, probe
+    retries, checkpoint recoveries) the CLI prints at the end of a run,
+    so silent degradation is observable (≙ the reference's stats
+    reporting philosophy, src/stats.c).
+
+Nothing here imports jax: classification is pure string logic so the
+fault-injection tests exercise every branch without a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import random
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class FailureClass(enum.Enum):
+    """What a probe/compile/runtime failure means for future dispatch."""
+
+    DETERMINISTIC = "deterministic"   # persist: will always fail here
+    TRANSIENT = "transient"           # retry w/ backoff; never persist
+    RESOURCE = "resource"             # demote for this shape only
+    UNKNOWN = "unknown"               # unproven; re-probe next process
+
+
+# Capacity failures first: an OOM message may also mention the kernel
+# compiler ("Mosaic ... scoped vmem limit exceeded"), and the right
+# verdict there is shape-scoped demotion, not a permanent rejection.
+RESOURCE_MARKERS = (
+    "RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM",
+    "vmem limit", "VMEM limit", "scoped vmem", "exceeds the limit",
+    "Attempting to allocate", "Attempting to reserve",
+)
+
+# Deterministic Mosaic/kernel-compiler rejection signatures — the ONLY
+# class that may be persisted as "compile_failed" (a persisted
+# misclassification demotes the flagship engine for every future
+# session, so this is a whitelist, not a transient-error blocklist).
+# 'HTTP code 500' and bare 'INTERNAL: ' were deliberately REMOVED from
+# this set (ADVICE.md round 5): they are classic transient relay
+# failures and live in TRANSIENT_MARKERS below.
+DETERMINISTIC_MARKERS = (
+    "Mosaic", "mosaic", "Internal TPU kernel compiler",
+    "Invalid input layout", "Unsupported lowering",
+    "not implemented", "NotImplementedError",
+)
+
+# Transient remote-compile / relay / service failures: retried with
+# backoff, rejected only for this attempt window, never persisted.
+TRANSIENT_MARKERS = (
+    "HTTP code 500", "HTTP code 502", "HTTP code 503", "HTTP code 504",
+    "INTERNAL: ", "UNAVAILABLE", "DEADLINE_EXCEEDED", "CANCELLED",
+    "Connection reset", "Connection refused", "Socket closed",
+    "Broken pipe", "timed out", "TimeoutError",
+    "temporarily unavailable", "Transient",
+)
+
+
+def failure_message(exc) -> str:
+    """The string classification runs on: "ExcType: message"."""
+    if isinstance(exc, str):
+        return exc
+    return f"{type(exc).__name__}: {exc}"
+
+
+def classify_failure(exc) -> FailureClass:
+    """Classify a probe/compile/runtime error (exception or message).
+
+    Order matters: RESOURCE outranks DETERMINISTIC (a Mosaic VMEM
+    message is capacity, not capability), and DETERMINISTIC outranks
+    TRANSIENT — "INTERNAL: Mosaic failed ..." carries a real compiler
+    signature, so the transient 'INTERNAL: ' prefix must not launder it
+    into a retry loop (ADVICE.md: bare 500/INTERNAL are transient
+    UNLESS they co-occur with a Mosaic/kernel-compiler marker).
+    """
+    msg = failure_message(exc)
+    if any(m in msg for m in RESOURCE_MARKERS):
+        return FailureClass.RESOURCE
+    if any(m in msg for m in DETERMINISTIC_MARKERS):
+        return FailureClass.DETERMINISTIC
+    if any(m in msg for m in TRANSIENT_MARKERS):
+        return FailureClass.TRANSIENT
+    return FailureClass.UNKNOWN
+
+
+# -- transient retry --------------------------------------------------------
+
+#: default retry budget for transient failures.  Small and capped: a
+#: wedged relay must degrade the session in bounded time (the probe
+#: machinery adds its own 240 s deadline on top).
+TRANSIENT_RETRIES = 3
+BACKOFF_BASE_S = 0.5
+BACKOFF_CAP_S = 8.0
+
+
+def retry_transient(fn: Callable, attempts: int = None,
+                    base: float = BACKOFF_BASE_S,
+                    cap: float = BACKOFF_CAP_S,
+                    sleep: Optional[Callable] = None,
+                    rng: Optional[Callable] = None,
+                    label: str = "") -> object:
+    """Run `fn`, retrying ONLY transient failures with capped
+    exponential backoff + full jitter (delay ~ U(0, min(cap, base·2^a))
+    — the decorrelated pattern that avoids thundering-herd re-compiles
+    against a shared relay).  Deterministic / resource / unknown
+    failures propagate immediately: retrying a proven rejection wastes
+    the chip window.  `sleep`/`rng` are injectable for tests.
+    """
+    if attempts is None:
+        attempts = TRANSIENT_RETRIES
+    if sleep is None:
+        sleep = time.sleep
+    if rng is None:
+        rng = random.random
+    last = None
+    for a in range(max(attempts, 1)):
+        try:
+            return fn()
+        except Exception as e:
+            last = e
+            if (classify_failure(e) is not FailureClass.TRANSIENT
+                    or a == attempts - 1):
+                raise
+            delay = min(cap, base * (2 ** a)) * rng()
+            run_report().add("transient_retry", label=label,
+                             attempt=a + 1, delay_s=round(delay, 3),
+                             error=failure_message(e)[:200])
+            sleep(delay)
+    raise last  # pragma: no cover — loop always returns or raises
+
+
+# -- engine demotion registry -----------------------------------------------
+
+@dataclasses.dataclass
+class Demotion:
+    """One runtime engine demotion: which engine, why, and its scope
+    (shape_key=None means process-wide; otherwise this shape only)."""
+
+    engine: str
+    failure_class: FailureClass
+    error: str
+    shape_key: Optional[str] = None
+    ts: float = dataclasses.field(default_factory=time.time)
+
+
+_DEMOTED: Dict[str, Demotion] = {}
+
+
+def _demotion_key(engine: str, shape_key: Optional[str]) -> str:
+    return engine if shape_key is None else f"{engine}@{shape_key}"
+
+
+def demote_engine(engine: str, error, shape_key: Optional[str] = None
+                  ) -> Demotion:
+    """Record a runtime demotion of `engine`; the fallback chain skips
+    it from now on.  RESOURCE failures demote per-shape (pass the
+    shape_key); everything else process-wide.  Never persisted to disk:
+    a demotion lasts one process — the probe cache owns cross-process
+    verdicts with its own (stricter) persistence rules."""
+    cls = classify_failure(error)
+    if cls is not FailureClass.RESOURCE:
+        shape_key = None
+    d = Demotion(engine=engine, failure_class=cls,
+                 error=failure_message(error)[:500], shape_key=shape_key)
+    _DEMOTED[_demotion_key(engine, shape_key)] = d
+    run_report().add("engine_demotion", engine=engine,
+                     failure_class=cls.value, shape_key=shape_key,
+                     error=d.error[:200])
+    return d
+
+
+def is_demoted(engine: str, shape_key: Optional[str] = None) -> bool:
+    """Whether `engine` was demoted process-wide, or for this shape."""
+    if engine in _DEMOTED:
+        return True
+    return (shape_key is not None
+            and _demotion_key(engine, shape_key) in _DEMOTED)
+
+
+def demotions() -> List[Demotion]:
+    return list(_DEMOTED.values())
+
+
+def reset_demotions() -> None:
+    """Clear runtime demotions (tests; a fresh run in one process)."""
+    _DEMOTED.clear()
+
+
+# -- last-attempt tracking --------------------------------------------------
+#
+# Failures on accelerators can surface ASYNCHRONOUSLY — not at the
+# mttkrp_blocked call that picked the engine, but at the next host sync
+# inside the sweep.  The dispatch layer notes which engine it handed
+# work to; the driver-level handler (cpd_als) uses it to demote the
+# right engine when an exception arrives with no call-site context.
+
+_LAST_ATTEMPT: Optional[tuple] = None
+
+
+def note_engine_attempt(engine: str, shape_key: Optional[str] = None
+                        ) -> None:
+    global _LAST_ATTEMPT
+    _LAST_ATTEMPT = (engine, shape_key)
+
+
+def last_engine_attempt() -> Optional[tuple]:
+    """(engine, shape_key) of the most recent dispatch, or None."""
+    return _LAST_ATTEMPT
+
+
+# -- engine fallback switch -------------------------------------------------
+
+_FALLBACK_ENV = "SPLATT_ENGINE_FALLBACK"
+_fallback_override: Optional[bool] = None
+
+
+def fallback_enabled() -> bool:
+    """Whether runtime engine fallback is on (default yes).  CLI
+    --engine-fallback off / SPLATT_ENGINE_FALLBACK=0 disable it — a
+    differential test chasing a kernel bug wants the crash, not the
+    silent rescue."""
+    if _fallback_override is not None:
+        return _fallback_override
+    return os.environ.get(_FALLBACK_ENV, "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+def set_fallback(enabled: Optional[bool]) -> None:
+    """Process-wide override (None restores the env default)."""
+    global _fallback_override
+    _fallback_override = enabled
+
+
+# -- run report -------------------------------------------------------------
+
+class RunReport:
+    """Append-only log of resilience events for one run: engine
+    demotions, transient retries, probe verdict downgrades, checkpoint
+    recoveries.  The CLI prints :meth:`summary` after the run so silent
+    degradation is observable; tests assert on :meth:`events`."""
+
+    def __init__(self):
+        self._events: List[dict] = []
+
+    def add(self, kind: str, **info) -> dict:
+        ev = dict(kind=kind, ts=time.time(), **info)
+        self._events.append(ev)
+        return ev
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        if kind is None:
+            return list(self._events)
+        return [e for e in self._events if e["kind"] == kind]
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def summary(self) -> List[str]:
+        """Human-readable lines, one per noteworthy event (retries are
+        aggregated — their details matter for debugging, not reporting)."""
+        lines = []
+        retries = self.events("transient_retry")
+        if retries:
+            lines.append(f"  {len(retries)} transient failure(s) retried "
+                         f"with backoff")
+        for e in self.events("engine_demotion"):
+            scope = (f"shape {e['shape_key']}" if e.get("shape_key")
+                     else "this process")
+            lines.append(f"  engine {e['engine']} demoted for {scope} "
+                         f"({e['failure_class']}: {e['error'][:80]})")
+        for e in self.events("checkpoint_recovery"):
+            lines.append(f"  checkpoint {e['path']} was corrupt "
+                         f"({e['error'][:80]}); {e['action']}")
+        for e in self.events("probe_downgrade"):
+            lines.append(f"  probe {e['state_key']}: {e['verdict']} "
+                         f"(unproven — re-probed next process)")
+        return lines
+
+
+_REPORT = RunReport()
+
+
+def run_report() -> RunReport:
+    """The process-wide resilience event log."""
+    return _REPORT

@@ -25,6 +25,29 @@ def check_int32_dims(dims) -> None:
             f"(max dim must be < {limit}); relabel/split the mode first")
 
 
+def shard_map(f, **kwargs):
+    """Version-portable `jax.shard_map` (resilience to jax API drift).
+
+    Newer jax exposes ``jax.shard_map`` with a ``check_vma`` kwarg;
+    older releases only have ``jax.experimental.shard_map.shard_map``
+    with the same contract under ``check_rep``.  One hard
+    ``from jax import shard_map`` at import time used to take down the
+    whole :mod:`splatt_tpu.parallel` package — and with it every
+    blocked-layout build — on an older jax; resolving lazily here keeps
+    the distributed stack importable everywhere and fails only if a
+    sweep actually runs on a jax with neither API.
+    """
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return sm(f, **kwargs)
+
+
 def host_fence(x):
     """Force true device completion of `x` and everything it depends on.
 

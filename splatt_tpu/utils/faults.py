@@ -1,0 +1,199 @@
+"""Fault injection harness — the test hook points of the resilience layer.
+
+Resilience code that only runs when the infrastructure misbehaves is
+dead code until the day it matters; this module makes the misbehavior
+reproducible.  Production call sites (probe compiles, engine dispatch,
+checkpoint writes) call :func:`maybe_fail`/:func:`consume` with a site
+name; tests (or an operator, via env var) arm faults against those
+sites and the real error-handling paths execute.
+
+Arming a fault
+    - context manager (tests)::
+
+        with faults.inject("probe_compile", "http500", times=2):
+            ...   # the first two probe compiles raise an HTTP 500
+
+    - env var (whole-process, e.g. under the CLI)::
+
+        SPLATT_FAULTS="probe_compile:http500:2,engine.fused_t:runtime"
+
+      Comma-separated ``site:kind[:times]`` specs; ``times`` defaults
+      to 1, ``*`` means every call.
+
+Sites used by the production code:
+    - ``probe_compile``          — the capability-probe remote compile
+    - ``engine.<name>``          — an MTTKRP dispatch engine at call
+      time (e.g. ``engine.fused_t``, ``engine.xla_scan``)
+    - ``checkpoint_write``       — raise during the checkpoint save
+    - ``checkpoint_torn``        — consumed (not raised): the writer
+      truncates the bytes it just wrote, simulating a torn write
+
+Fault kinds map to canned exceptions whose messages exercise specific
+:func:`splatt_tpu.resilience.classify_failure` branches:
+
+    ========== ==================================== ===============
+    kind       message signature                    classifies as
+    ========== ==================================== ===============
+    http500    ``... HTTP code 500``                transient
+    internal   ``INTERNAL: ...``                    transient
+    unavailable ``UNAVAILABLE: ...``                transient
+    timeout    ``TimeoutError``                     transient
+    oom        ``RESOURCE_EXHAUSTED: ...``          resource
+    mosaic     ``Mosaic ...``                       deterministic
+    runtime    generic runtime failure              unknown
+    ========== ==================================== ===============
+
+The registry is process-local and the checks are O(1) dict lookups on
+cold paths only (probes, dispatch resolution, checkpoint IO) — never
+inside a kernel.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Dict, Optional
+
+_FAULTS_ENV = "SPLATT_FAULTS"
+
+#: times value meaning "every call"
+ALWAYS = -1
+
+
+def _canned(kind: str, site: str) -> Exception:
+    if kind == "http500":
+        return RuntimeError(
+            f"XLA:TPU compile failed: HTTP code 500 from remote compile "
+            f"service (injected fault at {site})")
+    if kind == "internal":
+        return RuntimeError(
+            f"INTERNAL: injected transient service failure at {site}")
+    if kind == "unavailable":
+        return RuntimeError(
+            f"UNAVAILABLE: injected relay failure at {site}")
+    if kind == "timeout":
+        return TimeoutError(f"injected deadline expiry at {site}")
+    if kind == "oom":
+        return RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory at {site} "
+            f"(attempting to allocate 128.00G)")
+    if kind == "mosaic":
+        return RuntimeError(
+            f"Mosaic failed to compile the injected kernel at {site}")
+    if kind == "runtime":
+        return RuntimeError(f"injected engine runtime failure at {site}")
+    raise ValueError(f"unknown fault kind {kind!r}")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault: what to raise and how many calls it covers."""
+
+    kind: str
+    times: int = 1          # remaining trigger count; ALWAYS = unbounded
+    exc: Optional[Exception] = None   # overrides the canned exception
+    fired: int = 0          # how often it actually triggered
+
+
+_LOCK = threading.Lock()
+_ACTIVE: Dict[str, FaultSpec] = {}
+_env_loaded = False
+
+
+def _load_env_locked() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    raw = os.environ.get(_FAULTS_ENV, "")
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        # every malformation is warn-and-ignore: a typo in a fault spec
+        # must not kill the production run at some random hook site
+        try:
+            parts = item.split(":")
+            if len(parts) not in (2, 3):
+                raise ValueError("want site:kind[:times]")
+            site, kind = parts[0].strip(), parts[1].strip()
+            times = 1
+            if len(parts) == 3:
+                times = ALWAYS if parts[2].strip() == "*" \
+                    else int(parts[2])
+            _canned(kind, site)  # validate the kind at arm time
+        except (ValueError, TypeError) as e:
+            import sys
+
+            print(f"splatt-tpu: bad {_FAULTS_ENV} entry {item!r} "
+                  f"({e}); ignored", file=sys.stderr)
+            continue
+        _ACTIVE[site] = FaultSpec(kind=kind, times=times)
+
+
+def _take(site: str) -> Optional[FaultSpec]:
+    """Claim one firing of the fault armed at `site`, if any."""
+    with _LOCK:
+        _load_env_locked()
+        spec = _ACTIVE.get(site)
+        if spec is None or spec.times == 0:
+            return None
+        if spec.times != ALWAYS:
+            spec.times -= 1
+        spec.fired += 1
+        return spec
+
+
+def maybe_fail(site: str) -> None:
+    """Production hook: raise the armed fault for `site`, if any.
+    A no-op (one dict lookup) when nothing is armed."""
+    spec = _take(site)
+    if spec is not None:
+        raise spec.exc if spec.exc is not None else _canned(spec.kind, site)
+
+
+def consume(site: str) -> bool:
+    """Production hook for non-raising faults (e.g. torn writes): True
+    when a fault was armed at `site` (and claims one firing)."""
+    return _take(site) is not None
+
+
+def active(site: str) -> bool:
+    """Whether a fault is currently armed at `site` (no claim)."""
+    with _LOCK:
+        _load_env_locked()
+        spec = _ACTIVE.get(site)
+        return spec is not None and spec.times != 0
+
+
+@contextlib.contextmanager
+def inject(site: str, kind: str = "runtime", times: int = 1,
+           exc: Optional[Exception] = None):
+    """Arm a fault at `site` for the duration of the block (tests).
+    `times` bounds how many calls trigger (ALWAYS = every call); `exc`
+    substitutes a custom exception for the canned one."""
+    if exc is None:
+        _canned(kind, site)  # validate early
+    spec = FaultSpec(kind=kind, times=times, exc=exc)
+    with _LOCK:
+        _load_env_locked()
+        prev = _ACTIVE.get(site)
+        _ACTIVE[site] = spec
+    try:
+        yield spec
+    finally:
+        with _LOCK:
+            if prev is None:
+                _ACTIVE.pop(site, None)
+            else:
+                _ACTIVE[site] = prev
+
+
+def reset() -> None:
+    """Disarm everything and forget the env parse (tests)."""
+    global _env_loaded
+    with _LOCK:
+        _ACTIVE.clear()
+        _env_loaded = False

@@ -4,17 +4,27 @@ A probe's verdict depends only on (jax version, device kind, kernel,
 regime, block), so it is cached on disk and reused by later processes —
 a chip window is spent measuring, not re-proving what the previous
 session stage already paid a remote compile for.  The contract under
-test: proven verdicts ("ok"/"compile_failed") short-circuit the probe,
-"timeout" is recorded but always retried, and cache IO failures never
-break dispatch.
+test (the probe-cache lifecycle of the resilience layer): proven
+verdicts ("ok"/"compile_failed"/"resource") short-circuit the probe,
+"timeout"/"infra" are recorded but always retried, transient failures
+are retried in-place with backoff and NEVER persisted as a rejection,
+entries expire after a TTL, and cache IO failures never break dispatch.
 """
 
 import json
+import time
 
 import jax
 import pytest
 
 import splatt_tpu.ops.pallas_kernels as pk
+from splatt_tpu import resilience
+
+
+@pytest.fixture(autouse=True)
+def _fast_backoff(monkeypatch):
+    """Transient-retry backoff must not slow the suite down."""
+    monkeypatch.setattr(resilience.time, "sleep", lambda s: None)
 
 
 @pytest.fixture()
@@ -99,13 +109,134 @@ def test_infra_error_is_retried_not_inherited(cache_file, fake_tpu,
     monkeypatch.setattr(pk, "_probe_case", flaky)
     # a transient service failure is NOT a kernel rejection
     assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
-    assert pk.PROBE_STATES["testk:ck1:b4096"] == "infra_error"
-    assert pk.probe_cache_load("testk:ck1:b4096") == "infra_error"
+    assert pk.PROBE_STATES["testk:ck1:b4096"] == "infra"
+    assert pk.probe_cache_load("testk:ck1:b4096") == "infra"
     # the next process re-probes and can prove the kernel fine
     _states({})
     monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
     assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
     assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+
+
+def test_transient_500_retried_in_place_then_proven(cache_file, fake_tpu,
+                                                    monkeypatch):
+    """A transient HTTP 500 is retried with backoff INSIDE the probe:
+    when the relay recovers within the retry budget, the verdict is
+    proven in this very process — no demotion at all."""
+    _states({})
+    calls = []
+
+    def flaky_then_ok(fn, regime, block):
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("XLA compile: HTTP code 500 from relay")
+        return True
+
+    monkeypatch.setattr(pk, "_probe_case", flaky_then_ok)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+    assert len(calls) == 3
+    assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+
+
+def test_transient_500_never_persisted_as_compile_failed(cache_file,
+                                                         fake_tpu,
+                                                         monkeypatch):
+    """ADVICE.md medium: one wedged-relay 500 must NOT demote the
+    flagship engine for every future session.  Retries exhausted →
+    'infra' (re-probed next process); the on-disk cache must contain
+    no 'compile_failed' entry."""
+    _states({})
+
+    def always_500(fn, regime, block):
+        raise RuntimeError("XLA compile: HTTP code 500 from relay")
+
+    monkeypatch.setattr(pk, "_probe_case", always_500)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.PROBE_STATES["testk:ck1:b4096"] == "infra"
+    assert "compile_failed" not in cache_file.read_text()
+    # bare INTERNAL: is transient too (no Mosaic co-marker)
+    _states({})
+
+    def always_internal(fn, regime, block):
+        raise RuntimeError("INTERNAL: relay stream reset")
+
+    monkeypatch.setattr(pk, "_probe_case", always_internal)
+    assert pk._probe_compiles(None, "testk2", "ck1", 4096) is False
+    assert "compile_failed" not in cache_file.read_text()
+    # the next process re-probes and can prove the kernels fine
+    _states({})
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+
+
+def test_internal_mosaic_co_marker_is_deterministic(cache_file, fake_tpu,
+                                                    monkeypatch):
+    """'INTERNAL: Mosaic failed ...' carries a real compiler signature:
+    the transient INTERNAL: prefix must not launder it into a retry —
+    it persists as a proven rejection."""
+    _states({})
+
+    def mosaic_internal(fn, regime, block):
+        raise RuntimeError("INTERNAL: Mosaic failed to lower the kernel")
+
+    monkeypatch.setattr(pk, "_probe_case", mosaic_internal)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.probe_cache_load("testk:ck1:b4096") == "compile_failed"
+
+
+def test_resource_verdict_is_shape_scoped_and_persisted(cache_file,
+                                                        fake_tpu,
+                                                        monkeypatch):
+    """An OOM is capacity, not capability: persisted as 'resource' for
+    THIS (regime, block) shape only — other shapes keep probing."""
+    _states({})
+
+    def oom(fn, regime, block):
+        raise RuntimeError("RESOURCE_EXHAUSTED: attempting to allocate 9G")
+
+    monkeypatch.setattr(pk, "_probe_case", oom)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    assert pk.probe_cache_load("testk:ck1:b4096") == "resource"
+    # the verdict short-circuits the next process for the same shape
+    _states({})
+
+    def boom(fn, regime, block):
+        raise AssertionError("probe must not run on a cached resource "
+                             "verdict")
+
+    monkeypatch.setattr(pk, "_probe_case", boom)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
+    # ... but a DIFFERENT shape still probes
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    assert pk._probe_compiles(None, "testk", "ck1", 128) is True
+
+
+def test_ttl_expiry_reprobes(cache_file, fake_tpu, monkeypatch):
+    """Even a proven verdict expires after the TTL: infrastructure
+    drifts under a fixed env key, so stale rejections (and stale OKs)
+    are re-earned instead of trusted forever."""
+    _states({})
+    pk.probe_cache_store("testk:ck1:b4096", "compile_failed")
+    # age the entry past the TTL
+    data = json.loads(cache_file.read_text())
+    for env in data.values():
+        env["testk:ck1:b4096"]["ts"] = (
+            time.time() - pk.probe_cache_ttl() - 1)
+    cache_file.write_text(json.dumps(data))
+    assert pk.probe_cache_load("testk:ck1:b4096") is None
+    monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
+    assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+    assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+
+
+def test_ttl_env_override(cache_file, monkeypatch):
+    _states({})
+    pk.probe_cache_store("testk:ck1:b4096", "ok")
+    monkeypatch.setenv(pk._CACHE_TTL_ENV, "0.0")
+    # TTL <= 0 disables expiry entirely
+    assert pk.probe_cache_load("testk:ck1:b4096") == "ok"
+    monkeypatch.setenv(pk._CACHE_TTL_ENV, "1e-9")
+    assert pk.probe_cache_load("testk:ck1:b4096") is None
 
 
 def test_kernel_edit_invalidates_cache(cache_file, fake_tpu, monkeypatch):
@@ -143,7 +274,7 @@ def test_unrecognized_error_is_not_persisted_as_rejection(cache_file,
 
     monkeypatch.setattr(pk, "_probe_case", weird)
     assert pk._probe_compiles(None, "testk", "ck1", 4096) is False
-    assert pk.probe_cache_load("testk:ck1:b4096") == "infra_error"
+    assert pk.probe_cache_load("testk:ck1:b4096") == "infra"
     _states({})
     monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
     assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
