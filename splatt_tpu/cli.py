@@ -467,14 +467,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # initializes: site plugins may pre-register an accelerator backend
     # programmatically, which ignores the env var (bench.py does the
     # same; ≙ the reference CLI honoring its environment unconditionally).
-    import os as _os
-    p = _os.environ.get("JAX_PLATFORMS")
-    if p:
-        import jax
-        try:
-            jax.config.update("jax_platforms", p)
-        except Exception:
-            pass
+    # A config-update failure is classified and logged by the helper —
+    # it used to be swallowed here, losing the error entirely.
+    apply_env_platform()
     args = build_parser().parse_args(argv)
     if getattr(args, "rank", 1) < 1:
         print(f"splatt-tpu: error: rank must be >= 1 (got {args.rank})",

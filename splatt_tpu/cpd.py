@@ -482,26 +482,6 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     last_check_it = start_it
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
-        # runtime graceful degradation: a sweep-level failure (an engine
-        # dying at outer-jit compile time, or an async runtime failure
-        # surfacing at the next sync) demotes the implicated engine and
-        # retries THIS iteration on a rebuilt sweep — the run degrades
-        # to the next engine in the chain instead of crashing.  Failures
-        # inside mttkrp_blocked's own dispatch are already handled one
-        # level down; this catches what escapes it.
-        rescue_attempts = 0
-        while True:
-            try:
-                factors, grams, lam, znormsq, inner = sweep(
-                    factors, grams, it == 0)
-                break
-            except Exception as e:
-                rescue_attempts += 1
-                if (rescue_attempts > 6
-                        or not _try_engine_rescue(X, opts, e)):
-                    raise
-                sweep = build_sweep()
-        fit = _fit(xnormsq, znormsq, inner)
         # fetch the fit to host only at check iterations: on remote/
         # tunneled devices each fetch is a costly sync, and k sweeps
         # queue back-to-back between checks (k=1 ≙ the reference).
@@ -511,11 +491,40 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                           and (it + 1) % checkpoint_every == 0)
         check = ((it + 1) % k == 0 or it + 1 == opts.max_iterations
                  or checkpoint_due)
+        # runtime graceful degradation: a sweep-level failure (an engine
+        # dying at outer-jit compile time, or an async runtime failure
+        # surfacing at the next sync) demotes the implicated engine and
+        # retries THIS iteration on a rebuilt sweep — the run degrades
+        # to the next engine in the chain instead of crashing.  Failures
+        # inside mttkrp_blocked's own dispatch are already handled one
+        # level down; this catches what escapes it.  The host fetch of
+        # the fit is where ASYNC device failures actually surface, so
+        # it lives INSIDE the rescued scope — and the sweep outputs are
+        # committed to factors/grams only after it succeeds, so a
+        # rescued retry re-runs from the pre-sweep state instead of
+        # carrying a failed program's poisoned outputs forward.  (On a
+        # deferred iteration — fit_check_every > 1, no sync — an async
+        # failure can still land one iteration late; that is the
+        # documented trade of batching host syncs.)
+        rescue_attempts = 0
+        while True:
+            try:
+                f_new, g_new, lam_new, znormsq, inner = sweep(
+                    factors, grams, it == 0)
+                fit = _fit(xnormsq, znormsq, inner)
+                fitval = float(fit) if check else None
+                break
+            except Exception as e:
+                rescue_attempts += 1
+                if (rescue_attempts > 6
+                        or not _try_engine_rescue(X, opts, e)):
+                    raise
+                sweep = build_sweep()
+        factors, grams, lam = f_new, g_new, lam_new
         if not check:
             if opts.verbosity >= Verbosity.HIGH:
                 print(f"  its = {it + 1:3d} (deferred fit check)")
             continue
-        fitval = float(fit)
         elapsed = time.perf_counter() - t0
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"

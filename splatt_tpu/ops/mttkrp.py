@@ -26,7 +26,6 @@ p_is_privatized :221-236) is static at trace time.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 from typing import List, NamedTuple, Optional, Sequence, Union
 
@@ -37,6 +36,7 @@ import numpy as np
 from splatt_tpu.blocked import BlockedSparse, ModeLayout
 from splatt_tpu.config import Options
 from splatt_tpu.coo import SparseTensor
+from splatt_tpu.utils.env import read_env, read_env_int
 
 PATHS = ("stream", "sorted_onehot", "privatized", "scatter", "sorted_scatter")
 
@@ -143,14 +143,7 @@ def mttkrp_ttbox(inds: jax.Array, vals: jax.Array,
 #: the fallback's main tuning knob (more = fewer, bigger fused steps).
 #: Env-overridable so the hardware tuning sweep (tools/tpu_tune.py) can
 #: measure it; the default matches the round-2/3 measured configs.
-try:
-    _SCAN_TARGET = int(os.environ.get("SPLATT_SCAN_TARGET_ELEMS", 1 << 23))
-except ValueError:
-    import sys as _sys
-
-    print("splatt-tpu: bad SPLATT_SCAN_TARGET_ELEMS (want an int); "
-          "using the default", file=_sys.stderr)
-    _SCAN_TARGET = 1 << 23
+_SCAN_TARGET = read_env_int("SPLATT_SCAN_TARGET_ELEMS")
 
 
 def _block_chunks(nblocks: int, elems_per_block: int,
@@ -268,20 +261,36 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
         scan_target = _SCAN_TARGET
     if fallback is None:
         fallback = resilience.fallback_enabled()
-    chain = engine_chain(layout, factors, mode, path, impl)
-    shape_key = _engine_shape_key(layout, factors, mode)
-    interpret = impl == "pallas_interpret"
+    # regime/shape_key are computed ONCE per dispatch and threaded
+    # through the chain build — this runs once per mode per sweep
+    # iteration, and the three consumers must agree on the regime
     regime = _chain_regime(layout, factors, mode)
+    shape_key = _engine_shape_key(layout, factors, mode, regime=regime)
+    chain = engine_chain(layout, factors, mode, path, impl,
+                         shape_key=shape_key)
+    interpret = impl == "pallas_interpret"
     last = len(chain) - 1
     for i, engine in enumerate(chain):
         if i < last and not _engine_probed_ok(engine, regime, layout.block,
                                               interpret):
             continue
-        try:
-            resilience.note_engine_attempt(engine, shape_key)
+
+        def attempt(engine=engine):
             faults.maybe_fail(f"engine.{engine}")
             return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
                                        scan_target, engine)
+
+        try:
+            resilience.note_engine_attempt(engine, shape_key)
+            # TRANSIENT failures (a remote-compile relay hiccuping on
+            # this engine's first jit) are retried in place with capped
+            # backoff per the taxonomy contract — without this, one
+            # transient HTTP 500 at compile time would demote the
+            # flagship engine for the whole run, the PR 1 bug class at
+            # run scope.  Deterministic/resource/unknown failures
+            # propagate immediately to the demotion below.
+            return resilience.retry_transient(attempt,
+                                              label=f"engine.{engine}")
         except Exception as e:
             if not fallback or i == last:
                 raise
@@ -408,11 +417,16 @@ def _chain_regime(layout: ModeLayout, factors: Sequence[jax.Array],
 
 
 def _engine_shape_key(layout: ModeLayout, factors: Sequence[jax.Array],
-                      mode: int) -> str:
+                      mode: int, regime: Optional[str] = None) -> str:
     """Demotion scope for RESOURCE failures — the same (regime, block)
     granularity the capability probes use, so an OOM at one shape never
-    demotes the engine for shapes that fit."""
-    return f"{_chain_regime(layout, factors, mode)}:b{layout.block}"
+    demotes the engine for shapes that fit.  The single owner of the
+    key format: demotions recorded at dispatch and the chain pruning in
+    engine_plan must agree on it.  `regime` skips recomputation when
+    the caller already classified the call."""
+    if regime is None:
+        regime = _chain_regime(layout, factors, mode)
+    return f"{regime}:b{layout.block}"
 
 
 def _engine_probed_ok(engine: str, regime: str, block: int,
@@ -439,8 +453,8 @@ def _engine_probed_ok(engine: str, regime: str, block: int,
 
 
 def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
-                 path: str = "sorted_onehot", impl: str = "xla"
-                 ) -> List[str]:
+                 path: str = "sorted_onehot", impl: str = "xla",
+                 *, shape_key: Optional[str] = None) -> List[str]:
     """The ORDERED engine fallback chain for this call: every engine
     whose cheap gates (VMEM plan, HBM budget, runtime demotions) pass,
     best first — fused Pallas (fused_t → fused_tg → experimental fused)
@@ -468,7 +482,8 @@ def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
         width = -(-(dim + 1) // 8) * 8
     else:
         width = layout.seg_width
-    shape_key = _engine_shape_key(layout, factors, mode)
+    if shape_key is None:
+        shape_key = _engine_shape_key(layout, factors, mode)
 
     def live(name):
         return not resilience.is_demoted(name, shape_key)
@@ -485,7 +500,7 @@ def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
     # of the production dispatch order — no probe slot, no session time
     # — unless explicitly re-enabled for a future jax version.  Its
     # math stays covered by the interpret-mode tests.
-    if pallas and os.environ.get("SPLATT_EXPERIMENTAL_FUSED") == "1" \
+    if pallas and read_env("SPLATT_EXPERIMENTAL_FUSED") == "1" \
             and live("fused") and fused_vmem_ok(factors, mode, width, B):
         chain.append("fused")
     if (pallas and live("unfused_pallas")

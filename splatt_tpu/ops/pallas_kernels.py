@@ -48,6 +48,8 @@ _VMEM_BY_KIND = {"TPU v2": 16 << 20, "TPU v3": 16 << 20}
 def _vmem_limit() -> int:
     try:
         kind = jax.devices()[0].device_kind
+    # splint: ignore[SPL002] device discovery off-accelerator: no
+    # backend means "unknown kind", which selects the generic budget
     except Exception:
         kind = ""
     for prefix, size in _VMEM_BY_KIND.items():
@@ -564,31 +566,23 @@ PROBE_STATES: dict = {}
 
 _CACHE_ENV = "SPLATT_PROBE_CACHE"
 _CACHE_TTL_ENV = "SPLATT_PROBE_CACHE_TTL_S"
-_CACHE_TTL_DEFAULT_S = 14 * 24 * 3600.0
+# the default TTL (14 days) lives in utils/env.py:ENV_VARS — the
+# single registry the docs and the SPL007 check read
 
 
 def probe_cache_ttl() -> float:
     """Seconds a cached verdict stays fresh (<= 0 disables expiry)."""
-    import os
+    from splatt_tpu.utils.env import read_env_float
 
-    raw = os.environ.get(_CACHE_TTL_ENV)
-    if not raw:
-        return _CACHE_TTL_DEFAULT_S
-    try:
-        return float(raw)
-    except ValueError:
-        import sys
-
-        print(f"splatt-tpu: bad {_CACHE_TTL_ENV} (want seconds); using "
-              f"the default", file=sys.stderr)
-        return _CACHE_TTL_DEFAULT_S
+    return read_env_float(_CACHE_TTL_ENV)
 
 
 def _cache_path():
-    import os
     import pathlib
 
-    p = os.environ.get(_CACHE_ENV)
+    from splatt_tpu.utils.env import read_env
+
+    p = read_env(_CACHE_ENV)
     if p:
         return pathlib.Path(p)
     root = pathlib.Path(__file__).resolve().parents[2]
@@ -620,6 +614,8 @@ def _kernel_src_hash() -> str:
                     pkg / "utils" / "env.py"):
             h.update(src.read_bytes())
         return h.hexdigest()[:12]
+    # splint: ignore[SPL002] sources unreadable (zipped/frozen install):
+    # the sentinel keys one shared cache namespace, a safe degradation
     except Exception:
         return "nosrc"
 
@@ -627,15 +623,35 @@ def _kernel_src_hash() -> str:
 def _cache_env_key() -> str:
     try:
         kind = jax.devices()[0].device_kind
+    # splint: ignore[SPL002] device discovery off-accelerator: the
+    # cache key degrades to a shared "unknown" namespace
     except Exception:
         kind = "unknown"
     try:
         import jaxlib
 
         jl = getattr(jaxlib, "__version__", "?")
+    # splint: ignore[SPL002] optional-package version probe: absence
+    # is a legitimate environment, encoded as "?" in the cache key
     except Exception:
         jl = "?"
     return f"{jax.__version__}|jaxlib{jl}|{kind}|{_kernel_src_hash()}"
+
+
+def _cache_io_error(op: str, exc) -> None:
+    """Report a probe-cache IO failure through the failure taxonomy.
+
+    Cache IO stays best-effort by contract (a broken cache must never
+    break dispatch), but the failure used to vanish in a bare
+    ``except`` — a cache silently losing every verdict re-spends a
+    ~35 s remote compile per kernel per process, which is exactly the
+    silent degradation the run report exists to surface."""
+    from splatt_tpu import resilience
+
+    resilience.run_report().add(
+        "probe_cache_io_error", op=op,
+        failure_class=resilience.classify_failure(exc).value,
+        error=resilience.failure_message(exc)[:200])
 
 
 def probe_cache_load(state_key: str):
@@ -651,6 +667,12 @@ def probe_cache_load(state_key: str):
     try:
         with open(_cache_path()) as f:
             data = json.load(f)
+    except FileNotFoundError:
+        return None  # first run in this environment: nothing cached yet
+    except Exception as e:
+        _cache_io_error("load", e)
+        return None
+    try:
         entry = data.get(_cache_env_key(), {}).get(state_key)
         if not entry:
             return None
@@ -658,7 +680,10 @@ def probe_cache_load(state_key: str):
         if ttl > 0 and time.time() - float(entry.get("ts", 0)) > ttl:
             return None
         return entry["state"]
-    except Exception:
+    except Exception as e:
+        # a malformed entry (hand-edited file, schema drift) is an
+        # unusable verdict, not a dispatch failure: report and re-probe
+        _cache_io_error("load", e)
         return None
 
 
@@ -683,7 +708,12 @@ def probe_cache_store(state_key: str, state: str) -> None:
             try:
                 with open(path) as f:
                     data = json.load(f)
-            except Exception:
+            except FileNotFoundError:
+                data = {}  # first write creates the file
+            except Exception as e:
+                # unreadable/corrupt cache: replaced wholesale below —
+                # reported, because it drops every other kernel's verdict
+                _cache_io_error("store", e)
                 data = {}
             env = data.setdefault(_cache_env_key(), {})
             env[state_key] = {"state": state, "ts": time.time()}
@@ -698,8 +728,10 @@ def probe_cache_store(state_key: str, state: str) -> None:
                 except OSError:
                     pass
                 raise
-    except Exception:
-        pass
+    except Exception as e:
+        # best-effort by contract (cache IO must never break dispatch):
+        # degrade to an uncached probe, but say so in the run report
+        _cache_io_error("store", e)
 
 
 #: representative probe shapes per lane-chunk regime.  "ck1": the

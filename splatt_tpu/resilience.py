@@ -46,7 +46,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import os
 import random
 import time
 from typing import Callable, Dict, List, Optional
@@ -259,7 +258,9 @@ def fallback_enabled() -> bool:
     silent rescue."""
     if _fallback_override is not None:
         return _fallback_override
-    return os.environ.get(_FALLBACK_ENV, "1").lower() not in (
+    from splatt_tpu.utils.env import read_env
+
+    return str(read_env(_FALLBACK_ENV)).lower() not in (
         "0", "off", "false", "no")
 
 

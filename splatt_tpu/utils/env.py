@@ -1,8 +1,126 @@
-"""Environment/platform helpers shared by entry points."""
+"""Environment/platform helpers shared by entry points.
+
+This module is additionally the single place process environment is
+read from (`splint` rule SPL001 enforces it): every environment
+variable the project consumes is declared once in :data:`ENV_VARS`
+(name → default → doc) and read through :func:`read_env` /
+:func:`read_env_int` / :func:`read_env_float`.  Centralizing the reads
+matters beyond tidiness — this file feeds the probe cache's
+`_kernel_src_hash`, so an env-plumbing change invalidates cached
+capability verdicts instead of silently desynchronizing from them, and
+the registry is what keeps the docs (docs/resilience.md, DESIGN.md)
+and the SPL007 documentation check from drifting against the code.
+"""
 
 from __future__ import annotations
 
 import os
+import sys
+from typing import NamedTuple, Optional
+
+
+class EnvVar(NamedTuple):
+    """One declared environment variable: its default (None = unset)
+    and a one-line doc string (the authoritative documentation — docs
+    reference this registry instead of hand-listing variables)."""
+
+    default: Optional[object]
+    doc: str
+
+
+#: Every environment variable the project reads, name → (default, doc).
+#: `splint` rule SPL007 statically checks each SPLATT_* reference in
+#: the code against this table; `python -m tools.splint --env-docs`
+#: renders it for the docs.
+ENV_VARS = {
+    "JAX_PLATFORMS": EnvVar(None, "standard JAX platform selection; "
+                            "mirrored into jax.config by "
+                            "apply_env_platform() so it beats site "
+                            "plugins that pick a backend at startup"),
+    "SPLATT_ENGINE_FALLBACK": EnvVar("1", "runtime MTTKRP engine "
+                                     "fallback (docs/resilience.md); "
+                                     "0/off/false/no = fail loudly"),
+    "SPLATT_SCAN_TARGET_ELEMS": EnvVar(1 << 23, "one-hot elements "
+                                       "materialized per scan step of "
+                                       "the xla_scan MTTKRP engine"),
+    "SPLATT_EXPERIMENTAL_FUSED": EnvVar(None, "1 re-enables the "
+                                        "experimental row-major fused "
+                                        "Pallas kernel in the engine "
+                                        "chain (known-unlowerable on "
+                                        "current Mosaic)"),
+    "SPLATT_FAULTS": EnvVar("", "comma-separated fault-arming specs "
+                            "site:kind[:times] for the fault-injection "
+                            "harness (utils/faults.py)"),
+    "SPLATT_PROBE_CACHE": EnvVar(None, "path override for the "
+                                 "persistent capability-probe cache "
+                                 "(default: tools/probe_cache.json in "
+                                 "a repo checkout)"),
+    "SPLATT_PROBE_CACHE_TTL_S": EnvVar(14 * 24 * 3600.0, "seconds a "
+                                       "cached probe verdict stays "
+                                       "fresh; <= 0 disables expiry"),
+    # repo-root bench.py driver knobs (documented here; bench.py is a
+    # standalone script outside the package's SPL001 scope)
+    "SPLATT_BENCH_NNZ": EnvVar(None, "bench.py: synthetic tensor "
+                               "nonzero count (per-driver default)"),
+    "SPLATT_BENCH_RANK": EnvVar(None, "bench.py: CPD rank "
+                                "(per-driver default)"),
+    "SPLATT_BENCH_ITERS": EnvVar(3, "bench.py: timed iterations"),
+    "SPLATT_BENCH_DTYPE": EnvVar("float32", "bench.py: compute dtype"),
+    "SPLATT_BENCH_SHAPE": EnvVar("nell2", "bench.py: named tensor "
+                                 "shape or IxJxK"),
+    "SPLATT_BENCH_PATHS": EnvVar(None, "bench.py: comma-separated "
+                                 "MTTKRP paths to time"),
+    "SPLATT_BENCH_ENGINE": EnvVar("auto", "bench.py: force one "
+                                  "reduction engine"),
+    "SPLATT_BENCH_ALLOC": EnvVar("allmode", "bench.py: BlockAlloc "
+                                 "layout policy"),
+    "SPLATT_BENCH_JIT": EnvVar("auto", "bench.py: sweep jit mode"),
+    "SPLATT_BENCH_DEVICES": EnvVar(None, "bench.py: comma-separated "
+                                   "device counts for the scaling "
+                                   "sweep"),
+    "SPLATT_SCALING_CHILD": EnvVar(None, "bench.py internal: marks a "
+                                   "scaling-sweep child process"),
+}
+
+
+def read_env(name: str) -> Optional[object]:
+    """Read a declared environment variable: the process value when
+    set, the registered default otherwise.  Unregistered names raise —
+    an undeclared variable is exactly the drift SPL007 exists to stop,
+    so the runtime accessor enforces the same contract loudly."""
+    spec = ENV_VARS.get(name)
+    if spec is None:
+        raise KeyError(
+            f"environment variable {name!r} is not declared in "
+            f"splatt_tpu.utils.env.ENV_VARS; register it (with a doc "
+            f"string) before reading it")
+    raw = os.environ.get(name)
+    return spec.default if raw is None else raw
+
+
+def _read_env_parsed(name: str, parse, kind: str):
+    """Shared warn-and-default parse: a malformed value degrades to
+    the registered default with one stderr line instead of killing the
+    process at some random read site."""
+    val = read_env(name)
+    if isinstance(val, str):
+        try:
+            return parse(val)
+        except (TypeError, ValueError):
+            print(f"splatt-tpu: bad {name}={val!r} (want {kind}); "
+                  f"using the default", file=sys.stderr)
+            return ENV_VARS[name].default
+    return val
+
+
+def read_env_int(name: str) -> Optional[int]:
+    """:func:`read_env` + int parse (warn-and-default on bad values)."""
+    return _read_env_parsed(name, int, "an int")
+
+
+def read_env_float(name: str) -> Optional[float]:
+    """:func:`read_env` + float parse (warn-and-default on bad values)."""
+    return _read_env_parsed(name, float, "a float")
 
 
 def ceil_to(x: int, mult: int) -> int:
@@ -76,11 +194,25 @@ def apply_env_platform() -> None:
     the JAX_PLATFORMS env var.  Calling this before any backend
     initializes makes the env var authoritative again.
     """
-    platforms = os.environ.get("JAX_PLATFORMS")
+    platforms = read_env("JAX_PLATFORMS")
     if platforms:
         import jax
 
         try:
             jax.config.update("jax_platforms", platforms)
-        except Exception:
-            pass
+        except Exception as e:
+            # Losing the platform pin silently was the PR 1 bug class:
+            # the run continues (jax may still honor the env var on its
+            # own), but the failure is classified and reported so a
+            # CPU-pinned test run that lands on the TPU is explainable.
+            from splatt_tpu import resilience
+
+            cls = resilience.classify_failure(e)
+            resilience.run_report().add(
+                "env_platform_error", platforms=platforms,
+                failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
+            print(f"splatt-tpu: WARNING: could not mirror "
+                  f"JAX_PLATFORMS={platforms} into jax.config "
+                  f"({cls.value}: {e}); the env var may still apply",
+                  file=sys.stderr)

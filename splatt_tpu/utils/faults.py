@@ -52,7 +52,6 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import os
 import threading
 from typing import Dict, Optional
 
@@ -60,6 +59,27 @@ _FAULTS_ENV = "SPLATT_FAULTS"
 
 #: times value meaning "every call"
 ALWAYS = -1
+
+#: The declared fault sites of the production code, site → doc.  A
+#: trailing ``.*`` marks a dynamic family (the production call passes
+#: an f-string with that prefix).  This registry is load-bearing, not
+#: documentation-only: `splint` rule SPL006 checks that every site
+#: string the production code passes to :func:`maybe_fail` /
+#: :func:`consume` is declared here, that every declared site is still
+#: called somewhere, and that every declared site is exercised by at
+#: least one test — so a renamed hook cannot silently orphan the
+#: resilience path it was built to exercise.  (Tests may arm ad-hoc
+#: sites to test the harness itself; those need no declaration.)
+SITES = {
+    "probe_compile": "the capability-probe remote compile "
+                     "(ops/pallas_kernels.py)",
+    "engine.*": "an MTTKRP dispatch engine at call time, e.g. "
+                "engine.fused_t / engine.xla_scan (ops/mttkrp.py)",
+    "checkpoint_write": "raise during the checkpoint save (cpd.py)",
+    "checkpoint_torn": "consumed (not raised): the writer truncates "
+                       "the bytes it just wrote, simulating a torn "
+                       "write (cpd.py)",
+}
 
 
 def _canned(kind: str, site: str) -> Exception:
@@ -107,7 +127,9 @@ def _load_env_locked() -> None:
     if _env_loaded:
         return
     _env_loaded = True
-    raw = os.environ.get(_FAULTS_ENV, "")
+    from splatt_tpu.utils.env import read_env
+
+    raw = read_env(_FAULTS_ENV)
     for item in raw.split(","):
         item = item.strip()
         if not item:

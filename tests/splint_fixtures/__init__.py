@@ -1,0 +1,6 @@
+"""Fixture snippets for the splint rule tests (tests/test_splint.py).
+
+One known-bad and one known-good example per rule id.  These files are
+PARSED by the analyzer, never imported — they reference modules and
+names that may not resolve at runtime on purpose.
+"""

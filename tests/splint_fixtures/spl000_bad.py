@@ -1,0 +1,6 @@
+"""SPL000 bad: an ignore pragma with no reason (the escape hatch
+requires a justification)."""
+
+import jax.numpy as jnp
+
+A = jnp.zeros(4, jnp.float32)  # splint: ignore[SPL005]

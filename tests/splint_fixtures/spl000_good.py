@@ -1,0 +1,9 @@
+"""SPL000 good: justified pragmas, inline and full-line."""
+
+import jax.numpy as jnp
+
+A = jnp.zeros(4, jnp.float32)  # splint: ignore[SPL005] fixture constant
+
+# splint: ignore[SPL005] full-line pragma with a reason covers the
+# next code line, multi-line justification comments included
+B = jnp.ones(4, jnp.float64)

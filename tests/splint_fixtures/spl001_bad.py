@@ -1,0 +1,10 @@
+"""SPL001 bad: raw os.environ access outside utils/env.py."""
+
+import os
+from os import environ, getenv
+
+A = os.environ.get("SPLATT_ENGINE_FALLBACK", "1")
+B = os.environ["SPLATT_ENGINE_FALLBACK"]
+C = os.getenv("SPLATT_ENGINE_FALLBACK")
+D = environ.get("SPLATT_ENGINE_FALLBACK")
+E = getenv("SPLATT_ENGINE_FALLBACK")

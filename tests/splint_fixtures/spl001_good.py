@@ -1,0 +1,6 @@
+"""SPL001 good: reads go through the sanctioned accessor."""
+
+from splatt_tpu.utils.env import read_env, read_env_int
+
+A = read_env("SPLATT_ENGINE_FALLBACK")
+B = read_env_int("SPLATT_SCAN_TARGET_ELEMS")

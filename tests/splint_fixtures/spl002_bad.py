@@ -1,0 +1,22 @@
+"""SPL002 bad: broad excepts that lose the failure class entirely."""
+
+
+def swallow_and_default(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        pass
+
+
+def swallow_tuple(fn):
+    try:
+        return fn()
+    except (ValueError, Exception):
+        return 0

@@ -1,0 +1,35 @@
+"""SPL002 good: broad excepts that classify, re-raise, or are justified."""
+
+from splatt_tpu import resilience
+
+
+def classified(fn):
+    try:
+        return fn()
+    except Exception as e:
+        cls = resilience.classify_failure(e)
+        resilience.run_report().add("fixture", failure_class=cls.value)
+        return None
+
+
+def reraises(fn):
+    try:
+        return fn()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (ValueError, OSError):
+        return None
+
+
+def justified(fn):
+    try:
+        return fn()
+    # splint: ignore[SPL002] fixture: absence of the optional module is
+    # the signal, not a failure
+    except Exception:
+        return None

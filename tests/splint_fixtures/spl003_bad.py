@@ -1,0 +1,24 @@
+"""SPL003 bad: host-device syncs inside traced/hot code."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def sync_in_jit(x):
+    jax.block_until_ready(x)
+    host = np.asarray(x)
+    return host
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def item_in_jit(x, mode):
+    scale = x[0].item()
+    return jax.device_get(x) if mode else x * scale
+
+
+def hot_sweep(x):
+    # flagged only when configured as a hot function
+    return np.asarray(x)

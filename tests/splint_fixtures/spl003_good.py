@@ -1,0 +1,16 @@
+"""SPL003 good: device-side work stays device-side; syncs live at the
+un-traced sweep boundary."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_device(x):
+    return jnp.asarray(x) * 2.0  # jnp.asarray is device-side, not a sync
+
+
+def driver(x):
+    out = pure_device(x)
+    jax.block_until_ready(out)  # outside any traced function: fine
+    return float(out[0])

@@ -1,0 +1,22 @@
+"""SPL004 bad: Python control flow on non-static jit arguments."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def branch_on_array(x, mode):
+    if x > 0:  # x is traced: retrace per value or TracerBoolConversionError
+        return jnp.sqrt(x)
+    return x
+
+
+@jax.jit
+def loop_on_arg(n):
+    total = 0
+    while n:  # n is not static: recompiles per value
+        total += n
+        n -= 1
+    return total

@@ -1,0 +1,23 @@
+"""SPL004 good: branches on static args, device-side selects, and
+structural (is None) checks."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode", "n"))
+def branch_on_static(x, mode, n):
+    if mode == "fused" and n > 2:  # both static: one trace per config
+        return jnp.sqrt(x)
+    return x
+
+
+@jax.jit
+def select_on_device(x, y):
+    if y is None:  # pytree structure: static by construction
+        return x
+    if x.ndim == 2:  # shape metadata: static at trace time
+        return jnp.where(x > 0, x, -x) + y
+    return x + y

@@ -1,0 +1,11 @@
+"""SPL005 bad: dtype literals outside config.py."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def make(x):
+    a = jnp.zeros((4, 4), jnp.float32)
+    b = np.zeros(4, dtype=np.float64)
+    c = x.astype(jnp.bfloat16)
+    return a, b, c

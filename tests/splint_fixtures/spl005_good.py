@@ -1,0 +1,13 @@
+"""SPL005 good: dtypes resolved through the central policy or derived
+from inputs."""
+
+import jax.numpy as jnp
+
+from splatt_tpu.config import resolve_dtype
+
+
+def make(x, opts):
+    dtype = resolve_dtype(opts, x.dtype)
+    a = jnp.zeros((4, 4), dtype)
+    b = jnp.zeros(4, dtype=x.dtype)
+    return a, b

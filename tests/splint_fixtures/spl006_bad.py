@@ -1,0 +1,12 @@
+"""SPL006 bad: a fault site the SITES registry never declared."""
+
+from splatt_tpu.utils import faults
+
+
+def risky_write():
+    faults.maybe_fail("spl006_fixture_undeclared_site")
+
+
+def risky_dispatch(engine):
+    # dynamic family with an undeclared prefix
+    faults.maybe_fail(f"spl006_fixture_family.{engine}")

@@ -1,0 +1,11 @@
+"""SPL006 good: only declared fault sites (utils/faults.py:SITES)."""
+
+from splatt_tpu.utils import faults
+
+
+def risky_write():
+    faults.maybe_fail("checkpoint_write")
+
+
+def risky_dispatch(engine):
+    faults.maybe_fail(f"engine.{engine}")

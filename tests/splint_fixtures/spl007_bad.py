@@ -1,0 +1,8 @@
+"""SPL007 bad: SPLATT_* env vars read but not declared in ENV_VARS."""
+
+from splatt_tpu.utils.env import read_env
+
+_KNOB_ENV = "SPLATT_FIXTURE_UNDECLARED_TOO"
+
+A = read_env("SPLATT_FIXTURE_UNDECLARED")
+B = read_env(_KNOB_ENV)

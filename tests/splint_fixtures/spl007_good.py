@@ -1,0 +1,8 @@
+"""SPL007 good: every referenced SPLATT_* var is declared in ENV_VARS."""
+
+from splatt_tpu.utils.env import read_env, read_env_float
+
+_TTL_ENV = "SPLATT_PROBE_CACHE_TTL_S"
+
+A = read_env("SPLATT_ENGINE_FALLBACK")
+B = read_env_float(_TTL_ENV)

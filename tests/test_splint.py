@@ -1,0 +1,274 @@
+"""splint — the project-native static-analysis pass (tools/splint).
+
+Tier-1 wiring: the analyzer runs over splatt_tpu/ and the build fails
+on any non-baselined finding, so the dispatch/resilience/recompilation
+invariants (docs/static-analysis.md) are machine-checked on every test
+run, not re-litigated in review.  Per-rule fixtures under
+tests/splint_fixtures/ pin each rule's detection with one known-bad
+and one known-good example.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "splint_fixtures"
+
+sys.path.insert(0, str(REPO))  # `tools` is importable from the root
+
+from tools.splint import (Config, load_baseline, load_config, run,  # noqa: E402
+                          update_baseline)
+from tools.splint.config import _parse_table  # noqa: E402
+
+
+def _cfg(**overrides) -> Config:
+    cfg = load_config(REPO)
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def _rule_findings(report, rule: str, relpath: str):
+    return [f for f in report.findings
+            if f.rule == rule and f.path == relpath]
+
+
+# -- the tier-1 gate --------------------------------------------------------
+
+def test_package_has_zero_nonbaselined_findings():
+    """The acceptance invariant: splint over splatt_tpu/ is clean
+    modulo the justified baseline."""
+    baseline = load_baseline(REPO / "tools" / "splint" / "baseline.json")
+    report = run(_cfg(), baseline=baseline)
+    msg = "\n".join(f"{f.path}:{f.line}: {f.rule} {f.message}"
+                    for f in report.new)
+    assert report.ok, f"new splint findings:\n{msg}"
+
+
+def test_spl001_and_spl002_counts_are_zero():
+    """The PR's burn-down commitment: raw env access and classless
+    broad excepts are fixed in code, not grandfathered."""
+    report = run(_cfg(), baseline={})
+    by_rule = {}
+    for f in report.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert not by_rule.get("SPL001"), by_rule.get("SPL001")
+    assert not by_rule.get("SPL002"), by_rule.get("SPL002")
+
+
+def test_baseline_entries_are_justified():
+    baseline = load_baseline(REPO / "tools" / "splint" / "baseline.json")
+    assert baseline, "baseline should hold the grandfathered groups"
+    for key, entry in baseline.items():
+        reason = entry.get("reason", "")
+        assert reason and not reason.startswith("UNJUSTIFIED"), \
+            f"baseline entry {key} lacks a human-written reason"
+        assert entry["count"] > 0, f"stale baseline entry {key}"
+
+
+def test_baseline_has_no_stale_or_overcounted_entries():
+    """Every baseline entry matches reality: no stale groups (0
+    findings) and no padded counts (fewer findings than baselined) —
+    the ledger may only record what the code actually contains."""
+    baseline = load_baseline(REPO / "tools" / "splint" / "baseline.json")
+    report = run(_cfg(), baseline=baseline)
+    assert not report.stale, f"stale baseline entries: {report.stale}"
+    assert not report.shrunk, \
+        f"baseline counts exceed current findings: {report.shrunk}"
+
+
+# -- per-rule fixtures ------------------------------------------------------
+
+RULE_IDS = ["SPL000", "SPL001", "SPL002", "SPL003", "SPL004", "SPL005",
+            "SPL006", "SPL007"]
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_flags_bad_fixture(rule):
+    rel = f"tests/splint_fixtures/{rule.lower()}_bad.py"
+    report = run(_cfg(paths=[rel]), baseline={})
+    assert _rule_findings(report, rule, rel), \
+        f"{rule} found nothing in its known-bad fixture"
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_passes_good_fixture(rule):
+    rel = f"tests/splint_fixtures/{rule.lower()}_good.py"
+    report = run(_cfg(paths=[rel]), baseline={})
+    hits = _rule_findings(report, rule, rel)
+    assert not hits, f"{rule} false positives: " + "\n".join(
+        f"{f.path}:{f.line} {f.message}" for f in hits)
+
+
+def test_good_fixtures_are_fully_clean():
+    """The good fixtures are clean under EVERY rule, not only their
+    own (cross-rule noise in an exemplar would teach the wrong idiom)."""
+    rels = [f"tests/splint_fixtures/{r.lower()}_good.py"
+            for r in RULE_IDS]
+    report = run(_cfg(paths=rels), baseline={})
+    hits = [f for f in report.findings if f.path in rels]
+    assert not hits, "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in hits)
+
+
+def test_hot_function_config_extends_spl003():
+    rel = "tests/splint_fixtures/spl003_bad.py"
+    plain = run(_cfg(paths=[rel]), baseline={})
+    assert not any(f.line == 24 for f in
+                   _rule_findings(plain, "SPL003", rel))
+    hot = run(_cfg(paths=[rel],
+                   hot_functions=[f"{rel}::hot_sweep"]), baseline={})
+    assert any("hot path" in f.message for f in
+               _rule_findings(hot, "SPL003", rel))
+
+
+# -- pragma / baseline workflow --------------------------------------------
+
+def test_reasonless_pragma_is_spl000_and_still_suppresses():
+    rel = "tests/splint_fixtures/spl000_bad.py"
+    report = run(_cfg(paths=[rel]), baseline={})
+    assert _rule_findings(report, "SPL000", rel)
+    assert not _rule_findings(report, "SPL005", rel)
+    assert report.suppressed == 1
+
+
+def test_baseline_workflow_roundtrip(tmp_path):
+    """update-baseline grandfathers today's findings; a new violation
+    fails; burning one down is detected as shrinkage."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    mod = pkg / "m.py"
+    mod.write_text("import jax.numpy as jnp\n"
+                   "A = jnp.zeros(2, jnp.float32)\n"
+                   "B = jnp.zeros(2, jnp.float64)\n")
+    cfg = Config(root=tmp_path, paths=["pkg"])
+    bl_path = tmp_path / "baseline.json"
+
+    first = run(cfg, baseline={})
+    assert len(first.findings) == 2 and not first.ok
+    entries = update_baseline(bl_path, first)
+    assert entries["SPL005:pkg/m.py"]["count"] == 2
+    assert "UNJUSTIFIED" in entries["SPL005:pkg/m.py"]["reason"]
+
+    clean = run(cfg, baseline=load_baseline(bl_path))
+    assert clean.ok and len(clean.findings) == 2
+
+    mod.write_text(mod.read_text()
+                   + "C = jnp.zeros(2, jnp.bfloat16)\n")
+    over = run(cfg, baseline=load_baseline(bl_path))
+    assert not over.ok and len(over.new) == 3  # whole group surfaces
+
+    mod.write_text("import jax.numpy as jnp\n"
+                   "A = jnp.zeros(2, jnp.float32)\n")
+    shrunk = run(cfg, baseline=load_baseline(bl_path))
+    assert shrunk.ok and shrunk.shrunk["SPL005:pkg/m.py"] == (1, 2)
+    # reasons survive a baseline rewrite
+    entries["SPL005:pkg/m.py"]["reason"] = "fixture justification"
+    bl_path.write_text(json.dumps({"version": 1, "entries": entries}))
+    rewritten = update_baseline(bl_path, shrunk)
+    assert rewritten["SPL005:pkg/m.py"] == {
+        "count": 1, "reason": "fixture justification"}
+
+
+def test_spl006_declaration_drift(tmp_path):
+    """Both drift directions: a declared-but-never-called site and a
+    declared-but-untested site are findings at the registry."""
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "prod.py").write_text(
+        "from pkg import faults\n"
+        "faults.maybe_fail('used_site')\n")
+    faults_mod = tmp_path / "pkg" / "faults.py"
+    faults_mod.write_text(
+        "SITES = {'used_site': 'doc', 'dead_site': 'doc'}\n"
+        "def maybe_fail(site): ...\n")
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_x.py").write_text(
+        "from pkg import faults\n"
+        "def test_x():\n    faults.maybe_fail('other')\n")
+    cfg = Config(root=tmp_path, paths=["pkg"],
+                 faults_module="pkg/faults.py", tests_path="tests")
+    report = run(cfg, baseline={})
+    msgs = [f.message for f in report.findings if f.rule == "SPL006"]
+    assert any("dead_site" in m and "no production call" in m
+               for m in msgs)
+    assert any("used_site" in m and "not exercised" in m for m in msgs)
+    # exercising + calling both sites clears the drift
+    (tdir / "test_x.py").write_text(
+        "from pkg import faults\n"
+        "def test_x():\n"
+        "    faults.maybe_fail('used_site')\n"
+        "    faults.maybe_fail('dead_site')\n")
+    (tmp_path / "pkg" / "prod.py").write_text(
+        "from pkg import faults\n"
+        "faults.maybe_fail('used_site')\n"
+        "faults.maybe_fail('dead_site')\n")
+    assert not [f for f in run(cfg, baseline={}).findings
+                if f.rule == "SPL006"]
+
+
+# -- entry points stay in lockstep ------------------------------------------
+
+def test_cli_json_matches_pytest_wiring():
+    """`python -m tools.splint --json` (the CLI/CI entry) agrees with
+    the in-process run the tests use."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.splint", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    baseline = load_baseline(REPO / "tools" / "splint" / "baseline.json")
+    report = run(_cfg(), baseline=baseline)
+    assert len(payload["findings"]) == len(report.findings)
+
+
+def test_cli_focus_analyzes_full_tree():
+    """Positional paths focus the report only: no false SPL006 drift
+    from a partial view, and a focused --update-baseline still rewrites
+    from the full tree instead of destroying unanalyzed files' entries."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.splint", "splatt_tpu/ops"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no production call" not in proc.stdout
+    assert "focused on splatt_tpu/ops" in proc.stdout
+
+
+def test_cli_focused_update_baseline_keeps_all_groups(tmp_path):
+    bl = tmp_path / "bl.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.splint", "splatt_tpu/ops",
+         "--baseline", str(bl), "--update-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    repo_groups = set(load_baseline(
+        REPO / "tools" / "splint" / "baseline.json"))
+    assert set(load_baseline(bl)) == repo_groups
+
+
+def test_env_docs_render():
+    from tools.splint.__main__ import _env_docs
+
+    table = _env_docs(_cfg())
+    assert "SPLATT_ENGINE_FALLBACK" in table
+    assert "SPLATT_PROBE_CACHE_TTL_S" in table
+    assert "| variable |" in table
+
+
+def test_pyproject_table_parser():
+    text = ('[tool.other]\nx = 1\n[tool.splint]\npaths = ["a",\n'
+            '  "b"]\nbaseline = "bl.json"\n[tool.after]\ny = 2\n')
+    table = _parse_table(text, "tool.splint")
+    assert table == {"paths": ["a", "b"], "baseline": "bl.json"}
+
+
+def test_config_matches_pyproject():
+    cfg = load_config(REPO)
+    assert cfg.paths == ["splatt_tpu"]
+    assert cfg.resolve(cfg.baseline).exists()
+    assert "_cache_io_error" in cfg.resilience_routers

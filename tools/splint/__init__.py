@@ -1,0 +1,39 @@
+"""splint — the project-native static-analysis pass.
+
+An AST-based analyzer (stdlib only, no new dependencies) that enforces
+the code-shape invariants this codebase's resilience and dispatch
+layers depend on — properties no behavioral test can catch, because
+the hazard is what the code *would* do on the day the infrastructure
+misbehaves (PR 1 existed because one broad ``except Exception``
+persisted a transient HTTP 500 as a permanent engine demotion).
+
+Rules (see docs/static-analysis.md for the full catalog):
+
+- SPL000 — splint usage errors (malformed/reasonless ignore pragmas,
+  unparseable files)
+- SPL001 — raw ``os.environ`` access outside ``utils/env.py``
+- SPL002 — ``except Exception`` that swallows the failure class
+- SPL003 — host-device sync inside jitted functions / hot paths
+- SPL004 — recompilation hazards (Python branches on non-static jit args)
+- SPL005 — dtype literals outside ``config.py``
+- SPL006 — fault-site drift against ``utils/faults.py:SITES``
+- SPL007 — undocumented ``SPLATT_*`` environment variables
+
+Escape hatch: ``# splint: ignore[SPL002] <reason>`` on the flagged
+line (inline) or as a full-line comment directly above it; the reason
+is mandatory.  Grandfathered findings live in a checked-in baseline
+(``tools/splint/baseline.json``) so new violations fail while old ones
+burn down.
+
+Run: ``python -m tools.splint [--json]``; configured via
+``[tool.splint]`` in pyproject.toml; wired into tier-1 by
+``tests/test_splint.py``.
+"""
+
+from tools.splint.config import Config, load_config
+from tools.splint.core import (Finding, Report, load_baseline, run,
+                               update_baseline)
+from tools.splint.rules import RULES
+
+__all__ = ["Config", "Finding", "Report", "RULES", "load_baseline",
+           "load_config", "run", "update_baseline"]

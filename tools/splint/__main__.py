@@ -1,0 +1,171 @@
+"""``python -m tools.splint`` — the splint command-line front end.
+
+Runs identically to the pytest wiring (tests/test_splint.py) and any
+future CI job: same Config, same rules, same baseline reconciliation.
+
+Exit codes: 0 = no non-baselined findings; 1 = new findings; 2 = usage
+or configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+from pathlib import Path
+
+from tools.splint.config import load_config
+from tools.splint.core import load_baseline, run, update_baseline
+from tools.splint.rules import RULES
+
+
+def _env_docs(config) -> str:
+    """Render the ENV_VARS registry as a markdown table — statically,
+    so docs can be regenerated without importing the package (or jax)."""
+    path = config.resolve(config.env_module)
+    tree = ast.parse(path.read_text())
+    rows = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "ENV_VARS"
+                and isinstance(node.value, ast.Dict)):
+            for k, v in zip(node.value.keys, node.value.values):
+                name = k.value if isinstance(k, ast.Constant) else "?"
+                default, doc = "", ""
+                if isinstance(v, ast.Call) and v.args:
+                    default = ast.unparse(v.args[0])
+                    if len(v.args) > 1 and isinstance(v.args[1],
+                                                      ast.Constant):
+                        doc = v.args[1].value
+                rows.append((name, default, doc))
+    out = ["| variable | default | meaning |",
+           "|----------|---------|---------|"]
+    for name, default, doc in rows:
+        out.append(f"| `{name}` | `{default}` | {doc} |")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.splint",
+        description="project-native static analysis "
+                    "(docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="focus the REPORT on these files/dirs. The "
+                         "whole [tool.splint] tree is always analyzed "
+                         "(cross-file rules like SPL006 need the full "
+                         "picture); findings outside the focus are "
+                         "hidden and do not affect the exit code")
+    ap.add_argument("--root", default=".",
+                    help="project root holding pyproject.toml")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: [tool.splint] "
+                         "baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(reasons are preserved)")
+    ap.add_argument("--env-docs", action="store_true",
+                    help="print the ENV_VARS registry as markdown and "
+                         "exit")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        config = load_config(Path(args.root))
+    except ValueError as e:
+        print(f"splint: {e}", file=sys.stderr)
+        return 2
+    # positional paths FOCUS the report; they never shrink the analyzed
+    # tree — a partial analysis would feed cross-file rules (SPL006's
+    # "declared site has no production call") a factually wrong world,
+    # and --update-baseline would destroy entries for unanalyzed files
+    focus = [_norm_focus(config, p) for p in args.paths]
+
+    if args.list_rules:
+        print("SPL000  splint usage errors (malformed/reasonless "
+              "pragmas, unparseable files)")
+        for rule in RULES:
+            print(f"{rule.id}  {rule.title}")
+        return 0
+    if args.env_docs:
+        print(_env_docs(config))
+        return 0
+
+    baseline_path = config.resolve(args.baseline or config.baseline)
+    try:
+        baseline = ({} if args.no_baseline
+                    else load_baseline(baseline_path))
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"splint: bad baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+
+    report = run(config, baseline=baseline)
+
+    if args.update_baseline:
+        # always from the full analyzed tree, never a focused subset
+        entries = update_baseline(baseline_path, report)
+        print(f"splint: baseline {baseline_path} rewritten: "
+              f"{len(entries)} group(s), "
+              f"{sum(e['count'] for e in entries.values())} finding(s)")
+        return 0
+
+    def in_focus(f):
+        return not focus or any(f.path == p or f.path.startswith(p + "/")
+                                for p in focus)
+
+    shown = [f for f in report.findings if in_focus(f)]
+    new = [f for f in report.new if in_focus(f)]
+    ok = not new
+
+    if args.as_json:
+        new_keys = {id(f) for f in new}
+        print(json.dumps({
+            "ok": ok,
+            "findings": [f.as_dict(baselined=id(f) not in new_keys)
+                         for f in shown],
+            "suppressed": report.suppressed,
+            "stale_baseline": report.stale,
+            "shrunk_baseline": {k: {"found": a, "baselined": b}
+                                for k, (a, b) in report.shrunk.items()},
+        }, indent=1))
+        return 0 if ok else 1
+
+    for f in new:
+        print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        if f.hint:
+            print(f"    hint: {f.hint}")
+    print(f"splint: {len(new)} new finding(s), {len(shown) - len(new)} "
+          f"baselined, {report.suppressed} suppressed by pragma"
+          + (f" (report focused on {', '.join(focus)})" if focus else ""))
+    for key, (found, allowed) in sorted(report.shrunk.items()):
+        print(f"splint: baseline shrank: {key} {found} < {allowed} — "
+              f"run --update-baseline to lock in the burn-down")
+    for key in report.stale:
+        print(f"splint: stale baseline entry {key} (0 findings) — "
+              f"run --update-baseline to drop it")
+    return 0 if ok else 1
+
+
+def _norm_focus(config, p: str) -> str:
+    """Normalize a focus argument to the repo-relative posix form
+    findings use."""
+    path = Path(p)
+    if not path.is_absolute():
+        path = Path(config.root) / p
+    try:
+        return path.resolve().relative_to(
+            Path(config.root).resolve()).as_posix()
+    except ValueError:
+        return Path(p).as_posix()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

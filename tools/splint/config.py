@@ -1,0 +1,111 @@
+"""splint configuration: the ``[tool.splint]`` table of pyproject.toml.
+
+Python 3.10 has no ``tomllib`` and splint must not grow dependencies,
+so a minimal single-table parser lives here: it understands exactly the
+value shapes the splint table uses (strings, string arrays — including
+multiline arrays) and nothing more.  The same :class:`Config` object is
+what tests construct directly to point the analyzer at fixture
+mini-projects, so the analyzer runs identically from pytest, the CLI,
+and any future CI job.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class Config:
+    """Where splint looks and which project modules anchor its rules."""
+
+    #: project root every relative path below resolves against
+    root: Path
+    #: files/directories to analyze (relative to root)
+    paths: List[str] = dataclasses.field(
+        default_factory=lambda: ["splatt_tpu"])
+    #: the checked-in baseline of grandfathered findings
+    baseline: str = "tools/splint/baseline.json"
+    #: the single sanctioned env-access module (SPL001 exemption,
+    #: SPL007's ENV_VARS registry)
+    env_module: str = "splatt_tpu/utils/env.py"
+    #: the fault-injection harness declaring SITES (SPL006)
+    faults_module: str = "splatt_tpu/utils/faults.py"
+    #: the dtype-policy module (SPL005 exemption)
+    config_module: str = "splatt_tpu/config.py"
+    #: test tree scanned for exercised fault sites (SPL006)
+    tests_path: str = "tests"
+    #: non-jitted hot-path functions ("relpath::name") that get the
+    #: SPL003 host-sync scan as if they were jitted
+    hot_functions: List[str] = dataclasses.field(default_factory=list)
+    #: extra handler-body names SPL002 accepts as routing the failure
+    #: (project helpers that wrap resilience.classify_failure)
+    resilience_routers: List[str] = dataclasses.field(default_factory=list)
+    #: path fragments to skip entirely
+    exclude: List[str] = dataclasses.field(default_factory=list)
+
+    def resolve(self, rel: str) -> Path:
+        return (self.root / rel).resolve()
+
+
+_KEY_RE = re.compile(r"^\s*([A-Za-z0-9_-]+)\s*=\s*(.*)$")
+
+
+def _parse_table(text: str, table: str) -> dict:
+    """Parse one ``[table]`` of a TOML file into a dict.
+
+    Handles the subset splint uses: ``key = "string"`` and
+    ``key = ["a", "b", ...]`` (arrays may span lines).  TOML string and
+    array literals in this subset are also valid Python literals, so
+    ``ast.literal_eval`` does the value parsing.
+    """
+    lines = text.splitlines()
+    out: dict = {}
+    in_table = False
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("["):
+            in_table = line == f"[{table}]"
+            continue
+        if not in_table or not line or line.startswith("#"):
+            continue
+        m = _KEY_RE.match(line)
+        if not m:
+            raise ValueError(f"splint: cannot parse pyproject line: {line!r}")
+        key, val = m.group(1), m.group(2)
+        # accumulate a multiline array until brackets balance
+        while val.count("[") > val.count("]"):
+            if i >= len(lines):
+                raise ValueError(
+                    f"splint: unterminated array for {key!r} in [{table}]")
+            val += " " + lines[i].strip()
+            i += 1
+        try:
+            out[key.replace("-", "_")] = ast.literal_eval(val)
+        except (SyntaxError, ValueError) as e:
+            raise ValueError(
+                f"splint: unsupported value for {key!r} in [{table}] "
+                f"(splint's mini-parser takes strings and string arrays, "
+                f"no end-of-line comments): {val!r} ({e})") from e
+    return out
+
+
+def load_config(root: Optional[Path] = None) -> Config:
+    """Build a :class:`Config` from ``<root>/pyproject.toml``'s
+    ``[tool.splint]`` table (missing file/table → defaults)."""
+    root = Path(root) if root is not None else Path.cwd()
+    cfg = Config(root=root)
+    pp = root / "pyproject.toml"
+    if not pp.exists():
+        return cfg
+    table = _parse_table(pp.read_text(), "tool.splint")
+    for key, val in table.items():
+        if not hasattr(cfg, key):
+            raise ValueError(f"splint: unknown [tool.splint] key {key!r}")
+        setattr(cfg, key, val)
+    return cfg

@@ -1,0 +1,365 @@
+"""splint core: file model, ignore pragmas, baseline, and the run loop.
+
+The analyzer is deliberately pure — stdlib ``ast`` + ``tokenize``, no
+imports of the analyzed package (importing ``splatt_tpu`` would pull
+jax into every lint run and couple the checker to a working runtime).
+Everything a rule needs — module alias maps, dotted-name resolution,
+declared registries — is derived statically from source.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from tools.splint.config import Config
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str        # repo-relative, posix separators
+    line: int
+    message: str
+    hint: str = ""
+
+    @property
+    def key(self) -> str:
+        """Baseline grouping key.  Deliberately line-free: baselines
+        keyed on line numbers churn on every unrelated edit; keying on
+        (rule, file) with a count makes the baseline a burn-down
+        ledger instead of a merge-conflict generator."""
+        return f"{self.rule}:{self.path}"
+
+    def as_dict(self, baselined: bool) -> dict:
+        return dict(rule=self.rule, path=self.path, line=self.line,
+                    message=self.message, hint=self.hint,
+                    baselined=baselined)
+
+
+@dataclasses.dataclass
+class Report:
+    """Outcome of one analyzer run over a project."""
+
+    findings: List[Finding]            # all unsuppressed findings
+    new: List[Finding]                 # findings over baseline budget
+    suppressed: int                    # inline-pragma suppressions
+    stale: List[str]                   # baseline keys with 0 findings
+    shrunk: Dict[str, Tuple[int, int]]  # key -> (found, allowed), found<allowed
+
+    @property
+    def ok(self) -> bool:
+        return not self.new
+
+
+# -- ignore pragmas ---------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r"#\s*splint:\s*ignore\[\s*([A-Z0-9,\s]+?)\s*\]\s*(.*)$")
+_PRAGMA_HINT_RE = re.compile(r"#\s*splint\s*:")
+
+
+class Ignores:
+    """Per-file map of ``# splint: ignore[RULES] reason`` pragmas.
+
+    An inline pragma applies to its own line; a full-line comment
+    pragma applies to the next non-blank, non-comment line (so a
+    multi-line justification comment still covers the code below it).
+    """
+
+    def __init__(self, source: str):
+        #: target line -> (set of rule ids, reason, pragma line)
+        self.targets: Dict[int, Tuple[set, str, int]] = {}
+        #: pragma parse problems -> SPL000 findings
+        self.errors: List[Tuple[int, str]] = []
+        lines = source.splitlines()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # the file-level parse error is reported elsewhere
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if not m:
+                if _PRAGMA_HINT_RE.search(tok.string) and \
+                        "ignore" in tok.string:
+                    self.errors.append(
+                        (tok.start[0],
+                         "malformed splint pragma (want "
+                         "'# splint: ignore[RULE] reason')"))
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            row, col = tok.start
+            full_line = lines[row - 1][:col].strip() == ""
+            target = row
+            if full_line:
+                # skip over blank/comment lines (incl. the pragma's own
+                # continuation comments) to the code line below
+                t = row
+                while t < len(lines):
+                    nxt = lines[t].strip()
+                    if nxt and not nxt.startswith("#"):
+                        target = t + 1
+                        break
+                    t += 1
+            prev = self.targets.get(target)
+            if prev:
+                rules = rules | prev[0]
+                reason = reason or prev[1]
+            self.targets[target] = (rules, reason, row)
+
+    def suppresses(self, finding: Finding) -> Optional[Tuple[str, int]]:
+        """(reason, pragma_line) when `finding` is pragma-suppressed."""
+        entry = self.targets.get(finding.line)
+        if entry and finding.rule in entry[0]:
+            return entry[1], entry[2]
+        return None
+
+
+# -- file / project model ---------------------------------------------------
+
+class FileCtx:
+    """One analyzed source file: path, AST, alias map, pragmas."""
+
+    def __init__(self, path: Path, relpath: str, source: str,
+                 tree: ast.AST):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.ignores = Ignores(source)
+        self._aliases: Optional[Dict[str, str]] = None
+        self._consts: Optional[Dict[str, str]] = None
+
+    @property
+    def aliases(self) -> Dict[str, str]:
+        """name -> dotted module/object it is bound to, from imports
+        (``import numpy as np`` -> {'np': 'numpy'}; ``from jax import
+        numpy as jnp`` -> {'jnp': 'jax.numpy'})."""
+        if self._aliases is None:
+            amap: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        amap[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0])
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        amap[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+            self._aliases = amap
+        return self._aliases
+
+    @property
+    def str_consts(self) -> Dict[str, str]:
+        """Simple module/function-level ``NAME = "literal"`` bindings —
+        lets rules resolve ``read_env(_CACHE_ENV)`` to its value."""
+        if self._consts is None:
+            consts: Dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    consts[node.targets[0].id] = node.value.value
+            self._consts = consts
+        return self._consts
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of an expression through the alias map:
+        ``np.asarray`` -> 'numpy.asarray', ``os.environ.get`` ->
+        'os.environ.get'.  None for non-name expressions."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        return ".".join([base] + list(reversed(parts)))
+
+
+class Project:
+    """Cross-file state shared by the rules during one run."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.files: List[FileCtx] = []
+        self.parse_errors: List[Finding] = []
+        self._extra: Dict[str, Optional[FileCtx]] = {}
+
+    def ctx_for(self, rel: str) -> Optional[FileCtx]:
+        """FileCtx for a project module that may live outside the
+        analyzed paths (env/faults modules, test files)."""
+        for ctx in self.files:
+            if ctx.relpath == rel:
+                return ctx
+        if rel not in self._extra:
+            path = self.config.resolve(rel)
+            # a registry module a mini-project simply doesn't have is
+            # "nothing declared", not a parse error
+            self._extra[rel] = (_load_file(path, rel, self.parse_errors)
+                                if path.is_file() else None)
+        return self._extra[rel]
+
+    def test_ctxs(self) -> List[FileCtx]:
+        tests_root = self.config.resolve(self.config.tests_path)
+        out = []
+        if tests_root.is_dir():
+            for p in sorted(tests_root.rglob("*.py")):
+                rel = _relpath(p, self.config.root)
+                # splint's own rule fixtures arm deliberately-bogus
+                # sites; they must not count as "exercised by a test"
+                if "splint_fixtures" in rel:
+                    continue
+                ctx = self.ctx_for(rel)
+                if ctx is not None:
+                    out.append(ctx)
+        return out
+
+
+def _relpath(p: Path, root: Path) -> str:
+    try:
+        return p.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def _load_file(path: Path, rel: str,
+               errors: List[Finding]) -> Optional[FileCtx]:
+    try:
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError, ValueError) as e:
+        errors.append(Finding(
+            "SPL000", rel, getattr(e, "lineno", None) or 1,
+            f"cannot analyze file: {type(e).__name__}: {e}"))
+        return None
+    return FileCtx(path, rel, source, tree)
+
+
+def collect_files(config: Config) -> List[Path]:
+    out: List[Path] = []
+    for entry in config.paths:
+        p = config.resolve(entry)
+        if p.is_file():
+            out.append(p)
+        elif p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+    return [p for p in out
+            if not any(x in _relpath(p, config.root)
+                       for x in config.exclude)]
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Path) -> Dict[str, dict]:
+    """Baseline entries: ``{"RULE:path": {"count": N, "reason": ...}}``."""
+    if not path.exists():
+        return {}
+    data = json.loads(path.read_text())
+    entries = data.get("entries", {})
+    for key, entry in entries.items():
+        if "count" not in entry:
+            raise ValueError(f"splint baseline entry {key!r} has no count")
+    return entries
+
+
+def update_baseline(path: Path, report: Report) -> Dict[str, dict]:
+    """Rewrite the baseline from the current findings, preserving the
+    reasons of surviving entries.  Newly grandfathered groups get an
+    UNJUSTIFIED placeholder — tests refuse a baseline containing one,
+    so every grandfathered entry carries a human-written reason."""
+    old = load_baseline(path) if path.exists() else {}
+    groups: Dict[str, int] = {}
+    for f in report.findings:
+        groups[f.key] = groups.get(f.key, 0) + 1
+    entries = {}
+    for key in sorted(groups):
+        reason = old.get(key, {}).get(
+            "reason", "UNJUSTIFIED: justify this grandfathered group "
+                      "or fix the findings")
+        entries[key] = {"count": groups[key], "reason": reason}
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(
+        {"comment": "splint grandfathered findings — burn this down; "
+                    "regenerate with python -m tools.splint "
+                    "--update-baseline (reasons are preserved)",
+         "version": 1, "entries": entries}, indent=1, sort_keys=True)
+        + "\n")
+    return entries
+
+
+# -- run loop ---------------------------------------------------------------
+
+def run(config: Config, baseline: Optional[Dict[str, dict]] = None,
+        rules=None) -> Report:
+    """Analyze the configured paths and reconcile against `baseline`."""
+    from tools.splint.rules import RULES
+
+    rules = RULES if rules is None else rules
+    project = Project(config)
+    for path in collect_files(config):
+        rel = _relpath(path, config.root)
+        ctx = _load_file(path, rel, project.parse_errors)
+        if ctx is not None:
+            project.files.append(ctx)
+
+    raw: List[Finding] = list(project.parse_errors)
+    for rule in rules:
+        for ctx in project.files:
+            raw.extend(rule.check(ctx, project))
+    for rule in rules:
+        raw.extend(rule.finalize(project))
+
+    findings: List[Finding] = []
+    suppressed = 0
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        ctx = next((c for c in project.files if c.relpath == f.path), None)
+        hit = ctx.ignores.suppresses(f) if ctx else None
+        if hit is not None:
+            suppressed += 1
+            reason, pragma_line = hit
+            if not reason:
+                findings.append(Finding(
+                    "SPL000", f.path, pragma_line,
+                    f"ignore pragma for {f.rule} has no reason — the "
+                    f"escape hatch requires a justification"))
+            continue
+        findings.append(f)
+    # pragma syntax problems surface even when nothing was suppressed
+    for ctx in project.files:
+        for line, msg in ctx.ignores.errors:
+            findings.append(Finding("SPL000", ctx.relpath, line, msg))
+
+    baseline = baseline or {}
+    groups: Dict[str, List[Finding]] = {}
+    for f in findings:
+        groups.setdefault(f.key, []).append(f)
+    new: List[Finding] = []
+    shrunk: Dict[str, Tuple[int, int]] = {}
+    for key, group in sorted(groups.items()):
+        allowed = int(baseline.get(key, {}).get("count", 0))
+        if len(group) > allowed:
+            if allowed:
+                for f in group:
+                    f.message += (f" [group {key}: {len(group)} found > "
+                                  f"{allowed} baselined]")
+            new.extend(group)
+        elif len(group) < allowed:
+            shrunk[key] = (len(group), allowed)
+    stale = sorted(k for k in baseline if k not in groups)
+    return Report(findings=findings, new=new, suppressed=suppressed,
+                  stale=stale, shrunk=shrunk)

@@ -1,0 +1,502 @@
+"""The splint rule catalog — each rule encodes a project invariant.
+
+Every rule here is grounded in a real hazard this codebase has already
+paid for (see docs/static-analysis.md for the war stories): these are
+code-shape properties — what the code *would* do when infrastructure
+misbehaves — which is exactly what behavioral tests cannot catch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.splint.core import FileCtx, Finding, Project
+
+#: handler-body names accepted as "routing the failure through the
+#: taxonomy" — the resilience module's public verbs.  Projects add
+#: their own wrappers via [tool.splint] resilience-routers.
+RESILIENCE_ROUTERS = {
+    "classify_failure", "demote_engine", "retry_transient",
+    "run_report", "failure_message",
+}
+
+_DTYPE_LITERALS = {"float32", "float64", "bfloat16", "float16"}
+_DTYPE_MODULES = {"numpy", "jax.numpy"}
+_SYNC_JAX = {"jax.block_until_ready", "jax.device_get"}
+_NP_HOST = {"numpy.asarray", "numpy.array"}
+_FAULT_FNS = {"maybe_fail", "consume", "active", "inject"}
+_ENV_READ_FNS = {"read_env", "read_env_int", "read_env_float"}
+
+
+class Rule:
+    id = "SPL?"
+    title = ""
+    hint = ""
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+    def finding(self, ctx_or_path, line: int, message: str) -> Finding:
+        path = (ctx_or_path.relpath if isinstance(ctx_or_path, FileCtx)
+                else ctx_or_path)
+        return Finding(self.id, path, line, message, hint=self.hint)
+
+
+# -- SPL001 -----------------------------------------------------------------
+
+class RawEnvironAccess(Rule):
+    """Raw ``os.environ`` access outside the sanctioned env module.
+
+    Every env read outside ``utils/env.py`` bypasses the ENV_VARS
+    registry (so the variable escapes documentation and SPL007), and —
+    because env.py feeds the probe cache's ``_kernel_src_hash`` — can
+    change dispatch-relevant behavior without invalidating cached
+    capability verdicts."""
+
+    id = "SPL001"
+    title = "raw os.environ access outside utils/env.py"
+    hint = ("read through splatt_tpu.utils.env.read_env/read_env_int/"
+            "read_env_float and declare the variable in ENV_VARS")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        if ctx.relpath == project.config.env_module:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            dotted = None
+            if isinstance(node, ast.Attribute):
+                dotted = ctx.resolve(node)
+            elif isinstance(node, ast.Name):
+                dotted = ctx.aliases.get(node.id)
+            if dotted in ("os.environ", "os.getenv", "os.putenv"):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"raw {dotted} access bypasses the ENV_VARS "
+                    f"registry in {project.config.env_module}"))
+        return _dedupe(out)
+
+
+# -- SPL002 -----------------------------------------------------------------
+
+class BroadExceptSwallows(Rule):
+    """``except Exception`` that neither re-raises nor routes the error
+    through the failure taxonomy.  The PR 1 bug class: one broad except
+    swallowed a transient HTTP 500 and persisted it as a permanent
+    engine demotion."""
+
+    id = "SPL002"
+    title = "except Exception swallows the failure class"
+    hint = ("classify via resilience.classify_failure (or demote_engine/"
+            "retry_transient/run_report), re-raise, or add a justified "
+            "'# splint: ignore[SPL002] <reason>'")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        routers = RESILIENCE_ROUTERS | set(
+            project.config.resilience_routers)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            names: Set[str] = set()
+            reraises = False
+            for sub in node.body:
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Raise):
+                        reraises = True
+                    elif isinstance(n, ast.Name):
+                        names.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        names.add(n.attr)
+            if reraises or names & routers:
+                continue
+            out.append(self.finding(
+                ctx, node.lineno,
+                "broad except swallows the error without classifying "
+                "it — a transient infra failure and a real bug become "
+                "indistinguishable here"))
+        return out
+
+    @staticmethod
+    def _is_broad(type_node) -> bool:
+        if type_node is None:
+            return True  # bare except
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        return any(isinstance(n, ast.Name)
+                   and n.id in ("Exception", "BaseException")
+                   for n in nodes)
+
+
+# -- jit helpers (SPL003 / SPL004) ------------------------------------------
+
+def _jit_static_names(ctx: FileCtx,
+                      fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """The static argnames of a jit-decorated function, or None when
+    the function is not jitted.  Handles ``@jax.jit``,
+    ``@jax.jit(...)`` and ``@partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        call = dec if isinstance(dec, ast.Call) else None
+        target = call.func if call else dec
+        dotted = ctx.resolve(target) or ""
+        kwargs = {k.arg: k.value for k in call.keywords} if call else {}
+        if dotted.split(".")[-1] == "partial" and call and call.args:
+            inner = ctx.resolve(call.args[0]) or ""
+            if inner in ("jax.jit", "jit"):
+                return _static_names_from(kwargs, fn)
+            continue
+        if dotted in ("jax.jit", "jit"):
+            return _static_names_from(kwargs, fn)
+    return None
+
+
+def _static_names_from(kwargs: Dict[str, ast.AST],
+                       fn: ast.FunctionDef) -> Set[str]:
+    static: Set[str] = set()
+    names = kwargs.get("static_argnames")
+    if names is not None:
+        for n in ([names] if isinstance(names, ast.Constant)
+                  else getattr(names, "elts", [])):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                static.add(n.value)
+    nums = kwargs.get("static_argnums")
+    if nums is not None:
+        all_args = [a.arg for a in
+                    fn.args.posonlyargs + fn.args.args]
+        for n in ([nums] if isinstance(nums, ast.Constant)
+                  else getattr(nums, "elts", [])):
+            if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                    and 0 <= n.value < len(all_args):
+                static.add(all_args[n.value])
+    return static
+
+
+def _fn_params(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    return [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+# -- SPL003 -----------------------------------------------------------------
+
+class HostSyncInJit(Rule):
+    """Host-device synchronization inside a jitted function (where it
+    either fails at trace time or silently forces a device round-trip
+    per call) or a configured hot-path function."""
+
+    id = "SPL003"
+    title = "host sync inside a jitted function / hot path"
+    hint = ("keep block_until_ready/np.asarray/.item()/device_get out "
+            "of traced code; batch host fetches at the sweep boundary "
+            "(cpd.py's fit_check_every pattern)")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        hot = set(project.config.hot_functions)
+        out = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jitted = _jit_static_names(ctx, fn) is not None
+            if not jitted and f"{ctx.relpath}::{fn.name}" not in hot:
+                continue
+            where = ("jitted function" if jitted
+                     else "configured hot path")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = ctx.resolve(node.func) or ""
+                label = None
+                if dotted in _SYNC_JAX or \
+                        dotted.split(".")[-1] == "block_until_ready":
+                    label = dotted.split(".")[-1]
+                elif dotted in _NP_HOST:
+                    label = dotted
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not node.args and not node.keywords):
+                    label = ".item()"
+                if label:
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"host sync {label} inside {where} "
+                        f"'{fn.name}'"))
+        return out
+
+
+# -- SPL004 -----------------------------------------------------------------
+
+class RecompilationHazard(Rule):
+    """A jitted function branching in Python on a non-static argument:
+    jax either fails at trace time (tracer in bool context) or — when
+    the value is concrete, e.g. a shape-dependent int — specializes
+    the compilation to it, recompiling per distinct value."""
+
+    id = "SPL004"
+    title = "Python branch on a non-static jit argument"
+    hint = ("mark the argument static_argnames (accepting per-value "
+            "retraces deliberately) or branch on-device with "
+            "jnp.where/lax.cond/lax.while_loop")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            static = _jit_static_names(ctx, fn)
+            if static is None:
+                continue
+            nonstatic = set(_fn_params(fn)) - static - {"self"}
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                for name in self._branching_names(node.test, nonstatic):
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"Python {kind} on non-static jit argument "
+                        f"'{name}' of '{fn.name}' — recompiles per "
+                        f"value (or fails on a traced value)"))
+        return out
+
+    @staticmethod
+    def _branching_names(test: ast.AST, nonstatic: Set[str]) -> List[str]:
+        parents = {child: parent for parent in ast.walk(test)
+                   for child in ast.iter_child_nodes(parent)}
+        hits = []
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in nonstatic):
+                continue
+            parent = parents.get(node)
+            # attribute access (x.mode) is usually static metadata, and
+            # call arguments (len(x), isinstance(x, ...)) resolve to
+            # static values at trace time — only a direct value use of
+            # the argument is a per-value specialization
+            if isinstance(parent, ast.Attribute) and parent.value is node:
+                continue
+            if isinstance(parent, ast.Call) and node is not parent.func:
+                continue
+            if isinstance(parent, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in parent.ops):
+                continue  # `x is None`: pytree structure, static
+            hits.append(node.id)
+        return hits
+
+
+# -- SPL005 -----------------------------------------------------------------
+
+class DtypeLiteral(Rule):
+    """A dtype literal outside the config module: per-site dtype
+    choices drift from the central Options.val_dtype / resolve_dtype
+    policy (the bf16 and f64 paths both exist because dtype is a
+    *policy*, not a per-callsite constant)."""
+
+    id = "SPL005"
+    title = "dtype literal outside config.py"
+    hint = ("resolve dtypes through splatt_tpu.config.resolve_dtype / "
+            "Options.val_dtype (or derive from an input's .dtype)")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        if ctx.relpath == project.config.config_module:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in _DTYPE_LITERALS
+                    and (ctx.resolve(node.value) or "") in _DTYPE_MODULES):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"dtype literal .{node.attr} outside "
+                    f"{project.config.config_module}"))
+        return out
+
+
+# -- SPL006 -----------------------------------------------------------------
+
+def _call_sites(ctx: FileCtx) -> List[Tuple[Optional[str], int]]:
+    """(site, lineno) for every fault-hook call in `ctx`; site is the
+    literal string, 'prefix.*' for an f-string with a literal prefix,
+    or None when not statically resolvable."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        if dotted.split(".")[-1] not in _FAULT_FNS or \
+                "faults" not in dotted:
+            continue
+        arg = node.args[0] if node.args else None
+        site: Optional[str] = None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            site = arg.value
+        elif isinstance(arg, ast.Name):
+            site = ctx.str_consts.get(arg.id)
+        elif isinstance(arg, ast.JoinedStr) and arg.values:
+            first = arg.values[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) and first.value:
+                site = first.value + "*"
+        out.append((site, node.lineno))
+    return out
+
+
+def _declared_sites(ctx: FileCtx) -> Dict[str, int]:
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "SITES"
+                and isinstance(node.value, ast.Dict)):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+def _site_matches(declared: str, used: str) -> bool:
+    if declared.endswith(".*"):
+        return used == declared or used.startswith(declared[:-1])
+    return used == declared
+
+
+class FaultSiteDrift(Rule):
+    """Fault-site drift: every site string the production code passes
+    to the fault hooks must be declared in the faults module's SITES
+    registry and exercised by at least one test — and every declared
+    site must still exist in production.  A renamed hook otherwise
+    silently orphans the resilience path it was built to exercise."""
+
+    id = "SPL006"
+    title = "fault-site drift against utils/faults.py:SITES"
+    hint = ("declare the site (with a doc) in faults.SITES and "
+            "exercise it from a test via faults.inject")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        faults_ctx = project.ctx_for(cfg.faults_module)
+        if faults_ctx is None:
+            return []
+        declared = _declared_sites(faults_ctx)
+        out = []
+        prod_sites: List[Tuple[str, FileCtx, int]] = []
+        for ctx in project.files:
+            if ctx.relpath == cfg.faults_module:
+                continue
+            for site, line in _call_sites(ctx):
+                if site is None:
+                    out.append(self.finding(
+                        ctx, line,
+                        "fault site is not statically resolvable — "
+                        "splint cannot check it against SITES"))
+                else:
+                    prod_sites.append((site, ctx, line))
+        test_sites = {site for tctx in project.test_ctxs()
+                      for site, _ in _call_sites(tctx) if site}
+        for site, ctx, line in prod_sites:
+            if not any(_site_matches(d, site) for d in declared):
+                out.append(self.finding(
+                    ctx, line,
+                    f"fault site '{site}' is not declared in "
+                    f"{cfg.faults_module}:SITES"))
+        used = {s for s, _, _ in prod_sites}
+        for d, line in declared.items():
+            if not any(_site_matches(d, u) for u in used):
+                out.append(self.finding(
+                    faults_ctx, line,
+                    f"declared fault site '{d}' has no production "
+                    f"call — dead declaration or renamed hook"))
+            elif not any(_site_matches(d, t) for t in test_sites):
+                out.append(self.finding(
+                    faults_ctx, line,
+                    f"declared fault site '{d}' is not exercised by "
+                    f"any test under {cfg.tests_path}/"))
+        return out
+
+
+# -- SPL007 -----------------------------------------------------------------
+
+def _declared_env_vars(ctx: FileCtx) -> Dict[str, int]:
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "ENV_VARS"
+                and isinstance(node.value, ast.Dict)):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+class UndocumentedEnvVar(Rule):
+    """Every SPLATT_* environment variable the code reads must be
+    declared (with a doc string) in the env module's ENV_VARS registry
+    — the single source the docs render from."""
+
+    id = "SPL007"
+    title = "undocumented SPLATT_* environment variable"
+    hint = ("declare the variable in splatt_tpu/utils/env.py:ENV_VARS "
+            "(name -> default -> doc); docs render from that registry")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        env_ctx = project.ctx_for(project.config.env_module)
+        declared = _declared_env_vars(env_ctx) if env_ctx else {}
+        out = []
+        for ctx in project.files:
+            for name, line in self._env_reads(ctx):
+                if name.startswith("SPLATT_") and name not in declared:
+                    out.append(self.finding(
+                        ctx, line,
+                        f"env var {name} is read but not declared in "
+                        f"{project.config.env_module}:ENV_VARS"))
+        return out
+
+    @staticmethod
+    def _env_reads(ctx: FileCtx) -> List[Tuple[str, int]]:
+        out = []
+
+        def literal(arg) -> Optional[str]:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+            if isinstance(arg, ast.Name):
+                return ctx.str_consts.get(arg.id)
+            return None
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                dotted = ctx.resolve(node.func) or ""
+                if (dotted in ("os.environ.get", "os.getenv")
+                        or dotted.split(".")[-1] in _ENV_READ_FNS):
+                    name = literal(node.args[0]) if node.args else None
+                    if name:
+                        out.append((name, node.lineno))
+            elif isinstance(node, ast.Subscript) and \
+                    (ctx.resolve(node.value) or "") == "os.environ":
+                name = literal(node.slice)
+                if name:
+                    out.append((name, node.lineno))
+        return out
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen = set()
+    out = []
+    for f in findings:
+        k = (f.rule, f.path, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+RULES: List[Rule] = [
+    RawEnvironAccess(),
+    BroadExceptSwallows(),
+    HostSyncInJit(),
+    RecompilationHazard(),
+    DtypeLiteral(),
+    FaultSiteDrift(),
+    UndocumentedEnvVar(),
+]
