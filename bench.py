@@ -19,9 +19,12 @@ for the reference's memory-lean policies), SPLATT_BENCH_JIT
 (auto|fused|phased — whole-sweep jit vs. per-phase jits; auto picks
 phased on TPU where the fused program wedges the remote compiler),
 SPLATT_BENCH_SHAPE (nell2 default | enron4 — the 4-mode Enron-shaped
-workload of BASELINE.md row 2), SPLATT_BENCH_PATHS ("blocked,stream"
-default — which tensor representations to measure; "blocked" alone
-skips the slow stream oracle on long-rank configs / scarce chip time).
+workload of BASELINE.md row 2), SPLATT_BENCH_PATHS
+("blocked,tuned,stream" default — which representations to measure;
+"tuned" runs the splatt-tune autotuner (warm plan cache = zero
+measurement) and times the winning plan, reported with the chosen
+engine/nnz_block/scan_target under "tuned_plan"; "blocked" alone skips
+the slow stream oracle on long-rank configs / scarce chip time).
 """
 
 from __future__ import annotations
@@ -328,10 +331,14 @@ def main() -> None:
                 plan += " [fused whole-sweep jit: native falls back to xla]"
             note(plan)
         sweep = (_make_phased_sweep if phased
-                 else _make_sweep)(X, tt.nmodes, 0.0)
+                 else _make_sweep)(X, tt.nmodes, 0.0, donate=True)
+        # donated sweeps consume their inputs: give each path a private
+        # copy so the shared factor/gram set survives for the next path
+        f2 = [jnp.array(u) for u in factors]
+        g2 = [jnp.array(g) for g in grams]
         # warmup / compile
         note("compiling + first sweep")
-        f2, g2, *_ = sweep(factors, grams, True)
+        f2, g2, *_ = sweep(f2, g2, True)
         sync(f2)
         note("warm sweep")
         f2, g2, *_ = sweep(f2, g2, False)
@@ -371,19 +378,21 @@ def main() -> None:
         jax.clear_caches()
 
     results = {}
+    default_paths = "blocked,tuned,stream"
     raw_paths = [p.strip() for p in
                  os.environ.get("SPLATT_BENCH_PATHS",
-                                "blocked,stream").split(",") if p.strip()]
-    paths = [p for p in raw_paths if p in ("blocked", "stream")]
+                                default_paths).split(",") if p.strip()]
+    paths = [p for p in raw_paths if p in ("blocked", "stream", "tuned")]
     if paths != raw_paths:
         # keep the valid subset rather than silently re-enabling the
         # slow paths the caller asked to skip — inside a hard-timeout
         # chip window that would kill the run before any JSON prints
         print(f"bench: ignoring unknown SPLATT_BENCH_PATHS entries in "
-              f"{raw_paths!r}; running {paths or ['blocked', 'stream']}",
+              f"{raw_paths!r}; running "
+              f"{paths or default_paths.split(',')}",
               file=sys.stderr, flush=True)
     if not paths:
-        paths = ["blocked", "stream"]
+        paths = default_paths.split(",")
     engine = os.environ.get("SPLATT_BENCH_ENGINE", "auto").lower()
     if engine not in ("auto", "pallas", "xla"):
         print(f"bench: bad SPLATT_BENCH_ENGINE {engine!r}; using auto",
@@ -396,9 +405,11 @@ def main() -> None:
         print("bench: bad SPLATT_BENCH_ALLOC; using allmode",
               file=sys.stderr, flush=True)
         alloc = BlockAlloc.ALLMODE
+    # the "blocked" row is the STATIC-default reference the tuned row
+    # is judged against, so it must not consult the plan cache
     opts = Options(random_seed=7, verbosity=Verbosity.NONE,
                    val_dtype=bench_dtype, use_pallas=use_pallas,
-                   block_alloc=alloc)
+                   block_alloc=alloc, autotune=False)
     blocked_failed = False
     if "blocked" in paths:
         try:
@@ -419,6 +430,38 @@ def main() -> None:
         except Exception as e2:
             print(f"bench: blocked XLA engine failed too "
                   f"({type(e2).__name__})", file=sys.stderr, flush=True)
+        release()
+    tuned_plan_info = None
+    if "tuned" in paths:
+        # the autotuned row: measure candidate plans (or hit the warm
+        # plan cache), build the layouts at the tuned blocks, and time
+        # the same sweep — so the BENCH trajectory can attribute wins
+        # to tuning rather than to unrelated code movement
+        try:
+            import dataclasses as _dc
+
+            from splatt_tpu import tune as _tune
+
+            topts = Options(random_seed=7, verbosity=Verbosity.NONE,
+                            val_dtype=bench_dtype, use_pallas=use_pallas,
+                            block_alloc=alloc, autotune=True)
+            note(f"autotuning (plan cache: {_tune.cache_path()})")
+            tres = _tune.tune(tt, rank=rank, opts=topts)
+            if tres.measured == 0 and tres.plans:
+                note("tune: warm plan cache hit for every mode — "
+                     "skipped all measurement")
+            else:
+                note(f"tune: {tres.measured} candidate measurements, "
+                     f"{tres.cache_hits} cache hits")
+            tuned_plan_info = {str(m): _dc.asdict(p)
+                               for m, p in sorted(tres.plans.items())}
+            note(f"tuned plans: {tuned_plan_info}")
+            note("building tuned blocked layouts")
+            results["tuned"] = run(
+                BlockedSparse.compile(tt, topts, rank=rank))
+        except Exception as e:
+            print(f"bench: tuned path failed ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
         release()
     if "stream" in paths:
         try:
@@ -463,13 +506,17 @@ def main() -> None:
                              for s in ("median", "mean", "min", "max")}
                          for k, v in results.items()},
     }
+    if tuned_plan_info is not None:
+        # the tuner's chosen plan rides along with the "tuned" timing so
+        # the BENCH trajectory can attribute wins to tuning
+        rec["tuned_plan"] = tuned_plan_info
     try:
         # first-order roofline: one iteration = nmodes MTTKRPs' logical
         # HBM traffic (lower bound; layout partials omitted) against
         # the measured sec/iter — shows headroom next to the seconds
         from splatt_tpu.bench_algs import hbm_peak_gbs, mttkrp_bytes
 
-        if best.startswith("blocked"):
+        if best.startswith("blocked") or best == "tuned":
             # the winning blocked run used Pallas fused engines when
             # forced or on TPU (choose_impl semantics) — those stream
             # the factor TABLES once, a different traffic model

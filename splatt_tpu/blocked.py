@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from splatt_tpu.config import BlockAlloc, Options, default_opts, resolve_dtype
+from splatt_tpu.config import (BlockAlloc, Options, Verbosity, default_opts,
+                               resolve_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.utils.env import ceil_to as _ceil_to
 
@@ -87,6 +88,15 @@ class ModeLayout:
                 + self.vals.size * self.vals.dtype.itemsize
                 + self.row_start.size * self.row_start.dtype.itemsize)
 
+    def __repr__(self) -> str:
+        # the EFFECTIVE block is load-bearing (build_layout clamps the
+        # requested one), so surface it instead of the dataclass default
+        # repr dumping whole device arrays
+        return (f"ModeLayout(mode={self.mode}, dim={self.dim}, "
+                f"block={self.block}, seg_width={self.seg_width}, "
+                f"nnz={self.nnz}, nnz_pad={self.nnz_pad}, "
+                f"nblocks={self.nblocks})")
+
 
 def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
                     custom=None) -> List[int]:
@@ -117,12 +127,15 @@ def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
 
 def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
                  val_dtype=np.float32, mode_order=None,
-                 mode_order_custom=None) -> ModeLayout:
+                 mode_order_custom=None, verbose: bool = False) -> ModeLayout:
     """Sort, block and pad the tensor for output mode `mode`.
 
     ≙ csf_alloc's sort + fiber build (src/csf.c:613-726); the secondary
     mode ordering follows `mode_order` (default SMALLFIRST,
-    ≙ csf_find_mode_order).
+    ≙ csf_find_mode_order).  The block a caller (or the autotuner)
+    requests may be clamped to the tensor size; the override is
+    recorded in the run report (and printed when `verbose`) and the
+    effective block is what :class:`ModeLayout` reports.
     """
     nmodes, nnz = tt.nmodes, tt.nnz
     from splatt_tpu.utils.env import check_int32_dims
@@ -135,7 +148,19 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
 
     # Don't let the block dwarf a small tensor: clamp to the padded nnz
     # count (kept a multiple of 128 for lane alignment).
+    requested = int(block)
     block = max(128, min(block, _ceil_to(max(nnz, 1), 128)))
+    if block != requested:
+        # a silent override of a caller-requested block made the
+        # effective plan unobservable (ISSUE 3 satellite): record it
+        from splatt_tpu import resilience
+
+        resilience.run_report().add("block_clamp", mode=mode,
+                                    requested=requested, effective=block,
+                                    nnz=nnz)
+        if verbose:
+            print(f"  layout mode{mode}: requested nnz_block {requested} "
+                  f"clamped to {block} (nnz={nnz})")
     nnz_pad = max(block, _ceil_to(nnz, block))
     nblocks = nnz_pad // block
     inds = np.zeros((nmodes, nnz_pad), dtype=np.int32)
@@ -189,7 +214,9 @@ class BlockedSparse:
         return sum(l.storage_bytes() for l in self.layouts)
 
     @staticmethod
-    def from_coo(tt: SparseTensor, opts: Optional[Options] = None) -> "BlockedSparse":
+    def from_coo(tt: SparseTensor, opts: Optional[Options] = None,
+                 tuned_blocks: Optional[Dict[int, int]] = None
+                 ) -> "BlockedSparse":
         """Compile a COO tensor into blocked layouts per the alloc policy.
 
         ≙ splatt_csf_alloc (src/csf.c:770-814):
@@ -199,25 +226,51 @@ class BlockedSparse:
         - ALLMODE: one per mode.
         Every mode maps to its own layout when one exists, else to the
         first layout (generic path).
+
+        `tuned_blocks` (mode -> nnz_block, from the autotuner's plan
+        cache) overrides ``opts.nnz_block`` per build mode — the layout
+        is built once at the tuned block instead of rebuilt when the
+        plan disagrees with the default.  :meth:`compile` fills it in.
         """
         opts = (opts or default_opts()).validate()
         nmodes = tt.nmodes
+        tuned_blocks = tuned_blocks or {}
         # one selection rule shared with the distributed cell/shard
         # layout builders — they must never desynchronize
         from splatt_tpu.parallel.common import alloc_build_modes
 
         build_modes = alloc_build_modes(tt.dims, opts)
 
-        layouts = [build_layout(tt, m, block=opts.nnz_block,
+        layouts = [build_layout(tt, m,
+                                block=tuned_blocks.get(m, opts.nnz_block),
                                 val_dtype=resolve_dtype(opts, tt.vals.dtype),
                                 mode_order=opts.mode_order,
-                                mode_order_custom=opts.mode_order_custom)
+                                mode_order_custom=opts.mode_order_custom,
+                                verbose=opts.verbosity >= Verbosity.LOW)
                    for m in build_modes]
         mode_map = {}
         for m in range(nmodes):
             mode_map[m] = build_modes.index(m) if m in build_modes else 0
         return BlockedSparse(layouts=layouts, mode_map=mode_map,
                              dims=tt.dims, nnz=tt.nnz, opts=opts)
+
+    @staticmethod
+    def compile(tt: SparseTensor, opts: Optional[Options] = None,
+                rank: Optional[int] = None) -> "BlockedSparse":
+        """:meth:`from_coo` + autotune: consult the tuner's plan cache
+        (splatt_tpu/tune.py) for each mode's winning ``nnz_block`` and
+        build the layouts at it directly.  `rank` keys the plan lookup
+        (the winning configuration is rank-dependent); without it, or
+        with autotune off, this is plain :meth:`from_coo`."""
+        opts = (opts or default_opts()).validate()
+        tuned_blocks = None
+        if rank is not None:
+            from splatt_tpu import tune
+
+            if tune.autotune_enabled(opts.autotune):
+                tuned_blocks = tune.tuned_blocks_for(
+                    tt.dims, tt.nnz, rank, resolve_dtype(opts, tt.vals.dtype))
+        return BlockedSparse.from_coo(tt, opts, tuned_blocks=tuned_blocks)
 
     def frobsq(self) -> float:
         """Squared Frobenius norm (≙ csf_frobsq, src/csf.c:828-851).

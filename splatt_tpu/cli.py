@@ -60,6 +60,8 @@ def _build_opts(args) -> "Options":
         opts.mode_order = ModeOrder(args.mode_order)
     if getattr(args, "engine_fallback", None):
         opts.engine_fallback = args.engine_fallback == "on"
+    if getattr(args, "autotune", None):
+        opts.autotune = args.autotune == "on"
     return opts
 
 
@@ -142,7 +144,9 @@ def cmd_cpd(args) -> int:
                 "--grid/...); the single-chip blocked build "
                 "materializes its layouts in RAM")
         with timers.time("blocked_build"):
-            bs = BlockedSparse.from_coo(tt, opts)
+            # compile (not from_coo): with autotune on, the layouts are
+            # built directly at the plan cache's tuned nnz_block
+            bs = BlockedSparse.compile(tt, opts, rank=args.rank)
         print(cpd_stats_text(bs, args.rank, opts))
         out = cpd_als(bs, rank=args.rank, opts=opts,
                       checkpoint_path=args.checkpoint,
@@ -185,6 +189,39 @@ def cmd_cpd(args) -> int:
         print(timers.report(level=2 if opts.verbosity >= Verbosity.HIGH
                             else 1))
     return 0
+
+
+def cmd_tune(args) -> int:
+    """Pre-tune a tensor offline (docs/autotune.md): measure the
+    candidate MTTKRP plans — engine x nnz_block x scan_target — per
+    mode and persist the winners in the plan cache, so later `cpd`
+    runs (and other tensors in the same shape regime) dispatch straight
+    to the measured-fastest configuration with zero measurement cost."""
+    from splatt_tpu import tune
+    from splatt_tpu.io import load
+    from splatt_tpu.stats import tensor_stats
+
+    opts = _build_opts(args)
+    tt = load(args.tensor)
+    print(tensor_stats(tt, args.tensor))
+    res = tune.tune(tt, rank=args.rank, opts=opts, reps=args.reps,
+                    force=args.force)
+    for m in sorted(res.plans):
+        p = res.plans[m]
+        print(f"  mode {m}: path={p.path} engine={p.engine} "
+              f"nnz_block={p.nnz_block} scan_target={p.scan_target} "
+              f"({p.sec:.4f}s/call)")
+    print(f"tuned {len(res.plans)}/{tt.nmodes} modes "
+          f"({res.measured} measurements, {res.cache_hits} cache hits, "
+          f"{res.skipped} skipped) -> {tune.cache_path()}")
+    from splatt_tpu import resilience
+
+    lines = resilience.run_report().summary()
+    if lines:
+        print("Resilience events:")
+        for line in lines:
+            print(line)
+    return 0 if res.plans else 1
 
 
 def cmd_bench(args) -> int:
@@ -400,7 +437,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "engine in the chain runs instead of the "
                         "failure killing the run; 'off' fails loudly "
                         "(docs/resilience.md)")
+    p.add_argument("--autotune", choices=["on", "off"],
+                   help="consult the autotuner's plan cache for the "
+                        "MTTKRP engine/block/scan plan (default on; "
+                        "pre-tune with `splatt tune` — docs/autotune.md)")
     p.set_defaults(fn=cmd_cpd)
+
+    p = sub.add_parser(
+        "tune", help="pre-tune the MTTKRP plan for a tensor",
+        epilog="Times candidate plans (engine x nnz_block x "
+               "scan_target) per mode with short warm+timed runs and "
+               "persists the winners in the plan cache; later cpd runs "
+               "in the same shape regime dispatch straight to the "
+               "measured winner (docs/autotune.md)")
+    _common_opts(p)
+    p.add_argument("-r", "--rank", type=int, default=10)
+    p.add_argument("--reps", type=int, default=2,
+                   help="timed repetitions per candidate (median wins)")
+    p.add_argument("--force", action="store_true",
+                   help="re-measure even when the plan cache already "
+                        "holds an unexpired winner")
+    p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
+    p.add_argument("--f64", action="store_true")
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
         "bench", help="benchmark MTTKRP algorithms",

@@ -142,6 +142,25 @@ class Options:
     # False = fail loudly (differential tests chasing a kernel bug want
     # the crash, not the silent rescue).
     engine_fallback: Optional[bool] = None
+    # Empirical autotuner (splatt_tpu.tune, docs/autotune.md): when on,
+    # MTTKRP dispatch consults the persisted plan cache (measured
+    # winning engine / nnz_block / scan_target) before the heuristic
+    # engine chain, and BlockedSparse.compile builds layouts at the
+    # tuned block.  None = env default (SPLATT_AUTOTUNE, on unless
+    # disabled); False forces the static heuristics.  Consulting is
+    # cheap; the measurements themselves only run via `splatt tune`,
+    # bench.py, or an explicit tune.tune() call.
+    autotune: Optional[bool] = None
+    # Donate the factor/gram buffers to the jitted ALS sweep
+    # (jax donate_argnums): XLA aliases outputs onto the input buffers,
+    # so a sweep stops round-tripping per-iteration copies of every
+    # factor.  The sweep then CONSUMES its inputs — cpd_als holds a
+    # host snapshot (refreshed at fit-check iterations) and
+    # re-materializes from it when an engine rescue needs the pre-sweep
+    # state back.  None = on; False keeps copying semantics (a caller
+    # timing against the old behavior, or holding references to the
+    # arrays it passed in).
+    donate_sweep: Optional[bool] = None
 
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM

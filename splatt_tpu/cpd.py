@@ -19,6 +19,7 @@ src/cpd.c:391-411).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from functools import partial
 from typing import Callable, List, Optional, Tuple, Union
@@ -76,11 +77,20 @@ def _zz_inner(lam, grams, M, U_last):
 
 
 def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
-                reg: float) -> Callable:
-    """Build the jitted one-sweep function for this tensor."""
+                reg: float, donate: bool = False) -> Callable:
+    """Build the jitted one-sweep function for this tensor.
+
+    With `donate`, the factor/gram arguments are donated
+    (``donate_argnums``): XLA aliases the output factor/gram buffers
+    onto the inputs, so a sweep updates state in place instead of
+    round-tripping a copy of every factor per iteration — dispatch
+    overhead the autotuner would otherwise mis-attribute to the
+    engines it measures.  A donated sweep CONSUMES its inputs: callers
+    must not reuse the arrays they passed in (cpd_als re-materializes
+    from its host snapshot on an engine rescue).
+    """
     do_mttkrp = _mttkrp_closure(X)
 
-    @partial(jax.jit, static_argnames=("first",))
     def sweep(factors, grams, first: bool):
         lam = None
         M = None
@@ -97,11 +107,12 @@ def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
         znormsq, inner = _zz_inner(lam, grams, M, factors[nmodes - 1])
         return factors, grams, lam, znormsq, inner
 
-    return sweep
+    return jax.jit(sweep, static_argnames=("first",),
+                   donate_argnums=(0, 1) if donate else ())
 
 
 def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
-                       reg: float) -> Callable:
+                       reg: float, donate: bool = False) -> Callable:
     """Same contract as :func:`_make_sweep`, but each ALS phase is its
     own small jitted program (per-mode MTTKRP, one solve+normalize+gram
     update, one fit) chained asynchronously — no host syncs, so timing
@@ -112,15 +123,27 @@ def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     measured 2026-07-29), while the individual per-mode MTTKRP programs
     compile in ~35 s each there.  Dispatch overhead between phases is
     host-side microseconds against 100 ms-scale kernels.
+
+    With `donate`, every phase but the last donates its MTTKRP result
+    `M` — the (dim, R) buffer the solve consumes and the updated factor
+    aliases onto, so the per-phase factor update stops allocating and
+    copying a fresh buffer.  The grams stay undonated (every phase
+    reads the full gram list) and the LAST phase keeps its M live: the
+    fit phase still needs it (that is why the donation is per-phase,
+    not a blanket donate_argnums).
     """
     do_mttkrp = _mttkrp_closure(X)
 
-    @partial(jax.jit, static_argnames=("m", "first", "factor_dtype"))
-    def update_phase(grams, M, m: int, first: bool, factor_dtype):
+    def update(grams, M, m: int, first: bool, factor_dtype):
         U = solve_normals(form_normal_lhs(grams, m, reg), M)
         U, lam = normalize_columns(U, "2" if first else "max")
         U = U.astype(factor_dtype)
         return U, lam, gram(U)
+
+    statics = ("m", "first", "factor_dtype")
+    update_mid = jax.jit(update, static_argnames=statics,
+                         donate_argnums=(1,) if donate else ())
+    update_last = jax.jit(update, static_argnames=statics)
 
     fit_phase = jax.jit(_zz_inner)
 
@@ -133,7 +156,8 @@ def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
         M = None
         for m in range(nmodes):
             M = do_mttkrp(factors, m)
-            factors[m], lam, grams[m] = update_phase(
+            phase = update_mid if m < nmodes - 1 else update_last
+            factors[m], lam, grams[m] = phase(
                 grams, M, m, first, factors[m].dtype)
         znormsq, inner = fit_phase(lam, grams, M, factors[nmodes - 1])
         return factors, grams, lam, znormsq, inner
@@ -424,8 +448,12 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                     print(f"  resuming from {checkpoint_path} "
                           f"(iteration {start_it})")
 
+    donate = opts.donate_sweep if opts.donate_sweep is not None else True
     if init is not None:
-        factors = [jnp.asarray(f, dtype=dtype) for f in init]
+        # a PRIVATE copy even when dtypes already match: the donated
+        # sweep consumes its inputs, and the caller's init arrays (often
+        # reused across runs — the differential tests do) must survive
+        factors = [jnp.array(f, dtype=dtype, copy=True) for f in init]
     else:
         factors = init_factors(dims, rank, opts.seed(), dtype=dtype)
     grams = [gram(U) for U in factors]
@@ -438,11 +466,50 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         else:
             print("  engine plan: impl=xla mode*=stream (COO oracle)")
 
+    # surface the autotuned dispatch plan (docs/autotune.md) in the run
+    # report: silent tuning would be as unobservable as the silent
+    # engine fallback the resilience layer exists to report
+    from splatt_tpu import resilience as _resilience
+    from splatt_tpu import tune as _tune
+
+    if isinstance(X, BlockedSparse) and _tune.autotune_enabled(opts.autotune):
+        # report through the SAME applicability filter dispatch uses
+        # (_tuned_plan_for: path/block match, demotion-checked) — a plan
+        # the dispatch will reject must not be claimed as in effect
+        from splatt_tpu.ops.mttkrp import _choose_path_bs, _tuned_plan_for
+
+        tuned_plans = {}
+        for m in range(nmodes):
+            plan = _tuned_plan_for(X.layout_for(m), factors, m,
+                                   _choose_path_bs(X, m),
+                                   autotune=opts.autotune)
+            if plan is not None:
+                tuned_plans[m] = dataclasses.asdict(plan)
+        if tuned_plans:
+            _resilience.run_report().add("tuned_plan", plans=tuned_plans)
+            if opts.verbosity >= Verbosity.LOW:
+                parts = [f"mode{m}={p['path']}/{p['engine']}"
+                         f" b{p['nnz_block']} s{p['scan_target']}"
+                         for m, p in sorted(tuned_plans.items())]
+                print("  tuned plan: " + " ".join(parts))
+
     # -v -v: split-jit profiled sweep with real per-phase attribution.
     # On TPU the default is the phased sweep: one whole-sweep XLA
     # program at NELL scale wedges the tunneled remote-compile service
     # (>40 min), while the per-phase programs compile in seconds each.
     profiled = opts.verbosity >= Verbosity.HIGH
+    from splatt_tpu.ops.mttkrp import choose_impl
+
+    # phased also when the native C++ MTTKRP engine will run: it
+    # executes on host and cannot live inside a whole-sweep trace
+    phased = (jax.default_backend() == "tpu"
+              or (isinstance(X, BlockedSparse)
+                  and choose_impl(opts) == "native"))
+    # only the fused whole-sweep jit donates the CALLER-visible
+    # factor/gram inputs; the phased sweep donates intra-sweep buffers
+    # and the profiled sweep donates nothing, so neither needs (or
+    # should pay for) the rescue snapshot below
+    consumes_inputs = donate and not profiled and not phased
 
     def build_sweep():
         # a factory, not a value: after a runtime engine demotion the
@@ -450,15 +517,9 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         # compiled executable with the demoted engine inlined
         if profiled:
             return _make_profiled_sweep(X, nmodes, opts.regularization)
-        from splatt_tpu.ops.mttkrp import choose_impl
-
-        # phased also when the native C++ MTTKRP engine will run: it
-        # executes on host and cannot live inside a whole-sweep trace
-        phased = (jax.default_backend() == "tpu"
-                  or (isinstance(X, BlockedSparse)
-                      and choose_impl(opts) == "native"))
         return (_make_phased_sweep if phased
-                else _make_sweep)(X, nmodes, opts.regularization)
+                else _make_sweep)(X, nmodes, opts.regularization,
+                                  donate=donate)
 
     sweep = build_sweep()
     if profiled:
@@ -477,6 +538,22 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     fit = jnp.asarray(ck_fit, dtype=dtype)
     lam = (jnp.asarray(ck_lam, dtype=dtype) if ck_lam is not None
            else jnp.ones((rank,), dtype=dtype))
+    # The donated FUSED sweep consumes its factor/gram inputs, so a
+    # rescued retry cannot re-run from the pre-sweep device arrays —
+    # they are gone.  A host snapshot (factors are MBs, the tensor is
+    # the big thing) re-materializes the retry state instead.
+    # Refreshed at fit-check iterations, so a rescue loses at most the
+    # sweeps since the last check — the same window the deferred-fit-
+    # check contract already trades away.
+    can_rescue = isinstance(X, BlockedSparse)
+    snap = None
+
+    def snapshot():
+        return ([np.asarray(u) for u in factors],
+                [np.asarray(g) for g in grams])
+
+    if consumes_inputs and can_rescue:
+        snap = snapshot()
     timers.start("cpd")
     k = opts.fit_check_every
     last_check_it = start_it
@@ -520,12 +597,24 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                         or not _try_engine_rescue(X, opts, e)):
                     raise
                 sweep = build_sweep()
+                if snap is not None and any(
+                        getattr(a, "is_deleted", lambda: False)()
+                        for a in [*factors, *grams]):
+                    # the failed program consumed the donated inputs:
+                    # re-materialize the retry state from the host
+                    # snapshot (ALS is self-correcting, so restarting
+                    # from the last checked iterate just continues the
+                    # same optimization)
+                    factors = [jnp.asarray(u) for u in snap[0]]
+                    grams = [jnp.asarray(g) for g in snap[1]]
         factors, grams, lam = f_new, g_new, lam_new
         if not check:
             if opts.verbosity >= Verbosity.HIGH:
                 print(f"  its = {it + 1:3d} (deferred fit check)")
             continue
         elapsed = time.perf_counter() - t0
+        if snap is not None:
+            snap = snapshot()
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"
                   f"  delta = {fitval - fit_prev:+0.4e}")

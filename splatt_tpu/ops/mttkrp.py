@@ -221,11 +221,47 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
     return parts.reshape(nb_pad, width, R)[:nb]
 
 
+def _tuned_plan_for(layout: ModeLayout, factors: Sequence[jax.Array],
+                    mode: int, path: str,
+                    autotune: Optional[bool] = None,
+                    shape_key: Optional[str] = None):
+    """The applicable cached autotuner plan for this dispatch, or None.
+
+    Applicability is strict — the plan was measured for exactly this
+    (path, nnz_block) configuration, so a dispatch whose layout block
+    or chosen path disagrees keeps the heuristic chain, and an engine
+    the resilience registry demoted mid-run is never resurrected by a
+    stale plan.  The tuner can make dispatch faster, never wronger.
+    """
+    from splatt_tpu import resilience, tune
+
+    if not tune.autotune_enabled(autotune):
+        return None
+    nnz = getattr(layout, "nnz", None)
+    if nnz is None:
+        return None  # partial layout (gate-probing tests): no plan key
+    plan = tune.cached_plan([int(f.shape[0]) for f in factors],
+                            nnz, mode, int(factors[0].shape[1]),
+                            factors[0].dtype)
+    if plan is None or plan.path != path or plan.nnz_block != layout.block:
+        return None
+    # per-shape (OOM) demotions only match with the shape_key, so it
+    # must be computed when the caller (engine_plan, the cpd_als plan
+    # report) did not thread one through — otherwise reporting would
+    # promote an engine dispatch refuses to run
+    if shape_key is None:
+        shape_key = _engine_shape_key(layout, factors, mode)
+    if resilience.is_demoted(plan.engine, shape_key):
+        return None
+    return plan
+
+
 def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
                    path: str = "sorted_onehot",
                    impl: str = "xla",
                    scan_target: Optional[int] = None,
-                   fallback: Optional[bool] = None) -> jax.Array:
+                   fallback: Optional[bool] = None,
+                   autotune: Optional[bool] = None) -> jax.Array:
     """Blocked MTTKRP over one :class:`ModeLayout`.
 
     `path` picks the algorithm (static dispatch); `impl` picks the
@@ -241,9 +277,15 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     - "pallas_interpret": kernel semantics on CPU, for tests.
 
     `scan_target` tunes how much one-hot the XLA engine's scan step
-    materializes (default: SPLATT_SCAN_TARGET_ELEMS).  Resolved here —
-    outside the jit — so it is part of the cache key and changing it
-    always takes effect.
+    materializes (default: the autotuned plan's value when one applies,
+    else SPLATT_SCAN_TARGET_ELEMS).  Resolved here — outside the jit —
+    so it is part of the cache key and changing it always takes effect.
+
+    Autotuning (`autotune`, default from Options.autotune /
+    SPLATT_AUTOTUNE): when the plan cache (splatt_tpu/tune.py) holds a
+    measured winner for this exact (shape regime, rank, dtype, path,
+    nnz_block), that engine heads the chain; everything below — lazy
+    probes, demotion, runtime fallback — applies to it unchanged.
 
     Runtime graceful degradation (`fallback`, default from
     SPLATT_ENGINE_FALLBACK / resilience.fallback_enabled): the ordered
@@ -257,8 +299,6 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     from splatt_tpu import resilience
     from splatt_tpu.utils import faults
 
-    if scan_target is None:
-        scan_target = _SCAN_TARGET
     if fallback is None:
         fallback = resilience.fallback_enabled()
     # regime/shape_key are computed ONCE per dispatch and threaded
@@ -268,6 +308,18 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     shape_key = _engine_shape_key(layout, factors, mode, regime=regime)
     chain = engine_chain(layout, factors, mode, path, impl,
                          shape_key=shape_key)
+    # the autotuner's plan is the new head of dispatch: a measured
+    # winner for this exact (path, block) is tried first, and everything
+    # below — probes, demotion, fallback on failure — applies to it
+    # unchanged, so a stale plan degrades to the heuristic chain
+    tuned = _tuned_plan_for(layout, factors, mode, path,
+                            autotune=autotune, shape_key=shape_key)
+    if tuned is not None and tuned.engine in chain:
+        if scan_target is None and tuned.engine == "xla_scan":
+            scan_target = tuned.scan_target
+        chain = [tuned.engine] + [e for e in chain if e != tuned.engine]
+    if scan_target is None:
+        scan_target = _SCAN_TARGET
     interpret = impl == "pallas_interpret"
     last = len(chain) - 1
     for i, engine in enumerate(chain):
@@ -516,9 +568,11 @@ def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
 
 
 def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
-                path: str = "sorted_onehot", impl: str = "xla") -> str:
+                path: str = "sorted_onehot", impl: str = "xla",
+                autotune: Optional[bool] = None) -> str:
     """Which engine :func:`mttkrp_blocked` will actually run for this
-    call — the first :func:`engine_chain` entry whose (lazily probed)
+    call — the applicable autotuned plan's engine when one is cached,
+    else the first :func:`engine_chain` entry whose (lazily probed)
     capability gate passes.  Dispatch falls back silently (VMEM gates,
     Mosaic capability, runtime demotions), so benches and tests use
     this to label results truthfully.
@@ -526,6 +580,9 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     chain = engine_chain(layout, factors, mode, path, impl)
     regime = _chain_regime(layout, factors, mode)
     interpret = impl == "pallas_interpret"
+    tuned = _tuned_plan_for(layout, factors, mode, path, autotune=autotune)
+    if tuned is not None and tuned.engine in chain:
+        chain = [tuned.engine] + [e for e in chain if e != tuned.engine]
     for engine in chain[:-1]:
         if _engine_probed_ok(engine, regime, layout.block, interpret):
             return engine
@@ -594,7 +651,8 @@ def plan_mttkrp(X: "BlockedSparse", factors: Sequence[jax.Array], mode: int,
     if impl == "native":
         return Plan("native", path, "native")
     return Plan(impl, path,
-                engine_plan(X.layout_for(mode), factors, mode, path, impl))
+                engine_plan(X.layout_for(mode), factors, mode, path, impl,
+                            autotune=X.opts.autotune))
 
 
 def describe_plan(X: "BlockedSparse", factors: List[jax.Array]) -> str:
@@ -722,7 +780,8 @@ def mttkrp(X: Union[SparseTensor, BlockedSparse], factors: List[jax.Array],
         # condition — e.g. deleted mid-session); degrade to XLA
         rimpl = "xla"
     return mttkrp_blocked(layout, factors, mode, path=rpath, impl=rimpl,
-                          fallback=X.opts.engine_fallback)
+                          fallback=X.opts.engine_fallback,
+                          autotune=X.opts.autotune)
 
 
 def _run_native(layout: ModeLayout, factors: List[jax.Array],

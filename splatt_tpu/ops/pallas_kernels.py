@@ -687,17 +687,21 @@ def probe_cache_load(state_key: str):
         return None
 
 
-def probe_cache_store(state_key: str, state: str) -> None:
-    """Record a probe verdict on disk (atomic replace; best-effort —
-    cache IO must never break dispatch).  Timestamps let a TPU session
-    commit the file as evidence of when each verdict was proven."""
+def _json_cache_update(path, mutate, on_error=None) -> None:
+    """Locked atomic read-modify-write of a small JSON cache file —
+    shared by the capability-probe cache here and the autotuner's plan
+    cache (splatt_tpu/tune.py).  `mutate(data) -> data` transforms the
+    loaded dict (``{}`` when absent/corrupt).  Best-effort by contract:
+    cache IO must never break dispatch, so every failure is routed to
+    `on_error(op, exc)` (classified into the run report) and swallowed.
+    """
     import json
     import os
     import tempfile
-    import time
 
+    if on_error is None:
+        on_error = _cache_io_error
     try:
-        path = _cache_path()
         path.parent.mkdir(parents=True, exist_ok=True)
         # serialize concurrent read-modify-writes (two processes proving
         # different kernels must not drop each other's verdicts)
@@ -712,11 +716,10 @@ def probe_cache_store(state_key: str, state: str) -> None:
                 data = {}  # first write creates the file
             except Exception as e:
                 # unreadable/corrupt cache: replaced wholesale below —
-                # reported, because it drops every other kernel's verdict
-                _cache_io_error("store", e)
+                # reported, because it drops every other entry
+                on_error("store", e)
                 data = {}
-            env = data.setdefault(_cache_env_key(), {})
-            env[state_key] = {"state": state, "ts": time.time()}
+            data = mutate(data)
             fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
             try:
                 with os.fdopen(fd, "w") as f:
@@ -730,8 +733,24 @@ def probe_cache_store(state_key: str, state: str) -> None:
                 raise
     except Exception as e:
         # best-effort by contract (cache IO must never break dispatch):
-        # degrade to an uncached probe, but say so in the run report
-        _cache_io_error("store", e)
+        # degrade to an uncached probe/plan, but say so in the run report
+        on_error("store", e)
+
+
+def probe_cache_store(state_key: str, state: str) -> None:
+    """Record a probe verdict on disk (atomic replace; best-effort —
+    cache IO must never break dispatch).  Timestamps let a TPU session
+    commit the file as evidence of when each verdict was proven."""
+    import time
+
+    env_key = _cache_env_key()
+    entry = {"state": state, "ts": time.time()}
+
+    def mutate(data):
+        data.setdefault(env_key, {})[state_key] = entry
+        return data
+
+    _json_cache_update(_cache_path(), mutate)
 
 
 #: representative probe shapes per lane-chunk regime.  "ck1": the

@@ -313,6 +313,15 @@ class RunReport:
         for e in self.events("probe_downgrade"):
             lines.append(f"  probe {e['state_key']}: {e['verdict']} "
                          f"(unproven — re-probed next process)")
+        negatives = self.events("tuner_negative")
+        if negatives:
+            lines.append(f"  {len(negatives)} autotuner candidate(s) "
+                         f"failed to measure (deterministic failures "
+                         f"recorded as negative plan-cache entries)")
+        for e in self.events("tuner_degraded"):
+            lines.append(f"  autotuner: no measurable candidate for "
+                         f"mode {e['mode']} — dispatch keeps the "
+                         f"heuristic chain")
         return lines
 
 

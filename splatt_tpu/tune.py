@@ -1,0 +1,465 @@
+"""splatt-tune: empirical autotuner for MTTKRP engine plans.
+
+The blocked format's speed comes from picking the right execution plan
+per tensor — BENCH_r05 measured a 33x spread between dispatch paths on
+the same tensor — yet the port used to hardcode the plan: one
+``nnz_block`` (4096 + clamp), one ``scan_target``, an engine chain
+ordered by static heuristics.  GenTen's performance-portable MTTKRP and
+the load-balanced GPU MTTKRP line of work (PAPERS.md) both show the
+winning kernel configuration depends on the nnz distribution, the rank
+and the device: it must be *measured*, not guessed.  This module is
+that measurement layer.
+
+For a given (shape regime, rank, dtype) — the device kind lives in the
+cache environment key — :func:`tune` times candidate plans per mode:
+
+    engine (from :func:`splatt_tpu.ops.mttkrp.engine_chain`)
+      x nnz_block in NNZ_BLOCKS
+      x scan_target ladder (xla_scan engine only)
+
+with short warm+timed runs, and persists each mode's winner in a
+versioned on-disk **plan cache** next to the capability-probe cache.
+The cache shares the probe cache's environment key (jax version, device
+kind, ``_kernel_src_hash`` — editing a kernel source invalidates every
+cached plan) and TTL (``SPLATT_PROBE_CACHE_TTL_S``), and applies the
+same resilience verdict handling: engines demoted by the resilience
+registry are never candidates, transient timing failures are retried in
+place via :func:`resilience.retry_transient`, and deterministic or
+resource failures are recorded as **negative entries** so a later tune
+does not re-pay the failing compile.
+
+Dispatch integration: :func:`splatt_tpu.ops.mttkrp.mttkrp_blocked`
+consults :func:`cached_plan` first (the new head of dispatch) and falls
+back to the heuristic chain when no applicable plan exists or autotune
+is off (``Options.autotune`` / ``SPLATT_AUTOTUNE``);
+:meth:`BlockedSparse.compile` consults :func:`tuned_blocks_for` so the
+layouts are built at the tuned ``nnz_block`` directly.  ``splatt tune``
+(cli.py) pre-tunes a tensor offline; bench.py reports a ``"tuned"``
+timing next to ``"blocked"``/``"stream"``.
+
+Plans are tuned against the mode's OWN sorted layout (the allmode-style
+fast path).  A dispatch whose path or block disagrees with the stored
+plan simply does not match it and keeps today's heuristics — the tuner
+can make dispatch faster, never wronger.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: bump when the plan schema or the measurement methodology changes —
+#: a cache written by an older tuner is re-tuned, not reinterpreted
+PLAN_CACHE_VERSION = 1
+
+#: candidate nnz blocks (build_layout clamps small tensors; duplicate
+#: effective blocks are measured once)
+NNZ_BLOCKS = (1024, 2048, 4096, 8192, 16384)
+
+#: scan_target ladder for the xla_scan engine (elements of one-hot
+#: materialized per scan step); the middle rung is the static default
+SCAN_TARGETS = (1 << 21, 1 << 23, 1 << 25)
+
+_AUTOTUNE_ENV = "SPLATT_AUTOTUNE"
+_CACHE_ENV = "SPLATT_TUNE_CACHE"
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedPlan:
+    """One persisted dispatch decision: the measured-fastest
+    (path, engine, nnz_block, scan_target) for a plan-cache key, plus
+    the winning median seconds per MTTKRP call as evidence."""
+
+    path: str
+    engine: str
+    nnz_block: int
+    scan_target: int
+    sec: float
+
+
+@dataclasses.dataclass
+class TuneResult:
+    """What one :func:`tune` invocation did: the per-mode winning plans,
+    how many candidate measurements actually ran (0 on a fully warm
+    cache — the cache-hit contract bench and tests assert on), how many
+    modes were satisfied straight from the cache, and how many
+    candidates were skipped via negative entries or demotions."""
+
+    plans: Dict[int, TunedPlan]
+    measured: int = 0
+    cache_hits: int = 0
+    skipped: int = 0
+
+
+# -- enablement -------------------------------------------------------------
+
+def autotune_enabled(override: Optional[bool] = None) -> bool:
+    """Whether dispatch consults the plan cache: an explicit
+    ``Options.autotune`` wins; otherwise the SPLATT_AUTOTUNE env
+    default (on unless 0/off/false/no)."""
+    if override is not None:
+        return bool(override)
+    from splatt_tpu.utils.env import read_env
+
+    return str(read_env(_AUTOTUNE_ENV)).lower() not in (
+        "0", "off", "false", "no")
+
+
+# -- plan-cache keys --------------------------------------------------------
+
+def shape_regime(dims: Sequence[int], nnz: int) -> str:
+    """Power-of-two shape regime: per-mode dim buckets + an nnz bucket.
+    Tensors within 2x of each other per mode share plans — the same
+    granularity at which the winning configuration actually moves."""
+    db = "-".join(str(int(d).bit_length()) for d in dims)
+    return f"m{len(dims)}:d{db}:z{int(max(nnz, 1)).bit_length()}"
+
+
+def plan_key(dims: Sequence[int], nnz: int, mode: int, rank: int,
+             dtype) -> str:
+    """The cache key of one tuned dispatch site.  Device kind and
+    kernel-source hash live in the environment key (shared with the
+    probe cache), so this only carries the workload shape."""
+    import jax.numpy as jnp
+
+    return (f"{shape_regime(dims, nnz)}:mode{mode}:r{int(rank)}"
+            f":{jnp.dtype(dtype).name}")
+
+
+def _negative_key(key: str, engine: str, block: int,
+                  scan_target: int) -> str:
+    return f"neg:{key}:{engine}:b{block}:s{scan_target}"
+
+
+# -- on-disk plan cache -----------------------------------------------------
+#
+# Shares machinery with the capability-probe cache
+# (ops/pallas_kernels.py): the same environment key — jax version |
+# device kind | _kernel_src_hash, so editing any kernel source
+# invalidates every cached plan — the same TTL
+# (SPLATT_PROBE_CACHE_TTL_S), and the same locked atomic
+# read-modify-write so concurrent tuners do not drop each other's
+# plans.  Cache IO is best-effort by the same contract: a broken cache
+# degrades to re-tuning (and ultimately to the heuristic chain), never
+# to a failed dispatch.
+
+def cache_path():
+    """The plan-cache file: $SPLATT_TUNE_CACHE, else tune_cache.json
+    next to the probe cache."""
+    import pathlib
+
+    from splatt_tpu.ops.pallas_kernels import _cache_path
+    from splatt_tpu.utils.env import read_env
+
+    p = read_env(_CACHE_ENV)
+    if p:
+        return pathlib.Path(p)
+    return _cache_path().with_name("tune_cache.json")
+
+
+def _cache_io_error(op: str, exc) -> None:
+    """Route a plan-cache IO failure through the failure taxonomy into
+    the run report (same contract as the probe cache's helper)."""
+    from splatt_tpu import resilience
+
+    resilience.run_report().add(
+        "tune_cache_io_error", op=op,
+        failure_class=resilience.classify_failure(exc).value,
+        error=resilience.failure_message(exc)[:200])
+
+
+#: in-process memo of resolved cache entries, keyed
+#: (cache file, env key, entry key) -> entry dict | False (negative).
+#: Dispatch consults the plan once per (mode, sweep) — the memo keeps
+#: that a dict lookup instead of a JSON parse per MTTKRP.
+_MEM: dict = {}
+
+
+def reset_memo() -> None:
+    """Forget memoized cache entries (tests; a re-tune in-process)."""
+    _MEM.clear()
+
+
+def _load_file() -> Optional[dict]:
+    import json
+
+    try:
+        with open(cache_path()) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return None  # nothing tuned in this environment yet
+    except Exception as e:
+        # unreadable/corrupt cache: report through the taxonomy and
+        # degrade to a re-tune — a broken cache must never break
+        # dispatch (same contract as _cache_io_error in the probe cache)
+        _cache_io_error("load", e)
+        return None
+    if not isinstance(data, dict) \
+            or data.get("version") != PLAN_CACHE_VERSION:
+        # a different schema generation: re-tune rather than reinterpret
+        return None
+    return data
+
+
+def _entry_get(key: str) -> Optional[dict]:
+    """Resolve one cache entry (plan or negative) with TTL expiry,
+    memoized per (file, environment)."""
+    from splatt_tpu.ops.pallas_kernels import (_cache_env_key,
+                                               probe_cache_ttl)
+
+    memo_key = (str(cache_path()), _cache_env_key(), key)
+    if memo_key in _MEM:
+        hit = _MEM[memo_key]
+        return hit if hit is not False else None
+    entry = None
+    data = _load_file()
+    if data is not None:
+        try:
+            entry = data.get("envs", {}).get(_cache_env_key(), {}).get(key)
+            if entry is not None:
+                ttl = probe_cache_ttl()
+                if ttl > 0 and time.time() - float(entry.get("ts", 0)) > ttl:
+                    entry = None  # expired: re-earn the plan
+        except (AttributeError, TypeError, ValueError) as e:
+            # malformed entry (hand-edited file, schema drift): an
+            # unusable plan, not a dispatch failure — report and re-tune
+            _cache_io_error("load", e)
+            entry = None
+    _MEM[memo_key] = entry if entry is not None else False
+    return entry
+
+
+def _entry_store(key: str, value: dict) -> None:
+    """Persist one entry (locked atomic read-modify-write shared with
+    the probe cache); write-through to the in-process memo."""
+    from splatt_tpu.ops.pallas_kernels import (_cache_env_key,
+                                               _json_cache_update)
+
+    entry = dict(value, ts=time.time())
+    env_key = _cache_env_key()
+
+    def mutate(data):
+        if data.get("version") != PLAN_CACHE_VERSION:
+            # new or foreign-generation file: (re)start this schema
+            data.clear()
+            data["version"] = PLAN_CACHE_VERSION
+        data.setdefault("envs", {}).setdefault(env_key, {})[key] = entry
+        return data
+
+    _json_cache_update(cache_path(), mutate, on_error=_cache_io_error)
+    _MEM[(str(cache_path()), env_key, key)] = entry
+
+
+def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,
+                dtype) -> Optional[TunedPlan]:
+    """The persisted winning plan for this dispatch site, or None
+    (never tuned, expired, negative-only, or unreadable cache)."""
+    entry = _entry_get(plan_key(dims, nnz, mode, rank, dtype))
+    if not entry or "plan" not in entry:
+        return None
+    p = entry["plan"]
+    try:
+        return TunedPlan(path=str(p["path"]), engine=str(p["engine"]),
+                         nnz_block=int(p["nnz_block"]),
+                         scan_target=int(p["scan_target"]),
+                         sec=float(p.get("sec", 0.0)))
+    except (KeyError, TypeError, ValueError) as e:
+        _cache_io_error("load", e)
+        return None
+
+
+def tuned_blocks_for(dims: Sequence[int], nnz: int, rank: int,
+                     dtype) -> Dict[int, int]:
+    """Per-mode tuned nnz_block for every mode with a cached plan —
+    what :meth:`BlockedSparse.compile` builds layouts with, so the
+    layout is built once at the winning block instead of rebuilt when
+    the plan disagrees with the default."""
+    out = {}
+    for m in range(len(dims)):
+        plan = cached_plan(dims, nnz, m, rank, dtype)
+        if plan is not None:
+            out[m] = plan.nnz_block
+    return out
+
+
+# -- measurement ------------------------------------------------------------
+
+def _measure_candidate(layout, factors, mode: int, path: str, impl: str,
+                       engine: str, scan_target: int,
+                       warm: int = 1, reps: int = 2) -> float:
+    """Median seconds of one forced-engine MTTKRP over `layout` after
+    `warm` warm-up calls (compile excluded).  Module-level so tests can
+    substitute the timing body without touching the candidate walk."""
+    from splatt_tpu.ops.mttkrp import _mttkrp_blocked_jit
+    from splatt_tpu.utils import faults
+    from splatt_tpu.utils.env import host_fence
+
+    faults.maybe_fail("tuner.measure")
+
+    def call():
+        return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
+                                   scan_target, engine)
+
+    for _ in range(max(warm, 1)):
+        host_fence(call())
+    times = []
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        host_fence(call())
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def _tune_impl(opts) -> str:
+    """The jit engine family candidates are measured under.  The native
+    host engine sits before the blocked jit dispatch (plans do not
+    govern it), and interpret mode's timings are meaningless — both
+    coerce to the XLA family."""
+    from splatt_tpu.ops.mttkrp import choose_impl
+
+    impl = choose_impl(opts)
+    if impl in ("native", "pallas_interpret"):
+        return "xla"
+    return impl
+
+
+def _candidates(layout, factors, mode: int, path: str, impl: str,
+                scan_targets: Sequence[int],
+                default_scan: int) -> List[Tuple[str, int]]:
+    """(engine, scan_target) candidates for one layout: every live
+    engine_chain entry (demoted engines are pruned there — they are
+    never candidates), with the scan ladder applied only to the
+    xla_scan engine (the only consumer of scan_target)."""
+    from splatt_tpu.ops.mttkrp import engine_chain
+
+    out = []
+    for engine in engine_chain(layout, factors, mode, path, impl):
+        if engine == "xla_scan":
+            out.extend((engine, int(st)) for st in scan_targets)
+        else:
+            out.append((engine, int(default_scan)))
+    return out
+
+
+def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
+         blocks: Optional[Sequence[int]] = None,
+         scan_targets: Optional[Sequence[int]] = None,
+         warm: int = 1, reps: int = 2, force: bool = False) -> TuneResult:
+    """Tune the MTTKRP plan for each mode of `tt` at `rank` and persist
+    the winners in the plan cache.
+
+    Already-cached (unexpired) plans short-circuit their mode entirely
+    — a warm cache runs ZERO measurements (``result.measured == 0``),
+    which is what makes pre-tuning with ``splatt tune`` pay off.  Pass
+    ``force=True`` to re-measure anyway.
+
+    Failure handling follows the resilience taxonomy: transient timing
+    failures retry in place with backoff, deterministic/resource
+    failures persist as negative entries (skipped by later tunes),
+    unknown failures skip the candidate for this session only.  A mode
+    where every candidate fails gets NO plan — dispatch keeps the
+    heuristic chain, recorded as a ``tuner_degraded`` run-report event.
+    """
+    from splatt_tpu import resilience
+    from splatt_tpu.blocked import build_layout
+    from splatt_tpu.config import Verbosity, default_opts, resolve_dtype
+    from splatt_tpu.cpd import init_factors
+    from splatt_tpu.ops.mttkrp import _SCAN_TARGET, choose_path
+    from splatt_tpu.utils.env import read_env_int
+
+    opts = (opts or default_opts()).validate()
+    dtype = resolve_dtype(opts, tt.vals.dtype)
+    impl = _tune_impl(opts)
+    default_scan = read_env_int("SPLATT_SCAN_TARGET_ELEMS") or _SCAN_TARGET
+    blocks = tuple(blocks) if blocks else NNZ_BLOCKS
+    scan_targets = tuple(scan_targets) if scan_targets else SCAN_TARGETS
+    modes = range(tt.nmodes) if modes is None else modes
+    loud = opts.verbosity >= Verbosity.LOW
+    # plan-independent factor operands: the timing only needs shapes
+    # and a realistic dtype, not the caller's actual factors
+    factors = init_factors(tt.dims, rank, seed=0, dtype=dtype)
+
+    result = TuneResult(plans={})
+    for m in modes:
+        key = plan_key(tt.dims, tt.nnz, m, rank, dtype)
+        if not force:
+            plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype)
+            if plan is not None:
+                result.cache_hits += 1
+                result.plans[m] = plan
+                if loud:
+                    print(f"  tune mode {m}: plan cache hit "
+                          f"({plan.engine} b{plan.nnz_block} "
+                          f"s{plan.scan_target}) — skipping measurement")
+                continue
+        best: Optional[TunedPlan] = None
+        seen_blocks = set()
+        for req_block in blocks:
+            layout = build_layout(tt, m, block=int(req_block),
+                                  val_dtype=np.dtype(dtype),
+                                  mode_order=opts.mode_order,
+                                  mode_order_custom=opts.mode_order_custom)
+            if layout.block in seen_blocks:
+                continue  # the clamp collapsed this block onto one done
+            seen_blocks.add(layout.block)
+            path = choose_path(layout, m, opts)
+            for engine, st in _candidates(layout, factors, m, path, impl,
+                                          scan_targets, default_scan):
+                neg = _entry_get(_negative_key(key, engine,
+                                               layout.block, st))
+                if neg is not None:
+                    result.skipped += 1
+                    continue
+
+                def attempt(layout=layout, path=path, engine=engine,
+                            st=st):
+                    return _measure_candidate(layout, factors, m, path,
+                                              impl, engine, st,
+                                              warm=warm, reps=reps)
+
+                try:
+                    sec = resilience.retry_transient(
+                        attempt, label=f"tuner.{engine}")
+                except Exception as e:
+                    cls = resilience.classify_failure(e)
+                    if cls in (resilience.FailureClass.DETERMINISTIC,
+                               resilience.FailureClass.RESOURCE):
+                        # proven: never re-pay this candidate's compile
+                        _entry_store(
+                            _negative_key(key, engine, layout.block, st),
+                            {"state": cls.value,
+                             "error": resilience.failure_message(e)[:200]})
+                    resilience.run_report().add(
+                        "tuner_negative", key=key, engine=engine,
+                        block=layout.block, scan_target=st,
+                        failure_class=cls.value,
+                        error=resilience.failure_message(e)[:200])
+                    result.skipped += 1
+                    continue
+                result.measured += 1
+                if loud:
+                    print(f"  tune mode {m}: {path}/{engine} "
+                          f"b{layout.block} s{st}: {sec:.4f}s")
+                if best is None or sec < best.sec:
+                    best = TunedPlan(path=path, engine=engine,
+                                     nnz_block=layout.block,
+                                     scan_target=st, sec=sec)
+        if best is None:
+            # every candidate failed or was skipped: no plan — dispatch
+            # keeps the heuristic chain (observable, not silent)
+            resilience.run_report().add("tuner_degraded", mode=m, key=key)
+            if loud:
+                print(f"  tune mode {m}: no candidate measurable; "
+                      f"dispatch keeps the heuristic chain")
+            continue
+        _entry_store(key, {"plan": dataclasses.asdict(best)})
+        result.plans[m] = best
+        if loud:
+            print(f"  tune mode {m}: winner {best.path}/{best.engine} "
+                  f"b{best.nnz_block} s{best.scan_target} "
+                  f"({best.sec:.4f}s)")
+    return result

@@ -57,7 +57,18 @@ ENV_VARS = {
                                  "a repo checkout)"),
     "SPLATT_PROBE_CACHE_TTL_S": EnvVar(14 * 24 * 3600.0, "seconds a "
                                        "cached probe verdict stays "
-                                       "fresh; <= 0 disables expiry"),
+                                       "fresh; <= 0 disables expiry "
+                                       "(also the autotuner plan-cache "
+                                       "TTL, docs/autotune.md)"),
+    "SPLATT_AUTOTUNE": EnvVar("1", "MTTKRP dispatch consults the "
+                              "autotuner's persisted plan cache "
+                              "(docs/autotune.md) before the heuristic "
+                              "engine chain; 0/off/false/no = static "
+                              "heuristics only"),
+    "SPLATT_TUNE_CACHE": EnvVar(None, "path override for the "
+                                "autotuner's persistent plan cache "
+                                "(default: tune_cache.json next to the "
+                                "probe cache)"),
     # repo-root bench.py driver knobs (documented here; bench.py is a
     # standalone script outside the package's SPL001 scope)
     "SPLATT_BENCH_NNZ": EnvVar(None, "bench.py: synthetic tensor "

@@ -27,6 +27,8 @@ Sites used by the production code:
     - ``checkpoint_write``       — raise during the checkpoint save
     - ``checkpoint_torn``        — consumed (not raised): the writer
       truncates the bytes it just wrote, simulating a torn write
+    - ``tuner.measure``          — one autotuner candidate measurement
+      (tune.py)
 
 Fault kinds map to canned exceptions whose messages exercise specific
 :func:`splatt_tpu.resilience.classify_failure` branches:
@@ -79,6 +81,10 @@ SITES = {
     "checkpoint_torn": "consumed (not raised): the writer truncates "
                        "the bytes it just wrote, simulating a torn "
                        "write (cpd.py)",
+    "tuner.measure": "one autotuner candidate measurement — warm + "
+                     "timed MTTKRP runs of a forced engine (tune.py); "
+                     "a crashing measurement must degrade dispatch to "
+                     "the heuristic chain, never fail the run",
 }
 
 

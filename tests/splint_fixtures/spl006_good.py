@@ -9,3 +9,8 @@ def risky_write():
 
 def risky_dispatch(engine):
     faults.maybe_fail(f"engine.{engine}")
+
+
+def risky_measurement():
+    # the autotuner's candidate-timing hook (tune.py)
+    faults.maybe_fail("tuner.measure")
