@@ -129,8 +129,11 @@ def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     aliases onto, so the per-phase factor update stops allocating and
     copying a fresh buffer.  The grams stay undonated (every phase
     reads the full gram list) and the LAST phase keeps its M live: the
-    fit phase still needs it (that is why the donation is per-phase,
-    not a blanket donate_argnums).
+    fit phase still needs it.  That is why the last mode is updated
+    OUTSIDE the donating loop rather than by a conditional wrapper
+    pick inside it — the donated M is then never live at the fit read,
+    a property splint's SPL008 dataflow verifies statically instead of
+    jax discovering a deleted buffer at runtime.
     """
     do_mttkrp = _mttkrp_closure(X)
 
@@ -146,6 +149,7 @@ def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
     update_last = jax.jit(update, static_argnames=statics)
 
     fit_phase = jax.jit(_zz_inner)
+    last = nmodes - 1
 
     def sweep(factors, grams, first: bool):
         # contract parity with the jitted _make_sweep: never mutate the
@@ -153,13 +157,14 @@ def _make_phased_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
         factors = list(factors)
         grams = list(grams)
         lam = None
-        M = None
-        for m in range(nmodes):
+        for m in range(last):
             M = do_mttkrp(factors, m)
-            phase = update_mid if m < nmodes - 1 else update_last
-            factors[m], lam, grams[m] = phase(
+            factors[m], lam, grams[m] = update_mid(
                 grams, M, m, first, factors[m].dtype)
-        znormsq, inner = fit_phase(lam, grams, M, factors[nmodes - 1])
+        M = do_mttkrp(factors, last)
+        factors[last], lam, grams[last] = update_last(
+            grams, M, last, first, factors[last].dtype)
+        znormsq, inner = fit_phase(lam, grams, M, factors[last])
         return factors, grams, lam, znormsq, inner
 
     return sweep

@@ -654,6 +654,30 @@ def _cache_io_error(op: str, exc) -> None:
         error=resilience.failure_message(exc)[:200])
 
 
+def _json_cache_load(path, on_error=None):
+    """The shared read side of the JSON cache protocol — used by the
+    capability-probe cache here and the autotuner's plan cache
+    (splatt_tpu/tune.py), and the ONLY sanctioned way to read a shared
+    cache file (splint rule SPL011 flags inline ``open`` on cache
+    paths): a missing file is the normal first-run path (-> None), any
+    other failure is routed to `on_error(op, exc)` (classified into
+    the run report) and degrades to None — a broken cache must never
+    break dispatch.  Writers use :func:`_json_cache_update`; readers
+    need no lock because writes are atomic replaces."""
+    import json
+
+    if on_error is None:
+        on_error = _cache_io_error
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None  # first run in this environment: nothing cached yet
+    except Exception as e:
+        on_error("load", e)
+        return None
+
+
 def probe_cache_load(state_key: str):
     """Cached verdict for `state_key` in this environment, or None.
     Returns whatever was stored ("ok"/"compile_failed"/"resource"/
@@ -661,16 +685,10 @@ def probe_cache_load(state_key: str):
     authoritative.  Entries older than :func:`probe_cache_ttl` are
     expired (returned as None) so every verdict, even a proven one, is
     re-earned occasionally on drifting infrastructure."""
-    import json
     import time
 
-    try:
-        with open(_cache_path()) as f:
-            data = json.load(f)
-    except FileNotFoundError:
-        return None  # first run in this environment: nothing cached yet
-    except Exception as e:
-        _cache_io_error("load", e)
+    data = _json_cache_load(_cache_path())
+    if data is None:
         return None
     try:
         entry = data.get(_cache_env_key(), {}).get(state_key)

@@ -272,6 +272,49 @@ def set_fallback(enabled: Optional[bool]) -> None:
 
 # -- run report -------------------------------------------------------------
 
+#: Every run-report event kind the code emits, name -> one-line doc —
+#: the authoritative documentation of the observability surface,
+#: mirroring utils/env.py:ENV_VARS.  `splint` rule SPL012 statically
+#: checks every ``run_report().add("<kind>", ...)`` emission site
+#: against this registry (both directions: undeclared emissions and
+#: declared-but-never-emitted kinds are findings), so the docs and the
+#: code cannot drift apart.  Tests may add ad-hoc kinds through a
+#: RunReport instance directly; the registry governs production
+#: emissions only.
+RUN_REPORT_EVENTS = {
+    "transient_retry": "a transient failure was retried in place with "
+                       "capped backoff+jitter (retry_transient)",
+    "engine_demotion": "a dispatch engine was demoted at runtime "
+                       "(process-wide, or per-shape for RESOURCE "
+                       "failures) and the fallback chain skips it",
+    "checkpoint_recovery": "a corrupt/torn checkpoint degraded the "
+                           "resume to the .bak generation or a fresh "
+                           "start (cpd.load_checkpoint_resilient)",
+    "probe_downgrade": "a capability-probe verdict was downgraded to "
+                       "unproven for this session (re-probed next "
+                       "process)",
+    "probe_cache_io_error": "probe-cache IO failed and was degraded "
+                            "(cache stays best-effort; verdicts are "
+                            "re-earned)",
+    "tune_cache_io_error": "plan-cache IO failed and was degraded "
+                           "(dispatch falls back to re-tuning or the "
+                           "heuristic chain)",
+    "tuned_plan": "cpd_als dispatched through autotuned MTTKRP plans "
+                  "(docs/autotune.md); carries the per-mode plans",
+    "tuner_negative": "an autotuner candidate failed to measure; "
+                      "deterministic/resource failures persist as "
+                      "negative plan-cache entries",
+    "tuner_degraded": "no autotuner candidate was measurable for a "
+                      "mode; dispatch keeps the heuristic chain",
+    "block_clamp": "build_layout clamped the requested nnz block to "
+                   "the tensor's size (blocked.py)",
+    "env_platform_error": "JAX_PLATFORMS could not be mirrored into "
+                          "jax.config (utils/env.py:"
+                          "apply_env_platform); the run continues on "
+                          "whatever backend jax picks",
+}
+
+
 class RunReport:
     """Append-only log of resilience events for one run: engine
     demotions, transient retries, probe verdict downgrades, checkpoint

@@ -184,19 +184,13 @@ def reset_memo() -> None:
 
 
 def _load_file() -> Optional[dict]:
-    import json
+    from splatt_tpu.ops.pallas_kernels import _json_cache_load
 
-    try:
-        with open(cache_path()) as f:
-            data = json.load(f)
-    except FileNotFoundError:
-        return None  # nothing tuned in this environment yet
-    except Exception as e:
-        # unreadable/corrupt cache: report through the taxonomy and
-        # degrade to a re-tune — a broken cache must never break
-        # dispatch (same contract as _cache_io_error in the probe cache)
-        _cache_io_error("load", e)
-        return None
+    # the shared read helper owns the degradation contract: missing
+    # file -> None, unreadable/corrupt -> reported through the taxonomy
+    # (as tune_cache_io_error here) and degraded to a re-tune — a
+    # broken cache must never break dispatch
+    data = _json_cache_load(cache_path(), on_error=_cache_io_error)
     if not isinstance(data, dict) \
             or data.get("version") != PLAN_CACHE_VERSION:
         # a different schema generation: re-tune rather than reinterpret

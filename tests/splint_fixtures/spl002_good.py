@@ -8,7 +8,8 @@ def classified(fn):
         return fn()
     except Exception as e:
         cls = resilience.classify_failure(e)
-        resilience.run_report().add("fixture", failure_class=cls.value)
+        resilience.run_report().add("probe_cache_io_error", op="load",
+                                    failure_class=cls.value)
         return None
 
 

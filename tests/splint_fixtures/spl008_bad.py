@@ -1,0 +1,30 @@
+"""SPL008 bad: reading a buffer after donating it to a jitted call."""
+
+import jax
+
+
+def make_step(reg):
+    """A jit factory: its return value donates argnum 0."""
+    def step(state, grad):
+        return state - reg * grad
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def direct_reread(state, grad, reg):
+    step = make_step(reg)
+    new = step(state, grad)
+    return state + new  # state's buffer was donated: deleted at runtime
+
+
+def rescue_without_rematerialization(state, grad, reg):
+    """The cpd_als engine-rescue shape WITHOUT the snapshot restore:
+    the retry re-reads the consumed inputs."""
+    step = make_step(reg)
+    while True:
+        try:
+            out = step(state, grad)
+            break
+        except RuntimeError:
+            step = make_step(reg)  # rebuilt — but state is gone
+    return out

@@ -1,0 +1,35 @@
+"""SPL008 good: donated inputs are re-bound before any further read,
+or re-materialized behind the sanctioned is_deleted guard."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_step(reg):
+    def step(state, grad):
+        return state - reg * grad
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def rebind(state, grad, reg):
+    step = make_step(reg)
+    state = step(state, grad)  # donated and immediately re-bound
+    return state
+
+
+def rescue_with_snapshot(state, grad, reg):
+    """The cpd_als engine-rescue idiom: probe is_deleted, restore the
+    consumed input from a host snapshot before retrying."""
+    step = make_step(reg)
+    snap = np.asarray(state)
+    while True:
+        try:
+            state = step(state, grad)
+            break
+        except RuntimeError:
+            step = make_step(reg)
+            if getattr(state, "is_deleted", lambda: False)():
+                state = jnp.asarray(snap)
+    return state

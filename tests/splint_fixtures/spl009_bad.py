@@ -1,0 +1,28 @@
+"""SPL009 bad: values derived from traced arguments escaping the
+trace into long-lived state."""
+
+import jax
+
+TRACE_LOG = []
+
+_LAST = None
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        self.last_input = x * 1.0  # tracer stored on self
+        return x * 2
+
+
+@jax.jit
+def log_and_scale(x):
+    TRACE_LOG.append(x * 2)  # tracer pushed into a global container
+    return x * 3
+
+
+@jax.jit
+def stash(x):
+    global _LAST
+    _LAST = x + 1  # tracer assigned to module state
+    return x

@@ -1,0 +1,18 @@
+"""SPL009 good: traced functions return what they compute; host-side
+state is updated outside the trace, on committed arrays."""
+
+import jax
+
+HISTORY = []
+
+
+@jax.jit
+def scale(x):
+    y = x * 2  # locals are fine: they die with the trace
+    return y
+
+
+def record(x):
+    out = scale(x)
+    HISTORY.append(out)  # outside the trace: a real device array
+    return out

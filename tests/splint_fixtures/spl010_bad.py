@@ -1,0 +1,28 @@
+"""SPL010 bad: recompile/retrace triggers — jit built per iteration,
+a closure-captured device array, an unhashable static argument."""
+
+import jax
+import jax.numpy as jnp
+
+
+def per_step_jit(xs):
+    total = None
+    for x in xs:
+        step = jax.jit(lambda a: a * 2)  # fresh wrapper per iteration
+        total = step(x) if total is None else total + step(x)
+    return total
+
+
+def captured_array(n):
+    table = jnp.arange(n)
+
+    @jax.jit
+    def lookup(i):
+        return table[i]  # device array baked into the trace
+
+    return lookup
+
+
+def unhashable_static(x):
+    f = jax.jit(lambda a, cfg: a, static_argnums=(1,))
+    return f(x, [1, 2, 3])  # list at a static argnum: TypeError

@@ -1,0 +1,21 @@
+"""SPL011 bad: inline IO on the shared cache file, bypassing the
+locked read/write helpers."""
+
+import json
+import pathlib
+
+
+def cache_path():
+    return pathlib.Path("/tmp/spl011_fixture_cache.json")
+
+
+def read_inline():
+    with open(cache_path()) as f:  # bypasses _json_cache_load
+        return json.load(f)
+
+
+def write_inline(entry):
+    p = cache_path()
+    data = {"entry": entry}
+    with open(p, "w") as f:  # unlocked read-modify-write: drops
+        json.dump(data, f)   # concurrent writers' entries

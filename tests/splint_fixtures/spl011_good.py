@@ -1,0 +1,21 @@
+"""SPL011 good: cache IO routed through helpers that take the path as
+a parameter — the sanctioned chokepoint shape."""
+
+import json
+import pathlib
+
+
+def cache_path():
+    return pathlib.Path("/tmp/spl011_fixture_cache.json")
+
+
+def _json_cache_load(path, on_error=None):
+    try:
+        with open(path) as f:  # helper body: the path is a parameter
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+
+
+def read_via_helper():
+    return _json_cache_load(cache_path())

@@ -1,0 +1,9 @@
+"""SPL012 bad: emitting a run-report event kind the
+RUN_REPORT_EVENTS registry never declared."""
+
+from splatt_tpu import resilience
+
+
+def degrade_quietly(err):
+    resilience.run_report().add(
+        "spl012_fixture_undeclared_event", error=str(err))

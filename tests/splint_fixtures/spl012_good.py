@@ -1,0 +1,10 @@
+"""SPL012 good: emission sites name events declared in
+resilience.py:RUN_REPORT_EVENTS."""
+
+from splatt_tpu import resilience
+
+
+def degrade_loudly(err):
+    resilience.run_report().add(
+        "engine_demotion", engine="example",
+        failure_class="unknown", error=str(err))

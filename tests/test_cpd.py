@@ -209,3 +209,38 @@ def test_phased_sweep_matches_fused():
     np.testing.assert_array_equal(np.asarray(lam_a), np.asarray(lam_b))
     for ua, ub in zip(f_a, f_b):
         np.testing.assert_array_equal(np.asarray(ua), np.asarray(ub))
+
+
+def test_phased_sweep_donation_bit_identical():
+    """Regression for the SPL008-driven restructure of the phased
+    sweep (the last mode's update + fit moved OUTSIDE the donating
+    loop so the donated M is never live at the fit read): mid-phase M
+    donation stays a pure buffer-aliasing optimization — bit-identical
+    to the non-donating phased sweep, callers' factors untouched."""
+    from splatt_tpu.cpd import _make_phased_sweep
+    from splatt_tpu.ops.linalg import gram
+
+    rng = np.random.default_rng(7)
+    dims = (14, 11, 9)
+    ind = np.stack([rng.integers(0, d, size=300) for d in dims])
+    tt = SparseTensor(ind, rng.random(300), dims)
+    bs = BlockedSparse.from_coo(tt, _opts(nnz_block=128,
+                                          block_alloc=BlockAlloc.ALLMODE,
+                                          use_pallas=False))
+    outs = []
+    for donate in (False, True):
+        factors = init_factors(tt.dims, 6, 3, dtype=jnp.float64)
+        grams = [gram(U) for U in factors]
+        sweep = _make_phased_sweep(bs, tt.nmodes, 0.0, donate=donate)
+        f, g, lam, zz, inner = sweep(factors, grams, True)
+        for _ in range(2):
+            f, g, lam, zz, inner = sweep(f, g, False)
+        # the fit phase read M AFTER the last (non-donating) update —
+        # with donation on, a mid-phase M re-read would have raised
+        outs.append((f, lam, float(zz), float(inner)))
+        assert not any(u.is_deleted() for u in factors)
+    (f_a, lam_a, zz_a, in_a), (f_b, lam_b, zz_b, in_b) = outs
+    assert zz_a == zz_b and in_a == in_b
+    np.testing.assert_array_equal(np.asarray(lam_a), np.asarray(lam_b))
+    for ua, ub in zip(f_a, f_b):
+        np.testing.assert_array_equal(np.asarray(ua), np.asarray(ub))

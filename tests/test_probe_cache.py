@@ -296,3 +296,34 @@ def test_cache_io_failure_is_harmless(fake_tpu, monkeypatch, tmp_path):
     monkeypatch.setattr(pk, "_probe_case", lambda fn, regime, block: True)
     # store/load both raise internally; dispatch still gets its verdict
     assert pk._probe_compiles(None, "testk", "ck1", 4096) is True
+
+
+def test_reads_route_through_shared_json_cache_load(cache_file,
+                                                    monkeypatch):
+    """Regression for the SPL011 (cache-lock discipline) fix: both the
+    probe cache and the autotuner's plan cache read through the single
+    `_json_cache_load` helper — the sanctioned chokepoint of the locked
+    cache protocol — and a corrupt file degrades through it with a
+    classified run-report event instead of an inline open()."""
+    calls = []
+    real = pk._json_cache_load
+
+    def spy(path, on_error=None):
+        calls.append(str(path))
+        return real(path, on_error=on_error)
+
+    monkeypatch.setattr(pk, "_json_cache_load", spy)
+    cache_file.write_text("{ not json")
+    resilience.run_report().clear()
+    assert pk.probe_cache_load("anything") is None
+    assert calls and calls[0] == str(cache_file)
+    assert resilience.run_report().events("probe_cache_io_error")
+
+    from splatt_tpu import tune
+
+    tune.reset_memo()
+    monkeypatch.setenv(tune._CACHE_ENV, str(cache_file))
+    resilience.run_report().clear()
+    assert tune._load_file() is None
+    assert len(calls) >= 2 and calls[-1] == str(cache_file)
+    assert resilience.run_report().events("tune_cache_io_error")

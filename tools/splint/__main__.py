@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import ast
+import inspect
 import json
 import sys
 from pathlib import Path
@@ -47,6 +48,34 @@ def _env_docs(config) -> str:
     return "\n".join(out)
 
 
+def _explain(config, rule_id: str) -> int:
+    """Print one rule's documentation plus its known-bad / known-good
+    fixtures — the executable spec of what the rule flags and what the
+    sanctioned idiom looks like."""
+    rule = next((r for r in RULES if r.id == rule_id.upper()), None)
+    if rule is None:
+        ids = ", ".join(r.id for r in RULES)
+        print(f"splint: unknown rule {rule_id!r} (have: {ids})",
+              file=sys.stderr)
+        return 2
+    print(f"{rule.id}  {rule.title}")
+    doc = inspect.getdoc(type(rule)) or ""
+    if doc:
+        print()
+        print(doc)
+    if rule.hint:
+        print()
+        print(f"fix hint: {rule.hint}")
+    fixtures = config.resolve(config.tests_path) / "splint_fixtures"
+    for flavor in ("bad", "good"):
+        path = fixtures / f"{rule.id.lower()}_{flavor}.py"
+        if path.is_file():
+            rel = f"{config.tests_path}/splint_fixtures/{path.name}"
+            print(f"\n-- known-{flavor} fixture ({rel}) " + "-" * 20)
+            print(path.read_text().rstrip())
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.splint",
@@ -75,6 +104,9 @@ def main(argv=None) -> int:
                          "exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--explain", metavar="RULE", default=None,
+                    help="print one rule's doc plus its bad/good "
+                         "fixtures and exit (e.g. --explain SPL008)")
     args = ap.parse_args(argv)
 
     try:
@@ -94,6 +126,8 @@ def main(argv=None) -> int:
         for rule in RULES:
             print(f"{rule.id}  {rule.title}")
         return 0
+    if args.explain:
+        return _explain(config, args.explain)
     if args.env_docs:
         print(_env_docs(config))
         return 0

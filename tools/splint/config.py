@@ -44,6 +44,18 @@ class Config:
     #: extra handler-body names SPL002 accepts as routing the failure
     #: (project helpers that wrap resilience.classify_failure)
     resilience_routers: List[str] = dataclasses.field(default_factory=list)
+    #: the resilience module declaring RUN_REPORT_EVENTS (SPL012)
+    resilience_module: str = "splatt_tpu/resilience.py"
+    #: functions returning shared-cache file paths; values derived
+    #: from them must only reach IO through the locked helpers (SPL011)
+    cache_path_functions: List[str] = dataclasses.field(
+        default_factory=list)
+    #: the sanctioned cache-IO helper functions whose bodies SPL011
+    #: exempts (they ARE the locked chokepoints)
+    cache_io_helpers: List[str] = dataclasses.field(default_factory=list)
+    #: rules whose finding budget is ZERO — never baselined, never
+    #: grandfathered; the pytest gate enforces each at 0 findings
+    zero_rules: List[str] = dataclasses.field(default_factory=list)
     #: path fragments to skip entirely
     exclude: List[str] = dataclasses.field(default_factory=list)
 

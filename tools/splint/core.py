@@ -16,7 +16,7 @@ import json
 import re
 import tokenize
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 from tools.splint.config import Config
 
@@ -300,6 +300,628 @@ def update_baseline(path: Path, report: Report) -> Dict[str, dict]:
          "version": 1, "entries": entries}, indent=1, sort_keys=True)
         + "\n")
     return entries
+
+
+# -- dataflow engine --------------------------------------------------------
+#
+# Flow-sensitive machinery for the SPL008-SPL011 rule family: a
+# statement-level per-function CFG (with exception edges, because the
+# hazard SPL008 exists for — a donated buffer observed from an except
+# handler — only exists ON the exception edge), reaching-definition /
+# def-use chains over it, and a lightweight interprocedural
+# "jit-boundary map" recording which callables are jit-wrapped and
+# with which donate/static argnums.  Known imprecision is documented
+# in docs/static-analysis.md: nested function bodies are opaque nodes
+# (their free-variable reads are attributed to their call sites),
+# aliases (`a = factors`) are not tracked, and containers hide their
+# elements.  Rules built on this must therefore choose sides: SPL008
+# is tuned to zero false positives on the sanctioned idioms (the
+# is_deleted re-materialization guard) at the cost of missing
+# laundered reads.
+
+
+class CFGNode:
+    """One control-flow node: a simple statement, a compound-statement
+    header (``if``/``while`` test, ``for`` iter, ``with`` items, an
+    ``except`` entry), or the synthetic entry/exit."""
+
+    __slots__ = ("idx", "kind", "stmt", "succs", "exc_succs",
+                 "defs", "uses", "line")
+
+    def __init__(self, idx: int, kind: str, stmt):
+        self.idx = idx
+        self.kind = kind          # entry|exit|stmt|test|for|with|except
+        self.stmt = stmt          # owning ast node (None for entry/exit)
+        self.succs: List[int] = []      # normal-flow successor idxs
+        self.exc_succs: List[int] = []  # may-raise edges into handlers
+        self.defs: List[str] = []       # names this node (re)binds
+        self.uses: List[Tuple[str, int]] = []  # (name, line) reads
+        self.line = getattr(stmt, "lineno", 0)
+
+
+def _expr_loads(node, bound: FrozenSet[str] = frozenset()
+                ) -> List[Tuple[str, int]]:
+    """(name, line) for every Name *read* in an expression, excluding
+    names bound locally by nested lambdas / comprehension targets (so
+    ``[f(u) for u in xs]`` reads ``xs``, not ``u``) and skipping nested
+    function/class bodies entirely (opaque)."""
+    out: List[Tuple[str, int]] = []
+
+    def walk(n, bound):
+        if isinstance(n, ast.Name):
+            if isinstance(n.ctx, ast.Load) and n.id not in bound:
+                out.append((n.id, n.lineno))
+            return
+        if isinstance(n, ast.Lambda):
+            a = n.args
+            params = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+            if a.vararg:
+                params.add(a.vararg.arg)
+            if a.kwarg:
+                params.add(a.kwarg.arg)
+            for d in list(a.defaults) + [d for d in a.kw_defaults if d]:
+                walk(d, bound)
+            walk(n.body, bound | params)
+            return
+        if isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            targets: Set[str] = set()
+            for gen in n.generators:
+                walk(gen.iter, bound | targets)
+                targets |= {t.id for t in ast.walk(gen.target)
+                            if isinstance(t, ast.Name)}
+                for cond in gen.ifs:
+                    walk(cond, bound | targets)
+            if isinstance(n, ast.DictComp):
+                walk(n.key, bound | targets)
+                walk(n.value, bound | targets)
+            else:
+                walk(n.elt, bound | targets)
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return  # opaque: free-var reads attributed at call sites
+        for c in ast.iter_child_nodes(n):
+            walk(c, bound)
+
+    walk(node, frozenset(bound))
+    return out
+
+
+def _target_defs(target) -> List[str]:
+    """Plain names (re)bound by an assignment target, through tuple/
+    list unpacking and starred elements.  Subscript/attribute stores
+    bind nothing — their bases are *reads* (they need the object
+    alive), which :func:`_expr_loads` already collects."""
+    return [n.id for n in ast.walk(target)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)]
+
+
+def _fill_defs_uses(node: CFGNode) -> None:
+    """Populate `defs`/`uses` of one CFG node from its ast statement."""
+    s = node.stmt
+    if s is None:
+        return
+    if node.kind == "test":               # If/While header: the test
+        node.uses = _expr_loads(s.test)
+    elif node.kind == "for":              # For header: iter + target
+        node.uses = _expr_loads(s.iter)
+        node.defs = _target_defs(s.target)
+    elif node.kind == "with":             # With header: items
+        for item in s.items:
+            node.uses += _expr_loads(item.context_expr)
+            if item.optional_vars is not None:
+                node.defs += _target_defs(item.optional_vars)
+    elif node.kind == "except":           # handler entry: type + name
+        if s.type is not None:
+            node.uses = _expr_loads(s.type)
+        if s.name:
+            node.defs = [s.name]
+    elif isinstance(s, (ast.Assign, ast.AnnAssign)):
+        targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+        if getattr(s, "value", None) is not None:
+            node.uses += _expr_loads(s.value)
+        for t in targets:
+            node.uses += _expr_loads(t)   # subscript/attr bases+indices
+            node.defs += _target_defs(t)
+    elif isinstance(s, ast.AugAssign):
+        node.uses = _expr_loads(s.value) + _expr_loads(s.target)
+        if isinstance(s.target, ast.Name):
+            node.uses.append((s.target.id, s.target.lineno))
+            node.defs = [s.target.id]
+    elif isinstance(s, ast.Delete):
+        for t in s.targets:
+            if isinstance(t, ast.Name):
+                node.defs.append(t.id)    # the binding is gone: a kill
+            else:
+                node.uses += _expr_loads(t)
+    elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        node.defs = [s.name]
+        for dec in s.decorator_list:
+            node.uses += _expr_loads(dec)
+        a = s.args
+        for d in list(a.defaults) + [d for d in a.kw_defaults if d]:
+            node.uses += _expr_loads(d)
+    elif isinstance(s, ast.ClassDef):
+        node.defs = [s.name]
+        for e in s.bases + [k.value for k in s.keywords] \
+                + s.decorator_list:
+            node.uses += _expr_loads(e)
+    elif isinstance(s, (ast.Import, ast.ImportFrom)):
+        node.defs = [a.asname or a.name.split(".")[0] for a in s.names]
+    elif isinstance(s, (ast.Global, ast.Nonlocal, ast.Pass, ast.Break,
+                        ast.Continue)):
+        pass
+    else:  # Expr, Return, Raise, Assert, ...
+        node.uses = _expr_loads(s)
+
+
+class FunctionCFG:
+    """Statement-level control-flow graph of one function body.
+
+    Nested function/class bodies are opaque single nodes.  Exception
+    edges (`exc_succs`) run from every node inside a ``try`` body to
+    that try's handler entries — a raise can interrupt a statement
+    mid-effect, which is exactly when a donated buffer is observed
+    from the handler (SPL008's home turf).  ``break``/``continue``/
+    ``return``/``raise`` cut normal fallthrough."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.nodes: List[CFGNode] = []
+        self.entry = self._new("entry", None)
+        self.exit = self._new("exit", None)
+        self.entry.defs = [a.arg for a in
+                           fn.args.posonlyargs + fn.args.args
+                           + fn.args.kwonlyargs]
+        if fn.args.vararg:
+            self.entry.defs.append(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            self.entry.defs.append(fn.args.kwarg.arg)
+        self._loops: List[Tuple[int, List[int]]] = []  # header, breaks
+        self._handlers: List[List[int]] = []
+        for t in self._block(fn.body, [self.entry.idx]):
+            self._edge(t, self.exit.idx)
+
+    # - construction -
+
+    def _new(self, kind: str, stmt) -> CFGNode:
+        node = CFGNode(len(self.nodes), kind, stmt)
+        _fill_defs_uses(node)
+        self.nodes.append(node)
+        return node
+
+    def _edge(self, a: int, b: int) -> None:
+        if b not in self.nodes[a].succs:
+            self.nodes[a].succs.append(b)
+
+    def _node(self, kind: str, stmt, preds: List[int]) -> CFGNode:
+        node = self._new(kind, stmt)
+        for p in preds:
+            self._edge(p, node.idx)
+        for handlers in self._handlers:
+            for h in handlers:
+                if h not in node.exc_succs:
+                    node.exc_succs.append(h)
+        return node
+
+    def _block(self, stmts, preds: List[int]) -> List[int]:
+        for stmt in stmts:
+            preds = self._stmt(stmt, preds)
+            if not preds:
+                break  # code after return/raise/break is unreachable
+        return preds
+
+    def _stmt(self, stmt, preds: List[int]) -> List[int]:
+        if isinstance(stmt, ast.If):
+            test = self._node("test", stmt, preds)
+            out = self._block(stmt.body, [test.idx])
+            out += (self._block(stmt.orelse, [test.idx])
+                    if stmt.orelse else [test.idx])
+            return out
+        if isinstance(stmt, ast.While):
+            test = self._node("test", stmt, preds)
+            breaks: List[int] = []
+            self._loops.append((test.idx, breaks))
+            body_out = self._block(stmt.body, [test.idx])
+            self._loops.pop()
+            for t in body_out:
+                self._edge(t, test.idx)
+            out = (self._block(stmt.orelse, [test.idx])
+                   if stmt.orelse else [test.idx])
+            return out + breaks
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            head = self._node("for", stmt, preds)
+            breaks = []
+            self._loops.append((head.idx, breaks))
+            body_out = self._block(stmt.body, [head.idx])
+            self._loops.pop()
+            for t in body_out:
+                self._edge(t, head.idx)
+            out = (self._block(stmt.orelse, [head.idx])
+                   if stmt.orelse else [head.idx])
+            return out + breaks
+        if isinstance(stmt, ast.Try):
+            entries = [self._new("except", h) for h in stmt.handlers]
+            self._handlers.append([e.idx for e in entries])
+            out = self._block(stmt.body, preds)
+            self._handlers.pop()
+            if stmt.orelse:
+                out = self._block(stmt.orelse, out)
+            for e, h in zip(entries, stmt.handlers):
+                out += self._block(h.body, [e.idx])
+            if stmt.finalbody:
+                out = self._block(stmt.finalbody, out)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._node("with", stmt, preds)
+            return self._block(stmt.body, [head.idx])
+        node = self._node("stmt", stmt, preds)
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            self._edge(node.idx, self.exit.idx)
+            return []
+        if isinstance(stmt, ast.Break):
+            if self._loops:
+                self._loops[-1][1].append(node.idx)
+            return []
+        if isinstance(stmt, ast.Continue):
+            if self._loops:
+                self._edge(node.idx, self._loops[-1][0])
+            return []
+        return [node.idx]
+
+    # - predecessor views (exception edges carry a weaker state) -
+
+    def preds(self) -> Dict[int, List[Tuple[int, bool]]]:
+        """node idx -> [(pred idx, via_exception_edge)]."""
+        out: Dict[int, List[Tuple[int, bool]]] = {
+            n.idx: [] for n in self.nodes}
+        for n in self.nodes:
+            for s in n.succs:
+                out[s].append((n.idx, False))
+            for s in n.exc_succs:
+                out[s].append((n.idx, True))
+        return out
+
+
+def reaching_defs(cfg: FunctionCFG
+                  ) -> Tuple[List[Dict[str, Set[int]]],
+                             List[Dict[str, Set[int]]]]:
+    """Classic may-reach definitions over the CFG: per node, IN/OUT
+    maps of name -> defining node idxs.  Function parameters are defs
+    at the entry node.  Exception edges propagate IN ∪ GEN without the
+    kill — the raising statement may or may not have completed its
+    (re)binding."""
+    nodes = cfg.nodes
+    preds = cfg.preds()
+    ins: List[Dict[str, Set[int]]] = [{} for _ in nodes]
+    outs: List[Dict[str, Set[int]]] = [{} for _ in nodes]
+    excs: List[Dict[str, Set[int]]] = [{} for _ in nodes]
+
+    def apply(node: CFGNode, state: Dict[str, Set[int]], kill: bool
+              ) -> Dict[str, Set[int]]:
+        new = {k: set(v) for k, v in state.items()}
+        for name in node.defs:
+            if kill:
+                new[name] = {node.idx}
+            else:
+                new.setdefault(name, set()).add(node.idx)
+        return new
+
+    work = [n.idx for n in nodes]
+    while work:
+        i = work.pop()
+        node = nodes[i]
+        merged: Dict[str, Set[int]] = {}
+        for p, via_exc in preds[i]:
+            src = excs[p] if via_exc else outs[p]
+            for name, defs in src.items():
+                merged.setdefault(name, set()).update(defs)
+        new_out = apply(node, merged, kill=True)
+        new_exc = apply(node, merged, kill=False)
+        if merged != ins[i] or new_out != outs[i] or new_exc != excs[i]:
+            ins[i], outs[i], excs[i] = merged, new_out, new_exc
+            for s in node.succs + node.exc_succs:
+                if s not in work:
+                    work.append(s)
+    return ins, outs
+
+
+def def_use_chains(cfg: FunctionCFG) -> Dict[Tuple[int, str], Set[int]]:
+    """(use node idx, name) -> node idxs whose definition may reach the
+    use.  Uses evaluate before their own node's (re)bindings."""
+    ins, _ = reaching_defs(cfg)
+    out: Dict[Tuple[int, str], Set[int]] = {}
+    for node in cfg.nodes:
+        for name, _line in node.uses:
+            out[(node.idx, name)] = set(ins[node.idx].get(name, set()))
+    return out
+
+
+# -- jit-boundary map -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class JitSpec:
+    """Statically-known facts about one jit wrapper: donated/static
+    argument positions and names.  Conditional expressions contribute
+    the UNION of their branches (``donate_argnums=(0, 1) if donate
+    else ()`` may donate 0 and 1 — a may-analysis must assume it
+    does)."""
+
+    donate_argnums: FrozenSet[int] = frozenset()
+    donate_argnames: FrozenSet[str] = frozenset()
+    static_argnums: FrozenSet[int] = frozenset()
+    static_argnames: FrozenSet[str] = frozenset()
+    inner: Optional[str] = None   # wrapped callable name, when a Name
+    line: int = 0
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_argnums or self.donate_argnames)
+
+    def union(self, other: Optional["JitSpec"]) -> "JitSpec":
+        if other is None:
+            return self
+        return JitSpec(
+            donate_argnums=self.donate_argnums | other.donate_argnums,
+            donate_argnames=self.donate_argnames | other.donate_argnames,
+            static_argnums=self.static_argnums | other.static_argnums,
+            static_argnames=self.static_argnames | other.static_argnames,
+            inner=self.inner or other.inner,
+            line=self.line or other.line)
+
+
+def _const_ints(node) -> FrozenSet[int]:
+    if node is None:
+        return frozenset()
+    return frozenset(n.value for n in ast.walk(node)
+                     if isinstance(n, ast.Constant)
+                     and isinstance(n.value, int)
+                     and not isinstance(n.value, bool))
+
+
+def _const_strs(node) -> FrozenSet[str]:
+    if node is None:
+        return frozenset()
+    return frozenset(n.value for n in ast.walk(node)
+                     if isinstance(n, ast.Constant)
+                     and isinstance(n.value, str))
+
+
+_JIT_NAMES = ("jax.jit", "jit", "jax.pjit",
+              "jax.experimental.pjit.pjit", "pjit")
+
+
+def jit_call_spec(ctx: "FileCtx", call: ast.Call) -> Optional[JitSpec]:
+    """JitSpec of a ``jax.jit(f, ...)`` / ``pjit(...)`` /
+    ``functools.partial(jax.jit, ...)`` call expression, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    dotted = ctx.resolve(call.func) or ""
+    kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+    inner = None
+    if dotted.split(".")[-1] == "partial" and call.args:
+        if (ctx.resolve(call.args[0]) or "") not in _JIT_NAMES:
+            return None
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Name):
+            inner = call.args[1].id
+    elif dotted in _JIT_NAMES:
+        if call.args and isinstance(call.args[0], ast.Name):
+            inner = call.args[0].id
+    else:
+        return None
+    return JitSpec(
+        donate_argnums=_const_ints(kwargs.get("donate_argnums")),
+        donate_argnames=_const_strs(kwargs.get("donate_argnames")),
+        static_argnums=_const_ints(kwargs.get("static_argnums")),
+        static_argnames=_const_strs(kwargs.get("static_argnames")),
+        inner=inner, line=call.lineno)
+
+
+def jit_decorator_spec(ctx: "FileCtx", fn) -> Optional[JitSpec]:
+    """JitSpec when `fn` is jit-decorated (``@jax.jit``,
+    ``@jax.jit(...)``, ``@partial(jax.jit, ...)``), else None."""
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            spec = jit_call_spec(ctx, dec)
+            if spec is not None:
+                return dataclasses.replace(spec, inner=fn.name,
+                                           line=fn.lineno)
+        elif (ctx.resolve(dec) or "") in _JIT_NAMES:
+            return JitSpec(inner=fn.name, line=fn.lineno)
+    return None
+
+
+def _body_stmts(fn) -> List[ast.stmt]:
+    """Every statement of `fn`'s own body — nested function/class
+    *definitions* are included as statements, but their bodies are not
+    descended into (those are separate scopes)."""
+    out: List[ast.stmt] = []
+    stack = list(fn.body)
+    while stack:
+        s = stack.pop()
+        out.append(s)
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        stack.extend(c for c in ast.iter_child_nodes(s)
+                     if isinstance(c, ast.stmt))
+    return out
+
+
+def nested_defs(fn) -> List[ast.FunctionDef]:
+    """Function definitions nested directly in `fn`'s own scope."""
+    return [s for s in _body_stmts(fn)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def scope_functions(tree) -> List[ast.FunctionDef]:
+    """Every function definition NOT nested inside another function —
+    the entry points for per-function analyses: module-level functions
+    AND class methods (at any class-nesting depth).  Function-nested
+    defs are reached by each analysis' own recursion, which threads
+    the enclosing scope's environment down to them."""
+    nested: Set[int] = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(fn):
+                if sub is not fn and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    nested.add(id(sub))
+    return [fn for fn in ast.walk(tree)
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and id(fn) not in nested]
+
+
+def free_reads(fn) -> Set[str]:
+    """Names `fn`'s body reads that `fn` itself does not bind — the
+    closure/global reads, attributed to call sites by the donation
+    analysis (calling ``snapshot()`` reads whatever ``snapshot``
+    closes over, at the moment of the call)."""
+    cfg = FunctionCFG(fn)
+    bound: Set[str] = set()
+    for node in cfg.nodes:
+        bound.update(node.defs)
+    out: Set[str] = set()
+    for node in cfg.nodes:
+        out.update(name for name, _ in node.uses)
+    # comprehension-style targets inside expressions are already
+    # excluded by _expr_loads; nested defs are opaque, so one level of
+    # their own free reads is folded in (snapshot -> deeper closures)
+    for sub in nested_defs(fn):
+        out |= free_reads(sub)
+    return out - bound
+
+
+def callable_jit_spec(ctx: "FileCtx", expr,
+                      env: Dict[str, JitSpec],
+                      factories: Dict[str, JitSpec]
+                      ) -> Optional[JitSpec]:
+    """The JitSpec of the *value* of `expr`, when that value is a
+    jit-wrapped callable: a direct ``jax.jit(...)`` expression, a name
+    bound to one, a call to a jit *factory* (a function returning a
+    jit-wrapped callable), or a conditional union of those."""
+    if isinstance(expr, ast.IfExp):
+        a = callable_jit_spec(ctx, expr.body, env, factories)
+        b = callable_jit_spec(ctx, expr.orelse, env, factories)
+        if a is None:
+            return b
+        return a.union(b)
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id)
+    if isinstance(expr, ast.Call):
+        direct = jit_call_spec(ctx, expr)
+        if direct is not None:
+            return direct
+        func = expr.func
+        if isinstance(func, ast.IfExp):   # (_a if c else _b)(...)
+            a = (factories.get(func.body.id)
+                 if isinstance(func.body, ast.Name) else None)
+            b = (factories.get(func.orelse.id)
+                 if isinstance(func.orelse, ast.Name) else None)
+            if a is None:
+                return b
+            return a.union(b)
+        if isinstance(func, ast.Name):
+            return factories.get(func.id)
+    return None
+
+
+def returns_jit_spec(ctx: "FileCtx", fn,
+                     env: Dict[str, JitSpec],
+                     factories: Dict[str, JitSpec]
+                     ) -> Optional[JitSpec]:
+    """JitSpec of `fn`'s return value when `fn` is a jit factory —
+    it returns a jit-wrapped callable (directly, through a local
+    binding, or by delegating to another known factory)."""
+    local = dict(env)
+    for s in _body_stmts(fn):
+        if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                and isinstance(s.targets[0], ast.Name)):
+            spec = callable_jit_spec(ctx, s.value, local, factories)
+            if spec is not None:
+                local[s.targets[0].id] = spec
+    best: Optional[JitSpec] = None
+    for s in _body_stmts(fn):
+        if isinstance(s, ast.Return) and s.value is not None:
+            spec = callable_jit_spec(ctx, s.value, local, factories)
+            if spec is not None:
+                best = spec if best is None else best.union(spec)
+    return best
+
+
+class JitBoundary:
+    """Module-level jit-boundary map of one file.
+
+    - `wrapped`: names bound to jit-wrapped callables (decorated
+      functions, ``name = jax.jit(...)`` assignments);
+    - `factories`: module-level functions whose RETURN VALUE is a
+      jit-wrapped callable, resolved to a fixpoint so a factory may
+      delegate to another factory (``build_sweep`` -> ``_make_sweep``
+      -> ``jax.jit(sweep, donate_argnums=...)``);
+    - `traced`: FunctionDef nodes whose body is traced (decorated, or
+      referenced by name as a jit call's first argument), with the
+      spec — what SPL009/SPL010 scan.
+    """
+
+    def __init__(self, ctx: "FileCtx"):
+        self.wrapped: Dict[str, JitSpec] = {}
+        self.factories: Dict[str, JitSpec] = {}
+        self.traced: List[Tuple[ast.FunctionDef, JitSpec]] = []
+        module_fns = [s for s in ctx.tree.body
+                      if isinstance(s, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))]
+        for s in ctx.tree.body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spec = jit_decorator_spec(ctx, s)
+                if spec is not None:
+                    self.wrapped[s.name] = spec
+            elif (isinstance(s, ast.Assign) and len(s.targets) == 1
+                    and isinstance(s.targets[0], ast.Name)):
+                spec = jit_call_spec(ctx, s.value)
+                if spec is not None:
+                    self.wrapped[s.targets[0].id] = spec
+        # factory fixpoint over module-level functions (delegation
+        # chains are short; cap the iteration defensively)
+        for _ in range(8):
+            changed = False
+            for fn in module_fns:
+                spec = returns_jit_spec(ctx, fn, self.wrapped,
+                                        self.factories)
+                if spec is not None and spec != self.factories.get(fn.name):
+                    self.factories[fn.name] = spec
+                    changed = True
+            if not changed:
+                break
+        # traced functions: decorated ones, plus defs referenced by
+        # name from a jit call in the same (or an enclosing) scope
+        def visit(scope_fns: Dict[str, ast.FunctionDef], body):
+            local_defs = {s.name: s for s in body
+                          if isinstance(s, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+            fns = dict(scope_fns, **local_defs)
+            for s in body:
+                for call in ast.walk(s):
+                    spec = (jit_call_spec(ctx, call)
+                            if isinstance(call, ast.Call) else None)
+                    if spec is not None and spec.inner in fns:
+                        self.traced.append((fns[spec.inner], spec))
+            for fn in local_defs.values():
+                visit(fns, fn.body)
+
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spec = jit_decorator_spec(ctx, fn)
+                if spec is not None:
+                    self.traced.append((fn, spec))
+        visit({}, ctx.tree.body)
+
+
+def jit_boundary(ctx: "FileCtx") -> JitBoundary:
+    """The (cached) jit-boundary map of one analyzed file."""
+    if getattr(ctx, "_jit_boundary", None) is None:
+        ctx._jit_boundary = JitBoundary(ctx)
+    return ctx._jit_boundary
 
 
 # -- run loop ---------------------------------------------------------------

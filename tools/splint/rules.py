@@ -11,7 +11,11 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from tools.splint.core import FileCtx, Finding, Project
+from tools.splint.core import (FileCtx, Finding, FunctionCFG, JitSpec,
+                               Project, _body_stmts, _expr_loads,
+                               callable_jit_spec, free_reads,
+                               jit_boundary, jit_call_spec, nested_defs,
+                               returns_jit_spec, scope_functions)
 
 #: handler-body names accepted as "routing the failure through the
 #: taxonomy" — the resilience module's public verbs.  Projects add
@@ -480,6 +484,703 @@ class UndocumentedEnvVar(Rule):
         return out
 
 
+# -- SPL008 -----------------------------------------------------------------
+
+def _all_functions(tree) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _is_deleted_probe(test: ast.AST) -> bool:
+    """Whether a branch test probes buffer deletion — the sanctioned
+    re-materialization guard (``if any(a.is_deleted() for a in ...)``
+    or the ``getattr(a, "is_deleted", ...)`` spelling)."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr == "is_deleted":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "is_deleted":
+            return True
+    return False
+
+
+class UseAfterDonate(Rule):
+    """A value handed to a jitted call at a donated argnum is read
+    again without re-materialization.  ``donate_argnums`` aliases the
+    output buffers onto the inputs (what makes the ALS sweep update in
+    place), so the caller's array is GONE after the call — jax only
+    reports the re-read at runtime, as a RuntimeError naming a deleted
+    buffer.  The analysis is flow-sensitive (may-donate union over
+    conditional wrappers, exception edges into handlers) and follows
+    jit factories across function boundaries via the jit-boundary map.
+    Re-binding the name clears the state; so does the sanctioned
+    rescue idiom — a branch probing ``is_deleted`` whose body
+    re-materializes the name (cpd_als's engine-rescue path).  Known
+    imprecision: aliases (``a = factors``) and containers are not
+    tracked; nested-function bodies are opaque, but calling a local
+    closure counts as reading every name it closes over."""
+
+    id = "SPL008"
+    title = "donated buffer read after the jitted call"
+    hint = ("re-materialize before the read (re-bind the name, or "
+            "guard with the is_deleted + host-snapshot rescue idiom "
+            "in cpd.py), or drop the argnum from donate_argnums")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        jb = jit_boundary(ctx)
+        out: List[Finding] = []
+
+        def analyze(fn, env: Dict[str, JitSpec],
+                    factories: Dict[str, JitSpec]) -> None:
+            env = dict(env)
+            factories = dict(factories)
+            subs = nested_defs(fn)
+            # nested factories (build_sweep) against the inherited maps
+            for _ in range(4):
+                changed = False
+                for sub in subs:
+                    spec = returns_jit_spec(ctx, sub, env, factories)
+                    if spec is not None and spec != factories.get(sub.name):
+                        factories[sub.name] = spec
+                        changed = True
+                if not changed:
+                    break
+            # flow-insensitive local bindings: sweep = build_sweep()
+            for s in _body_stmts(fn):
+                if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                        and isinstance(s.targets[0], ast.Name)):
+                    spec = callable_jit_spec(ctx, s.value, env, factories)
+                    if spec is not None:
+                        env[s.targets[0].id] = spec
+            donating = (any(s.donates for s in env.values())
+                        or any(s.donates for s in factories.values()))
+            if not donating:
+                # a donating wrapper invoked without ever being bound:
+                # jax.jit(f, donate_argnums=...)(x), make_step(r)(x, g)
+                donating = any(
+                    (spec := jit_call_spec(ctx, n)) is not None
+                    and spec.donates
+                    for n in ast.walk(fn) if isinstance(n, ast.Call))
+            if donating:
+                out.extend(self._dataflow(ctx, fn, env, factories))
+            for sub in subs:
+                analyze(sub, env, factories)
+
+        module_env = dict(jb.wrapped)
+        for fn in scope_functions(ctx.tree):
+            analyze(fn, module_env, dict(jb.factories))
+        return _dedupe(out)
+
+    def _dataflow(self, ctx, fn, env, factories) -> List[Finding]:
+        cfg = FunctionCFG(fn)
+        closures = {sub.name: free_reads(sub) for sub in nested_defs(fn)}
+        findings: Dict[Tuple[str, int], Finding] = {}
+
+        def node_effects(node):
+            """(exempt_uses, extra_uses, sanitized, donations) of one
+            CFG node; donations = [(name, call line)]."""
+            stmt = node.stmt
+            exprs: List[ast.AST] = []
+            if node.kind == "test":
+                exprs = [stmt.test]
+            elif node.kind == "for":
+                exprs = [stmt.iter]
+            elif node.kind == "with":
+                exprs = [i.context_expr for i in stmt.items]
+            elif node.kind == "except":
+                exprs = [stmt.type] if stmt.type is not None else []
+            elif node.kind == "stmt" and not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+                exprs = [stmt]
+            exempt = (node.kind == "test"
+                      and _is_deleted_probe(stmt.test))
+            sanitized: Set[str] = set()
+            if exempt and isinstance(stmt, ast.If):
+                # the guard's body re-materializes these names; the
+                # false branch has PROVEN the buffers are not deleted,
+                # so both out-edges are clean
+                for sub in stmt.body:
+                    for n in ast.walk(sub):
+                        if isinstance(n, ast.Name) and \
+                                isinstance(n.ctx, ast.Store):
+                            sanitized.add(n.id)
+            extra_uses: List[Tuple[str, int]] = []
+            donations: List[Tuple[str, int]] = []
+            for root in exprs:
+                for call in ast.walk(root):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if isinstance(call.func, ast.Name) and \
+                            call.func.id in closures:
+                        extra_uses += [(n, call.lineno)
+                                       for n in closures[call.func.id]]
+                    spec = callable_jit_spec(ctx, call.func, env,
+                                             factories)
+                    if spec is None or not spec.donates:
+                        continue
+                    for i in sorted(spec.donate_argnums):
+                        if i < len(call.args) and \
+                                isinstance(call.args[i], ast.Name):
+                            donations.append(
+                                (call.args[i].id, call.lineno))
+                    for kw in call.keywords:
+                        if kw.arg in spec.donate_argnames and \
+                                isinstance(kw.value, ast.Name):
+                            donations.append((kw.value.id, call.lineno))
+            return exempt, extra_uses, sanitized, donations
+
+        effects = {n.idx: node_effects(n) for n in cfg.nodes}
+        preds = cfg.preds()
+        # state: name -> line of the donating call; merge = union
+        ins: List[Dict[str, int]] = [{} for _ in cfg.nodes]
+        outs: List[Dict[str, int]] = [{} for _ in cfg.nodes]
+        excs: List[Dict[str, int]] = [{} for _ in cfg.nodes]
+        work = [n.idx for n in cfg.nodes]
+        while work:
+            i = work.pop()
+            node = cfg.nodes[i]
+            exempt, extra_uses, sanitized, donations = effects[i]
+            merged: Dict[str, int] = {}
+            for p, via_exc in preds[i]:
+                src = excs[p] if via_exc else outs[p]
+                for name, line in src.items():
+                    merged[name] = min(merged.get(name, line), line)
+            state = {k: v for k, v in merged.items()
+                     if k not in sanitized}
+            if not exempt:
+                for name, line in list(node.uses) + extra_uses:
+                    if name in state:
+                        key = (name, line)
+                        if key not in findings:
+                            findings[key] = self.finding(
+                                ctx, line,
+                                f"'{name}' was donated to the jitted "
+                                f"call at line {state[name]} "
+                                f"(donate_argnums) and is read here "
+                                f"without re-materialization")
+            after_donate = dict(state)
+            for name, line in donations:
+                after_donate[name] = line
+            new_out = {k: v for k, v in after_donate.items()
+                       if k not in node.defs}
+            if merged != ins[i] or new_out != outs[i] \
+                    or after_donate != excs[i]:
+                ins[i], outs[i], excs[i] = merged, new_out, after_donate
+                for s in node.succs + node.exc_succs:
+                    if s not in work:
+                        work.append(s)
+        return list(findings.values())
+
+
+# -- SPL009 -----------------------------------------------------------------
+
+_MUTATORS = {"append", "extend", "add", "insert", "update", "setdefault",
+             "appendleft"}
+
+
+class TracerLeak(Rule):
+    """A value derived from a traced argument escapes the trace into
+    long-lived state: assigned to ``self.``/a global/nonlocal, or
+    pushed into a closed-over container.  The stored object is a
+    tracer (or, post-trace, a stale constant from one compilation) —
+    it outlives the trace that created it, and jax reports the misuse
+    only when the leaked tracer is touched later, far from the leak."""
+
+    id = "SPL009"
+    title = "traced value escapes the trace into outer state"
+    hint = ("return the value from the jitted function instead of "
+            "stashing it on self/globals/closures; host-side logging "
+            "belongs outside the traced region")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for fn, spec in jit_boundary(ctx).traced:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.extend(self._check_traced(ctx, fn, spec))
+        return _dedupe(out)
+
+    def _check_traced(self, ctx, fn, spec: JitSpec) -> List[Finding]:
+        params = _fn_params(fn)
+        static = set(spec.static_argnames) | {
+            params[i] for i in spec.static_argnums if i < len(params)}
+        tainted: Set[str] = set(params) - static - {"self"}
+        if not tainted:
+            return []
+        body = _body_stmts(fn)
+        local: Set[str] = set(params)
+        declared_outer: Set[str] = set()
+        for s in body:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    local.add(n.id)
+            if isinstance(s, (ast.Global, ast.Nonlocal)):
+                declared_outer.update(s.names)
+        local -= declared_outer
+
+        def value_tainted(expr) -> bool:
+            return any(name in tainted for name, _ in _expr_loads(expr))
+
+        # taint propagation to a fixpoint (assignments only: the leak
+        # verbs below are the sinks, not propagators)
+        changed = True
+        while changed:
+            changed = False
+            for s in body:
+                targets = []
+                if isinstance(s, ast.Assign):
+                    targets, value = s.targets, s.value
+                elif isinstance(s, (ast.AnnAssign, ast.AugAssign)):
+                    targets, value = [s.target], s.value
+                elif isinstance(s, (ast.For, ast.AsyncFor)):
+                    targets, value = [s.target], s.iter
+                else:
+                    continue
+                if value is None or not value_tainted(value):
+                    continue
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and \
+                                isinstance(n.ctx, ast.Store) and \
+                                n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+
+        out: List[Finding] = []
+        for s in body:
+            if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = s.targets if isinstance(s, ast.Assign) \
+                    else [s.target]
+                value = getattr(s, "value", None)
+                if value is None or not value_tainted(value):
+                    # a nonlocal/global REBIND leaks even untainted?
+                    # no: only traced-derived values are the hazard
+                    continue
+                for t in targets:
+                    base = t.value if isinstance(
+                        t, (ast.Attribute, ast.Subscript)) else None
+                    if isinstance(base, ast.Name) and (
+                            base.id == "self" or base.id not in local):
+                        kind = ("self" if base.id == "self"
+                                else f"outer object '{base.id}'")
+                        out.append(self.finding(
+                            ctx, s.lineno,
+                            f"traced value stored on {kind} inside "
+                            f"jitted '{fn.name}' — the tracer outlives "
+                            f"its trace"))
+                    elif isinstance(t, ast.Name) and \
+                            t.id in declared_outer:
+                        out.append(self.finding(
+                            ctx, s.lineno,
+                            f"traced value assigned to "
+                            f"global/nonlocal '{t.id}' inside jitted "
+                            f"'{fn.name}' — the tracer outlives its "
+                            f"trace"))
+            elif isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+                call = s.value
+                f = call.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATORS
+                        and isinstance(f.value, ast.Name)):
+                    continue
+                holder = f.value.id
+                if holder in local and holder != "self":
+                    continue
+                if any(value_tainted(a) for a in call.args) or any(
+                        value_tainted(k.value) for k in call.keywords):
+                    out.append(self.finding(
+                        ctx, s.lineno,
+                        f"traced value .{f.attr}()-ed into closed-over "
+                        f"container '{holder}' inside jitted "
+                        f"'{fn.name}' — the tracer outlives its trace"))
+        return out
+
+
+# -- SPL010 -----------------------------------------------------------------
+
+_ARRAY_MAKERS = {
+    "jax.numpy.asarray", "jax.numpy.array", "jax.numpy.zeros",
+    "jax.numpy.ones", "jax.numpy.full", "jax.numpy.arange",
+    "jax.numpy.empty", "jax.numpy.linspace", "jax.device_put",
+    "numpy.asarray", "numpy.array", "numpy.zeros", "numpy.ones",
+}
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+class RecompileTrigger(Rule):
+    """Constructs that silently rebuild or re-specialize a compiled
+    program: a ``jax.jit`` wrapper created inside a loop (every
+    iteration compiles from scratch — each a ~35 s remote compile on
+    the relay), a jitted closure capturing a device array from an
+    enclosing function (baked into the executable as a constant:
+    silent staleness when the array changes, a retrace when the
+    closure is rebuilt), and an unhashable literal (list/dict/set)
+    passed at a static argnum — a guaranteed ``TypeError`` at call
+    time."""
+
+    id = "SPL010"
+    title = "recompile/retrace trigger (jit-in-loop, captured array, "\
+            "unhashable static)"
+    hint = ("hoist the jit wrapper out of the loop (rebuild only on "
+            "demotion — the build_sweep factory pattern); pass device "
+            "arrays as arguments, not closure captures; static args "
+            "must be hashable (tuples, not lists)")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        out += self._jit_in_loop(ctx)
+        out += self._captured_arrays(ctx)
+        out += self._unhashable_statics(ctx)
+        return _dedupe(out)
+
+    # - (a) jit constructed inside a loop -
+
+    def _jit_in_loop(self, ctx) -> List[Finding]:
+        out = []
+
+        def walk(node, depth):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                for child in ast.iter_child_nodes(node):
+                    walk(child, 0)  # new scope: built when called
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                # target/iter evaluate once per loop ENTRY; only the
+                # body (and a while test) re-run per iteration
+                walk(node.target, depth)
+                walk(node.iter, depth)
+                for s in node.body:
+                    walk(s, depth + 1)
+                for s in node.orelse:
+                    walk(s, depth)
+                return
+            if isinstance(node, ast.While):
+                walk(node.test, depth + 1)
+                for s in node.body:
+                    walk(s, depth + 1)
+                for s in node.orelse:
+                    walk(s, depth)
+                return
+            if isinstance(node, ast.Call) and depth > 0 \
+                    and jit_call_spec(ctx, node) is not None:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "jax.jit wrapper constructed inside a loop — "
+                    "every iteration pays a fresh trace+compile"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, depth)
+
+        walk(ctx.tree, 0)
+        return out
+
+    # - (b) jitted closure capturing an enclosing-scope device array -
+
+    def _captured_arrays(self, ctx) -> List[Finding]:
+        jb = jit_boundary(ctx)
+        traced_ids = {id(fn) for fn, _ in jb.traced}
+        out = []
+
+        def array_bindings(fn) -> Dict[str, int]:
+            binds = {}
+            for s in _body_stmts(fn):
+                if not (isinstance(s, ast.Assign)
+                        and isinstance(s.value, ast.Call)):
+                    continue
+                if (ctx.resolve(s.value.func) or "") not in _ARRAY_MAKERS:
+                    continue
+                for t in s.targets:
+                    if isinstance(t, ast.Name):
+                        binds[t.id] = s.lineno
+            return binds
+
+        def visit(fn, outer_binds: Dict[str, int]):
+            binds = dict(outer_binds, **array_bindings(fn))
+            for sub in nested_defs(fn):
+                if id(sub) in traced_ids:
+                    for name in sorted(free_reads(sub) & set(binds)):
+                        out.append(self.finding(
+                            ctx, sub.lineno,
+                            f"jitted '{sub.name}' closes over device "
+                            f"array '{name}' (materialized at line "
+                            f"{binds[name]}) — baked into the trace "
+                            f"as a constant"))
+                visit(sub, binds)
+
+        for fn in scope_functions(ctx.tree):
+            visit(fn, {})
+        return out
+
+    # - (c) unhashable literal at a static argnum -
+
+    def _unhashable_statics(self, ctx) -> List[Finding]:
+        jb = jit_boundary(ctx)
+        out = []
+
+        def analyze(fn, env):
+            env = dict(env)
+            body = _body_stmts(fn)
+            # bindings first (flow-insensitively), then the call scan —
+            # statement order must not hide a wrapper from its calls
+            for s in body:
+                if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                        and isinstance(s.targets[0], ast.Name)):
+                    spec = callable_jit_spec(ctx, s.value, env,
+                                             jb.factories)
+                    if spec is not None:
+                        env[s.targets[0].id] = spec
+            for s in body:
+                for call in ast.walk(s):
+                    if not (isinstance(call, ast.Call)
+                            and isinstance(call.func, ast.Name)):
+                        continue
+                    spec = env.get(call.func.id)
+                    if spec is None:
+                        continue
+                    for i in sorted(spec.static_argnums):
+                        if i < len(call.args) and isinstance(
+                                call.args[i], _UNHASHABLE):
+                            out.append(self.finding(
+                                ctx, call.lineno,
+                                f"unhashable literal at static argnum "
+                                f"{i} of jitted '{call.func.id}' — "
+                                f"TypeError at call time"))
+                    for kw in call.keywords:
+                        if kw.arg in spec.static_argnames and \
+                                isinstance(kw.value, _UNHASHABLE):
+                            out.append(self.finding(
+                                ctx, call.lineno,
+                                f"unhashable literal for static arg "
+                                f"'{kw.arg}' of jitted "
+                                f"'{call.func.id}' — TypeError at "
+                                f"call time"))
+            for sub in nested_defs(fn):
+                analyze(sub, env)
+
+        for fn in scope_functions(ctx.tree):
+            analyze(fn, jb.wrapped)
+        return out
+
+
+# -- SPL011 -----------------------------------------------------------------
+
+_IO_PATH_METHODS = {"open", "read_text", "write_text", "read_bytes",
+                    "write_bytes", "unlink", "rename", "replace"}
+_IO_OS_FNS = {"os.replace", "os.rename", "os.remove", "os.unlink",
+              "shutil.move", "shutil.copy"}
+
+
+class CacheLockDiscipline(Rule):
+    """Raw IO on the shared probe/tune JSON cache files outside the
+    locked helpers.  Two processes proving kernels or tuning plans
+    share one cache file; only ``_json_cache_update`` (flock +
+    atomic-replace read-modify-write) and ``_json_cache_load`` (the
+    degrading read side) uphold the concurrency and best-effort
+    contracts — an inline ``open(cache_path())``/``json.dump`` can
+    drop concurrent writers' entries or crash dispatch on a corrupt
+    file.  Detection is dataflow-based: any value derived from a
+    configured cache-path function that reaches an IO verb is
+    flagged.  Known imprecision: a helper that receives the path as a
+    parameter is trusted (that is the sanctioned chokepoint shape)."""
+
+    id = "SPL011"
+    title = "cache-file IO bypasses the locked cache helpers"
+    hint = ("route writes through pallas_kernels._json_cache_update "
+            "and reads through _json_cache_load (tune.py and the "
+            "probe cache share them); see docs/autotune.md")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        cfg = project.config
+        path_fns = set(cfg.cache_path_functions)
+        helpers = set(cfg.cache_io_helpers)
+        if not path_fns:
+            return []
+        out: List[Finding] = []
+
+        def is_path_call(node) -> bool:
+            return (isinstance(node, ast.Call)
+                    and (ctx.resolve(node.func) or ""
+                         ).split(".")[-1] in path_fns)
+
+        def scope(stmts, fname: str) -> None:
+            if fname in helpers:
+                return
+            tainted: Set[str] = set()
+            flat: List[ast.stmt] = []
+            for s in stmts:
+                flat.append(s)
+                if not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    flat.extend(c for c in ast.walk(s)
+                                if isinstance(c, ast.stmt)
+                                and c is not s)
+
+            def expr_tainted(expr) -> bool:
+                if any(is_path_call(n) for n in ast.walk(expr)):
+                    return True
+                return any(n in tainted for n, _ in _expr_loads(expr))
+
+            changed = True
+            while changed:
+                changed = False
+                for s in flat:
+                    pairs = []
+                    if isinstance(s, ast.Assign):
+                        pairs = [(t, s.value) for t in s.targets]
+                    elif isinstance(s, (ast.With, ast.AsyncWith)):
+                        pairs = [(i.optional_vars, i.context_expr)
+                                 for i in s.items if i.optional_vars]
+                    for t, v in pairs:
+                        if not expr_tainted(v):
+                            continue
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name) and \
+                                    isinstance(n.ctx, ast.Store) and \
+                                    n.id not in tainted:
+                                tainted.add(n.id)
+                                changed = True
+            for s in flat:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                for call in ast.walk(s):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    dotted = ctx.resolve(call.func) or ""
+                    hit = None
+                    if dotted == "open" and call.args and \
+                            expr_tainted(call.args[0]):
+                        hit = "open()"
+                    elif isinstance(call.func, ast.Attribute) and \
+                            call.func.attr in _IO_PATH_METHODS and \
+                            expr_tainted(call.func.value):
+                        hit = f".{call.func.attr}()"
+                    elif dotted in _IO_OS_FNS and any(
+                            expr_tainted(a) for a in call.args):
+                        hit = dotted
+                    if hit:
+                        out.append(self.finding(
+                            ctx, call.lineno,
+                            f"direct {hit} on the shared cache file "
+                            f"bypasses the locked cache helpers"))
+            for s in flat:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scope(s.body, s.name)
+                elif isinstance(s, ast.ClassDef):
+                    # class bodies hold methods (their own scopes) and
+                    # occasionally class-level statements
+                    scope(s.body, f"<class {s.name}>")
+
+        module_stmts = [s for s in ctx.tree.body]
+        scope(module_stmts, "<module>")
+        return _dedupe(out)
+
+
+# -- SPL012 -----------------------------------------------------------------
+
+def _declared_registry(ctx: FileCtx, registry: str) -> Dict[str, int]:
+    """String keys (-> line) of a module-level ``REGISTRY = {...}``."""
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == registry
+                and isinstance(node.value, ast.Dict)):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return {}
+
+
+class RunReportEventDrift(Rule):
+    """Run-report event drift: every event kind the code emits via
+    ``run_report().add("<kind>", ...)`` must be declared (with a doc)
+    in the resilience module's RUN_REPORT_EVENTS registry, and every
+    declared kind must still be emitted somewhere.  The run report is
+    the observability surface for silent degradation — an undocumented
+    event is invisible to operators reading the docs, and a declared-
+    but-never-emitted one is a dead promise (usually a renamed
+    emission site)."""
+
+    id = "SPL012"
+    title = "run-report event drift against resilience.py:" \
+            "RUN_REPORT_EVENTS"
+    hint = ("declare the event kind (with a one-line doc) in "
+            "splatt_tpu/resilience.py:RUN_REPORT_EVENTS; docs render "
+            "from that registry")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        res_ctx = project.ctx_for(cfg.resilience_module)
+        if res_ctx is None:
+            return []
+        declared = _declared_registry(res_ctx, "RUN_REPORT_EVENTS")
+        if not declared:
+            return []  # registry-less mini-projects: nothing to check
+        out: List[Finding] = []
+        emitted: Set[str] = set()
+        for ctx in project.files + (
+                [res_ctx] if res_ctx not in project.files else []):
+            for kind, line in self._emissions(ctx):
+                if kind is None:
+                    out.append(self.finding(
+                        ctx, line,
+                        "run-report event kind is not statically "
+                        "resolvable — splint cannot check it against "
+                        "RUN_REPORT_EVENTS"))
+                    continue
+                emitted.add(kind)
+                if kind not in declared and ctx in project.files:
+                    out.append(self.finding(
+                        ctx, line,
+                        f"run-report event '{kind}' is not declared "
+                        f"in {cfg.resilience_module}:RUN_REPORT_EVENTS"))
+        for kind, line in declared.items():
+            if kind not in emitted:
+                out.append(self.finding(
+                    res_ctx, line,
+                    f"declared run-report event '{kind}' is never "
+                    f"emitted — dead declaration or renamed emission "
+                    f"site"))
+        return out
+
+    @staticmethod
+    def _emissions(ctx: FileCtx) -> List[Tuple[Optional[str], int]]:
+        def is_run_report_call(node) -> bool:
+            return (isinstance(node, ast.Call)
+                    and (ctx.resolve(node.func) or ""
+                         ).split(".")[-1] == "run_report")
+
+        # names bound to the report object: rr = run_report()
+        report_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and is_run_report_call(node.value)):
+                report_names.add(node.targets[0].id)
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add"):
+                continue
+            base = node.func.value
+            if not (is_run_report_call(base)
+                    or (isinstance(base, ast.Name)
+                        and base.id in report_names)):
+                continue
+            arg = node.args[0] if node.args else None
+            kind: Optional[str] = None
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                kind = arg.value
+            elif isinstance(arg, ast.Name):
+                kind = ctx.str_consts.get(arg.id)
+            out.append((kind, node.lineno))
+        return out
+
+
 def _dedupe(findings: List[Finding]) -> List[Finding]:
     seen = set()
     out = []
@@ -499,4 +1200,9 @@ RULES: List[Rule] = [
     DtypeLiteral(),
     FaultSiteDrift(),
     UndocumentedEnvVar(),
+    UseAfterDonate(),
+    TracerLeak(),
+    RecompileTrigger(),
+    CacheLockDiscipline(),
+    RunReportEventDrift(),
 ]
