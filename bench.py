@@ -410,14 +410,29 @@ def main() -> None:
     opts = Options(random_seed=7, verbosity=Verbosity.NONE,
                    val_dtype=bench_dtype, use_pallas=use_pallas,
                    block_alloc=alloc, autotune=False)
+    # a path that fails mid-run is CLASSIFIED and recorded (the
+    # bench_path_error run-report event + the path_errors JSON field)
+    # and the remaining paths continue — one path's Mosaic rejection or
+    # OOM must not cost the whole benchmark's chip window
+    path_errors = {}
+
+    def record_failure(label, e):
+        from splatt_tpu import resilience
+
+        ev = resilience.record_path_error(label, e)
+        path_errors[label] = {"error": f"{ev['failure_class']}: "
+                                       f"{ev['error']}"}
+        print(f"bench: {label} path failed ({ev['failure_class']}: "
+              f"{type(e).__name__}: {e}); continuing with the "
+              f"remaining paths", file=sys.stderr, flush=True)
+
     blocked_failed = False
     if "blocked" in paths:
         try:
             note("building blocked layouts")
             results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
         except Exception as e:
-            print(f"bench: blocked path failed ({type(e).__name__}: {e})",
-                  file=sys.stderr, flush=True)
+            record_failure("blocked", e)
             blocked_failed = True
         release()  # outside any handler: no traceback pinning buffers
     if blocked_failed:
@@ -428,8 +443,7 @@ def main() -> None:
                              block_alloc=alloc)
             results["blocked_xla"] = run(BlockedSparse.from_coo(tt, opts_x))
         except Exception as e2:
-            print(f"bench: blocked XLA engine failed too "
-                  f"({type(e2).__name__})", file=sys.stderr, flush=True)
+            record_failure("blocked_xla", e2)
         release()
     tuned_plan_info = None
     if "tuned" in paths:
@@ -460,18 +474,17 @@ def main() -> None:
             results["tuned"] = run(
                 BlockedSparse.compile(tt, topts, rank=rank))
         except Exception as e:
-            print(f"bench: tuned path failed ({type(e).__name__}: {e})",
-                  file=sys.stderr, flush=True)
+            record_failure("tuned", e)
         release()
     if "stream" in paths:
         try:
             note("stream path")
             results["stream"] = run(tt)
         except Exception as e:
-            print(f"bench: stream path failed ({type(e).__name__})",
-                  file=sys.stderr, flush=True)
+            record_failure("stream", e)
     if not results:
-        raise RuntimeError("all benchmark paths failed")
+        raise RuntimeError(
+            f"all benchmark paths failed: {path_errors}")
     best = min(results, key=lambda k: results[k]["median"])
     sec_per_iter = results[best]["median"]
     timings = {k: round(v["median"], 4) for k, v in results.items()}
@@ -506,6 +519,11 @@ def main() -> None:
                              for s in ("median", "mean", "min", "max")}
                          for k, v in results.items()},
     }
+    if path_errors:
+        # failed paths ride along classified: `{"error": <class>: msg}`
+        # per path, so the artifact records WHY a row is missing
+        # instead of silently narrowing the comparison
+        rec["path_errors"] = path_errors
     if tuned_plan_info is not None:
         # the tuner's chosen plan rides along with the "tuned" timing so
         # the BENCH trajectory can attribute wins to tuning

@@ -1,0 +1,235 @@
+"""Chaos-schedule soak harness — `splatt chaos` (docs/guarded-als.md).
+
+Resilience machinery that is only exercised by unit tests decays the
+moment two guards interact in a way no unit test composed.  This module
+runs a REAL (small, seeded, synthetic) CPD under a declarative fault
+schedule — NaN poisoning, blown deadlines, transient relay failures,
+engine crashes, all at once — and asserts the single invariant the
+guarded execution layer promises:
+
+    **converged-or-gracefully-degraded, with zero unhandled exceptions
+    and a complete run report.**
+
+Concretely, a chaos run passes iff:
+
+1. no exception escapes the drivers (the guards caught everything);
+2. every armed fault that actually FIRED left a matching run-report
+   event (``health_*`` for poison, ``deadline_blown``/demotion for
+   slow, ``transient_retry``/demotion for raising kinds) — degradation
+   is observable, never silent;
+3. every emitted event kind is declared in
+   :data:`splatt_tpu.resilience.RUN_REPORT_EVENTS` (the report is
+   complete/documented);
+4. the final factors are finite, or the run explicitly reported a
+   ``health_degraded`` verdict.
+
+``splatt chaos --smoke`` is the tier-1 entry: a seconds-scale seeded
+run on a tiny tensor, exercised on every PR so the soak invariant
+cannot rot.  The full-size invocation (bigger tensor, more iterations,
+probabilistic schedules) is the soak tool operators run against new
+jax/device combinations before trusting them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+#: default schedule: one of each guard's quarry — a NaN poisoning at a
+#: fixed iteration (sentinel + rollback), a slow tuner measurement
+#: under the deadline watchdog (TIMEOUT), and a transient relay
+#: failure at an engine's first compile (retry-with-backoff;
+#: ``engine.xla`` is the terminal engine, live on every backend).
+#: Deterministic: every trigger is count- or iteration-keyed; add a
+#: probabilistic leg via --schedule 'site:kind:p=0.1:seed=N'.
+DEFAULT_SCHEDULE = ("cpd.sweep:nan:iter=2,"
+                    "tuner.measure:slow:delay=1.5,"
+                    "engine.xla:internal:1")
+
+#: expected run-report evidence per fired fault kind: at least one of
+#: these event kinds must appear when a fault of that kind fired
+_EVIDENCE = {
+    "nan": ("health_nonfinite", "health_rollback", "health_degraded"),
+    "inf": ("health_nonfinite", "health_rollback", "health_degraded"),
+    "slow": ("deadline_blown",),
+    "http500": ("transient_retry", "engine_demotion",
+                "tuner_negative", "probe_downgrade"),
+    "internal": ("transient_retry", "engine_demotion",
+                 "tuner_negative", "probe_downgrade"),
+    "unavailable": ("transient_retry", "engine_demotion",
+                    "tuner_negative", "probe_downgrade"),
+    "timeout": ("transient_retry", "engine_demotion",
+                "tuner_negative", "probe_downgrade"),
+    "oom": ("engine_demotion", "tuner_negative", "probe_downgrade"),
+    "mosaic": ("engine_demotion", "tuner_negative", "probe_downgrade"),
+    "runtime": ("engine_demotion", "tuner_negative",
+                "checkpoint_recovery", "probe_downgrade"),
+}
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    """One chaos run's verdict and its evidence."""
+
+    verdict: str                  # "converged" | "degraded" | "violated"
+    fit: Optional[float]
+    finite: bool
+    fired: Dict[str, int]         # site -> how often its fault fired
+    events: List[dict]            # the full run report
+    violations: List[str]         # invariant breaches (empty = pass)
+    error: Optional[str] = None   # the escaped exception, if any
+    schedule: str = ""            # the RESOLVED schedule that ran
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return dict(verdict=self.verdict, fit=self.fit,
+                    finite=self.finite, fired=self.fired,
+                    violations=self.violations, error=self.error,
+                    schedule=self.schedule,
+                    events=[{k: v for k, v in e.items() if k != "ts"}
+                            for e in self.events])
+
+
+def _synthetic(dims, nnz: int, seed: int):
+    """Seeded power-law synthetic tensor (every slice nonempty so the
+    CPD shapes are exact)."""
+    from splatt_tpu.coo import SparseTensor
+
+    rng = np.random.default_rng(seed)
+    inds = np.empty((len(dims), nnz), dtype=np.int64)
+    for m, d in enumerate(dims):
+        raw = rng.zipf(1.4, size=nnz).astype(np.int64)
+        inds[m] = (raw + rng.integers(0, d, size=nnz)) % d
+    vals = rng.random(nnz) + 0.1
+    return SparseTensor(inds, vals, dims).deduplicate() \
+                                         .remove_empty_slices()
+
+
+def run_chaos(schedule: Optional[str] = None, seed: int = 0,
+              dims=(40, 32, 24), nnz: int = 3000, rank: int = 4,
+              iters: int = 8, deadline_s: float = 0.5,
+              tune_first: bool = True, smoke: bool = False,
+              verbose: bool = False) -> ChaosResult:
+    """Run one seeded CPD soak under a chaos schedule and check the
+    guarded-execution invariant.  Owns process-global resilience state
+    (faults, demotions, the run report, the deadline override): a chaos
+    run is a diagnostic, not a library call — it resets that state on
+    entry and disarms on exit.
+    """
+    from splatt_tpu import resilience, tune
+    from splatt_tpu.blocked import BlockedSparse
+    from splatt_tpu.config import Options, Verbosity
+    from splatt_tpu.cpd import cpd_als
+    from splatt_tpu.utils import faults
+    from splatt_tpu.utils.env import read_env
+
+    if schedule is None:
+        schedule = str(read_env("SPLATT_CHAOS_SCHEDULE") or "") \
+            or DEFAULT_SCHEDULE
+    if smoke:
+        dims, nnz, rank, iters = (20, 16, 12), 1200, 3, 6
+    specs = faults.parse_schedule(schedule)
+
+    faults.reset()
+    resilience.reset_demotions()
+    resilience.run_report().clear()
+    # 0 = explicit disable (beats an exported SPLATT_DEADLINE_S); the
+    # probe's own always-on default survives either way
+    resilience.set_deadline(deadline_s if deadline_s > 0 else 0.0)
+    for site, spec in specs.items():
+        faults.arm(site, spec)
+
+    tt = _synthetic(dims, nnz, seed)
+    opts = Options(random_seed=seed, max_iterations=iters,
+                   verbosity=Verbosity.LOW if verbose
+                   else Verbosity.NONE,
+                   use_pallas=False,   # CPU-safe: xla_scan/xla engines
+                   autotune=False)     # plans measured live, not cached
+    error = None
+    fit = None
+    finite = False
+    out = None
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="splatt-chaos-") as td:
+            # a throwaway plan cache: plans measured under injected
+            # faults must never leak into the real cache
+            tune.set_cache_path(f"{td}/tune_cache.json")
+            if tune_first and "tuner.measure" in specs:
+                # exercise the tuner leg of the schedule: measurements
+                # run under the deadline watchdog and must degrade,
+                # not crash
+                tune.tune(tt, rank=rank, opts=opts, blocks=(512,),
+                          scan_targets=(1 << 21,), reps=1)
+            bs = BlockedSparse.from_coo(tt, opts)
+            out = cpd_als(bs, rank=rank, opts=opts)
+        fit = float(out.fit)
+        finite = bool(all(np.isfinite(np.asarray(U)).all()
+                          for U in out.factors)
+                      and np.isfinite(np.asarray(out.lam)).all())
+    except Exception as e:  # the invariant IS "nothing escapes"
+        error = (f"{resilience.classify_failure(e).value}: "
+                 f"{resilience.failure_message(e)[:300]}")
+    finally:
+        fired = faults.fired()
+        faults.reset()
+        resilience.set_deadline(None)
+        tune.set_cache_path(None)
+
+    report = resilience.run_report()
+    events = report.events()
+    degraded = bool(report.events("health_degraded"))
+
+    violations: List[str] = []
+    if error is not None:
+        violations.append(f"unhandled exception escaped the guarded "
+                          f"drivers: {error}")
+    for site, spec in specs.items():
+        if fired.get(site, 0) == 0:
+            continue
+        want = _EVIDENCE.get(spec.kind, ())
+        if want and not any(report.events(kind) for kind in want):
+            violations.append(
+                f"fault {site}:{spec.kind} fired "
+                f"{fired[site]}x but left none of the expected "
+                f"run-report events {list(want)} — silent degradation")
+    undeclared = sorted({e["kind"] for e in events}
+                        - set(resilience.RUN_REPORT_EVENTS))
+    if undeclared:
+        violations.append(f"run report contains undeclared event "
+                          f"kinds {undeclared} (SPL012 contract)")
+    if error is None and not finite and not degraded:
+        violations.append("final factors are non-finite and the run "
+                          "did not report a health_degraded verdict")
+
+    verdict = ("violated" if violations
+               else "degraded" if degraded else "converged")
+    return ChaosResult(verdict=verdict, fit=fit, finite=finite,
+                       fired=dict(fired), events=events,
+                       violations=violations, error=error,
+                       schedule=schedule)
+
+
+def format_report(res: ChaosResult) -> List[str]:
+    """Human-readable chaos verdict lines for the CLI."""
+    lines = [f"chaos schedule: {res.schedule}",
+             f"faults fired: " + (", ".join(
+                 f"{s}x{n}" for s, n in sorted(res.fired.items()) if n)
+                 or "(none)")]
+    from splatt_tpu import resilience
+
+    lines += ["run report:"] + (resilience.run_report().summary()
+                                or ["  (no resilience events)"])
+    if res.fit is not None:
+        lines.append(f"final fit: {res.fit:0.5f} "
+                     f"({'finite' if res.finite else 'NON-FINITE'})")
+    for v in res.violations:
+        lines.append(f"INVARIANT VIOLATED: {v}")
+    lines.append(f"chaos verdict: {res.verdict.upper()}")
+    return lines

@@ -65,6 +65,23 @@ def _build_opts(args) -> "Options":
     return opts
 
 
+def _resilience_record(report, **extra) -> dict:
+    """The machine-readable run summary for --json verbs: final fit,
+    every run-report event (health rollbacks included) and every
+    engine demotion — the same facts the human summary prints."""
+    from splatt_tpu import resilience
+
+    return dict(
+        extra,
+        degraded=bool(report.events("health_degraded")),
+        events=[{k: v for k, v in e.items() if k != "ts"}
+                for e in report.events()],
+        demotions=[dict(engine=d.engine,
+                        failure_class=d.failure_class.value,
+                        shape_key=d.shape_key, error=d.error[:120])
+                   for d in resilience.demotions()])
+
+
 def cmd_cpd(args) -> int:
     """≙ splatt_cpd_cmd (src/cmds/cmd_cpd.c:159-243; distributed flags ≙
     the mpirun variant's -d, src/cmds/mpi_cmd_cpd.c:175-338)."""
@@ -152,17 +169,23 @@ def cmd_cpd(args) -> int:
                       checkpoint_path=args.checkpoint,
                       checkpoint_every=args.checkpoint_every)
     print(f"Final fit: {float(out.fit):0.5f}")
-    if opts.verbosity >= Verbosity.LOW:
-        # resilience report: silent degradation (engine demotions,
-        # transient retries, checkpoint recoveries) must be observable
-        # in the run log, not only in exit codes
-        from splatt_tpu import resilience
+    # resilience report: silent degradation (engine demotions,
+    # transient retries, health rollbacks, checkpoint recoveries) must
+    # be observable in the run log, not only in exit codes — on the
+    # single-device AND distributed paths alike
+    from splatt_tpu import resilience
 
-        lines = resilience.run_report().summary()
+    report = resilience.run_report()
+    if opts.verbosity >= Verbosity.LOW:
+        lines = report.summary()
         if lines:
             print("Resilience events:")
             for line in lines:
                 print(line)
+    if getattr(args, "json", False):
+        import json as _json
+
+        print(_json.dumps(_resilience_record(report, fit=float(out.fit))))
     if bs is not None and opts.verbosity >= Verbosity.HIGH:
         # per-mode MTTKRP profile (≙ the per-mode times of `cpd -v -v`,
         # src/cpd.c:361-366) — at HIGH verbosity cpd_als runs the
@@ -222,6 +245,31 @@ def cmd_tune(args) -> int:
         for line in lines:
             print(line)
     return 0 if res.plans else 1
+
+
+def cmd_chaos(args) -> int:
+    """Chaos-schedule soak (docs/guarded-als.md): run a small seeded
+    CPD under injected NaNs / blown deadlines / transient failures and
+    assert the guarded-execution invariant — converged or gracefully
+    degraded, zero unhandled exceptions, complete run report.  Exit 0
+    iff the invariant held."""
+    from splatt_tpu import chaos
+
+    # schedule resolution (--schedule, else $SPLATT_CHAOS_SCHEDULE,
+    # else the default recipe) lives in run_chaos — the single owner;
+    # the resolved string comes back on the result for reporting
+    res = chaos.run_chaos(schedule=args.schedule, seed=args.seed,
+                          rank=args.rank, iters=args.iters,
+                          deadline_s=args.deadline,
+                          smoke=args.smoke,
+                          verbose=args.verbose > 0)
+    for line in chaos.format_report(res):
+        print(line)
+    if args.json:
+        import json as _json
+
+        print(_json.dumps(res.to_json()))
+    return 0 if res.ok else 1
 
 
 def cmd_bench(args) -> int:
@@ -441,7 +489,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="consult the autotuner's plan cache for the "
                         "MTTKRP engine/block/scan plan (default on; "
                         "pre-tune with `splatt tune` — docs/autotune.md)")
+    p.add_argument("--json", action="store_true",
+                   help="also print a machine-readable JSON run "
+                        "summary (fit, run-report events including "
+                        "health rollbacks, engine demotions)")
     p.set_defaults(fn=cmd_cpd)
+
+    p = sub.add_parser(
+        "chaos", help="chaos-schedule soak of the guarded ALS layer",
+        epilog="Runs a small seeded synthetic CPD under a declarative "
+               "fault schedule (same grammar as SPLATT_FAULTS, plus "
+               "iter=k / p=x:seed=N / after=t schedule modifiers) and "
+               "asserts: converged or gracefully degraded, zero "
+               "unhandled exceptions, a complete run report, finite "
+               "factors or an explicit degraded verdict "
+               "(docs/guarded-als.md).  Exit 0 iff the invariant held.")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("--schedule", metavar="SPEC",
+                   help="fault schedule (default: "
+                        "$SPLATT_CHAOS_SCHEDULE, else a seeded "
+                        "NaN+deadline+transient recipe)")
+    p.add_argument("--smoke", action="store_true",
+                   help="seconds-scale seeded run on a tiny tensor "
+                        "(the tier-1 CI entry)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("-r", "--rank", type=int, default=4)
+    p.add_argument("-i", "--iters", type=int, default=8)
+    p.add_argument("--deadline", type=float, default=0.5, metavar="S",
+                   help="watchdog budget for the run (seconds; the "
+                        "slow fault kind blows it deliberately)")
+    p.add_argument("--json", action="store_true",
+                   help="also print the full ChaosResult as JSON")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser(
         "tune", help="pre-tune the MTTKRP plan for a tensor",

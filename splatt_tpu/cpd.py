@@ -240,8 +240,12 @@ def _try_engine_rescue(X, opts: Options, err: Exception) -> bool:
                else resilience.fallback_enabled())
     if not enabled:
         return False
-    if (resilience.classify_failure(err)
-            is resilience.FailureClass.UNKNOWN):
+    if resilience.classify_failure(err) in (
+            resilience.FailureClass.UNKNOWN,
+            resilience.FailureClass.NUMERICAL):
+        # UNKNOWN: a LinAlgError or user shape bug must surface;
+        # NUMERICAL: non-finite outputs are the sentinel's to roll
+        # back, not evidence against the engine that computed them
         return False
     attempt = resilience.last_engine_attempt()
     if attempt is None:
@@ -260,6 +264,46 @@ def _try_engine_rescue(X, opts: Options, err: Exception) -> bool:
 def _fit(xnormsq: float, znormsq: jax.Array, inner: jax.Array) -> jax.Array:
     residual = jnp.sqrt(jnp.maximum(xnormsq + znormsq - 2.0 * inner, 0.0))
     return 1.0 - residual / np.sqrt(xnormsq)
+
+
+# -- numerical-health sentinel (docs/guarded-als.md) ------------------------
+
+@jax.jit
+def _health_pack(factors, lam, fit):
+    """Fold the sentinel's finite-check reduction into ONE small device
+    array: ``[fit, isfinite(U_0), ..., isfinite(U_{n-1}), isfinite(λ),
+    isfinite(fit)]`` (flags are 1.0/0.0 in fit's dtype).  The drivers
+    fetch this at the fit-check host sync they already pay for, so the
+    sentinel adds no extra device round-trip."""
+    flags = [jnp.isfinite(U).all() for U in factors]
+    flags.append(jnp.isfinite(lam).all())
+    flags.append(jnp.isfinite(fit))
+    fit = jnp.asarray(fit)
+    return jnp.concatenate([fit.reshape(1),
+                            jnp.stack(flags).astype(fit.dtype)])
+
+
+def _health_verdict(vec: np.ndarray, nmodes: int):
+    """(fitval, offending-mode list, healthy) from a fetched
+    :func:`_health_pack` vector.  `offending` lists factor modes whose
+    isfinite flag tripped; λ/fit-only blowups report an empty list (the
+    rollback then bumps regularization without re-randomizing)."""
+    fitval = float(vec[0])
+    flags = np.asarray(vec[1:]) > 0.5
+    offending = [m for m in range(nmodes) if not flags[m]]
+    healthy = bool(flags.all())
+    return fitval, offending, healthy
+
+
+def health_retries() -> int:
+    """The sentinel's rollback budget (SPLATT_HEALTH_RETRIES): how many
+    times a run may roll back to the last-good snapshot before it
+    degrades to checkpoint-and-abort.  0 disables the sentinel (and its
+    snapshot upkeep) entirely."""
+    from splatt_tpu.utils.env import read_env_int
+
+    v = read_env_int("SPLATT_HEALTH_RETRIES")
+    return int(v) if v is not None else 0
 
 
 #: checkpoint schema: v1 = the original field set (no integrity data);
@@ -516,15 +560,15 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     # should pay for) the rescue snapshot below
     consumes_inputs = donate and not profiled and not phased
 
-    def build_sweep():
-        # a factory, not a value: after a runtime engine demotion the
-        # sweep must be REBUILT — the old jit wrapper may hold a
-        # compiled executable with the demoted engine inlined
+    def build_sweep(reg=opts.regularization):
+        # a factory, not a value: after a runtime engine demotion (or a
+        # health rollback's regularization bump) the sweep must be
+        # REBUILT — the old jit wrapper may hold a compiled executable
+        # with the demoted engine (or a fault-poisoned trace) inlined
         if profiled:
-            return _make_profiled_sweep(X, nmodes, opts.regularization)
+            return _make_profiled_sweep(X, nmodes, reg)
         return (_make_phased_sweep if phased
-                else _make_sweep)(X, nmodes, opts.regularization,
-                                  donate=donate)
+                else _make_sweep)(X, nmodes, reg, donate=donate)
 
     sweep = build_sweep()
     if profiled:
@@ -549,19 +593,34 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     # the big thing) re-materializes the retry state instead.
     # Refreshed at fit-check iterations, so a rescue loses at most the
     # sweeps since the last check — the same window the deferred-fit-
-    # check contract already trades away.
+    # check contract already trades away.  The numerical-health
+    # sentinel shares the same snapshot as its rollback target: it is
+    # only ever refreshed AFTER a check verified the state finite, so
+    # it is last-GOOD, not merely last-checked.
     can_rescue = isinstance(X, BlockedSparse)
+    guard = health_retries()
     snap = None
 
     def snapshot():
-        return ([np.asarray(u) for u in factors],
-                [np.asarray(g) for g in grams])
+        if consumes_inputs:
+            # the donated sweep will CONSUME these buffers: only a
+            # host copy survives as a rollback target
+            return ([np.asarray(u) for u in factors],
+                    [np.asarray(g) for g in grams],
+                    np.asarray(lam))
+        # non-donating sweeps never consume their inputs: holding the
+        # committed device arrays IS the snapshot — no transfer, just
+        # one older generation of factors+grams kept alive per check
+        return (list(factors), list(grams), lam)
 
-    if consumes_inputs and can_rescue:
+    if (consumes_inputs and can_rescue) or guard > 0:
         snap = snapshot()
     timers.start("cpd")
     k = opts.fit_check_every
     last_check_it = start_it
+    health_attempts = 0
+    degraded = False
+    from splatt_tpu.utils import faults as _faults
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         # fetch the fit to host only at check iterations: on remote/
@@ -593,9 +652,73 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             try:
                 f_new, g_new, lam_new, znormsq, inner = sweep(
                     factors, grams, it == 0)
+                # chaos hook: a poison-armed cpd.sweep fault corrupts
+                # one sweep's factor output with non-finite values —
+                # the silent blowup the sentinel exists to catch.  The
+                # LAST factor: every next-sweep MTTKRP reads it, so an
+                # unguarded run genuinely diverges (a poisoned FIRST
+                # factor would be silently recomputed by mode 0's own
+                # update before anything reads it)
+                f_new[-1] = _faults.poison("cpd.sweep", f_new[-1])
                 fit = _fit(xnormsq, znormsq, inner)
-                fitval = float(fit) if check else None
+                if check and guard > 0:
+                    # numerical-health sentinel: the finite-check
+                    # reduction rides the fit fetch (ONE host sync)
+                    fitval, offending, healthy = _health_verdict(
+                        np.asarray(_health_pack(f_new, lam_new, fit)),
+                        nmodes)
+                    if not healthy:
+                        err = _resilience.NumericalHealthError(
+                            f"non-finite sweep outputs at iteration "
+                            f"{it + 1} (factor modes "
+                            f"{offending or 'none'}; λ/fit "
+                            f"{'finite' if offending else 'non-finite'})")
+                        err.offending = offending
+                        raise err
+                else:
+                    fitval = float(fit) if check else None
                 break
+            except _resilience.NumericalHealthError as e:
+                health_attempts += 1
+                offending = getattr(e, "offending", [])
+                _resilience.run_report().add(
+                    "health_nonfinite", iteration=it + 1,
+                    modes=offending,
+                    error=_resilience.failure_message(e)[:200])
+                if health_attempts > guard:
+                    # budget exhausted: degrade to checkpoint-and-abort
+                    # — return the last-good snapshot instead of
+                    # diverging or crashing (docs/guarded-als.md)
+                    degraded = True
+                    break
+                # rollback: restore the last-good host snapshot, bump
+                # regularization (re-conditioning the normal equations)
+                # and re-randomize the offending factor(s); the sweep is
+                # REBUILT so a fault-poisoned trace cannot survive
+                factors = [jnp.asarray(u) for u in snap[0]]
+                grams = [jnp.asarray(g) for g in snap[1]]
+                lam = jnp.asarray(snap[2])
+                reg = ((opts.regularization
+                        if opts.regularization > 0 else 1e-6)
+                       * (10.0 ** health_attempts))
+                key = jax.random.PRNGKey(opts.seed() + 7919)
+                for m in offending:
+                    factors[m] = jax.random.uniform(
+                        jax.random.fold_in(key,
+                                           health_attempts * 64 + m),
+                        factors[m].shape, dtype=factors[m].dtype)
+                    grams[m] = gram(factors[m])
+                _resilience.run_report().add(
+                    "health_rollback", iteration=it + 1,
+                    attempt=health_attempts, regularization=reg,
+                    rerandomized=offending)
+                if opts.verbosity >= Verbosity.LOW:
+                    print(f"  non-finite sweep outputs at iteration "
+                          f"{it + 1}; rolled back to the last-good "
+                          f"snapshot (attempt {health_attempts}/"
+                          f"{guard}: reg={reg:g}, re-randomized modes "
+                          f"{offending})")
+                sweep = build_sweep(reg)
             except Exception as e:
                 rescue_attempts += 1
                 if (rescue_attempts > 6
@@ -612,6 +735,27 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                     # same optimization)
                     factors = [jnp.asarray(u) for u in snap[0]]
                     grams = [jnp.asarray(g) for g in snap[1]]
+        if degraded:
+            # the result is the last-good state; persist it so a later
+            # resume (perhaps with more retries or a fixed input)
+            # continues from here instead of redoing the work
+            factors = [jnp.asarray(u) for u in snap[0]]
+            grams = [jnp.asarray(g) for g in snap[1]]
+            lam = jnp.asarray(snap[2])
+            action = "stopped early with the last-good factors"
+            if checkpoint_path is not None:
+                # the snapshot corresponds to the LAST HEALTHY check,
+                # not the iteration the blowup was detected at — a
+                # resume must redo the rolled-back window, not skip it
+                _save_checkpoint(checkpoint_path, factors, lam,
+                                 last_check_it, fit_prev)
+                action += f"; checkpointed to {checkpoint_path}"
+            _resilience.run_report().add(
+                "health_degraded", iteration=it + 1, action=action)
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  health-retry budget ({guard}) exhausted at "
+                      f"iteration {it + 1}; {action}")
+            break
         factors, grams, lam = f_new, g_new, lam_new
         if not check:
             if opts.verbosity >= Verbosity.HIGH:

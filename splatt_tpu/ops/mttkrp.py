@@ -256,6 +256,11 @@ def _tuned_plan_for(layout: ModeLayout, factors: Sequence[jax.Array],
     return plan
 
 
+#: (engine, shape_key) pairs whose first (compile-bearing) dispatch
+#: already ran under the deadline watchdog — warm calls skip the timer
+_DEADLINE_ARMED: set = set()
+
+
 def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
                    path: str = "sorted_onehot",
                    impl: str = "xla",
@@ -329,8 +334,28 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
 
         def attempt(engine=engine):
             faults.maybe_fail(f"engine.{engine}")
-            return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
-                                       scan_target, engine)
+            # deadline watchdog (docs/guarded-als.md): bounds this
+            # engine's FIRST call per shape — the one that compiles
+            # (off by default; a blown deadline classifies TIMEOUT and
+            # demotes per-shape below, exactly like OOM).  Warm
+            # dispatches are microsecond async launches: skipping the
+            # watchdog there saves a Timer thread per MTTKRP call.
+            first = (engine, shape_key) not in _DEADLINE_ARMED
+            if first:
+                _DEADLINE_ARMED.add((engine, shape_key))
+                with resilience.deadline(f"engine.{engine}"):
+                    out = _mttkrp_blocked_jit(layout, factors, mode,
+                                              path, impl, scan_target,
+                                              engine)
+            else:
+                out = _mttkrp_blocked_jit(layout, factors, mode, path,
+                                          impl, scan_target, engine)
+            # chaos hook: a poison-armed engine fault corrupts this
+            # engine's OUTPUT with non-finite values (under a fused
+            # whole-sweep trace the poison is baked into the traced
+            # program — flushed by the sweep rebuild a health rollback
+            # performs)
+            return faults.poison(f"engine.{engine}", out)
 
         try:
             resilience.note_engine_attempt(engine, shape_key)

@@ -901,13 +901,22 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
 
     t = threading.Thread(target=runner, daemon=True)
     t.start()
-    t.join(timeout=240)
+    # the probe's watchdog budget: SPLATT_DEADLINE_S when configured
+    # (the shared deadline knob, docs/guarded-als.md), else the
+    # measured-safe 240 s default — the probe always keeps SOME
+    # deadline even when the watchdog is globally off, because a probe
+    # compile is the call the >40 min hangs were observed on
+    probe_deadline = resilience.deadline_seconds(default=240.0)
+    t.join(timeout=probe_deadline)
     if not result:
         # close the race where the probe completed between the join
         # deadline expiring and this check: one short grace re-join,
         # then a final read, before declaring a timeout
         t.join(timeout=2.0)
     if not result:
+        resilience.run_report().add("deadline_blown",
+                                    site="probe_compile",
+                                    seconds=float(probe_deadline))
         # Deadline hit, not a compile rejection: the verdict is unproven
         # and the orphaned thread may still occupy the (single-lease)
         # chip.  Cache it anyway — re-probing would stall every dispatch
@@ -920,7 +929,7 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
         import sys
 
         print(f"splatt-tpu: WARNING: {state_key} capability probe timed out "
-              f"after 240 s (remote compile slow/wedged, NOT a kernel "
+              f"after {probe_deadline:g} s (remote compile slow/wedged, NOT a kernel "
               f"rejection); treating as unsupported this session — an "
               f"orphaned compile thread may briefly contend for the chip "
               f"(recorded as unproven; the next process will re-probe)",

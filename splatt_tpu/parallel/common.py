@@ -707,8 +707,12 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     """
     import os
 
-    from splatt_tpu.cpd import _save_checkpoint, load_checkpoint_resilient
+    from splatt_tpu import resilience
+    from splatt_tpu.cpd import (_health_pack, _health_verdict,
+                                _save_checkpoint, health_retries,
+                                load_checkpoint_resilient)
     from splatt_tpu.ops.linalg import gram as gram_fn
+    from splatt_tpu.utils import faults
 
     if checkpoint_path and checkpoint_every < 1:
         raise ValueError(
@@ -749,10 +753,35 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     k = opts.fit_check_every
     last_check_it = start_it
     done_it = start_it
+    # numerical-health sentinel (docs/guarded-als.md): same policy as
+    # cpd_als, with two distributed differences — the rollback
+    # re-randomizes the offending factor without bumping
+    # regularization (reg is baked into the caller's compiled step;
+    # docs/MULTIHOST.md), and the last-good snapshot is just a held
+    # REFERENCE to the committed sharded arrays (distributed steps
+    # never donate, so the buffers survive; no per-check collective,
+    # only one older factor/gram generation kept alive on device).
+    # Gathering to the original row space happens only on the degrade
+    # path, like a checkpoint.
+    guard = health_retries()
+    health_attempts = 0
+    degraded = False
+    save_pending = False
+    snap = (tuple(factors), tuple(grams), lam) if guard > 0 else None
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
         factors, grams, lam, znormsq, inner = step(factors, grams, flag)
+        # chaos hook: a poison-armed cpd.sweep fault corrupts one
+        # sweep's LAST factor output (the one every next-sweep MTTKRP
+        # reads — see cpd_als; container type preserved, since
+        # changing list/tuple would alter the step's pytree and force
+        # a retrace)
+        poisoned = faults.poison("cpd.sweep", factors[-1])
+        if poisoned is not factors[-1]:
+            seq = list(factors)
+            seq[-1] = poisoned
+            factors = type(factors)(seq)
         save_now = (checkpoint_path
                     and (it + 1) % checkpoint_every == 0
                     and it + 1 != opts.max_iterations)
@@ -763,8 +792,61 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             if opts.verbosity >= Verbosity.HIGH:
                 print(f"  its = {it + 1:3d} (deferred fit check)")
             continue
-        fitval = float(_fit(xnormsq, znormsq, inner))
-        if save_now:
+        fit_arr = _fit(xnormsq, znormsq, inner)
+        if guard > 0:
+            # sentinel: the finite-check reduction rides the fit fetch
+            fitval, offending, healthy = _health_verdict(
+                np.asarray(_health_pack(list(factors), lam, fit_arr)),
+                len(factors))
+        else:
+            fitval, offending, healthy = float(fit_arr), [], True
+        if not healthy:
+            health_attempts += 1
+            resilience.run_report().add(
+                "health_nonfinite", iteration=it + 1, modes=offending,
+                error=f"non-finite distributed sweep outputs at "
+                      f"iteration {it + 1}")
+            if health_attempts > guard:
+                degraded = True
+                break
+            # rollback: the held last-good sharded arrays ARE the
+            # restore (no re-placement needed); offending factors are
+            # re-randomized in the original row space and placed with
+            # the checkpoint-resume machinery, their Grams recomputed
+            sel = row_select
+            seq_f = list(snap[0])
+            seq_g = list(snap[1])
+            rng = np.random.default_rng(
+                opts.seed() + 7919 + health_attempts)
+            for m in offending:
+                fresh = rng.random((int(dims[m]), rank))
+                seq_f[m] = _place_original(
+                    fresh, seq_f[m], sel[m] if sel is not None
+                    else None)
+                seq_g[m] = jax.device_put(
+                    gram_fn(seq_f[m]).astype(seq_g[m].dtype),
+                    seq_g[m].sharding)
+            factors = type(factors)(seq_f)
+            grams = type(grams)(seq_g)
+            lam = snap[2]
+            # a checkpoint that was due this iteration must not be
+            # silently skipped: carry it to the next healthy check
+            save_pending = save_pending or bool(save_now)
+            resilience.run_report().add(
+                "health_rollback", iteration=it + 1,
+                attempt=health_attempts, regularization=None,
+                rerandomized=offending)
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  non-finite sweep outputs at iteration "
+                      f"{it + 1}; rolled back to the last-good "
+                      f"snapshot (attempt {health_attempts}/{guard}, "
+                      f"re-randomized modes {offending})")
+            continue
+        if guard > 0:
+            # verified finite: refresh the rollback target (reference
+            # hold, not a copy — see the snapshot comment above)
+            snap = (tuple(factors), tuple(grams), lam)
+        if save_now or save_pending:
             # the gather is a COLLECTIVE in multi-controller runs
             # (process_allgather) — every process must enter it; only
             # the WRITE is single-writer (racing np.savez on one path
@@ -773,6 +855,7 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             if jax.process_index() == 0:
                 _save_checkpoint(checkpoint_path, gathered, lam, it + 1,
                                  fitval)
+            save_pending = False
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({time.perf_counter() - t0:.3f}s)"
                   f"  fit = {fitval:0.5f}  delta = {fitval - fit_prev:+0.4e}")
@@ -787,6 +870,26 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
             break
         fit_prev = fitval
 
+    if degraded:
+        # checkpoint-and-abort: the result is the last-good (finite)
+        # snapshot, gathered to the original row space (the one
+        # collective the guard pays, and only on this path) and
+        # persisted so a later resume continues from it
+        gathered = _gather_original(snap[0], dims, row_select)
+        lam = snap[2]
+        action = "stopped early with the last-good factors"
+        if checkpoint_path and jax.process_index() == 0:
+            _save_checkpoint(checkpoint_path, gathered, lam, done_it,
+                             fit_prev)
+            action += f"; checkpointed to {checkpoint_path}"
+        resilience.run_report().add("health_degraded",
+                                    iteration=done_it, action=action)
+        if opts.verbosity >= Verbosity.LOW:
+            print(f"  health-retry budget ({guard}) exhausted; "
+                  f"{action}")
+        return post_process([jnp.asarray(U) for U in gathered], lam,
+                            jnp.asarray(fit_prev, dtype=dtype),
+                            dims=dims)
     gathered = _gather_original(factors, dims, row_select)
     # final checkpoint, like cpd_als's last-iteration save: a completed
     # (or converged) run must not leave the checkpoint several

@@ -24,11 +24,25 @@ Failure taxonomy
     - ``UNKNOWN``       — anything unrecognized.  Treated like
       transient for persistence purposes (rejected this session,
       re-probed next process) but not retried in-place.
+    - ``NUMERICAL``     — non-finite factors/λ/fit caught by the
+      numerical-health sentinel (docs/guarded-als.md).  Handled by
+      rollback + re-conditioning in the ALS drivers, never by the
+      engine-demotion registry.
+    - ``TIMEOUT``       — our own deadline watchdog (:func:`deadline`)
+      blew on a host-side compile/measure/probe call.  Demotes
+      per-shape exactly like RESOURCE.
+
+Deadline watchdog
+    :func:`deadline` — a thread-timer context manager bounding
+    host-side compile/measure/probe calls (probe compiles, tuner
+    measurements, first-call engine compiles); configured via
+    ``SPLATT_DEADLINE_S`` / :func:`set_deadline`, fault-injectable via
+    the ``slow`` kind (utils/faults.py).
 
 Engine demotion registry
     :func:`demote_engine` / :func:`is_demoted` — runtime failures of a
     dispatch engine demote it (process-wide, or per-shape for RESOURCE
-    failures) so the ordered fallback chain in
+    and TIMEOUT failures) so the ordered fallback chain in
     :func:`splatt_tpu.ops.mttkrp.engine_chain` skips it mid-run instead
     of crashing ``cpd_als``.
 
@@ -44,9 +58,11 @@ fault-injection tests exercise every branch without a device.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import random
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -58,6 +74,9 @@ class FailureClass(enum.Enum):
     TRANSIENT = "transient"           # retry w/ backoff; never persist
     RESOURCE = "resource"             # demote for this shape only
     UNKNOWN = "unknown"               # unproven; re-probe next process
+    NUMERICAL = "numerical"           # non-finite factors/fit: roll back
+    TIMEOUT = "timeout"               # our own deadline watchdog blew:
+                                      # demote per-shape, like RESOURCE
 
 
 # Capacity failures first: an OOM message may also mention the kernel
@@ -92,6 +111,32 @@ TRANSIENT_MARKERS = (
     "temporarily unavailable", "Transient",
 )
 
+# Our OWN watchdog's signature (resilience.deadline), checked before
+# everything else: "DEADLINE_EXCEEDED"/"timed out" above are RPC-level
+# transients worth retrying, but a deadline WE set and blew is a local
+# capacity verdict for this shape — retrying the same slow compile
+# would burn the budget again, so it demotes per-shape like OOM.
+TIMEOUT_MARKERS = ("splatt deadline blown",)
+
+# The health sentinel's signature (non-finite factors/λ/fit).  Never an
+# engine-capability statement: rollback + re-conditioning owns it, not
+# the demotion registry (docs/guarded-als.md).
+NUMERICAL_MARKERS = ("non-finite", "NonFinite", "NumericalHealthError")
+
+
+class DeadlineExceeded(RuntimeError):
+    """The deadline watchdog (:func:`deadline`) blew on a host-side
+    compile/measure/probe call.  Classifies as TIMEOUT: demoted
+    per-shape like a RESOURCE failure — the same shapes will be slow
+    again, other shapes are unindicted."""
+
+
+class NumericalHealthError(RuntimeError):
+    """The numerical-health sentinel found non-finite factors/λ/fit in
+    a sweep's outputs (docs/guarded-als.md).  Classifies as NUMERICAL:
+    handled by rollback + re-conditioning in the ALS drivers, never by
+    the engine-demotion registry."""
+
 
 def failure_message(exc) -> str:
     """The string classification runs on: "ExcType: message"."""
@@ -111,6 +156,15 @@ def classify_failure(exc) -> FailureClass:
     UNLESS they co-occur with a Mosaic/kernel-compiler marker).
     """
     msg = failure_message(exc)
+    # the two project-raised classes first: their markers are exact and
+    # their messages may echo infrastructure noise (a blown deadline
+    # message quoting 'timed out' must not become a retry loop)
+    if isinstance(exc, DeadlineExceeded) \
+            or any(m in msg for m in TIMEOUT_MARKERS):
+        return FailureClass.TIMEOUT
+    if isinstance(exc, NumericalHealthError) \
+            or any(m in msg for m in NUMERICAL_MARKERS):
+        return FailureClass.NUMERICAL
     if any(m in msg for m in RESOURCE_MARKERS):
         return FailureClass.RESOURCE
     if any(m in msg for m in DETERMINISTIC_MARKERS):
@@ -190,12 +244,14 @@ def _demotion_key(engine: str, shape_key: Optional[str]) -> str:
 def demote_engine(engine: str, error, shape_key: Optional[str] = None
                   ) -> Demotion:
     """Record a runtime demotion of `engine`; the fallback chain skips
-    it from now on.  RESOURCE failures demote per-shape (pass the
-    shape_key); everything else process-wide.  Never persisted to disk:
-    a demotion lasts one process — the probe cache owns cross-process
-    verdicts with its own (stricter) persistence rules."""
+    it from now on.  RESOURCE and TIMEOUT failures demote per-shape
+    (pass the shape_key — an OOM or a blown compile deadline indicts
+    only shapes of that size); everything else process-wide.  Never
+    persisted to disk: a demotion lasts one process — the probe cache
+    owns cross-process verdicts with its own (stricter) persistence
+    rules."""
     cls = classify_failure(error)
-    if cls is not FailureClass.RESOURCE:
+    if cls not in (FailureClass.RESOURCE, FailureClass.TIMEOUT):
         shape_key = None
     d = Demotion(engine=engine, failure_class=cls,
                  error=failure_message(error)[:500], shape_key=shape_key)
@@ -270,6 +326,132 @@ def set_fallback(enabled: Optional[bool]) -> None:
     _fallback_override = enabled
 
 
+# -- deadline watchdog ------------------------------------------------------
+#
+# A pathological shape can hang a remote compile long past any useful
+# deadline (observed: >40 min probe compiles).  The watchdog bounds
+# host-side compile/measure/probe calls with a plain threading.Timer —
+# no signals (they do not compose with jax's own handlers or with
+# non-main threads), and jit-safe because it only ever wraps HOST-side
+# work: inside a trace it wraps tracing time, which is bounded anyway.
+
+_DEADLINE_ENV = "SPLATT_DEADLINE_S"
+_deadline_override: Optional[float] = None
+
+
+def set_deadline(seconds: Optional[float]) -> None:
+    """Process-wide deadline override for :func:`deadline` sites (None
+    restores the env default; <= 0 disables the optional sites even
+    when SPLATT_DEADLINE_S is exported — sites with their own default,
+    like the probe, keep it).  The chaos harness uses this instead of
+    mutating the environment."""
+    global _deadline_override
+    _deadline_override = seconds
+
+
+def deadline_seconds(default: Optional[float] = None) -> Optional[float]:
+    """The configured watchdog deadline: the process override if set
+    (<= 0 meaning "disabled" — the caller's `default` still applies,
+    so the probe's always-on 240 s survives an explicit disable), else
+    SPLATT_DEADLINE_S, else `default`.  None = disabled."""
+    if _deadline_override is not None:
+        if _deadline_override > 0:
+            return _deadline_override
+        return default
+    from splatt_tpu.utils.env import read_env_float
+
+    env = read_env_float(_DEADLINE_ENV)
+    if env is not None and float(env) > 0:
+        return float(env)
+    return default
+
+
+@contextlib.contextmanager
+def deadline(site: str, seconds: Optional[float] = None):
+    """Bound a host-side compile/measure/probe call: if the wrapped
+    block runs longer than `seconds` (default: the configured
+    :func:`deadline_seconds`), raise :class:`DeadlineExceeded`
+    (→ TIMEOUT: demoted per-shape exactly like OOM) and record a
+    ``deadline_blown`` run-report event.
+
+    Mechanics: a daemon ``threading.Timer`` fires after `seconds`.
+    From the MAIN thread it additionally calls
+    ``_thread.interrupt_main()`` so a blocked Python-level call is
+    interrupted between bytecodes (a call hung inside C that never
+    releases the GIL still gets the after-the-fact raise when it
+    returns); from any other thread the blown deadline raises when the
+    block completes.  Either way the failure is classified the same —
+    the watchdog's job is converting "slow" into a *classified* error
+    instead of an unbounded hang.
+    """
+    if seconds is None:
+        seconds = deadline_seconds()
+    if not seconds or seconds <= 0:
+        yield
+        return
+    state = {"fired": False, "done": False}
+    lock = threading.Lock()
+    on_main = threading.current_thread() is threading.main_thread()
+
+    def fire():
+        # fired-flag and interrupt are one critical section: once the
+        # main thread observes fired=True (it reads under this lock's
+        # ordering in the finally below), the interrupt is already
+        # pending, so the absorb sleep deterministically receives it —
+        # no window where a stray KeyboardInterrupt can outlive the
+        # context manager and kill a later, unguarded sweep
+        with lock:
+            if state["done"]:
+                return
+            state["fired"] = True
+            if on_main:
+                import _thread
+
+                _thread.interrupt_main()
+
+    timer = threading.Timer(seconds, fire)
+    timer.daemon = True
+    timer.start()
+
+    def blew() -> "DeadlineExceeded":
+        run_report().add("deadline_blown", site=site,
+                         seconds=float(seconds))
+        return DeadlineExceeded(
+            f"splatt deadline blown at {site} after {seconds:g}s "
+            f"(host-side call exceeded the watchdog budget)")
+
+    try:
+        try:
+            yield
+        finally:
+            with lock:
+                state["done"] = True
+            timer.cancel()
+            if state["fired"] and on_main:
+                # the timer fired (possibly while we were already
+                # exiting): absorb the pending interrupt_main HERE,
+                # inside the guarded region, so it cannot escape as a
+                # bare KeyboardInterrupt after the with-block
+                try:
+                    time.sleep(0.05)
+                except KeyboardInterrupt:
+                    pass
+    except KeyboardInterrupt:
+        # covers both the yield and the cleanup above: an interrupt
+        # delivered mid-finally (lock acquire, timer.cancel) still
+        # converts to the classified error instead of leaking.  Known
+        # ambiguity: a GENUINE Ctrl-C landing inside a blown-deadline
+        # window is indistinguishable from the watchdog's own interrupt
+        # (no signal handlers by design) and is reclassified as the
+        # timeout; the window is one blown call per site, after which
+        # the demotion prevents repeats — a second Ctrl-C aborts.
+        if state["fired"]:
+            raise blew() from None
+        raise
+    if state["fired"]:
+        raise blew()
+
+
 # -- run report -------------------------------------------------------------
 
 #: Every run-report event kind the code emits, name -> one-line doc —
@@ -312,7 +494,37 @@ RUN_REPORT_EVENTS = {
                           "jax.config (utils/env.py:"
                           "apply_env_platform); the run continues on "
                           "whatever backend jax picks",
+    "health_nonfinite": "the numerical-health sentinel found "
+                        "non-finite factors/λ/fit in a sweep's outputs "
+                        "at a fit-check iteration "
+                        "(docs/guarded-als.md)",
+    "health_rollback": "the ALS driver rolled back to the last-good "
+                       "host snapshot, bumped regularization and/or "
+                       "re-randomized the offending factor, and "
+                       "retried the sweep",
+    "health_degraded": "the rollback budget (SPLATT_HEALTH_RETRIES) "
+                       "was exhausted: the run checkpointed the "
+                       "last-good state and stopped early with a "
+                       "degraded verdict instead of diverging",
+    "deadline_blown": "the deadline watchdog (resilience.deadline) "
+                      "expired on a host-side compile/measure/probe "
+                      "call; classified TIMEOUT and demoted per-shape "
+                      "like OOM",
+    "bench_path_error": "one benchmark path failed mid-run; the error "
+                        "was classified and recorded and the "
+                        "remaining paths continued (bench.py)",
 }
+
+
+def record_path_error(label: str, exc) -> dict:
+    """Classify a benchmark path failure into a ``bench_path_error``
+    run-report event and return the event — the shared emission point
+    bench.py uses so a failing path is recorded and skipped instead of
+    aborting the whole benchmark."""
+    return run_report().add(
+        "bench_path_error", path=label,
+        failure_class=classify_failure(exc).value,
+        error=failure_message(exc)[:200])
 
 
 class RunReport:
@@ -365,6 +577,29 @@ class RunReport:
             lines.append(f"  autotuner: no measurable candidate for "
                          f"mode {e['mode']} — dispatch keeps the "
                          f"heuristic chain")
+        nonfinite = self.events("health_nonfinite")
+        if nonfinite:
+            its = sorted({e.get("iteration") for e in nonfinite})
+            lines.append(f"  numerical-health sentinel: non-finite "
+                         f"sweep outputs at iteration(s) "
+                         f"{', '.join(str(i) for i in its)}")
+        for e in self.events("health_rollback"):
+            lines.append(f"  rolled back to the last-good snapshot at "
+                         f"iteration {e.get('iteration')} (attempt "
+                         f"{e.get('attempt')}: reg={e.get('regularization')}"
+                         f", re-randomized modes "
+                         f"{e.get('rerandomized') or []})")
+        for e in self.events("health_degraded"):
+            lines.append(f"  HEALTH BUDGET EXHAUSTED at iteration "
+                         f"{e.get('iteration')}: returned the last-good "
+                         f"state ({e.get('action')})")
+        for e in self.events("deadline_blown"):
+            lines.append(f"  deadline watchdog blew at {e['site']} "
+                         f"({e['seconds']:g}s budget)")
+        for e in self.events("bench_path_error"):
+            lines.append(f"  bench path {e['path']} failed "
+                         f"({e['failure_class']}: {e['error'][:80]}); "
+                         f"remaining paths continued")
         return lines
 
 

@@ -146,14 +146,32 @@ def _negative_key(key: str, engine: str, block: int,
 # degrades to re-tuning (and ultimately to the heuristic chain), never
 # to a failed dispatch.
 
+#: process-wide plan-cache path override (beats the env var): the
+#: chaos harness points measurements at a throwaway file so a soak run
+#: cannot dirty the real cache with plans measured under injected
+#: faults.  None = env/default resolution.
+_cache_path_override: Optional[str] = None
+
+
+def set_cache_path(path: Optional[str]) -> None:
+    """Override the plan-cache file for this process (None restores
+    the env/default resolution).  Clears the in-process memo so stale
+    entries from the previous file cannot leak across."""
+    global _cache_path_override
+    _cache_path_override = str(path) if path is not None else None
+    reset_memo()
+
+
 def cache_path():
-    """The plan-cache file: $SPLATT_TUNE_CACHE, else tune_cache.json
-    next to the probe cache."""
+    """The plan-cache file: the process override, else
+    $SPLATT_TUNE_CACHE, else tune_cache.json next to the probe cache."""
     import pathlib
 
     from splatt_tpu.ops.pallas_kernels import _cache_path
     from splatt_tpu.utils.env import read_env
 
+    if _cache_path_override:
+        return pathlib.Path(_cache_path_override)
     p = read_env(_CACHE_ENV)
     if p:
         return pathlib.Path(p)
@@ -287,23 +305,28 @@ def _measure_candidate(layout, factors, mode: int, path: str, impl: str,
     """Median seconds of one forced-engine MTTKRP over `layout` after
     `warm` warm-up calls (compile excluded).  Module-level so tests can
     substitute the timing body without touching the candidate walk."""
+    from splatt_tpu import resilience
     from splatt_tpu.ops.mttkrp import _mttkrp_blocked_jit
     from splatt_tpu.utils import faults
     from splatt_tpu.utils.env import host_fence
-
-    faults.maybe_fail("tuner.measure")
 
     def call():
         return _mttkrp_blocked_jit(layout, factors, mode, path, impl,
                                    scan_target, engine)
 
-    for _ in range(max(warm, 1)):
-        host_fence(call())
-    times = []
-    for _ in range(max(reps, 1)):
-        t0 = time.perf_counter()
-        host_fence(call())
-        times.append(time.perf_counter() - t0)
+    # deadline watchdog (docs/guarded-als.md): one pathological
+    # candidate's compile must not wedge the whole tune; a blown
+    # deadline classifies TIMEOUT — skipped this session, never
+    # persisted as a negative entry (slow today may be fine tomorrow)
+    with resilience.deadline("tuner.measure"):
+        faults.maybe_fail("tuner.measure")
+        for _ in range(max(warm, 1)):
+            host_fence(call())
+        times = []
+        for _ in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            host_fence(call())
+            times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]
 
@@ -354,9 +377,13 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
     Failure handling follows the resilience taxonomy: transient timing
     failures retry in place with backoff, deterministic/resource
     failures persist as negative entries (skipped by later tunes),
-    unknown failures skip the candidate for this session only.  A mode
-    where every candidate fails gets NO plan — dispatch keeps the
-    heuristic chain, recorded as a ``tuner_degraded`` run-report event.
+    unknown failures skip the candidate for this session only, and a
+    measurement that blows the deadline watchdog (TIMEOUT,
+    docs/guarded-als.md) is skipped this session but never persisted —
+    a wedged relay today must not blacklist a healthy candidate
+    forever.  A mode where every candidate fails gets NO plan —
+    dispatch keeps the heuristic chain, recorded as a
+    ``tuner_degraded`` run-report event.
     """
     from splatt_tpu import resilience
     from splatt_tpu.blocked import build_layout

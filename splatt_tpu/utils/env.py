@@ -49,8 +49,32 @@ ENV_VARS = {
                                         "chain (known-unlowerable on "
                                         "current Mosaic)"),
     "SPLATT_FAULTS": EnvVar("", "comma-separated fault-arming specs "
-                            "site:kind[:times] for the fault-injection "
-                            "harness (utils/faults.py)"),
+                            "site[:kind][:modifier]... for the fault-"
+                            "injection harness, including seeded chaos "
+                            "schedules iter=k / p=x:seed=N / after=t "
+                            "(utils/faults.py, docs/guarded-als.md)"),
+    "SPLATT_HEALTH_RETRIES": EnvVar(3, "numerical-health sentinel "
+                                    "rollback budget: how many times a "
+                                    "run may restore the last-good "
+                                    "snapshot (bumping regularization "
+                                    "/ re-randomizing the offending "
+                                    "factor) before degrading to "
+                                    "checkpoint-and-abort; 0 disables "
+                                    "the sentinel "
+                                    "(docs/guarded-als.md)"),
+    "SPLATT_DEADLINE_S": EnvVar(0.0, "deadline watchdog budget in "
+                                "seconds for host-side compile/"
+                                "measure/probe calls (probe compiles, "
+                                "tuner measurements, engine dispatch); "
+                                "a blown deadline classifies TIMEOUT "
+                                "and demotes per-shape like OOM; <= 0 "
+                                "disables (the probe keeps its own "
+                                "240 s default) (docs/guarded-als.md)"),
+    "SPLATT_CHAOS_SCHEDULE": EnvVar("", "default fault schedule for "
+                                    "the `splatt chaos` soak verb when "
+                                    "no --schedule flag is given; same "
+                                    "grammar as SPLATT_FAULTS "
+                                    "(docs/guarded-als.md)"),
     "SPLATT_PROBE_CACHE": EnvVar(None, "path override for the "
                                  "persistent capability-probe cache "
                                  "(default: tools/probe_cache.json in "

@@ -3,9 +3,10 @@
 Resilience code that only runs when the infrastructure misbehaves is
 dead code until the day it matters; this module makes the misbehavior
 reproducible.  Production call sites (probe compiles, engine dispatch,
-checkpoint writes) call :func:`maybe_fail`/:func:`consume` with a site
-name; tests (or an operator, via env var) arm faults against those
-sites and the real error-handling paths execute.
+checkpoint writes, sweep outputs) call :func:`maybe_fail` /
+:func:`consume` / :func:`poison` with a site name; tests (or an
+operator, via env var) arm faults against those sites and the real
+error-handling paths execute.
 
 Arming a fault
     - context manager (tests)::
@@ -17,8 +18,29 @@ Arming a fault
 
         SPLATT_FAULTS="probe_compile:http500:2,engine.fused_t:runtime"
 
-      Comma-separated ``site:kind[:times]`` specs; ``times`` defaults
-      to 1, ``*`` means every call.
+      Comma-separated ``site[:kind][:modifier]...`` specs; ``times``
+      defaults to 1, ``*`` means every eligible call.
+
+Chaos schedules (docs/guarded-als.md)
+    Beyond the one-shot ``times`` counter, a spec may carry seeded,
+    declarative *schedule* modifiers deciding WHEN the armed fault is
+    eligible to fire:
+
+    - ``site:kind:iter=k``          — fire on exactly the k-th call to
+      the site (1-based; each check at the site counts one call)
+    - ``site:kind:p=0.1:seed=N``    — fire each call with probability
+      p, drawn from a per-spec ``random.Random(seed)`` so the firing
+      pattern is deterministic and replayable
+    - ``site:kind:after=t``         — fire on any call once t seconds
+      have elapsed since arming
+    - ``site:slow:delay=s``         — the ``slow`` kind's sleep length
+
+    Modifiers compose; ``times`` still bounds the TOTAL number of
+    firings once a call is eligible.  A spec whose kind is omitted
+    (``engine.fused_t:iter=3``) defaults to ``runtime``.
+    :func:`parse_schedule` / :func:`format_schedule` round-trip the
+    grammar; ``splatt chaos`` (splatt_tpu/chaos.py) drives a CPD under
+    a schedule and asserts the soak invariant.
 
 Sites used by the production code:
     - ``probe_compile``          — the capability-probe remote compile
@@ -29,6 +51,9 @@ Sites used by the production code:
       truncates the bytes it just wrote, simulating a torn write
     - ``tuner.measure``          — one autotuner candidate measurement
       (tune.py)
+    - ``cpd.sweep``              — poison (not raise): corrupt one ALS
+      sweep's outputs with non-finite values, exercising the
+      numerical-health sentinel (cpd.py / parallel/common.py)
 
 Fault kinds map to canned exceptions whose messages exercise specific
 :func:`splatt_tpu.resilience.classify_failure` branches:
@@ -45,38 +70,64 @@ Fault kinds map to canned exceptions whose messages exercise specific
     runtime    generic runtime failure              unknown
     ========== ==================================== ===============
 
+Two kinds do not raise at all:
+
+    - ``nan`` / ``inf`` — claimed only by :func:`poison`, which
+      multiplies the value it guards by NaN/Inf (a silent numerical
+      blowup, the sentinel's quarry);
+    - ``slow``          — claimed by :func:`maybe_fail`, which SLEEPS
+      ``delay`` seconds instead of raising, so the deadline watchdog
+      (:func:`splatt_tpu.resilience.deadline`) fires for real.
+
 The registry is process-local and the checks are O(1) dict lookups on
-cold paths only (probes, dispatch resolution, checkpoint IO) — never
-inside a kernel.
+cold paths only (probes, dispatch resolution, checkpoint IO, one check
+per sweep) — never inside a kernel.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import random
 import threading
-from typing import Dict, Optional
+import time
+from typing import Dict, Optional, Tuple
 
 _FAULTS_ENV = "SPLATT_FAULTS"
 
-#: times value meaning "every call"
+#: times value meaning "every eligible call"
 ALWAYS = -1
+
+#: kinds whose firing RAISES a canned exception from maybe_fail
+RAISING_KINDS = ("http500", "internal", "unavailable", "timeout",
+                 "oom", "mosaic", "runtime")
+#: kinds claimed only by poison(): corrupt a value instead of raising
+POISON_KINDS = ("nan", "inf")
+#: kinds claimed by maybe_fail() that sleep instead of raising — the
+#: way to make a real call blow a real deadline
+DELAY_KINDS = ("slow",)
+
+#: default sleep of the ``slow`` kind (overridable per spec: delay=s)
+SLOW_DELAY_S = 1.0
 
 #: The declared fault sites of the production code, site → doc.  A
 #: trailing ``.*`` marks a dynamic family (the production call passes
 #: an f-string with that prefix).  This registry is load-bearing, not
 #: documentation-only: `splint` rule SPL006 checks that every site
 #: string the production code passes to :func:`maybe_fail` /
-#: :func:`consume` is declared here, that every declared site is still
-#: called somewhere, and that every declared site is exercised by at
-#: least one test — so a renamed hook cannot silently orphan the
-#: resilience path it was built to exercise.  (Tests may arm ad-hoc
-#: sites to test the harness itself; those need no declaration.)
+#: :func:`consume` / :func:`poison` is declared here, that every
+#: declared site is still called somewhere, and that every declared
+#: site is exercised by at least one test — so a renamed hook cannot
+#: silently orphan the resilience path it was built to exercise.
+#: (Tests may arm ad-hoc sites to test the harness itself; those need
+#: no declaration.)
 SITES = {
     "probe_compile": "the capability-probe remote compile "
                      "(ops/pallas_kernels.py)",
     "engine.*": "an MTTKRP dispatch engine at call time, e.g. "
-                "engine.fused_t / engine.xla_scan (ops/mttkrp.py)",
+                "engine.fused_t / engine.xla_scan (ops/mttkrp.py); "
+                "poison-armed specs corrupt the engine's OUTPUT "
+                "instead of raising",
     "checkpoint_write": "raise during the checkpoint save (cpd.py)",
     "checkpoint_torn": "consumed (not raised): the writer truncates "
                        "the bytes it just wrote, simulating a torn "
@@ -85,6 +136,10 @@ SITES = {
                      "timed MTTKRP runs of a forced engine (tune.py); "
                      "a crashing measurement must degrade dispatch to "
                      "the heuristic chain, never fail the run",
+    "cpd.sweep": "poisoned (not raised): corrupt one ALS sweep's "
+                 "factor output with non-finite values, exercising "
+                 "the numerical-health sentinel and its rollback "
+                 "(cpd.py, parallel/common.py)",
 }
 
 
@@ -113,19 +168,148 @@ def _canned(kind: str, site: str) -> Exception:
     raise ValueError(f"unknown fault kind {kind!r}")
 
 
+def _validate_kind(kind: str) -> None:
+    """Arm-time validation of every kind, raising or not."""
+    if kind in POISON_KINDS or kind in DELAY_KINDS:
+        return
+    _canned(kind, "validate")  # raises ValueError on unknown kinds
+
+
 @dataclasses.dataclass
 class FaultSpec:
-    """One armed fault: what to raise and how many calls it covers."""
+    """One armed fault: what to do and the schedule deciding when.
+
+    `times` bounds total firings (ALWAYS = unbounded); `iter_at`, `p`
+    (+ `seed`), and `after` decide per-call ELIGIBILITY — see the
+    module docstring's chaos-schedule grammar.
+    """
 
     kind: str
     times: int = 1          # remaining trigger count; ALWAYS = unbounded
     exc: Optional[Exception] = None   # overrides the canned exception
     fired: int = 0          # how often it actually triggered
+    iter_at: Optional[int] = None     # fire on the N-th call only
+    p: Optional[float] = None         # per-call Bernoulli probability
+    seed: Optional[int] = None        # seeds the Bernoulli draw
+    after: Optional[float] = None     # eligible after N seconds armed
+    delay: Optional[float] = None     # 'slow' kind: sleep length
+    calls: int = 0          # calls observed at the site since arming
+    armed_ts: float = dataclasses.field(default_factory=time.monotonic)
+    _rng: Optional[random.Random] = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    def rng(self) -> random.Random:
+        if self._rng is None:
+            self._rng = random.Random(self.seed)
+        return self._rng
 
 
 _LOCK = threading.Lock()
 _ACTIVE: Dict[str, FaultSpec] = {}
 _env_loaded = False
+
+
+def parse_spec(item: str) -> Tuple[str, FaultSpec]:
+    """Parse one ``site[:kind][:modifier]...`` spec → (site, FaultSpec).
+
+    Raises ValueError/TypeError on malformation — callers decide
+    whether that is fatal (:func:`parse_schedule` from code) or
+    warn-and-ignore (the env loader).
+    """
+    parts = [p.strip() for p in item.split(":")]
+    if len(parts) < 2 or not parts[0]:
+        raise ValueError("want site:kind[:modifier]... or "
+                         "site:modifier=value")
+    site = parts[0]
+    rest = parts[1:]
+    kind = "runtime"
+    if rest and "=" not in rest[0] and rest[0] != "*" \
+            and not rest[0].isdigit():
+        kind = rest[0]
+        rest = rest[1:]
+    _validate_kind(kind)
+    spec = FaultSpec(kind=kind)
+    for mod in rest:
+        if mod == "*":
+            spec.times = ALWAYS
+        elif mod.isdigit():
+            spec.times = int(mod)
+        elif "=" in mod:
+            key, _, val = mod.partition("=")
+            key = key.strip()
+            val = val.strip()
+            if key == "iter":
+                spec.iter_at = int(val)
+                if spec.iter_at < 1:
+                    raise ValueError("iter= is 1-based")
+            elif key == "p":
+                spec.p = float(val)
+                if not 0.0 <= spec.p <= 1.0:
+                    raise ValueError("p= must lie in [0, 1]")
+            elif key == "seed":
+                spec.seed = int(val)
+            elif key == "after":
+                spec.after = float(val)
+            elif key == "delay":
+                spec.delay = float(val)
+            elif key == "times":
+                spec.times = ALWAYS if val == "*" else int(val)
+            else:
+                raise ValueError(f"unknown schedule modifier {key!r}")
+        else:
+            raise ValueError(f"unparseable modifier {mod!r}")
+    return site, spec
+
+
+def format_spec(site: str, spec: FaultSpec) -> str:
+    """Inverse of :func:`parse_spec` (round-trip: parse(format(s)) == s
+    for every schedule field)."""
+    parts = [site, spec.kind]
+    if spec.iter_at is not None:
+        parts.append(f"iter={spec.iter_at}")
+    if spec.p is not None:
+        parts.append(f"p={spec.p:g}")
+    if spec.seed is not None:
+        parts.append(f"seed={spec.seed}")
+    if spec.after is not None:
+        parts.append(f"after={spec.after:g}")
+    if spec.delay is not None:
+        parts.append(f"delay={spec.delay:g}")
+    if spec.times == ALWAYS:
+        parts.append("*")
+    elif spec.times != 1:
+        parts.append(str(spec.times))
+    return ":".join(parts)
+
+
+def parse_schedule(text: str) -> Dict[str, FaultSpec]:
+    """Parse a comma-separated chaos schedule → {site: FaultSpec}.
+    Strict: a malformed entry raises (the env loader has its own
+    warn-and-ignore wrapper — a typo in an interactive chaos run should
+    fail loudly, a typo in a production env var should not kill the
+    run)."""
+    out: Dict[str, FaultSpec] = {}
+    for item in text.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        site, spec = parse_spec(item)
+        out[site] = spec
+    return out
+
+
+def format_schedule(schedule: Dict[str, FaultSpec]) -> str:
+    """Inverse of :func:`parse_schedule`."""
+    return ",".join(format_spec(site, spec)
+                    for site, spec in schedule.items())
+
+
+def arm(site: str, spec: FaultSpec) -> None:
+    """Arm `spec` at `site` until :func:`reset` (chaos harness; tests
+    preferring scoped arming use :func:`inject`)."""
+    with _LOCK:
+        _load_env_locked()
+        _ACTIVE[site] = spec
 
 
 def _load_env_locked() -> None:
@@ -143,30 +327,44 @@ def _load_env_locked() -> None:
         # every malformation is warn-and-ignore: a typo in a fault spec
         # must not kill the production run at some random hook site
         try:
-            parts = item.split(":")
-            if len(parts) not in (2, 3):
-                raise ValueError("want site:kind[:times]")
-            site, kind = parts[0].strip(), parts[1].strip()
-            times = 1
-            if len(parts) == 3:
-                times = ALWAYS if parts[2].strip() == "*" \
-                    else int(parts[2])
-            _canned(kind, site)  # validate the kind at arm time
+            site, spec = parse_spec(item)
         except (ValueError, TypeError) as e:
             import sys
 
             print(f"splatt-tpu: bad {_FAULTS_ENV} entry {item!r} "
                   f"({e}); ignored", file=sys.stderr)
             continue
-        _ACTIVE[site] = FaultSpec(kind=kind, times=times)
+        _ACTIVE[site] = spec
 
 
-def _take(site: str) -> Optional[FaultSpec]:
-    """Claim one firing of the fault armed at `site`, if any."""
+def _eligible_locked(spec: FaultSpec) -> bool:
+    """Whether THIS call (already counted) satisfies the schedule."""
+    if spec.iter_at is not None and spec.calls != spec.iter_at:
+        return False
+    if spec.after is not None \
+            and time.monotonic() - spec.armed_ts < spec.after:
+        return False
+    if spec.p is not None and not spec.rng().random() < spec.p:
+        return False
+    return True
+
+
+def _take(site: str, kinds: Optional[tuple] = None) -> Optional[FaultSpec]:
+    """Claim one firing of the fault armed at `site`, if any.  `kinds`
+    restricts which fault kinds this hook may claim, so a poison-armed
+    spec is never consumed (and wasted) by a raise-shaped hook at the
+    same site."""
     with _LOCK:
         _load_env_locked()
         spec = _ACTIVE.get(site)
-        if spec is None or spec.times == 0:
+        if spec is None:
+            return None
+        if kinds is not None and spec.kind not in kinds:
+            return None
+        spec.calls += 1
+        if spec.times == 0:
+            return None
+        if not _eligible_locked(spec):
             return None
         if spec.times != ALWAYS:
             spec.times -= 1
@@ -175,11 +373,30 @@ def _take(site: str) -> Optional[FaultSpec]:
 
 
 def maybe_fail(site: str) -> None:
-    """Production hook: raise the armed fault for `site`, if any.
-    A no-op (one dict lookup) when nothing is armed."""
-    spec = _take(site)
-    if spec is not None:
-        raise spec.exc if spec.exc is not None else _canned(spec.kind, site)
+    """Production hook: raise the armed fault for `site`, if any —
+    or SLEEP, for the ``slow`` kind, so a wrapping deadline watchdog
+    fires for real.  A no-op (one dict lookup) when nothing is armed."""
+    spec = _take(site, kinds=RAISING_KINDS + DELAY_KINDS)
+    if spec is None:
+        return
+    if spec.kind in DELAY_KINDS:
+        time.sleep(spec.delay if spec.delay is not None else SLOW_DELAY_S)
+        return
+    raise spec.exc if spec.exc is not None else _canned(spec.kind, site)
+
+
+def poison(site: str, value):
+    """Production hook for non-finite injection: when a ``nan``/``inf``
+    fault is armed (and scheduled) at `site`, return `value` multiplied
+    by NaN/Inf — the silent numerical blowup the health sentinel
+    exists to catch; otherwise return `value` unchanged.  Works on any
+    array-like with scalar broadcasting (jax arrays included; under a
+    jit trace the corruption is baked into the traced program, flushed
+    by the sweep rebuild a rollback performs)."""
+    spec = _take(site, kinds=POISON_KINDS)
+    if spec is None:
+        return value
+    return value * float("nan" if spec.kind == "nan" else "inf")
 
 
 def consume(site: str) -> bool:
@@ -196,15 +413,33 @@ def active(site: str) -> bool:
         return spec is not None and spec.times != 0
 
 
+def fired(site: Optional[str] = None):
+    """How often armed faults actually triggered: a count for one
+    `site`, or {site: count} for every armed site (the chaos harness
+    matches run-report events against what actually fired)."""
+    with _LOCK:
+        _load_env_locked()
+        if site is not None:
+            spec = _ACTIVE.get(site)
+            return spec.fired if spec is not None else 0
+        return {s: spec.fired for s, spec in _ACTIVE.items()}
+
+
 @contextlib.contextmanager
 def inject(site: str, kind: str = "runtime", times: int = 1,
-           exc: Optional[Exception] = None):
+           exc: Optional[Exception] = None,
+           iter_at: Optional[int] = None, p: Optional[float] = None,
+           seed: Optional[int] = None, after: Optional[float] = None,
+           delay: Optional[float] = None):
     """Arm a fault at `site` for the duration of the block (tests).
     `times` bounds how many calls trigger (ALWAYS = every call); `exc`
-    substitutes a custom exception for the canned one."""
+    substitutes a custom exception for the canned one; `iter_at` / `p`
+    (+ `seed`) / `after` / `delay` are the chaos-schedule fields (see
+    the module docstring)."""
     if exc is None:
-        _canned(kind, site)  # validate early
-    spec = FaultSpec(kind=kind, times=times, exc=exc)
+        _validate_kind(kind)  # validate early
+    spec = FaultSpec(kind=kind, times=times, exc=exc, iter_at=iter_at,
+                     p=p, seed=seed, after=after, delay=delay)
     with _LOCK:
         _load_env_locked()
         prev = _ACTIVE.get(site)

@@ -29,7 +29,7 @@ _DTYPE_LITERALS = {"float32", "float64", "bfloat16", "float16"}
 _DTYPE_MODULES = {"numpy", "jax.numpy"}
 _SYNC_JAX = {"jax.block_until_ready", "jax.device_get"}
 _NP_HOST = {"numpy.asarray", "numpy.array"}
-_FAULT_FNS = {"maybe_fail", "consume", "active", "inject"}
+_FAULT_FNS = {"maybe_fail", "consume", "active", "inject", "poison"}
 _ENV_READ_FNS = {"read_env", "read_env_int", "read_env_float"}
 
 
