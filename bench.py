@@ -25,6 +25,15 @@ workload of BASELINE.md row 2), SPLATT_BENCH_PATHS
 measurement) and times the winning plan, reported with the chosen
 engine/nnz_block/scan_target under "tuned_plan"; "blocked" alone skips
 the slow stream oracle on long-rank configs / scarce chip time).
+
+Regression gate (ROADMAP open item 1): the fresh result is compared
+against the newest prior ``BENCH_*.json`` (same metric only — unlike
+workloads are never compared); any headline or per-path slowdown
+beyond 10% is recorded as a ``bench_regression`` run-report event and
+rides along in the JSON under ``"bench_regressions"``.  Run with
+``--gate`` to turn regressions into a nonzero exit, so a perf PR ships
+with a verdict, not just a number.  SPLATT_BENCH_PRIOR_DIR overrides
+where priors are searched (tests).
 """
 
 from __future__ import annotations
@@ -186,6 +195,98 @@ def _run_scaling(devices) -> None:
         raise SystemExit(1)
 
 
+#: slowdown threshold of the regression gate: >10% beyond the newest
+#: prior on the same metric flags a bench_regression
+REGRESSION_THRESHOLD = 0.10
+
+
+def _prior_bench_record(search_dir: str, metric: str = None):
+    """(filename, parsed-record) of the newest prior ``BENCH_*.json``
+    holding a usable bench record — the newest SAME-METRIC one when
+    `metric` is given, so a different workload benched in between
+    cannot silently disable the gate against an older comparable
+    prior.  Newest = highest name in sort order (the drivers write
+    BENCH_r01, BENCH_r02, ...); files without a usable record (or CPU
+    side-artifacts without "parsed") are skipped rather than trusted."""
+    import glob
+
+    candidates = sorted(glob.glob(os.path.join(search_dir,
+                                               "BENCH_*.json")),
+                        reverse=True)
+    for path in candidates:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        rec = data.get("parsed") if isinstance(data, dict) else None
+        if rec is None and isinstance(data, dict) and "value" in data:
+            rec = data  # a bare bench record is also a valid prior
+        if not (isinstance(rec, dict) and rec.get("value")
+                and rec.get("unit") == "sec/iter"):
+            continue
+        if metric is not None and rec.get("metric") != metric:
+            continue  # unlike workload: keep searching older priors
+        return os.path.basename(path), rec
+    return None
+
+
+def _bench_regressions(rec: dict, prior: dict,
+                       threshold: float = REGRESSION_THRESHOLD) -> list:
+    """Slowdowns beyond `threshold` between a fresh bench record and a
+    prior one ON THE SAME METRIC: the headline value, plus every path
+    both runs timed (per-path medians localize a regression to the
+    representation that slipped, even when a different path holds the
+    headline).  Pure function — the gate's unit under test."""
+    out = []
+    if rec.get("metric") != prior.get("metric"):
+        return out  # unlike workloads: no comparison, no verdict
+    pairs = [("headline", rec.get("value"), prior.get("value"))]
+    mine = rec.get("timing_stats") or {}
+    theirs = prior.get("timing_stats") or {}
+    for path in sorted(set(mine) & set(theirs)):
+        pairs.append((path, (mine[path] or {}).get("median"),
+                      (theirs[path] or {}).get("median")))
+    for path, sec, prior_sec in pairs:
+        if not sec or not prior_sec:
+            continue
+        if sec > prior_sec * (1.0 + threshold):
+            out.append(dict(path=path, sec=round(float(sec), 4),
+                            prior_sec=round(float(prior_sec), 4),
+                            pct=round((sec / prior_sec - 1.0) * 100, 1)))
+    return out
+
+
+def _apply_regression_gate(rec: dict) -> list:
+    """Compare `rec` against the newest prior and record every
+    regression (run-report event + stderr line + the record itself
+    under ``bench_regressions``).  Returns the regression list."""
+    from splatt_tpu import resilience
+
+    search_dir = (os.environ.get("SPLATT_BENCH_PRIOR_DIR")
+                  or os.path.dirname(os.path.abspath(__file__)))
+    prior = _prior_bench_record(search_dir, metric=rec.get("metric"))
+    if prior is None:
+        print("bench: no prior BENCH_*.json with this metric found; "
+              "regression gate has no baseline", file=sys.stderr,
+              flush=True)
+        return []
+    fname, prec = prior
+    regs = _bench_regressions(rec, prec)
+    for r in regs:
+        resilience.record_bench_regression(prior_file=fname, **r)
+        print(f"bench: REGRESSION on {r['path']}: {r['sec']}s vs "
+              f"{r['prior_sec']}s in {fname} (+{r['pct']}%)",
+              file=sys.stderr, flush=True)
+    if regs:
+        rec["bench_regressions"] = regs
+        rec["bench_prior"] = fname
+    else:
+        print(f"bench: no >{int(REGRESSION_THRESHOLD * 100)}% "
+              f"regression vs {fname}", file=sys.stderr, flush=True)
+    return regs
+
+
 def _device_precheck(timeout_sec: int = 180) -> None:
     """Probe device availability in a subprocess so a wedged accelerator
     lease cannot hang the benchmark; fall back to CPU on failure.
@@ -238,7 +339,7 @@ def _device_precheck(timeout_sec: int = 180) -> None:
             pass
 
 
-def main() -> None:
+def main(gate: bool = False) -> None:
     child = os.environ.get("SPLATT_SCALING_CHILD")
     if child:
         _scaling_child(int(child))
@@ -555,8 +656,30 @@ def main() -> None:
     except Exception as e:  # the headline number must never be lost
         print(f"bench: roofline model skipped ({type(e).__name__}: {e})",
               file=sys.stderr, flush=True)
+    # regression gate (ROADMAP open item 1): compare against the newest
+    # prior BENCH_*.json on the same metric; >10% slowdowns are
+    # recorded (bench_regression event + the JSON artifact) and, under
+    # --gate, fail the run AFTER the headline JSON prints — the number
+    # is never lost to the verdict
+    regressions = []
+    try:
+        regressions = _apply_regression_gate(rec)
+    except Exception as e:
+        from splatt_tpu import resilience
+
+        print(f"bench: regression gate skipped "
+              f"({resilience.classify_failure(e).value}: {e})",
+              file=sys.stderr, flush=True)
     print(json.dumps(rec))
+    if gate and regressions:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
-    main()
+    _unknown = [a for a in sys.argv[1:] if a != "--gate"]
+    if _unknown:
+        print(f"bench: unknown arguments {_unknown}; only --gate is "
+              f"accepted (knobs are SPLATT_BENCH_* env vars)",
+              file=sys.stderr, flush=True)
+        raise SystemExit(2)
+    main(gate="--gate" in sys.argv[1:])

@@ -95,9 +95,10 @@ class ChaosResult:
                             for e in self.events])
 
 
-def _synthetic(dims, nnz: int, seed: int):
+def synthetic_tensor(dims, nnz: int, seed: int):
     """Seeded power-law synthetic tensor (every slice nonempty so the
-    CPD shapes are exact)."""
+    CPD shapes are exact) — shared by the chaos soak and the serve
+    daemon's ``{"synthetic": ...}`` job workloads (serve.py)."""
     from splatt_tpu.coo import SparseTensor
 
     rng = np.random.default_rng(seed)
@@ -144,7 +145,7 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
     for site, spec in specs.items():
         faults.arm(site, spec)
 
-    tt = _synthetic(dims, nnz, seed)
+    tt = synthetic_tensor(dims, nnz, seed)
     opts = Options(random_seed=seed, max_iterations=iters,
                    verbosity=Verbosity.LOW if verbose
                    else Verbosity.NONE,
@@ -214,6 +215,199 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
                        fired=dict(fired), events=events,
                        violations=violations, error=error,
                        schedule=schedule)
+
+
+# -- serve soak (docs/serve.md) ---------------------------------------------
+#
+# The single-run soak above cannot exercise the serve daemon's two
+# load-bearing promises: (1) kill-and-restart mid-queue loses no
+# accepted job, and (2) one tenant's injected NaN never demotes (or
+# otherwise poisons) a neighbor's engines.  This soak proves both with
+# a REAL daemon subprocess: file jobs, start `splatt serve --once`,
+# SIGKILL it mid-job (a per-job `serve.job_run:slow` fault pins the
+# first job open so the kill window is deterministic), restart, and
+# assert every accepted job reached a terminal state with the
+# isolation evidence in its result record.
+
+@dataclasses.dataclass
+class ServeChaosResult:
+    """One serve kill-and-restart soak's verdict and evidence."""
+
+    verdict: str                  # "survived" | "violated"
+    jobs: Dict[str, str]          # job id -> terminal status
+    killed_mid_queue: bool        # the SIGKILL landed before drain
+    resumed: List[str]            # jobs the restart re-enqueued
+    violations: List[str]         # invariant breaches (empty = pass)
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def run_serve_chaos(seed: int = 0, smoke: bool = True,
+                    verbose: bool = False) -> ServeChaosResult:
+    """Kill-and-restart soak of the serve daemon (docs/serve.md).
+
+    Files three jobs — one NaN-poisoned (sentinel + rollback), pinned
+    open by a slow fault so the SIGKILL deterministically lands
+    mid-job, and two clean neighbors — starts the daemon, SIGKILLs it,
+    restarts with ``--once`` and checks:
+
+    1. every accepted job reached a terminal state (no accepted job is
+       lost to the crash);
+    2. the journal replays cleanly and shows a resume lineage;
+    3. the NaN job's result carries the health evidence
+       (``health_rollback``/``health_degraded``) and demoted NOTHING;
+    4. the clean jobs' results carry no health events and no demotions
+       — the poisoned tenant stayed contained.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    from splatt_tpu import resilience, serve
+
+    dims, nnz, rank, iters = (20, 16, 12), 1200, 3, 6
+    if not smoke:
+        dims, nnz, rank, iters = (40, 32, 24), 3000, 4, 10
+    syn = {"dims": list(dims), "nnz": nnz, "seed": seed}
+    violations: List[str] = []
+    jobs: Dict[str, str] = {}
+    resumed: List[str] = []
+    killed_mid_queue = False
+    error = None
+    tmp = tempfile.mkdtemp(prefix="splatt-serve-chaos-")
+    # splint: ignore[SPL001] forwarding the whole environment to the
+    # daemon subprocess, not reading config — no single ENV_VARS name
+    env = dict(os.environ)
+    # a throwaway plan cache: plans the soak's jobs measure must never
+    # leak into the real shared cache
+    env["SPLATT_TUNE_CACHE"] = os.path.join(tmp, "tune_cache.json")
+    try:
+        # the NaN job's id sorts FIRST ("0" < "c" in the spool's
+        # sorted-filename ingest order), so with one worker it is the
+        # job the slow fault pins open — the kill window below is
+        # keyed to ITS started record, not to whichever job happened
+        # to start first
+        nan_id = "chaos-0-nan"
+        nan_job = {"id": nan_id, "rank": rank, "iters": iters,
+                   "synthetic": syn, "health_retries": 2,
+                   "faults": "serve.job_run:slow:delay=4,"
+                             "cpd.sweep:nan:iter=2"}
+        clean = [{"id": f"chaos-clean{i}", "rank": rank, "iters": iters,
+                  "synthetic": dict(syn, seed=seed + 1 + i)}
+                 for i in range(2)]
+        for spec in [nan_job] + clean:
+            serve.file_request(tmp, spec)
+        cmd = [sys.executable, "-m", "splatt_tpu.cli", "serve", tmp,
+               "--once", "--workers", "1"]
+        jpath = os.path.join(tmp, "journal.jsonl")
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+        deadline = time.time() + 180
+        started = False
+        while time.time() < deadline and proc.poll() is None:
+            started = any(
+                r.get("rec") == "started" and r.get("job") == nan_id
+                for r in serve.Journal(jpath).replay()[0])
+            if started:
+                break
+            time.sleep(0.1)
+        if started and proc.poll() is None:
+            time.sleep(0.5)  # well inside the 4 s slow-fault window
+            proc.kill()      # SIGKILL: no drain, no cleanup
+            killed_mid_queue = True
+        else:
+            violations.append(
+                "daemon finished (or died) before the kill — the soak "
+                "did not exercise a mid-queue restart")
+        proc.wait(timeout=60)
+
+        restart = subprocess.run(cmd + ["--json"], env=env,
+                                 capture_output=True, text=True,
+                                 timeout=600)
+        if restart.returncode != 0:
+            violations.append(
+                f"restarted daemon exited nonzero "
+                f"({restart.returncode}): {restart.stderr[-300:]}")
+
+        recs, torn = serve.Journal(jpath).replay()
+        accepted = {r["job"] for r in recs if r.get("rec") == "accepted"}
+        resumed = sorted({r["job"] for r in recs
+                          if r.get("rec") == "resumed"})
+        if killed_mid_queue and not resumed:
+            violations.append("kill landed mid-queue but the restart "
+                              "resumed nothing — journal replay broken")
+        for jid in sorted(accepted):
+            res = serve.read_result(tmp, jid)
+            states = [r.get("rec") for r in recs if r.get("job") == jid]
+            if not any(s in serve.TERMINAL for s in states):
+                violations.append(f"accepted job {jid} never reached a "
+                                  f"terminal state — a job was LOST")
+                jobs[jid] = "lost"
+                continue
+            if res is None:
+                violations.append(f"job {jid} is terminal but published "
+                                  f"no result record")
+                jobs[jid] = "no-result"
+                continue
+            jobs[jid] = res["status"]
+            kinds = {e["kind"] for e in res.get("events", [])}
+            if jid == nan_id:
+                if res["status"] == "converged" \
+                        and not kinds & {"health_rollback",
+                                         "health_degraded"}:
+                    violations.append(
+                        "the NaN job converged with no health evidence "
+                        "— the injected fault was silently lost")
+                if res.get("demotions"):
+                    violations.append(
+                        "the NaN job demoted engines — NUMERICAL "
+                        "failures must roll back, never demote")
+            else:
+                if kinds & {"health_nonfinite", "health_rollback",
+                            "health_degraded"}:
+                    violations.append(
+                        f"clean job {jid} carries health events — the "
+                        f"NaN tenant leaked into a neighbor")
+                if res.get("demotions"):
+                    violations.append(
+                        f"clean job {jid} carries engine demotions "
+                        f"{res['demotions']} — cross-job poisoning")
+                if res["status"] != "converged":
+                    violations.append(
+                        f"clean job {jid} finished {res['status']!r} "
+                        f"instead of converging")
+    except Exception as e:  # the harness itself must not crash the CLI
+        error = (f"{resilience.classify_failure(e).value}: "
+                 f"{resilience.failure_message(e)[:300]}")
+        violations.append(f"serve-chaos harness error: {error}")
+    verdict = "violated" if violations else "survived"
+    return ServeChaosResult(verdict=verdict, jobs=jobs,
+                            killed_mid_queue=killed_mid_queue,
+                            resumed=resumed, violations=violations,
+                            error=error)
+
+
+def format_serve_report(res: ServeChaosResult) -> List[str]:
+    """Human-readable serve-soak verdict lines for the CLI."""
+    lines = [f"serve chaos: SIGKILL mid-queue "
+             f"{'landed' if res.killed_mid_queue else 'MISSED'}; "
+             f"resumed after restart: "
+             f"{', '.join(res.resumed) or '(none)'}"]
+    for jid, status in sorted(res.jobs.items()):
+        lines.append(f"  job {jid}: {status}")
+    for v in res.violations:
+        lines.append(f"INVARIANT VIOLATED: {v}")
+    lines.append(f"serve chaos verdict: {res.verdict.upper()}")
+    return lines
 
 
 def format_report(res: ChaosResult) -> List[str]:

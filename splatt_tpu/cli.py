@@ -255,6 +255,19 @@ def cmd_chaos(args) -> int:
     iff the invariant held."""
     from splatt_tpu import chaos
 
+    if args.serve:
+        # serve-daemon soak: SIGKILL a real daemon mid-queue, restart,
+        # assert no accepted job is lost and one tenant's NaN never
+        # demotes a neighbor's engines (docs/serve.md)
+        res = chaos.run_serve_chaos(seed=args.seed, smoke=args.smoke,
+                                    verbose=args.verbose > 0)
+        for line in chaos.format_serve_report(res):
+            print(line)
+        if args.json:
+            import json as _json
+
+            print(_json.dumps(res.to_json()))
+        return 0 if res.ok else 1
     # schedule resolution (--schedule, else $SPLATT_CHAOS_SCHEDULE,
     # else the default recipe) lives in run_chaos — the single owner;
     # the resolved string comes back on the result for reporting
@@ -270,6 +283,48 @@ def cmd_chaos(args) -> int:
 
         print(_json.dumps(res.to_json()))
     return 0 if res.ok else 1
+
+
+def cmd_serve(args) -> int:
+    """`splatt serve` — the isolated, crash-resumable multi-tenant
+    decomposition daemon (docs/serve.md).  Daemon mode runs the
+    journal-backed queue over DIR; --submit/--status are the
+    client-side filed-request API."""
+    import json as _json
+
+    from splatt_tpu import serve
+
+    if args.submit:
+        with open(args.submit) as f:
+            spec = _json.load(f)
+        jid = serve.file_request(args.dir, spec)
+        print(_json.dumps({"job": jid, "filed": True}))
+        return 0
+    if args.status:
+        print(_json.dumps(serve.read_status(args.dir, args.status)))
+        return 0
+    srv = serve.Server(args.dir, workers=args.workers,
+                       queue_max=args.queue_max, poll_s=args.poll,
+                       job_deadline_s=args.job_deadline,
+                       verbose=args.verbose > 0)
+    srv.install_signal_handlers()
+    summary = srv.run_once() if args.once else srv.serve_forever()
+    from splatt_tpu import resilience
+
+    lines = resilience.run_report().summary()
+    if lines and args.verbose > 0:
+        print("Resilience events:")
+        for line in lines:
+            print(line)
+    print(_json.dumps(summary if args.json
+                      else {"jobs": summary["counts"],
+                            "pending": summary["pending"]}))
+    # --once is the batch/CI entry: nonzero when any accepted job
+    # failed outright (degraded-but-terminal is a success of the
+    # guarded contract; interrupted jobs resume next start)
+    if args.once and summary["counts"].get(serve.FAILED):
+        return 1
+    return 0
 
 
 def cmd_bench(args) -> int:
@@ -512,6 +567,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--smoke", action="store_true",
                    help="seconds-scale seeded run on a tiny tensor "
                         "(the tier-1 CI entry)")
+    p.add_argument("--serve", action="store_true",
+                   help="soak the serve daemon instead: SIGKILL a "
+                        "real daemon mid-queue, restart it, and "
+                        "assert no accepted job is lost and one "
+                        "tenant's injected NaN never demotes a "
+                        "neighbor's engines (docs/serve.md)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-r", "--rank", type=int, default=4)
     p.add_argument("-i", "--iters", type=int, default=8)
@@ -521,6 +582,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="also print the full ChaosResult as JSON")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "serve", help="run the multi-tenant decomposition daemon",
+        epilog="A journal-backed job queue over DIR: clients drop job "
+               "specs into DIR/requests/ (or --submit them), the "
+               "daemon runs each CPD under the guarded drivers with "
+               "per-job isolation of demotions/health verdicts, "
+               "results appear in DIR/results/<id>.json with the "
+               "--json run-report schema.  Crash-resumable: a killed "
+               "daemon replays its journal on restart and resumes "
+               "every accepted job from its checkpoint; SIGTERM "
+               "drains gracefully (docs/serve.md).")
+    p.add_argument("dir", help="serve state directory (journal, "
+                               "requests/, results/, ckpt/)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("--workers", type=_positive_int,
+                   help="concurrent job-supervisor threads "
+                        "(default: $SPLATT_SERVE_WORKERS)")
+    p.add_argument("--queue-max", type=int, dest="queue_max",
+                   help="bounded pending-queue depth; submissions past "
+                        "it are load-shed with an explicit queue_full "
+                        "rejection (default: $SPLATT_SERVE_QUEUE_MAX; "
+                        "<= 0 unbounded)")
+    p.add_argument("--poll", type=float,
+                   help="seconds between request-spool scans "
+                        "(default: $SPLATT_SERVE_POLL_S)")
+    p.add_argument("--job-deadline", type=float, dest="job_deadline",
+                   help="default per-job deadline in seconds; a blown "
+                        "deadline classifies TIMEOUT and the job is "
+                        "marked failed, releasing its worker (default: "
+                        "$SPLATT_SERVE_JOB_DEADLINE_S; <= 0 off)")
+    p.add_argument("--once", action="store_true",
+                   help="process the spool and queue to completion, "
+                        "then exit (batch/CI mode; nonzero exit iff "
+                        "a job failed outright)")
+    p.add_argument("--submit", metavar="SPEC_JSON",
+                   help="client mode: file this job-spec JSON into "
+                        "DIR/requests/ and exit")
+    p.add_argument("--status", metavar="JOB_ID",
+                   help="client mode: print the job's journal-derived "
+                        "state (and result, when terminal) as JSON")
+    p.add_argument("--json", action="store_true",
+                   help="print the full per-job state map on exit")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
         "tune", help="pre-tune the MTTKRP plan for a tensor",

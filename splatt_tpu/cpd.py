@@ -296,12 +296,18 @@ def _health_verdict(vec: np.ndarray, nmodes: int):
 
 
 def health_retries() -> int:
-    """The sentinel's rollback budget (SPLATT_HEALTH_RETRIES): how many
+    """The sentinel's rollback budget: the active resilience scope's
+    per-job override when one is set (serve gives each tenant its own
+    budget — docs/serve.md), else SPLATT_HEALTH_RETRIES.  How many
     times a run may roll back to the last-good snapshot before it
     degrades to checkpoint-and-abort.  0 disables the sentinel (and its
     snapshot upkeep) entirely."""
+    from splatt_tpu import resilience
     from splatt_tpu.utils.env import read_env_int
 
+    scoped = resilience.scope_health_retries()
+    if scoped is not None:
+        return int(scoped)
     v = read_env_int("SPLATT_HEALTH_RETRIES")
     return int(v) if v is not None else 0
 
@@ -444,7 +450,8 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             init: Optional[List[jax.Array]] = None,
             checkpoint_path: Optional[str] = None,
             checkpoint_every: int = 10,
-            resume: bool = True) -> KruskalTensor:
+            resume: bool = True,
+            stop: Optional[Callable[[], bool]] = None) -> KruskalTensor:
     """Compute a rank-`rank` CPD of X (≙ splatt_cpd_als, src/cpd.c:22-63).
 
     Checkpoint/resume (beyond the reference, which only writes terminal
@@ -453,6 +460,13 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     resumed from (pass resume=False to overwrite).  ALS is
     self-correcting, so restarting from checkpointed factors continues
     the same optimization.
+
+    `stop` is a cooperative interruption hook, polled at fit-check
+    iterations (host syncs already happen there): when it returns True
+    the run checkpoints the just-committed state (if `checkpoint_path`
+    is set) and returns early — the serve daemon's graceful drain
+    (docs/serve.md) hands this a "draining?" probe so a SIGTERM
+    checkpoints running jobs instead of abandoning or outliving them.
     """
     opts = (opts or default_opts()).validate()
     if isinstance(X, SparseTensor):
@@ -762,13 +776,31 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                 print(f"  its = {it + 1:3d} (deferred fit check)")
             continue
         elapsed = time.perf_counter() - t0
-        if snap is not None:
+        if snap is not None and guard > 0:
+            # refresh the rollback target only after a verified-finite
+            # check.  With the sentinel disabled (guard == 0) the
+            # refresh is SKIPPED entirely — guards must be free when
+            # off, and for the donated fused sweep each refresh is a
+            # full host copy of every factor.  The initial snapshot is
+            # kept for the (rare) engine rescue, which then
+            # re-materializes the pre-run state: ALS is self-correcting,
+            # so the retry re-converges, just from further back.
             snap = snapshot()
         if opts.verbosity >= Verbosity.LOW:
             print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"
                   f"  delta = {fitval - fit_prev:+0.4e}")
         if checkpoint_due:
             _save_checkpoint(checkpoint_path, factors, lam, it + 1, fitval)
+        if stop is not None and stop():
+            # cooperative interruption (serve drain): the state just
+            # committed is checkpointed so a later resume redoes
+            # nothing, and the caller decides what the early return
+            # means (the fit so far is a truthful partial result)
+            if checkpoint_path is not None and not checkpoint_due:
+                _save_checkpoint(checkpoint_path, factors, lam, it + 1,
+                                 fitval)
+            fit_prev = fitval
+            break
         # tolerance scales with the *actual* delta window: k sweeps
         # between regular checks, but a checkpoint-forced check can land
         # mid-window (≙ the k=1 per-iteration test, src/cpd.c:368-370)

@@ -25,6 +25,7 @@ Both take `interpret=` so the differential tests run on CPU
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -705,11 +706,24 @@ def probe_cache_load(state_key: str):
         return None
 
 
+#: intra-process serialization of cache writes, complementing the
+#: inter-process flock below: concurrent serve jobs (threads in ONE
+#: process) tuning simultaneously must not interleave their
+#: read-modify-writes.  flock on separate fds does conflict within a
+#: process too, but holding a plain Lock makes the thread contract
+#: independent of that platform detail and keeps the (open, flock)
+#: pair itself race-free.
+_JSON_CACHE_THREAD_LOCK = threading.Lock()
+
+
 def _json_cache_update(path, mutate, on_error=None) -> None:
     """Locked atomic read-modify-write of a small JSON cache file —
     shared by the capability-probe cache here and the autotuner's plan
     cache (splatt_tpu/tune.py).  `mutate(data) -> data` transforms the
-    loaded dict (``{}`` when absent/corrupt).  Best-effort by contract:
+    loaded dict (``{}`` when absent/corrupt).  Serialized against other
+    processes (flock) AND other threads of this process (concurrent
+    serve jobs share the warm caches — docs/serve.md), so two writers
+    never drop each other's entries.  Best-effort by contract:
     cache IO must never break dispatch, so every failure is routed to
     `on_error(op, exc)` (classified into the run report) and swallowed.
     """
@@ -725,7 +739,8 @@ def _json_cache_update(path, mutate, on_error=None) -> None:
         # different kernels must not drop each other's verdicts)
         import fcntl
 
-        with open(str(path) + ".lock", "w") as lock:
+        with _JSON_CACHE_THREAD_LOCK, \
+                open(str(path) + ".lock", "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
             try:
                 with open(path) as f:

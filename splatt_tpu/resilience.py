@@ -52,6 +52,18 @@ Run report
     so silent degradation is observable (≙ the reference's stats
     reporting philosophy, src/stats.c).
 
+Per-job scoping (docs/serve.md)
+    All of the mutable state above — the demotion table, the
+    last-attempt note, the run report, plus overrides for the
+    health-retry budget and the deadline watchdog — lives in a
+    :class:`ResilienceScope`.  Outside any scope the process-global
+    scope applies (single-run CLI behavior, unchanged); the serve
+    daemon wraps each supervised job in :func:`scope`, a contextvars-
+    backed context manager, so one tenant's NUMERICAL rollback or OOM
+    demotion is attributed to (and contained within) that job while the
+    probe/tune/compile caches stay shared and warm across jobs (≙ the
+    reference's per-run ``splatt_opts``/workspace separation).
+
 Nothing here imports jax: classification is pure string logic so the
 fault-injection tests exercise every branch without a device.
 """
@@ -59,6 +71,7 @@ fault-injection tests exercise every branch without a device.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import dataclasses
 import enum
 import random
@@ -234,9 +247,6 @@ class Demotion:
     ts: float = dataclasses.field(default_factory=time.time)
 
 
-_DEMOTED: Dict[str, Demotion] = {}
-
-
 def _demotion_key(engine: str, shape_key: Optional[str]) -> str:
     return engine if shape_key is None else f"{engine}@{shape_key}"
 
@@ -249,13 +259,14 @@ def demote_engine(engine: str, error, shape_key: Optional[str] = None
     only shapes of that size); everything else process-wide.  Never
     persisted to disk: a demotion lasts one process — the probe cache
     owns cross-process verdicts with its own (stricter) persistence
-    rules."""
+    rules.  Inside a :func:`scope` the demotion is confined to that
+    job: one tenant's OOM must not steer its neighbors' dispatch."""
     cls = classify_failure(error)
     if cls not in (FailureClass.RESOURCE, FailureClass.TIMEOUT):
         shape_key = None
     d = Demotion(engine=engine, failure_class=cls,
                  error=failure_message(error)[:500], shape_key=shape_key)
-    _DEMOTED[_demotion_key(engine, shape_key)] = d
+    _state().demoted[_demotion_key(engine, shape_key)] = d
     run_report().add("engine_demotion", engine=engine,
                      failure_class=cls.value, shape_key=shape_key,
                      error=d.error[:200])
@@ -263,20 +274,23 @@ def demote_engine(engine: str, error, shape_key: Optional[str] = None
 
 
 def is_demoted(engine: str, shape_key: Optional[str] = None) -> bool:
-    """Whether `engine` was demoted process-wide, or for this shape."""
-    if engine in _DEMOTED:
+    """Whether `engine` was demoted in the current scope (process-wide
+    outside any :func:`scope`), or for this shape."""
+    demoted = _state().demoted
+    if engine in demoted:
         return True
     return (shape_key is not None
-            and _demotion_key(engine, shape_key) in _DEMOTED)
+            and _demotion_key(engine, shape_key) in demoted)
 
 
 def demotions() -> List[Demotion]:
-    return list(_DEMOTED.values())
+    return list(_state().demoted.values())
 
 
 def reset_demotions() -> None:
-    """Clear runtime demotions (tests; a fresh run in one process)."""
-    _DEMOTED.clear()
+    """Clear the current scope's runtime demotions (tests; a fresh run
+    in one process)."""
+    _state().demoted.clear()
 
 
 # -- last-attempt tracking --------------------------------------------------
@@ -286,19 +300,18 @@ def reset_demotions() -> None:
 # inside the sweep.  The dispatch layer notes which engine it handed
 # work to; the driver-level handler (cpd_als) uses it to demote the
 # right engine when an exception arrives with no call-site context.
-
-_LAST_ATTEMPT: Optional[tuple] = None
+# Scope-local: two concurrent jobs' dispatches must not cross-attribute.
 
 
 def note_engine_attempt(engine: str, shape_key: Optional[str] = None
                         ) -> None:
-    global _LAST_ATTEMPT
-    _LAST_ATTEMPT = (engine, shape_key)
+    _state().last_attempt = (engine, shape_key)
 
 
 def last_engine_attempt() -> Optional[tuple]:
-    """(engine, shape_key) of the most recent dispatch, or None."""
-    return _LAST_ATTEMPT
+    """(engine, shape_key) of the current scope's most recent dispatch,
+    or None."""
+    return _state().last_attempt
 
 
 # -- engine fallback switch -------------------------------------------------
@@ -350,10 +363,17 @@ def set_deadline(seconds: Optional[float]) -> None:
 
 
 def deadline_seconds(default: Optional[float] = None) -> Optional[float]:
-    """The configured watchdog deadline: the process override if set
-    (<= 0 meaning "disabled" — the caller's `default` still applies,
-    so the probe's always-on 240 s survives an explicit disable), else
-    SPLATT_DEADLINE_S, else `default`.  None = disabled."""
+    """The configured watchdog deadline: the current job scope's
+    override if set (serve gives each job its own budget), else the
+    process override (<= 0 meaning "disabled" — the caller's `default`
+    still applies, so the probe's always-on 240 s survives an explicit
+    disable), else SPLATT_DEADLINE_S, else `default`.  None = disabled.
+    """
+    sc = _SCOPE.get()
+    if sc is not None and sc.deadline_s is not None:
+        if sc.deadline_s > 0:
+            return sc.deadline_s
+        return default
     if _deadline_override is not None:
         if _deadline_override > 0:
             return _deadline_override
@@ -513,6 +533,26 @@ RUN_REPORT_EVENTS = {
     "bench_path_error": "one benchmark path failed mid-run; the error "
                         "was classified and recorded and the "
                         "remaining paths continued (bench.py)",
+    "bench_regression": "the fresh benchmark ran >10% slower than the "
+                        "newest prior BENCH_*.json on the same metric; "
+                        "bench.py --gate turns this into a nonzero "
+                        "exit (record_bench_regression)",
+    "job_accepted": "the serve daemon accepted a job submission and "
+                    "journaled it durably (docs/serve.md); an accepted "
+                    "job reaches a terminal state even across daemon "
+                    "crashes",
+    "job_resumed": "journal replay re-enqueued a non-terminal job "
+                   "after a daemon restart; the job resumes from its "
+                   "last hardened checkpoint (docs/serve.md)",
+    "queue_full": "the serve daemon's bounded queue load-shed a "
+                  "submission (SPLATT_SERVE_QUEUE_MAX); the client "
+                  "gets an explicit rejection instead of unbounded "
+                  "queueing (docs/serve.md)",
+    "job_degraded": "a supervised job finished degraded or failed "
+                    "(health budget exhausted, blown deadline, or a "
+                    "classified error) instead of converging; the "
+                    "job's own run report carries the evidence "
+                    "(docs/serve.md)",
 }
 
 
@@ -527,17 +567,35 @@ def record_path_error(label: str, exc) -> dict:
         error=failure_message(exc)[:200])
 
 
+def record_bench_regression(path: str, sec: float, prior_sec: float,
+                            pct: float, prior_file: str) -> dict:
+    """Record a ``bench_regression`` run-report event — the shared
+    emission point bench.py's gate uses when a fresh timing runs >10%
+    slower than the newest prior BENCH_*.json on the same metric, so
+    every future PR ships with a perf verdict instead of a bare number
+    (ROADMAP open item 1)."""
+    return run_report().add(
+        "bench_regression", path=path, sec=round(float(sec), 4),
+        prior_sec=round(float(prior_sec), 4), pct=round(float(pct), 1),
+        prior_file=prior_file)
+
+
 class RunReport:
     """Append-only log of resilience events for one run: engine
     demotions, transient retries, probe verdict downgrades, checkpoint
     recoveries.  The CLI prints :meth:`summary` after the run so silent
-    degradation is observable; tests assert on :meth:`events`."""
+    degradation is observable; tests assert on :meth:`events`.  A
+    report owned by a job :func:`scope` stamps its ``job_id`` onto
+    every event so multi-tenant logs stay attributable."""
 
-    def __init__(self):
+    def __init__(self, job_id: Optional[str] = None):
         self._events: List[dict] = []
+        self.job_id = job_id
 
     def add(self, kind: str, **info) -> dict:
         ev = dict(kind=kind, ts=time.time(), **info)
+        if self.job_id is not None and "job" not in ev:
+            ev["job"] = self.job_id
         self._events.append(ev)
         return ev
 
@@ -600,12 +658,104 @@ class RunReport:
             lines.append(f"  bench path {e['path']} failed "
                          f"({e['failure_class']}: {e['error'][:80]}); "
                          f"remaining paths continued")
+        for e in self.events("bench_regression"):
+            lines.append(f"  BENCH REGRESSION on {e['path']}: "
+                         f"{e['sec']}s vs {e['prior_sec']}s in "
+                         f"{e['prior_file']} (+{e['pct']}%)")
+        for e in self.events("queue_full"):
+            lines.append(f"  job {e.get('job')} load-shed: the serve "
+                         f"queue was full ({e.get('queue_max')} pending)")
+        for e in self.events("job_resumed"):
+            lines.append(f"  job {e.get('job')} resumed from the "
+                         f"journal after a daemon restart")
+        for e in self.events("job_degraded"):
+            lines.append(f"  job {e.get('job')} finished degraded "
+                         f"({e.get('failure_class')}: "
+                         f"{str(e.get('error', ''))[:80]})")
         return lines
 
 
-_REPORT = RunReport()
+# -- per-job scoping (docs/serve.md) ----------------------------------------
+#
+# One serve daemon runs many tenants' decompositions in one process.
+# The mutable resilience state — the demotion table, the async
+# last-attempt note, the run report — used to be module-global, so one
+# tenant's OOM demotion silently steered every neighbor's dispatch and
+# one job's health rollback polluted every other job's report.  A
+# ResilienceScope is the isolation unit: contextvars-backed, so each
+# supervised job (one thread/async context) sees its own state while
+# code outside any scope keeps the process-global scope — the
+# single-run CLI behavior, unchanged.  The probe/tune/compile caches
+# are deliberately NOT scoped: capability and plan verdicts are
+# facts about the environment, not about a tenant, and sharing them
+# warm is the point of serving many jobs from one process.
+
+@dataclasses.dataclass
+class ResilienceScope:
+    """One isolation unit of mutable resilience state: the engine
+    demotion table, the last-attempt note, the run report, and
+    per-scope overrides for the health-retry budget and the deadline
+    watchdog (None = inherit the env/process default)."""
+
+    job_id: Optional[str] = None
+    demoted: Dict[str, Demotion] = dataclasses.field(default_factory=dict)
+    last_attempt: Optional[tuple] = None
+    health_retries: Optional[int] = None
+    deadline_s: Optional[float] = None
+    report: RunReport = None
+
+    def __post_init__(self):
+        if self.report is None:
+            self.report = RunReport(job_id=self.job_id)
+
+
+_GLOBAL_SCOPE = ResilienceScope()
+_SCOPE: contextvars.ContextVar = contextvars.ContextVar(
+    "splatt_resilience_scope", default=None)
+
+
+def _state() -> ResilienceScope:
+    """The active scope: the contextvar's if a job scope is entered on
+    this thread/context, else the process-global scope."""
+    return _SCOPE.get() or _GLOBAL_SCOPE
+
+
+def current_job() -> Optional[str]:
+    """The job id of the active scope, or None outside any scope."""
+    sc = _SCOPE.get()
+    return sc.job_id if sc is not None else None
+
+
+def scope_health_retries() -> Optional[int]:
+    """The active scope's health-retry budget override, or None (the
+    env default applies) — consulted by cpd.health_retries()."""
+    sc = _SCOPE.get()
+    return sc.health_retries if sc is not None else None
+
+
+@contextlib.contextmanager
+def scope(job_id: str, health_retries: Optional[int] = None,
+          deadline_s: Optional[float] = None):
+    """Enter a fresh per-job resilience scope: demotions, health
+    verdicts, the last-attempt note and every run-report event inside
+    the block are attributed to `job_id` and isolated from the global
+    scope and from every sibling job.  Scopes start EMPTY (no inherited
+    demotions): a neighbor's capacity verdict is not evidence against
+    this tenant's shapes — cross-job capability facts belong to the
+    shared probe cache, which has stricter persistence rules.
+
+    `health_retries` / `deadline_s` override the env-configured
+    sentinel budget and watchdog deadline for this job only."""
+    st = ResilienceScope(job_id=str(job_id), health_retries=health_retries,
+                         deadline_s=deadline_s)
+    token = _SCOPE.set(st)
+    try:
+        yield st
+    finally:
+        _SCOPE.reset(token)
 
 
 def run_report() -> RunReport:
-    """The process-wide resilience event log."""
-    return _REPORT
+    """The active scope's resilience event log (the process-wide log
+    outside any :func:`scope`)."""
+    return _state().report

@@ -1,0 +1,787 @@
+"""`splatt serve` — an isolated, crash-resumable multi-tenant
+decomposition daemon (ROADMAP open item 4; docs/serve.md).
+
+The million-user scenario is many concurrent jobs, not one big run.
+This module turns the single-run reliability spine (failure taxonomy,
+engine demotion, health sentinel + rollback, deadline watchdog) into a
+SERVICE without letting one tenant's failures poison its neighbors:
+
+Durable job queue
+    Every accepted job is journaled to an append-only JSONL file
+    (:class:`Journal`) before the submitter hears "accepted" — one
+    fsynced line per state transition (``accepted`` → ``started`` →
+    ``done``/``failed``, plus ``resumed``/``interrupted``/``rejected``).
+    A crashed or preempted daemon replays the journal on start: every
+    accepted-but-non-terminal job is re-enqueued (a ``job_resumed``
+    event) and resumes from its last hardened checkpoint — the
+    checksummed, ``.bak``-generationed checkpoints of cpd.py, one per
+    job under ``<root>/ckpt/``.  A torn final line (SIGKILL mid-append)
+    is skipped, never fatal.
+
+Per-job isolation
+    Each job runs under :func:`splatt_tpu.resilience.scope`: its engine
+    demotions, health verdicts, retry budget, watchdog deadline and
+    run-report events are attributed to the job and invisible to every
+    neighbor — one tenant's NUMERICAL rollback or OOM demotion must not
+    steer another tenant's dispatch (≙ the reference's per-run
+    ``splatt_opts``/workspace separation).  A job spec may declare its
+    own fault schedule (``"faults"``, SPLATT_FAULTS grammar), armed via
+    :func:`splatt_tpu.utils.faults.scoped` inside that job only.  The
+    probe/tune/compile caches stay SHARED and warm — the Nth request in
+    a known shape regime pays zero compile — behind the locked cache
+    protocol (ops/pallas_kernels.py).
+
+Overload handling
+    The pending queue is bounded (``SPLATT_SERVE_QUEUE_MAX``); a
+    submission past the bound is load-shed with an explicit rejection
+    (``queue_full`` event + a ``rejected`` result) instead of queueing
+    unboundedly.  Per-job deadlines ride the PR 5 watchdog
+    (``SPLATT_SERVE_JOB_DEADLINE_S`` / spec ``deadline_s``).  SIGTERM
+    drains gracefully: running jobs checkpoint through the cpd ``stop``
+    hook and are journaled ``interrupted`` (→ resumed next start),
+    queued jobs simply stay journaled.
+
+Job API (machine-readable)
+    Filed requests: clients drop ``<id>.json`` job specs into
+    ``<root>/requests/`` (:func:`file_request` writes them atomically);
+    the daemon ingests, journals and deletes them.  Results appear as
+    ``<root>/results/<id>.json`` carrying the same machine-readable
+    schema as ``splatt cpd --json`` (fit, events, demotions) plus the
+    job's status.  :func:`read_status` / :func:`read_result` are the
+    client-side readers.  The :class:`Server` methods are the same API
+    in-process.
+
+A job spec is a JSON object::
+
+    {"id": "j1", "rank": 8, "iters": 25, "seed": 0,
+     "synthetic": {"dims": [40, 32, 24], "nnz": 3000, "seed": 0},
+     # or "tensor": "/path/to/tensor.tns",
+     "tol": 1e-5, "checkpoint_every": 5, "tune": false,
+     "autotune": null, "health_retries": null, "deadline_s": null,
+     "faults": ""}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+# journal record kinds (the `rec` field of each JSONL line)
+#: in-memory-only reservation state while the accept append fsyncs
+#: (never journaled; a concurrent same-id submission dedups on it)
+ACCEPTING = "accepting"
+ACCEPTED = "accepted"
+STARTED = "started"
+RESUMED = "resumed"
+INTERRUPTED = "interrupted"
+DONE = "done"          # terminal: converged or degraded (see status)
+FAILED = "failed"      # terminal: a classified error
+REJECTED = "rejected"  # terminal: load-shed or invalid
+
+#: records after which a job needs no further work
+TERMINAL = (DONE, FAILED, REJECTED)
+
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def _job_id(spec: dict) -> str:
+    """The job's id: the spec's, else a fresh one.  Ids name journal
+    records, checkpoint files and result files, so they are restricted
+    to a filesystem-safe alphabet."""
+    jid = str(spec.get("id") or uuid.uuid4().hex[:12])
+    if not _ID_RE.match(jid):
+        raise ValueError(
+            f"job id {jid!r} is not filesystem-safe (want "
+            f"[A-Za-z0-9][A-Za-z0-9._-]*, max 64 chars)")
+    return jid
+
+
+class Journal:
+    """Append-only JSONL job journal with durable, atomic appends.
+
+    One `write()` of a full line + flush + fsync per record: a SIGKILL
+    can tear at most the final line, which :meth:`replay` skips (the
+    record it carried is re-derived — an un-journaled terminal record
+    just means the job re-runs, and resume makes that cheap).  Appends
+    are serialized across threads; the journal is single-writer by
+    design (one daemon per serve root)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+
+    def append(self, rec: dict) -> None:
+        """Durably append one record (raises on IO failure — callers
+        decide whether durability is load-bearing for this record)."""
+        from splatt_tpu.utils import faults
+
+        faults.maybe_fail("serve.journal_write")
+        line = json.dumps(dict(rec, ts=time.time()), sort_keys=True)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def replay(self):
+        """Parse every complete record → (records, torn_line_count).
+        A torn/garbled line (the one a SIGKILL can leave) is counted
+        and skipped — replay must never die on its own crash debris."""
+        recs: List[dict] = []
+        torn = 0
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        torn += 1
+                        continue
+                    if isinstance(rec, dict):
+                        recs.append(rec)
+                    else:
+                        torn += 1
+        except FileNotFoundError:
+            pass  # fresh serve root: nothing journaled yet
+        return recs, torn
+
+
+class Server:
+    """The serve daemon: a bounded, journal-backed job queue and a
+    small supervisor pool running each CPD under the guarded drivers
+    with per-job resilience scoping (module docstring; docs/serve.md).
+    """
+
+    def __init__(self, root: str, workers: Optional[int] = None,
+                 queue_max: Optional[int] = None,
+                 poll_s: Optional[float] = None,
+                 job_deadline_s: Optional[float] = None,
+                 verbose: bool = False):
+        from splatt_tpu.utils.env import read_env_float, read_env_int
+
+        self.root = os.path.abspath(root)
+        self.requests_dir = os.path.join(self.root, "requests")
+        self.results_dir = os.path.join(self.root, "results")
+        self.ckpt_dir = os.path.join(self.root, "ckpt")
+        for d in (self.root, self.requests_dir, self.results_dir,
+                  self.ckpt_dir):
+            os.makedirs(d, exist_ok=True)
+        self.journal = Journal(os.path.join(self.root, "journal.jsonl"))
+        self.workers = int(workers if workers is not None
+                           else read_env_int("SPLATT_SERVE_WORKERS"))
+        self.queue_max = int(queue_max if queue_max is not None
+                             else read_env_int("SPLATT_SERVE_QUEUE_MAX"))
+        self.poll_s = float(poll_s if poll_s is not None
+                            else read_env_float("SPLATT_SERVE_POLL_S"))
+        self.job_deadline_s = float(
+            job_deadline_s if job_deadline_s is not None
+            else read_env_float("SPLATT_SERVE_JOB_DEADLINE_S"))
+        self.verbose = verbose
+        self._lock = threading.Lock()
+        #: id -> {"spec": dict|None, "state": str, "status": str|None,
+        #:        "resumed": bool}
+        self._jobs: Dict[str, dict] = {}
+        self._queue: deque = deque()
+        self._draining = threading.Event()
+        self._replay()
+
+    # -- crash recovery -----------------------------------------------------
+
+    def _replay(self) -> None:
+        """Rebuild queue state from the journal: the last record per
+        job wins; every accepted-but-non-terminal job is re-enqueued
+        (``job_resumed``) and will resume from its checkpoint."""
+        from splatt_tpu import resilience
+
+        recs, torn = self.journal.replay()
+        if torn:
+            self._log(f"journal: skipped {torn} torn line(s) "
+                      f"(crash debris)")
+        for rec in recs:
+            jid = rec.get("job")
+            kind = rec.get("rec")
+            if not jid or not kind:
+                continue
+            j = self._jobs.setdefault(
+                jid, {"spec": None, "state": None, "status": None,
+                      "resumed": False})
+            if kind == ACCEPTED:
+                j["spec"] = rec.get("spec")
+                j["state"] = ACCEPTED
+            else:
+                j["state"] = kind
+                if kind == DONE:
+                    j["status"] = rec.get("status")
+        for jid, j in self._jobs.items():
+            if j["state"] in TERMINAL or j["spec"] is None:
+                continue
+            j["resumed"] = True
+            self._queue.append(jid)
+            resilience.run_report().add("job_resumed", job=jid,
+                                        from_state=j["state"])
+            self._log(f"job {jid}: resumed from journal "
+                      f"(was {j['state']})")
+            try:
+                self.journal.append({"rec": RESUMED, "job": jid})
+            except Exception as e:
+                # lineage entry only — the ACCEPTED record already
+                # guarantees a later replay re-finds this job
+                self._warn_journal("resume", jid, e)
+
+    # -- submission / job API ----------------------------------------------
+
+    def submit(self, spec: dict) -> dict:
+        """Accept (journal durably + enqueue) or reject one job.
+
+        Durability-first: the submitter hears "accepted" only after the
+        journal append succeeded — a submission the journal cannot
+        record is REJECTED, because a crash would silently forget it.
+        A full pending queue load-sheds with an explicit ``queue_full``
+        rejection.  Re-submitting a known id is idempotent (a crashed
+        client retrying, or a spool file re-ingested after a crash)."""
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        faults.maybe_fail("serve.submit")
+        jid = _job_id(spec)
+        spec = dict(spec, id=jid)
+        # decide under the lock, do the durable IO OUTSIDE it: fsyncs
+        # must not stall the daemon's control plane (status/summary/
+        # worker dequeue all share this lock)
+        reason = None
+        with self._lock:
+            known = self._jobs.get(jid)
+            if known is not None and known["state"] != REJECTED:
+                # idempotent re-submission of a live/terminal job; a
+                # REJECTED id may be resubmitted — load shedding is an
+                # invitation to retry, not a permanent verdict
+                return {"job": jid, "state": known["state"],
+                        "duplicate": True}
+            if not (spec.get("synthetic") or spec.get("tensor")):
+                reason = ("invalid: no workload (give 'synthetic' or "
+                          "'tensor')")
+            elif spec.get("faults"):
+                # validate the declared chaos schedule at the door: a
+                # typo rejects THIS submission with the parse error
+                # instead of failing the job at run time
+                try:
+                    faults.parse_schedule(str(spec["faults"]))
+                except (ValueError, TypeError) as e:
+                    reason = f"invalid: bad faults schedule ({e})"
+            if reason is None and self.queue_max > 0 \
+                    and len(self._queue) >= self.queue_max:
+                resilience.run_report().add("queue_full", job=jid,
+                                            queue_max=self.queue_max)
+                reason = "queue_full"
+            if reason is None:
+                # reserve the id so a concurrent same-id submission
+                # dedups while we journal lock-free below
+                self._jobs[jid] = {"spec": spec, "state": ACCEPTING,
+                                   "status": None, "resumed": False}
+        if reason is not None:
+            return self._reject(jid, spec, reason)
+        # durability-first: the submitter hears "accepted" only once
+        # this append has fsynced
+        try:
+            self.journal.append({"rec": ACCEPTED, "job": jid,
+                                 "spec": spec})
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            return self._reject(
+                jid, spec, f"journal_error ({cls.value}: "
+                f"{resilience.failure_message(e)[:120]})")
+        resilience.run_report().add("job_accepted", job=jid)
+        with self._lock:
+            self._jobs[jid]["state"] = ACCEPTED
+            self._queue.append(jid)
+        self._log(f"job {jid}: accepted")
+        return {"job": jid, "state": ACCEPTED}
+
+    def _reject(self, jid: str, spec: dict, reason: str) -> dict:
+        """Record one rejection (result file + best-effort journal
+        line) — explicit load shedding, never a silent drop.  Takes
+        the server lock only for the state update; the IO runs
+        outside it."""
+        from splatt_tpu import resilience
+
+        with self._lock:
+            self._jobs[jid] = {"spec": spec, "state": REJECTED,
+                               "status": "rejected", "resumed": False}
+        try:
+            self.journal.append(
+                {"rec": REJECTED, "job": jid, "reason": reason})
+        except Exception as e:
+            # the rejection itself needs no durability: an un-journaled
+            # rejected job simply never existed after a restart
+            self._warn_journal("reject", jid, e)
+        self._write_result(jid, {"job": jid, "status": "rejected",
+                                 "reason": reason})
+        self._log(f"job {jid}: rejected ({reason})")
+        return {"job": jid, "state": REJECTED, "reason": reason}
+
+    def status(self, jid: str) -> dict:
+        """The job's current state (and terminal status, when known)."""
+        with self._lock:
+            j = self._jobs.get(jid)
+            if j is None:
+                return {"job": jid, "state": None}
+            return {"job": jid, "state": j["state"],
+                    "status": j["status"], "resumed": j["resumed"]}
+
+    def result(self, jid: str) -> Optional[dict]:
+        """The job's result record, or None while non-terminal."""
+        return read_result(self.root, jid)
+
+    def summary(self) -> dict:
+        """Machine-readable daemon summary (the `splatt serve` exit
+        report): per-job states, state counts, queue depth."""
+        with self._lock:
+            jobs = {jid: j["state"] for jid, j in self._jobs.items()}
+            pending = len(self._queue)
+        counts: Dict[str, int] = {}
+        for s in jobs.values():
+            counts[s] = counts.get(s, 0) + 1
+        return {"jobs": jobs, "counts": counts, "pending": pending,
+                "draining": self._draining.is_set()}
+
+    # -- filed-request spool -------------------------------------------------
+
+    def scan_requests(self) -> int:
+        """Ingest filed requests: every ``*.json`` under ``requests/``
+        is parsed, submitted and unlinked — journal-first, so a crash
+        between journaling and unlink re-ingests a known id, which the
+        idempotent :meth:`submit` dedups.  A malformed or failing
+        request is quarantined as ``<name>.bad`` (classified, logged)
+        so the scanner cannot spin on it."""
+        from splatt_tpu import resilience
+
+        n = 0
+        for name in sorted(os.listdir(self.requests_dir)):
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.requests_dir, name)
+            try:
+                with open(path) as f:
+                    spec = json.load(f)
+                if not isinstance(spec, dict):
+                    raise ValueError("job spec must be a JSON object")
+                spec.setdefault("id", name[:-5])
+                self.submit(spec)
+                n += 1
+            except Exception as e:
+                cls = resilience.classify_failure(e)
+                self._log(f"request {name} failed to ingest "
+                          f"({cls.value}: "
+                          f"{resilience.failure_message(e)[:120]}); "
+                          f"quarantined as {name}.bad", error=True)
+                try:
+                    os.replace(path, path + ".bad")
+                except OSError:
+                    pass
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                pass  # re-ingested next scan; submit dedups
+        return n
+
+    # -- supervisor ----------------------------------------------------------
+
+    def _next(self) -> Optional[str]:
+        with self._lock:
+            return self._queue.popleft() if self._queue else None
+
+    def run_once(self) -> dict:
+        """Ingest the spool, then run every queued job to a terminal
+        state (or until a drain interrupts) on `workers` supervisor
+        threads.  Returns :meth:`summary`."""
+        from splatt_tpu import resilience
+
+        self.scan_requests()
+        with self._lock:
+            idle = not self._queue
+        if idle:
+            # nothing queued (the serve_forever steady state): skip
+            # worker-thread construction entirely — an idle daemon
+            # must not churn threads twice a second
+            return self.summary()
+
+        def loop():
+            while not self._draining.is_set():
+                jid = self._next()
+                if jid is None:
+                    return
+                try:
+                    self._run_job(jid)
+                except Exception as e:
+                    # backstop: _run_job handles job failures itself,
+                    # so anything landing here is a supervisor bug —
+                    # mark the job failed (classified) rather than
+                    # dying silently and stranding the rest of the
+                    # queue behind a dead worker
+                    cls = resilience.classify_failure(e)
+                    msg = resilience.failure_message(e)[:200]
+                    self._log(f"job {jid}: supervisor error "
+                              f"({cls.value}: {msg})", error=True)
+                    self._write_result(jid, {"job": jid,
+                                             "status": "failed",
+                                             "failure_class": cls.value,
+                                             "error": msg})
+                    try:
+                        self.journal.append({"rec": FAILED, "job": jid,
+                                             "status": "failed"})
+                    except Exception as e2:
+                        self._warn_journal("finish", jid, e2)
+                    with self._lock:
+                        self._jobs[jid]["state"] = FAILED
+                        self._jobs[jid]["status"] = "failed"
+
+        threads = [threading.Thread(target=loop, daemon=True,
+                                    name=f"splatt-serve-w{i}")
+                   for i in range(max(self.workers, 1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.summary()
+
+    def serve_forever(self) -> dict:
+        """The daemon loop: process the queue, poll the spool, repeat —
+        until a drain (SIGTERM via :meth:`install_signal_handlers`, or
+        :meth:`drain`).  Returns the final :meth:`summary`."""
+        while not self._draining.is_set():
+            self.run_once()
+            self._draining.wait(self.poll_s)
+        return self.summary()
+
+    def drain(self) -> None:
+        """Begin a graceful drain: stop pulling queued jobs, interrupt
+        running jobs at their next fit check (they checkpoint through
+        the cpd `stop` hook and are journaled ``interrupted``), leave
+        everything else journaled for the next start."""
+        self._draining.set()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain (main thread only)."""
+        signal.signal(signal.SIGTERM, lambda s, f: self.drain())
+        signal.signal(signal.SIGINT, lambda s, f: self.drain())
+
+    # -- one supervised job --------------------------------------------------
+
+    def _run_job(self, jid: str) -> None:
+        from splatt_tpu import resilience
+
+        with self._lock:
+            j = self._jobs[jid]
+            spec, resumed = j["spec"], j["resumed"]
+            j["state"] = STARTED
+        try:
+            self.journal.append({"rec": STARTED, "job": jid})
+        except Exception as e:
+            # non-fatal: without this line a crash replays the job from
+            # ACCEPTED — it re-runs, and checkpoint resume makes the
+            # re-run cheap
+            self._warn_journal("start", jid, e)
+        self._log(f"job {jid}: started" + (" (resumed)" if resumed else ""))
+        record = self._execute(jid, spec, resumed)
+        if record is None:
+            # drain interrupt: NOT terminal — the job already
+            # checkpointed via the stop hook; journal the interruption
+            # so the restart lineage is explicit
+            try:
+                self.journal.append({"rec": INTERRUPTED, "job": jid})
+            except Exception as e:
+                self._warn_journal("interrupt", jid, e)
+            with self._lock:
+                self._jobs[jid]["state"] = INTERRUPTED
+            self._log(f"job {jid}: interrupted by drain (checkpointed; "
+                      f"resumes next start)")
+            return
+        self._write_result(jid, record)
+        kind = FAILED if record["status"] == "failed" else DONE
+        try:
+            self.journal.append({"rec": kind, "job": jid,
+                                 "status": record["status"]})
+        except Exception as e:
+            self._warn_journal("finish", jid, e)
+        with self._lock:
+            self._jobs[jid]["state"] = kind
+            self._jobs[jid]["status"] = record["status"]
+        self._log(f"job {jid}: {record['status']}"
+                  + (f" fit={record['fit']:.5f}"
+                     if record.get("fit") is not None else ""))
+
+    def _execute(self, jid: str, spec: dict, resumed: bool
+                 ) -> Optional[dict]:
+        """Run one job under its own resilience scope and fault
+        schedule; returns the result record, or None when a drain
+        interrupted the run (already checkpointed, not terminal)."""
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        t0 = time.time()
+        stopped = {"drain": False, "deadline": False}
+
+        def _stop() -> bool:
+            if self._draining.is_set():
+                stopped["drain"] = True
+                return True
+            return False
+
+        # an explicit deadline_s (0 included — a documented opt-out for
+        # a known-long job) beats the server default; only an UNSET
+        # spec field falls back to it
+        ds = spec.get("deadline_s")
+        deadline_s = float(ds if ds is not None
+                           else (self.job_deadline_s or 0.0))
+        deadline_end = (time.monotonic() + deadline_s
+                        if deadline_s > 0 else None)
+
+        def _stop_or_deadline() -> bool:
+            # the watchdog timer cannot preempt a worker thread (no
+            # interrupt_main off the main thread), so the deadline is
+            # ALSO enforced cooperatively through the same fit-check
+            # poll the drain uses — a runaway job releases its worker
+            # at the next check instead of holding the queue hostage
+            if deadline_end is not None \
+                    and time.monotonic() > deadline_end:
+                stopped["deadline"] = True
+                return True
+            return _stop()
+
+        with resilience.scope(jid,
+                              health_retries=spec.get("health_retries"),
+                              deadline_s=spec.get("deadline_s")) as sc:
+            record: dict = {"job": jid}
+            armed: Dict[str, object] = {}
+            try:
+                # the job's declared fault schedule parses INSIDE the
+                # guarded region: a tenant's typo fails THAT job,
+                # classified — never the supervisor thread
+                with faults.scoped(spec.get("faults") or "") as armed:
+                    with resilience.deadline("serve.job_run",
+                                             deadline_s
+                                             if deadline_s > 0 else 0):
+                        faults.maybe_fail("serve.job_run")
+                        out, tune_info = self._run_cpd(
+                            jid, spec, _stop_or_deadline)
+                        if stopped["deadline"]:
+                            # the cooperative stop beat the post-hoc
+                            # timer raise: convert explicitly (with
+                            # the watchdog's own event) so the verdict
+                            # is TIMEOUT either way
+                            resilience.run_report().add(
+                                "deadline_blown", site="serve.job_run",
+                                seconds=float(deadline_s))
+                            raise resilience.DeadlineExceeded(
+                                f"splatt deadline blown at "
+                                f"serve.job_run after {deadline_s:g}s "
+                                f"(cooperative job-deadline stop)")
+                if stopped["drain"]:
+                    return None
+                degraded = bool(sc.report.events("health_degraded"))
+                if degraded:
+                    # run_report() here IS the job scope's report
+                    resilience.run_report().add(
+                        "job_degraded", job=jid,
+                        failure_class="numerical",
+                        error="health-retry budget exhausted")
+                record.update(status="degraded" if degraded
+                              else "converged",
+                              fit=float(out.fit))
+                if tune_info is not None:
+                    record["tune"] = tune_info
+            except Exception as e:
+                cls = resilience.classify_failure(e)
+                msg = resilience.failure_message(e)[:200]
+                resilience.run_report().add(
+                    "job_degraded", job=jid,
+                    failure_class=cls.value, error=msg)
+                record.update(status="failed",
+                              failure_class=cls.value, error=msg)
+            # fired counts survive both outcomes (a failed NaN job's
+            # evidence matters most); {} when the schedule never parsed
+            fired = {site: s.fired for site, s in armed.items()
+                     if s.fired}
+            record.update(
+                resumed=resumed, seconds=round(time.time() - t0, 3),
+                degraded=record["status"] != "converged",
+                events=[{k: v for k, v in e.items() if k != "ts"}
+                        for e in sc.report.events()],
+                demotions=[dict(engine=d.engine,
+                                failure_class=d.failure_class.value,
+                                shape_key=d.shape_key,
+                                error=d.error[:120])
+                           for d in resilience.demotions()])
+            if fired:
+                record["faults_fired"] = fired
+        return record
+
+    def _run_cpd(self, jid: str, spec: dict, stop: Callable[[], bool]):
+        """The job body: workload → (optional pre-tune) → blocked
+        build → guarded cpd_als with a per-job checkpoint."""
+        import dataclasses
+
+        from splatt_tpu import tune as _tune
+        from splatt_tpu.blocked import BlockedSparse
+        from splatt_tpu.config import Options, Verbosity
+        from splatt_tpu.cpd import cpd_als
+
+        tt = _load_workload(spec)
+        rank = int(spec.get("rank", 8))
+        opts = Options(
+            random_seed=int(spec.get("seed", 0)),
+            max_iterations=int(spec.get("iters", 25)),
+            tolerance=float(spec.get("tol", 1e-5)),
+            verbosity=Verbosity.LOW if self.verbose else Verbosity.NONE,
+            use_pallas=spec.get("use_pallas"),
+            autotune=spec.get("autotune"),
+            engine_fallback=spec.get("engine_fallback"))
+        tune_info = None
+        if spec.get("tune"):
+            # pre-tune inside the job scope: the Nth same-regime job
+            # hits the warm shared plan cache (measured == 0), which is
+            # the serving payoff the result records as evidence
+            res = _tune.tune(tt, rank=rank, opts=opts)
+            tune_info = dict(
+                measured=res.measured, cache_hits=res.cache_hits,
+                skipped=res.skipped,
+                plans={str(m): dataclasses.asdict(p)
+                       for m, p in sorted(res.plans.items())})
+        bs = BlockedSparse.compile(tt, opts, rank=rank)
+        ckpt = os.path.join(self.ckpt_dir, f"{jid}.npz")
+        out = cpd_als(bs, rank=rank, opts=opts, checkpoint_path=ckpt,
+                      checkpoint_every=int(spec.get("checkpoint_every", 5)),
+                      stop=stop)
+        return out, tune_info
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _write_result(self, jid: str, record: dict) -> None:
+        """Atomic result publish (tmp + rename): a reader never sees a
+        torn result file."""
+        from splatt_tpu import resilience
+
+        path = os.path.join(self.results_dir, f"{jid}.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f, sort_keys=True)
+            os.replace(tmp, path)
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            self._log(f"job {jid}: result write failed ({cls.value}: "
+                      f"{resilience.failure_message(e)[:120]}) — the "
+                      f"journal still carries the terminal state",
+                      error=True)
+
+    def _warn_journal(self, op: str, jid: str, exc) -> None:
+        """Classified warn-and-continue for non-load-bearing journal
+        appends (submission appends are load-bearing and reject
+        instead — see submit)."""
+        from splatt_tpu import resilience
+
+        cls = resilience.classify_failure(exc)
+        self._log(f"job {jid}: journal append ({op}) failed "
+                  f"({cls.value}: "
+                  f"{resilience.failure_message(exc)[:120]}); "
+                  f"continuing — replay re-derives this record",
+                  error=True)
+
+    def _log(self, msg: str, error: bool = False) -> None:
+        import sys
+
+        if error or self.verbose:
+            print(f"splatt-serve: {msg}",
+                  file=sys.stderr if error else sys.stdout, flush=True)
+
+
+def _load_workload(spec: dict):
+    """The job's tensor: an on-disk file (``tensor``) or a seeded
+    synthetic (``synthetic: {dims, nnz, seed}``)."""
+    if spec.get("tensor"):
+        from splatt_tpu.io import load
+
+        return load(spec["tensor"])
+    syn = spec.get("synthetic")
+    if not isinstance(syn, dict) or not syn.get("dims"):
+        raise ValueError("job spec needs 'tensor': <path> or "
+                         "'synthetic': {dims, nnz, seed}")
+    from splatt_tpu.chaos import synthetic_tensor
+
+    return synthetic_tensor(tuple(int(d) for d in syn["dims"]),
+                            int(syn.get("nnz", 1000)),
+                            int(syn.get("seed", 0)))
+
+
+# -- client-side filed-request API -------------------------------------------
+
+def file_request(root: str, spec: dict) -> str:
+    """Client side of the filed-request API: atomically drop a job
+    spec into ``<root>/requests/`` for a (possibly not-yet-running)
+    daemon to ingest.  Returns the job id."""
+    jid = _job_id(spec)
+    spec = dict(spec, id=jid)
+    reqs = os.path.join(os.path.abspath(root), "requests")
+    os.makedirs(reqs, exist_ok=True)
+    tmp = os.path.join(reqs, f".{jid}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(spec, f)
+    os.replace(tmp, os.path.join(reqs, f"{jid}.json"))
+    return jid
+
+
+def read_result(root: str, jid: str) -> Optional[dict]:
+    """The published result record for `jid`, or None while the job is
+    non-terminal (or unknown)."""
+    path = os.path.join(os.path.abspath(root), "results", f"{jid}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except ValueError:
+        return None  # mid-replace torn read cannot happen (atomic
+        #               rename); a hand-damaged file reads as absent
+
+
+def read_status(root: str, jid: str) -> dict:
+    """Journal-derived job state (client side, no daemon needed): the
+    last journal record wins; the result record rides along when the
+    job is terminal."""
+    journal = Journal(os.path.join(os.path.abspath(root),
+                                   "journal.jsonl"))
+    recs, _ = journal.replay()
+    state = None
+    status = None
+    for rec in recs:
+        if rec.get("job") != jid:
+            continue
+        state = rec.get("rec")
+        if state in (DONE, FAILED):
+            status = rec.get("status")
+        elif state == REJECTED:
+            status = "rejected"
+        else:
+            status = None  # re-accepted after a rejection: not terminal
+    out = {"job": jid, "state": state, "status": status}
+    if state in TERMINAL:
+        res = read_result(root, jid)
+        if res is not None:
+            out["result"] = res
+    # a spool file not yet ingested still counts as "filed"
+    if state is None and os.path.exists(
+            os.path.join(os.path.abspath(root), "requests",
+                         f"{jid}.json")):
+        out["state"] = "filed"
+    return out

@@ -46,6 +46,7 @@ can make dispatch faster, never wronger.
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -192,13 +193,22 @@ def _cache_io_error(op: str, exc) -> None:
 #: in-process memo of resolved cache entries, keyed
 #: (cache file, env key, entry key) -> entry dict | False (negative).
 #: Dispatch consults the plan once per (mode, sweep) — the memo keeps
-#: that a dict lookup instead of a JSON parse per MTTKRP.
+#: that a dict lookup instead of a JSON parse per MTTKRP.  Guarded by
+#: a lock: concurrent serve jobs share this memo (warm plans are the
+#: point of multi-tenancy — docs/serve.md), and a reset racing a
+#: write-through must not resurrect an entry from a replaced cache
+#: file.
 _MEM: dict = {}
+_MEM_LOCK = threading.Lock()
+
+#: lookup-miss sentinel (None is a legitimate memoized value)
+_MISS = object()
 
 
 def reset_memo() -> None:
     """Forget memoized cache entries (tests; a re-tune in-process)."""
-    _MEM.clear()
+    with _MEM_LOCK:
+        _MEM.clear()
 
 
 def _load_file() -> Optional[dict]:
@@ -223,8 +233,9 @@ def _entry_get(key: str) -> Optional[dict]:
                                                probe_cache_ttl)
 
     memo_key = (str(cache_path()), _cache_env_key(), key)
-    if memo_key in _MEM:
-        hit = _MEM[memo_key]
+    with _MEM_LOCK:
+        hit = _MEM.get(memo_key, _MISS)
+    if hit is not _MISS:
         return hit if hit is not False else None
     entry = None
     data = _load_file()
@@ -240,7 +251,16 @@ def _entry_get(key: str) -> Optional[dict]:
             # unusable plan, not a dispatch failure — report and re-tune
             _cache_io_error("load", e)
             entry = None
-    _MEM[memo_key] = entry if entry is not None else False
+    with _MEM_LOCK:
+        # never clobber a concurrent write-through: a sibling job's
+        # _entry_store may have landed between our file read and here,
+        # and overwriting its fresh entry with our (older-read) miss
+        # would negative-cache a persisted plan for the process life
+        cur = _MEM.get(memo_key, _MISS)
+        if cur is _MISS:
+            _MEM[memo_key] = entry if entry is not None else False
+        else:
+            entry = cur if cur is not False else None
     return entry
 
 
@@ -262,7 +282,8 @@ def _entry_store(key: str, value: dict) -> None:
         return data
 
     _json_cache_update(cache_path(), mutate, on_error=_cache_io_error)
-    _MEM[(str(cache_path()), env_key, key)] = entry
+    with _MEM_LOCK:
+        _MEM[(str(cache_path()), env_key, key)] = entry
 
 
 def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,

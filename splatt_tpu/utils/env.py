@@ -93,8 +93,36 @@ ENV_VARS = {
                                 "autotuner's persistent plan cache "
                                 "(default: tune_cache.json next to the "
                                 "probe cache)"),
+    # serve daemon knobs (splatt_tpu/serve.py, docs/serve.md)
+    "SPLATT_SERVE_WORKERS": EnvVar(1, "serve: concurrent job-supervisor "
+                                   "threads; each job runs under its "
+                                   "own resilience scope, sharing the "
+                                   "warm probe/tune/compile caches"),
+    "SPLATT_SERVE_QUEUE_MAX": EnvVar(16, "serve: bounded pending-queue "
+                                     "depth; a submission past it is "
+                                     "load-shed with an explicit "
+                                     "queue_full rejection instead of "
+                                     "queueing unboundedly; <= 0 "
+                                     "disables the bound"),
+    "SPLATT_SERVE_POLL_S": EnvVar(0.5, "serve: seconds between "
+                                  "filed-request spool scans in the "
+                                  "daemon loop"),
+    "SPLATT_SERVE_JOB_DEADLINE_S": EnvVar(0.0, "serve: default per-job "
+                                          "deadline in seconds (a job "
+                                          "spec's deadline_s "
+                                          "overrides, 0 = explicit "
+                                          "opt-out); a blown job "
+                                          "deadline classifies "
+                                          "TIMEOUT and the job is "
+                                          "marked failed, releasing "
+                                          "its worker; <= 0 disables"),
     # repo-root bench.py driver knobs (documented here; bench.py is a
     # standalone script outside the package's SPL001 scope)
+    "SPLATT_BENCH_PRIOR_DIR": EnvVar(None, "bench.py: directory "
+                                     "searched for the newest prior "
+                                     "BENCH_*.json the regression "
+                                     "gate compares against (default: "
+                                     "the repo root)"),
     "SPLATT_BENCH_NNZ": EnvVar(None, "bench.py: synthetic tensor "
                                "nonzero count (per-driver default)"),
     "SPLATT_BENCH_RANK": EnvVar(None, "bench.py: CPD rank "

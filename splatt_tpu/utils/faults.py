@@ -54,6 +54,15 @@ Sites used by the production code:
     - ``cpd.sweep``              — poison (not raise): corrupt one ALS
       sweep's outputs with non-finite values, exercising the
       numerical-health sentinel (cpd.py / parallel/common.py)
+    - ``serve.submit`` / ``serve.journal_write`` / ``serve.job_run``
+      — the serve daemon's submission, durable-journal and supervised-
+      job hooks (serve.py, docs/serve.md)
+
+Per-job scoping (docs/serve.md)
+    :func:`scoped` arms a schedule in a contextvars overlay shadowing
+    the global registry for the sites it names — the serve daemon
+    wraps each supervised job in one, so a job spec's declared faults
+    fire inside that job's thread only.
 
 Fault kinds map to canned exceptions whose messages exercise specific
 :func:`splatt_tpu.resilience.classify_failure` branches:
@@ -87,11 +96,12 @@ per sweep) — never inside a kernel.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import dataclasses
 import random
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple, Union
 
 _FAULTS_ENV = "SPLATT_FAULTS"
 
@@ -140,6 +150,20 @@ SITES = {
                  "factor output with non-finite values, exercising "
                  "the numerical-health sentinel and its rollback "
                  "(cpd.py, parallel/common.py)",
+    "serve.submit": "one job submission into the serve daemon's "
+                    "queue (serve.py); a raised fault must reject "
+                    "that submission, classified — never kill the "
+                    "daemon",
+    "serve.journal_write": "one durable journal append (serve.py); a "
+                           "failure while journaling a submission "
+                           "rejects the job (durability cannot be "
+                           "promised), terminal-record failures "
+                           "degrade to warn-and-continue",
+    "serve.job_run": "the start of one supervised job (serve.py); a "
+                     "raising kind marks the job failed/degraded, "
+                     "'slow' holds the job open (blowing a per-job "
+                     "deadline, or pinning it for kill-and-restart "
+                     "soaks)",
 }
 
 
@@ -207,6 +231,44 @@ class FaultSpec:
 _LOCK = threading.Lock()
 _ACTIVE: Dict[str, FaultSpec] = {}
 _env_loaded = False
+
+#: per-context fault overlay (docs/serve.md): a serve job's declared
+#: schedule shadows the global registry for the sites it names, so one
+#: tenant's chaos drill fires inside that job only — sites the overlay
+#: does not name fall through to the global/env-armed registry.
+_SCOPED: contextvars.ContextVar = contextvars.ContextVar(
+    "splatt_faults_scope", default=None)
+
+
+def _lookup_locked(site: str) -> Optional[FaultSpec]:
+    """The spec governing `site` in this context: the scoped overlay's
+    when it names the site, else the global registry's."""
+    overlay = _SCOPED.get()
+    if overlay is not None and site in overlay:
+        return overlay[site]
+    return _ACTIVE.get(site)
+
+
+@contextlib.contextmanager
+def scoped(schedule: Union[str, Dict[str, FaultSpec], None]):
+    """Arm a per-context fault schedule (same grammar as SPLATT_FAULTS
+    / :func:`parse_schedule`) overlaying the global registry for the
+    duration of the block.  The serve daemon wraps each supervised job
+    in one of these so a job spec's declared faults fire inside that
+    job's thread only — per-tenant chaos without cross-tenant blast
+    radius.  Yields the {site: FaultSpec} dict; callers read each
+    spec's ``fired`` counter afterwards for evidence matching."""
+    if schedule is None:
+        specs: Dict[str, FaultSpec] = {}
+    elif isinstance(schedule, str):
+        specs = parse_schedule(schedule)
+    else:
+        specs = dict(schedule)
+    token = _SCOPED.set(specs)
+    try:
+        yield specs
+    finally:
+        _SCOPED.reset(token)
 
 
 def parse_spec(item: str) -> Tuple[str, FaultSpec]:
@@ -356,7 +418,7 @@ def _take(site: str, kinds: Optional[tuple] = None) -> Optional[FaultSpec]:
     same site."""
     with _LOCK:
         _load_env_locked()
-        spec = _ACTIVE.get(site)
+        spec = _lookup_locked(site)
         if spec is None:
             return None
         if kinds is not None and spec.kind not in kinds:
@@ -406,10 +468,11 @@ def consume(site: str) -> bool:
 
 
 def active(site: str) -> bool:
-    """Whether a fault is currently armed at `site` (no claim)."""
+    """Whether a fault is currently armed at `site` (no claim) — the
+    scoped overlay included."""
     with _LOCK:
         _load_env_locked()
-        spec = _ACTIVE.get(site)
+        spec = _lookup_locked(site)
         return spec is not None and spec.times != 0
 
 
@@ -419,10 +482,13 @@ def fired(site: Optional[str] = None):
     matches run-report events against what actually fired)."""
     with _LOCK:
         _load_env_locked()
+        overlay = _SCOPED.get() or {}
         if site is not None:
-            spec = _ACTIVE.get(site)
+            spec = _lookup_locked(site)
             return spec.fired if spec is not None else 0
-        return {s: spec.fired for s, spec in _ACTIVE.items()}
+        merged = dict(_ACTIVE)
+        merged.update(overlay)  # overlay shadows, as in _lookup_locked
+        return {s: spec.fired for s, spec in merged.items()}
 
 
 @contextlib.contextmanager

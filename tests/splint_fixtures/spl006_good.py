@@ -14,3 +14,11 @@ def risky_dispatch(engine):
 def risky_measurement():
     # the autotuner's candidate-timing hook (tune.py)
     faults.maybe_fail("tuner.measure")
+
+
+def risky_serve():
+    # the serve daemon's submission / durable-journal / supervised-job
+    # hooks (serve.py, docs/serve.md)
+    faults.maybe_fail("serve.submit")
+    faults.maybe_fail("serve.journal_write")
+    faults.maybe_fail("serve.job_run")

@@ -1,0 +1,143 @@
+"""bench.py regression gate (ROADMAP open item 1, docs/serve.md era).
+
+The gate compares a fresh bench record against the newest prior
+``BENCH_*.json`` ON THE SAME METRIC, flags >10% slowdowns as
+``bench_regression`` run-report events (via the shared resilience
+helper), carries them in the JSON artifact, and — under ``--gate`` —
+exits nonzero so a perf PR ships with a verdict, not just a number.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+from splatt_tpu import resilience
+
+REC = {"metric": "M1", "value": 2.0, "unit": "sec/iter",
+       "timing_stats": {"blocked": {"median": 2.0},
+                        "stream": {"median": 10.0}}}
+PRIOR = {"metric": "M1", "value": 1.5, "unit": "sec/iter",
+         "timing_stats": {"blocked": {"median": 1.5},
+                          "stream": {"median": 11.0}}}
+
+
+def test_regressions_flag_headline_and_per_path():
+    regs = bench._bench_regressions(REC, PRIOR)
+    assert {r["path"] for r in regs} == {"headline", "blocked"}
+    head = next(r for r in regs if r["path"] == "headline")
+    assert head["sec"] == 2.0 and head["prior_sec"] == 1.5
+    assert head["pct"] == pytest.approx(33.3)
+    # stream got FASTER: not flagged
+
+
+def test_within_threshold_is_clean():
+    ok = dict(REC, value=1.64, timing_stats={})  # +9.3% < 10%
+    assert bench._bench_regressions(ok, PRIOR) == []
+
+
+def test_unlike_metrics_are_never_compared():
+    other = dict(PRIOR, metric="a different workload")
+    assert bench._bench_regressions(REC, other) == []
+
+
+def test_prior_discovery_newest_usable_wins(tmp_path):
+    def write(name, value, wrap=True):
+        rec = {"metric": "M1", "value": value, "unit": "sec/iter"}
+        payload = {"parsed": rec} if wrap else rec
+        (tmp_path / name).write_text(json.dumps(payload))
+
+    write("BENCH_r01.json", 1.0)
+    write("BENCH_r02.json", 1.5)
+    (tmp_path / "BENCH_r03.json").write_text("not json at all")
+    name, rec = bench._prior_bench_record(str(tmp_path))
+    assert name == "BENCH_r02.json" and rec["value"] == 1.5
+    # a bare (unwrapped) record is also a valid prior
+    write("BENCH_r04.json", 1.7, wrap=False)
+    name, rec = bench._prior_bench_record(str(tmp_path))
+    assert name == "BENCH_r04.json" and rec["value"] == 1.7
+
+
+def test_prior_discovery_empty_dir(tmp_path):
+    assert bench._prior_bench_record(str(tmp_path)) is None
+
+
+def test_record_bench_regression_event():
+    resilience.run_report().clear()
+    ev = resilience.record_bench_regression("blocked", 2.0, 1.5, 33.3,
+                                            "BENCH_r05.json")
+    assert ev["kind"] == "bench_regression" and ev["pct"] == 33.3
+    lines = resilience.run_report().summary()
+    assert any("BENCH REGRESSION" in ln for ln in lines)
+    resilience.run_report().clear()
+
+
+def test_repo_priors_are_discoverable():
+    """The real repo artifacts parse: the gate has a baseline today."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    found = bench._prior_bench_record(repo)
+    assert found is not None
+    name, rec = found
+    assert name.startswith("BENCH_") and rec["value"] > 0
+
+
+def test_gate_end_to_end_nonzero_exit(tmp_path):
+    """--gate e2e: a tiny bench run against a fabricated prior with an
+    impossibly fast value exits nonzero, records bench_regression in
+    the JSON artifact, and still prints the headline number (the
+    verdict never eats the measurement)."""
+    nnz, rank = 60000, 4
+    metric = (f"CPD-ALS sec/iteration, synthetic NELL-2-shaped "
+              f"(3-mode, {nnz} nnz, rank {rank}, float32) on cpu; "
+              f"baseline: reference 1-thread CPU same tensor")
+    (tmp_path / "BENCH_r98.json").write_text(json.dumps(
+        {"parsed": {"metric": metric, "value": 0.0001,
+                    "unit": "sec/iter"}}))
+    env = dict(os.environ)
+    env.update(SPLATT_BENCH_NNZ=str(nnz), SPLATT_BENCH_RANK=str(rank),
+               SPLATT_BENCH_ITERS="1", SPLATT_BENCH_PATHS="blocked",
+               SPLATT_BENCH_PRIOR_DIR=str(tmp_path),
+               SPLATT_TUNE_CACHE=str(tmp_path / "tc.json"),
+               JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run([sys.executable, os.path.join(repo, "bench.py"),
+                        "--gate"], env=env, capture_output=True,
+                       text=True, timeout=600, cwd=repo)
+    assert p.returncode == 1, (p.returncode, p.stderr[-800:])
+    line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert line, p.stderr[-800:]
+    rec = json.loads(line[-1])
+    assert rec["value"] > 0                       # headline survived
+    regs = rec["bench_regressions"]
+    assert rec["bench_prior"] == "BENCH_r98.json"
+    assert any(r["path"] == "headline" for r in regs)
+    assert "REGRESSION" in p.stderr
+
+
+def test_unknown_argv_rejected():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run([sys.executable, os.path.join(repo, "bench.py"),
+                        "--bogus"], capture_output=True, text=True,
+                       timeout=120)
+    assert p.returncode == 2 and "unknown arguments" in p.stderr
+
+
+def test_prior_discovery_skips_unlike_metrics_to_older_prior(tmp_path):
+    """A different workload benched in between must not disable the
+    gate: the search keeps walking to the newest SAME-metric prior."""
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"metric": "M1", "value": 1.5, "unit": "sec/iter"}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"metric": "OTHER", "value": 9.0,
+                    "unit": "sec/iter"}}))
+    name, rec = bench._prior_bench_record(str(tmp_path), metric="M1")
+    assert name == "BENCH_r01.json" and rec["value"] == 1.5
+    # and with no metric constraint the newest usable one still wins
+    name, _ = bench._prior_bench_record(str(tmp_path))
+    assert name == "BENCH_r02.json"
+    # no same-metric prior at all -> no baseline
+    assert bench._prior_bench_record(str(tmp_path),
+                                     metric="UNSEEN") is None

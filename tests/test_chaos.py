@@ -202,3 +202,33 @@ def test_bench_continues_past_failing_path(tmp_path):
     assert rec["path_errors"]["blocked"]["error"].startswith(
         "deterministic:")
     assert "continuing with the remaining paths" in p.stderr
+
+
+# -- serve kill-and-restart soak (docs/serve.md) -----------------------------
+
+def test_serve_chaos_smoke_kill_and_restart():
+    """Acceptance (tier-1): SIGKILL a real serve daemon mid-queue,
+    restart it, and the soak invariant holds — no accepted job is
+    lost (every one reaches a terminal state with its journal and
+    checkpoint lineage intact), the restart resumes the in-flight
+    jobs, and the NaN-poisoned tenant's rollback stays contained: the
+    clean neighbors' results carry no health events and no demotions.
+    """
+    res = chaos.run_serve_chaos(smoke=True)
+    assert res.ok, res.violations
+    assert res.verdict == "survived"
+    assert res.killed_mid_queue  # the kill genuinely landed mid-queue
+    assert res.resumed           # the restart re-enqueued jobs
+    assert set(res.jobs) == {"chaos-0-nan", "chaos-clean0",
+                             "chaos-clean1"}
+    assert all(s in ("converged", "degraded")
+               for s in res.jobs.values())
+    rec = res.to_json()
+    assert rec["verdict"] == "survived" and not rec["violations"]
+
+
+def test_serve_chaos_cli_flag_parses():
+    from splatt_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["chaos", "--serve", "--smoke"])
+    assert args.serve and args.smoke

@@ -244,3 +244,75 @@ def test_phased_sweep_donation_bit_identical():
     np.testing.assert_array_equal(np.asarray(lam_a), np.asarray(lam_b))
     for ua, ub in zip(f_a, f_b):
         np.testing.assert_array_equal(np.asarray(ua), np.asarray(ub))
+
+
+def test_stop_hook_checkpoints_and_returns_early(tmp_path):
+    """The cooperative `stop` hook (the serve daemon's drain,
+    docs/serve.md): polled at fit-check iterations; returning True
+    checkpoints the just-committed state and returns early, and a
+    later resume continues the same optimization to the un-stopped
+    result."""
+    from splatt_tpu.cpd import load_checkpoint
+
+    tt = lowrank_tensor((15, 12, 10), rank=3)
+    ck = str(tmp_path / "stop.npz")
+    opts = _opts(max_iterations=20, tolerance=0.0)
+    calls = []
+
+    def stop():
+        calls.append(1)
+        return len(calls) >= 3
+
+    partial = cpd_als(tt, rank=3, opts=opts, checkpoint_path=ck,
+                      checkpoint_every=100, stop=stop)
+    _, _, it, fit = load_checkpoint(ck)
+    assert it == 3 and len(calls) == 3      # stopped at the 3rd check
+    assert fit == pytest.approx(float(partial.fit))
+    # resume without the hook: finishes the remaining iterations and
+    # matches an uninterrupted run of the same config
+    resumed = cpd_als(tt, rank=3, opts=opts, checkpoint_path=ck,
+                      checkpoint_every=100)
+    straight = cpd_als(tt, rank=3, opts=opts)
+    assert float(resumed.fit) == pytest.approx(float(straight.fit),
+                                               abs=1e-6)
+
+
+def test_stop_hook_never_true_changes_nothing(tmp_path):
+    tt = lowrank_tensor((15, 12, 10), rank=3)
+    opts = _opts(max_iterations=10, tolerance=0.0)
+    a = cpd_als(tt, rank=3, opts=opts)
+    b = cpd_als(tt, rank=3, opts=opts, stop=lambda: False)
+    assert float(a.fit) == pytest.approx(float(b.fit), abs=0.0)
+
+
+def test_health_guard_disabled_skips_snapshot_refresh(monkeypatch):
+    """Satellite: with SPLATT_HEALTH_RETRIES=0 the sentinel's host-
+    snapshot refresh is skipped entirely (guards must be free when
+    disabled) — only the single initial rescue snapshot is taken for
+    the donated fused sweep, and none at all for non-donating sweeps.
+    """
+    import splatt_tpu.cpd as cpd_mod
+
+    monkeypatch.setenv("SPLATT_HEALTH_RETRIES", "0")
+    tt = lowrank_tensor((15, 12, 10), rank=3)
+    opts = _opts(max_iterations=6, tolerance=0.0)
+    bs = BlockedSparse.from_coo(tt, opts)
+
+    copies = []
+    real = np.asarray
+
+    def counting_asarray(a, *k, **kw):
+        copies.append(1)
+        return real(a, *k, **kw)
+
+    monkeypatch.setattr(cpd_mod.np, "asarray", counting_asarray)
+    out = cpd_als(bs, rank=3, opts=opts)
+    disabled_copies = len(copies)
+    assert np.isfinite(float(out.fit))
+
+    # with the sentinel ON, the snapshot refreshes at every check
+    # iteration — strictly more host copies than the disabled run
+    monkeypatch.setenv("SPLATT_HEALTH_RETRIES", "3")
+    copies.clear()
+    cpd_als(bs, rank=3, opts=opts)
+    assert len(copies) > disabled_copies

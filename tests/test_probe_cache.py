@@ -327,3 +327,88 @@ def test_reads_route_through_shared_json_cache_load(cache_file,
     assert tune._load_file() is None
     assert len(calls) >= 2 and calls[-1] == str(cache_file)
     assert resilience.run_report().events("tune_cache_io_error")
+
+
+# -- concurrent shared-cache access (docs/serve.md) --------------------------
+
+def test_concurrent_probe_stores_lose_no_verdicts(cache_file):
+    """N threads persisting distinct probe verdicts simultaneously
+    (concurrent serve jobs proving different kernels): the locked
+    read-modify-write keeps every verdict — no lost updates, no torn
+    JSON."""
+    import threading
+
+    n = 16
+    errs = []
+
+    def store(i):
+        try:
+            pk.probe_cache_store(f"conc_state{i}",
+                                 "ok" if i % 2 == 0 else "compile_failed")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=store, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    data = json.loads(cache_file.read_text())  # parses: not torn
+    env = data[pk._cache_env_key()]
+    assert {f"conc_state{i}" for i in range(n)} <= set(env)
+    for i in range(n):
+        want = "ok" if i % 2 == 0 else "compile_failed"
+        assert pk.probe_cache_load(f"conc_state{i}") == want
+
+
+def test_concurrent_probe_and_tune_writers_share_one_protocol(cache_file,
+                                                              monkeypatch):
+    """Probe verdicts and tuner plans hammering their caches from
+    interleaved threads (the serve steady state): both files end
+    complete and parseable — the shared locked protocol serializes
+    writers within the process as well as across processes."""
+    import threading
+
+    from splatt_tpu import tune
+
+    monkeypatch.setenv(tune._CACHE_ENV,
+                       str(cache_file.with_name("tc.json")))
+    tune.reset_memo()
+    errs = []
+
+    def probe_writer(i):
+        try:
+            for k in range(4):
+                pk.probe_cache_store(f"pt{i}k{k}", "ok")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def tune_writer(i):
+        try:
+            for k in range(4):
+                tune._entry_store(
+                    f"tt{i}k{k}",
+                    {"plan": dict(path="sorted_onehot", engine="xla",
+                                  nnz_block=512, scan_target=1 << 21,
+                                  sec=0.5)})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = ([threading.Thread(target=probe_writer, args=(i,))
+                for i in range(4)]
+               + [threading.Thread(target=tune_writer, args=(i,))
+                  for i in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    probe_env = json.loads(cache_file.read_text())[pk._cache_env_key()]
+    assert {f"pt{i}k{k}" for i in range(4) for k in range(4)} \
+        <= set(probe_env)
+    tune.reset_memo()
+    for i in range(4):
+        for k in range(4):
+            assert tune._entry_get(f"tt{i}k{k}") is not None

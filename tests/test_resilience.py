@@ -357,8 +357,8 @@ def test_sweep_level_rescue_decision():
 
     tt, bs = _blocked()
     err = RuntimeError("INTERNAL: async runtime failure")
-    # no attempt noted yet
-    resilience._LAST_ATTEMPT = None
+    # no attempt noted yet (the attempt note is scope state now)
+    resilience._state().last_attempt = None
     assert _try_engine_rescue(bs, _opts(), err) is False
     resilience.note_engine_attempt("fused_t", "ck1:b256")
     assert _try_engine_rescue(bs, _opts(), err) is True

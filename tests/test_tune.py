@@ -525,3 +525,146 @@ def test_tuned_plan_never_slower_than_static_default():
         tmod._measure_candidate = orig
     for m, plan in res.plans.items():
         assert plan.sec <= min(recorded[m].values()) + 1e-12
+
+
+# -- concurrent shared-cache access (docs/serve.md) --------------------------
+#
+# The serve daemon runs N tenants' jobs as threads in ONE process, all
+# sharing the warm plan cache.  The locked protocol must hold under
+# that contention: no torn JSON, no lost winners, and a broken cache
+# degrades classified — never into a failed dispatch.
+
+def test_concurrent_plan_stores_lose_no_winners():
+    """N threads storing distinct winners simultaneously: the final
+    cache file holds every one (the locked read-modify-write cannot
+    drop a concurrent writer's entry) and parses as one JSON object."""
+    import threading
+
+    n = 16
+    errs = []
+
+    def store(i):
+        try:
+            tune._entry_store(f"conc:key{i}",
+                              {"plan": dict(path="sorted_onehot",
+                                            engine="xla", nnz_block=512,
+                                            scan_target=1 << 21,
+                                            sec=0.001 * (i + 1))})
+        except Exception as e:  # pragma: no cover - the assert reports
+            errs.append(e)
+
+    threads = [threading.Thread(target=store, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    data = json.loads(_cache_file().read_text())  # not torn
+    env = data["envs"][pk._cache_env_key()]
+    assert {f"conc:key{i}" for i in range(n)} <= set(env)
+    # and every winner is readable back through the memo-less path
+    tune.reset_memo()
+    for i in range(n):
+        assert tune._entry_get(f"conc:key{i}")["plan"]["sec"] == \
+            pytest.approx(0.001 * (i + 1))
+
+
+def test_concurrent_loads_and_stores_interleaved():
+    """Readers hammering the cache while writers mutate it: every read
+    returns either None (not yet written) or a complete entry — never
+    a torn/partial one — and no exception escapes."""
+    import threading
+
+    stop = threading.Event()
+    errs = []
+
+    def writer(i):
+        try:
+            for k in range(8):
+                tune._entry_store(
+                    f"mix:w{i}k{k}",
+                    {"plan": dict(path="sorted_onehot", engine="xla",
+                                  nnz_block=512, scan_target=1 << 21,
+                                  sec=0.5)})
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                tune.reset_memo()  # force real file reads
+                for i in range(4):
+                    ent = tune._entry_get(f"mix:w{i}k0")
+                    assert ent is None or ent["plan"]["sec"] == 0.5
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    writers = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for t in readers + writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert not errs
+    tune.reset_memo()
+    for i in range(4):
+        for k in range(8):
+            assert tune._entry_get(f"mix:w{i}k{k}") is not None
+
+
+def test_concurrent_reads_of_corrupt_cache_degrade_classified():
+    """A corrupt cache under concurrent readers: every read degrades
+    to None (re-tune) and the failure is CLASSIFIED into the run
+    report (tune_cache_io_error) — never an exception, never a torn
+    verdict."""
+    import threading
+
+    _cache_file().write_text("{ definitely not json")
+    errs = []
+
+    def reader():
+        try:
+            tune.reset_memo()
+            for _ in range(5):
+                assert tune._load_file() is None
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    evs = resilience.run_report().events("tune_cache_io_error")
+    assert evs and all(e["failure_class"] == "unknown" for e in evs)
+
+
+def test_entry_get_never_clobbers_concurrent_write_through(monkeypatch):
+    """The memo's check-then-act window: a reader that missed the memo
+    and read a stale (empty) cache file must ADOPT a write-through
+    that landed mid-read, not negative-cache over it — otherwise a
+    persisted plan reads as missing for the rest of the process."""
+    key = "race:key"
+    plan = {"plan": dict(path="sorted_onehot", engine="xla",
+                         nnz_block=512, scan_target=1 << 21, sec=0.5)}
+    real_load = tune._load_file
+
+    def stale_read_with_concurrent_store():
+        # a sibling job's store lands while this reader holds its
+        # stale view of the file
+        tune._entry_store(key, plan)
+        return None  # the reader's read: nothing on disk
+
+    monkeypatch.setattr(tune, "_load_file",
+                        stale_read_with_concurrent_store)
+    got = tune._entry_get(key)
+    assert got is not None and got["plan"]["sec"] == 0.5
+    # and the memo was not poisoned with a negative entry
+    monkeypatch.setattr(tune, "_load_file", real_load)
+    assert tune._entry_get(key) is not None
