@@ -20,11 +20,22 @@ for the reference's memory-lean policies), SPLATT_BENCH_JIT
 phased on TPU where the fused program wedges the remote compiler),
 SPLATT_BENCH_SHAPE (nell2 default | enron4 — the 4-mode Enron-shaped
 workload of BASELINE.md row 2), SPLATT_BENCH_PATHS
-("blocked,tuned,stream" default — which representations to measure;
-"tuned" runs the splatt-tune autotuner (warm plan cache = zero
-measurement) and times the winning plan, reported with the chosen
-engine/nnz_block/scan_target under "tuned_plan"; "blocked" alone skips
-the slow stream oracle on long-rank configs / scarce chip time).
+("blocked,compact,tuned,stream" default — which representations to
+measure; "compact" is the format-v2 row: local narrow indices +
+segment encoding + bf16 storage (docs/format.md), timed with matching
+bf16 factors; "tuned" runs the splatt-tune autotuner (warm plan cache
+= zero measurement) and times the winning plan — now including format
+candidates — reported with the chosen
+engine/nnz_block/scan_target/format under "tuned_plan"; "blocked"
+alone skips the slow stream oracle on long-rank configs / scarce chip
+time).
+
+Bytes are reported per path from the ENCODED layouts
+(bench_algs.mttkrp_bytes_encoded): ``model_gb_per_path`` carries each
+path's achieved bytes/iteration, ``format`` its achieved encoding
+summary, and the regression gate compares the bytes too — a format
+change that silently re-inflates traffic >10% fails ``--gate`` exactly
+like a time regression.
 
 Regression gate (ROADMAP open item 1): the fresh result is compared
 against the newest prior ``BENCH_*.json`` (same metric only — unlike
@@ -247,6 +258,13 @@ def _bench_regressions(rec: dict, prior: dict,
     for path in sorted(set(mine) & set(theirs)):
         pairs.append((path, (mine[path] or {}).get("median"),
                       (theirs[path] or {}).get("median")))
+    # achieved bytes/iteration per path (the encoded-format model,
+    # docs/format.md): a format that silently re-inflates traffic is a
+    # regression even when the clock has not caught it yet
+    mine_gb = rec.get("model_gb_per_path") or {}
+    theirs_gb = prior.get("model_gb_per_path") or {}
+    for path in sorted(set(mine_gb) & set(theirs_gb)):
+        pairs.append((f"bytes:{path}", mine_gb[path], theirs_gb[path]))
     for path, sec, prior_sec in pairs:
         if not sec or not prior_sec:
             continue
@@ -389,7 +407,6 @@ def main(gate: bool = False) -> None:
                           seed=1 if shape == "enron4" else 0)
 
     factors = init_factors(tt.dims, rank, 7, dtype=bench_dtype)
-    grams = [gram(U) for U in factors]
 
     def sync(f2):
         # The timed sweeps chain (each consumes the previous factors),
@@ -434,9 +451,14 @@ def main(gate: bool = False) -> None:
         sweep = (_make_phased_sweep if phased
                  else _make_sweep)(X, tt.nmodes, 0.0, donate=True)
         # donated sweeps consume their inputs: give each path a private
-        # copy so the shared factor/gram set survives for the next path
-        f2 = [jnp.array(u) for u in factors]
-        g2 = [jnp.array(g) for g in grams]
+        # copy so the shared factor set survives for the next path —
+        # cast to the layout's STORAGE dtype (the compact path stores
+        # bf16 and runs bf16 factors with f32 accumulation, exactly as
+        # a cpd_als over that BlockedSparse would; docs/format.md)
+        dt = (X.layouts[0].vals.dtype if isinstance(X, BlockedSparse)
+              else bench_dtype)
+        f2 = [jnp.array(u, dtype=dt) for u in factors]
+        g2 = [gram(u) for u in f2]
         # warmup / compile
         note("compiling + first sweep")
         f2, g2, *_ = sweep(f2, g2, True)
@@ -479,11 +501,12 @@ def main(gate: bool = False) -> None:
         jax.clear_caches()
 
     results = {}
-    default_paths = "blocked,tuned,stream"
+    default_paths = "blocked,compact,tuned,stream"
     raw_paths = [p.strip() for p in
                  os.environ.get("SPLATT_BENCH_PATHS",
                                 default_paths).split(",") if p.strip()]
-    paths = [p for p in raw_paths if p in ("blocked", "stream", "tuned")]
+    paths = [p for p in raw_paths
+             if p in ("blocked", "compact", "stream", "tuned")]
     if paths != raw_paths:
         # keep the valid subset rather than silently re-enabling the
         # slow paths the caller asked to skip — inside a hard-timeout
@@ -516,6 +539,33 @@ def main(gate: bool = False) -> None:
     # and the remaining paths continue — one path's Mosaic rejection or
     # OOM must not cost the whole benchmark's chip window
     path_errors = {}
+    # per-path ACHIEVED bytes/iteration + format summary, from the
+    # encoded layouts (docs/format.md) — the fixed i32/f32 model would
+    # claim the compact format moves bytes it no longer does
+    path_gb = {}
+    path_fmt = {}
+    pallas_ran = (use_pallas is True
+                  or (use_pallas is None
+                      and jax.default_backend() == "tpu"))
+
+    def note_format(label, X, pallas=None):
+        from splatt_tpu.bench_algs import mttkrp_bytes_encoded
+
+        # `pallas` overrides the run-wide engine family for paths that
+        # force their own (the blocked_xla fallback): the traffic model
+        # must match what the path's engines actually stream
+        if pallas is None:
+            pallas = pallas_ran
+        alg = "blocked_pallas" if pallas else "blocked"
+        itemsize = jnp.dtype(X.layouts[0].vals.dtype).itemsize
+        gb = sum(mttkrp_bytes_encoded(alg, X, rank, m, itemsize)
+                 for m in range(X.nmodes)) / 1e9
+        # 4 decimals (0.1 MB): the gate COMPARES these values, and a
+        # 2-decimal round would blind the >10% bytes leg at smoke scale
+        path_gb[label] = round(gb, 4)
+        path_fmt[label] = X.format_summary()
+        note(f"format[{label}]: {path_fmt[label]} -> "
+             f"{path_gb[label]} GB/iter (achieved bytes)")
 
     def record_failure(label, e):
         from splatt_tpu import resilience
@@ -531,7 +581,9 @@ def main(gate: bool = False) -> None:
     if "blocked" in paths:
         try:
             note("building blocked layouts")
-            results["blocked"] = run(BlockedSparse.from_coo(tt, opts))
+            X = BlockedSparse.from_coo(tt, opts)
+            note_format("blocked", X)
+            results["blocked"] = run(X)
         except Exception as e:
             record_failure("blocked", e)
             blocked_failed = True
@@ -542,9 +594,28 @@ def main(gate: bool = False) -> None:
             opts_x = Options(random_seed=7, verbosity=Verbosity.NONE,
                              val_dtype=bench_dtype, use_pallas=False,
                              block_alloc=alloc)
-            results["blocked_xla"] = run(BlockedSparse.from_coo(tt, opts_x))
+            X = BlockedSparse.from_coo(tt, opts_x)
+            note_format("blocked_xla", X, pallas=False)
+            results["blocked_xla"] = run(X)
         except Exception as e2:
             record_failure("blocked_xla", e2)
+        release()
+    if "compact" in paths:
+        # the format-v2 row (docs/format.md): same sweep, layouts
+        # encoded with local narrow indices + segment ids + bf16 value
+        # storage — the bytes/iteration halving the roofline analysis
+        # says the bandwidth-bound kernel converts into speed
+        try:
+            note("building compact (v2 idx + bf16 storage) layouts")
+            opts_c = Options(random_seed=7, verbosity=Verbosity.NONE,
+                             val_dtype=bench_dtype, use_pallas=use_pallas,
+                             block_alloc=alloc, autotune=False,
+                             idx_width="auto", val_storage="bf16")
+            X = BlockedSparse.from_coo(tt, opts_c)
+            note_format("compact", X)
+            results["compact"] = run(X)
+        except Exception as e:
+            record_failure("compact", e)
         release()
     tuned_plan_info = None
     if "tuned" in paths:
@@ -572,8 +643,9 @@ def main(gate: bool = False) -> None:
                                for m, p in sorted(tres.plans.items())}
             note(f"tuned plans: {tuned_plan_info}")
             note("building tuned blocked layouts")
-            results["tuned"] = run(
-                BlockedSparse.compile(tt, topts, rank=rank))
+            X = BlockedSparse.compile(tt, topts, rank=rank)
+            note_format("tuned", X)
+            results["tuned"] = run(X)
         except Exception as e:
             record_failure("tuned", e)
         release()
@@ -630,26 +702,29 @@ def main(gate: bool = False) -> None:
         # the BENCH trajectory can attribute wins to tuning
         rec["tuned_plan"] = tuned_plan_info
     try:
-        # first-order roofline: one iteration = nmodes MTTKRPs' logical
-        # HBM traffic (lower bound; layout partials omitted) against
-        # the measured sec/iter — shows headroom next to the seconds
+        # first-order roofline: one iteration = nmodes MTTKRPs' HBM
+        # traffic against the measured sec/iter — shows headroom next
+        # to the seconds.  Blocked paths report ACHIEVED bytes from
+        # their encoded layouts (computed per path above); the stream
+        # path keeps the logical COO model.
         from splatt_tpu.bench_algs import hbm_peak_gbs, mttkrp_bytes
 
-        if best.startswith("blocked") or best == "tuned":
-            # the winning blocked run used Pallas fused engines when
-            # forced or on TPU (choose_impl semantics) — those stream
-            # the factor TABLES once, a different traffic model
-            pallas_ran = (use_pallas is True
-                          or (use_pallas is None
-                              and jax.default_backend() == "tpu"))
-            alg = "blocked_pallas" if pallas_ran else "blocked"
+        if best in path_gb:
+            gb = float(path_gb[best])
         else:
-            alg = "stream"
-        itemsize = jnp.dtype(bench_dtype).itemsize
-        gb = sum(mttkrp_bytes(alg, tt, rank, m, itemsize)
-                 for m in range(tt.nmodes)) / 1e9
+            itemsize = jnp.dtype(bench_dtype).itemsize
+            gb = sum(mttkrp_bytes("stream", tt, rank, m, itemsize)
+                     for m in range(tt.nmodes)) / 1e9
         rec["model_gb_per_iter"] = round(gb, 2)
         rec["eff_gbs"] = round(gb / sec_per_iter, 1)
+        if path_gb:
+            # per-path achieved bytes + eff_gbs + format summary: what
+            # the --gate comparison and the BENCH trajectory read
+            rec["model_gb_per_path"] = dict(path_gb)
+            rec["eff_gbs_per_path"] = {
+                k: round(path_gb[k] / results[k]["median"], 1)
+                for k in path_gb if k in results}
+            rec["format"] = dict(path_fmt)
         peak = hbm_peak_gbs()
         if peak:
             rec["hbm_peak_pct"] = round(100 * gb / sec_per_iter / peak, 1)

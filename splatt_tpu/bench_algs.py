@@ -245,6 +245,34 @@ def mttkrp_bytes(alg: str, tt: SparseTensor, rank: int, mode: int,
     raise ValueError(f"unknown algorithm {alg!r}")
 
 
+def mttkrp_bytes_encoded(alg: str, X: BlockedSparse, rank: int, mode: int,
+                         factor_itemsize: int) -> float:
+    """ACHIEVED HBM bytes of one MTTKRP over a compiled
+    :class:`BlockedSparse` — the same traffic structure as
+    :func:`mttkrp_bytes`, but the index/value streams are costed at the
+    layout's STORED widths (``ModeLayout.storage_bytes``: narrow v2
+    local indices + per-block bases, bf16 values) and the factor terms
+    at the factors' actual itemsize.  This is what bench reports per
+    path (docs/format.md): the fixed i32/f32 model would claim the
+    compact format moves bytes it no longer does.
+    """
+    lay = X.layout_for(mode)
+    nmodes, nnz = lay.nmodes, lay.nnz
+    acc = 4  # f32 accumulator width
+    out = X.dims[mode] * rank * acc
+    streams = lay.storage_bytes()     # encoded idx + bases + vals + starts
+    rows = (nmodes - 1) * nnz * rank * factor_itemsize
+    if alg in ("blocked", "blocked_pallas"):
+        partials = 2 * lay.nblocks * lay.seg_width * rank * acc
+        if alg == "blocked_pallas":
+            tables = sum(d * rank * factor_itemsize
+                         for k, d in enumerate(X.dims) if k != mode)
+            return streams + tables + partials + out
+        return streams + rows + partials + out
+    # stream/scatter formulation over the layout's encoded arrays
+    return streams + rows + out
+
+
 def roofline_report(tt: SparseTensor, results: Dict[str, List[float]],
                     rank: int, itemsize: int,
                     layouts=None) -> List[str]:

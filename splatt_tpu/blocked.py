@@ -34,10 +34,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from splatt_tpu.config import (BlockAlloc, Options, Verbosity, default_opts,
-                               resolve_dtype)
+from splatt_tpu.config import (BlockAlloc, LayoutFormat, Options, Verbosity,
+                               default_opts, layout_format, resolve_dtype,
+                               resolve_storage_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.utils.env import ceil_to as _ceil_to
+
+#: short dtype names for format descriptions ("mode0=u16/seg/bf16")
+_DTYPE_SHORT = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+                "float16": "f16"}
 
 
 @jax.tree_util.register_dataclass
@@ -45,10 +50,29 @@ from splatt_tpu.utils.env import ceil_to as _ceil_to
 class ModeLayout:
     """One sorted+blocked copy of the nonzeros (≙ one splatt_csf).
 
-    Data (device arrays):
-      inds: (nmodes, nnz_pad) int32 coordinates, sorted by ``mode``;
-        pad entries hold ``dim`` for ``mode`` and 0 elsewhere.
-      vals: (nnz_pad,) values, zero-padded.
+    Two encodings share this container (docs/format.md):
+
+    v1 ("i32" index width — the original format):
+      inds: (nmodes, nnz_pad) int32 GLOBAL coordinates, sorted by
+        ``mode``; pad entries hold ``dim`` for ``mode`` and 0 elsewhere.
+      base: None.
+
+    v2 (compact — "auto"/"u16" index width, ≙ the reference's
+    configurable splatt_idx_t done per block + CSF fiber compression):
+      inds: a TUPLE of per-mode (nnz_pad,) arrays of LOCAL within-block
+        indices, each at the narrowest width that fits that mode's
+        maximum per-block extent (uint16 when it allows, int32
+        otherwise).  The sorted mode's stream holds segment ids against
+        the block's run start (row_start) — the output-row coordinate
+        is no longer repeated per nonzero at full width.
+      base: matching tuple of per-mode (nblocks,) int32 block base
+        offsets; ``global = local + base[block]``.  For the sorted
+        mode ``base == row_start``.
+
+    Shared:
+      vals: (nnz_pad,) values, zero-padded — stored at ``val_storage``
+        ("bf16" stores bfloat16, decoded at gather and accumulated in
+        f32 via the engines' _acc_dtype path).
       row_start: (nblocks,) int32 — first output row each block touches
         (``dim`` for all-padding blocks).
 
@@ -59,6 +83,9 @@ class ModeLayout:
       seg_width: S — max output-row span of any block, rounded up to a
         multiple of 8 (f32 sublane); the one-hot reduce is (S×B)@(B×R).
       nnz: true nonzero count (before padding).
+      idx_width / val_storage: the REQUESTED format policy this layout
+        was built under — what the autotuner's plan matching compares,
+        so a plan measured for one encoding never steers another.
     """
 
     inds: jax.Array
@@ -69,10 +96,15 @@ class ModeLayout:
     block: int = dataclasses.field(metadata=dict(static=True))
     seg_width: int = dataclasses.field(metadata=dict(static=True))
     nnz: int = dataclasses.field(metadata=dict(static=True))
+    base: Optional[Tuple[jax.Array, ...]] = None
+    idx_width: str = dataclasses.field(default="i32",
+                                       metadata=dict(static=True))
+    val_storage: str = dataclasses.field(default="auto",
+                                         metadata=dict(static=True))
 
     @property
     def nnz_pad(self) -> int:
-        return int(self.inds.shape[1])
+        return int(self.vals.shape[0])
 
     @property
     def nblocks(self) -> int:
@@ -80,22 +112,91 @@ class ModeLayout:
 
     @property
     def nmodes(self) -> int:
-        return int(self.inds.shape[0])
+        # len() covers both the v1 (nmodes, nnz_pad) array and the v2
+        # per-mode tuple
+        return len(self.inds)
+
+    @property
+    def encoding(self) -> str:
+        """"v1" (global i32) or "v2" (local narrow + base)."""
+        return "v1" if self.base is None else "v2"
+
+    # -- trace-safe decode (the engines' view of the format) ---------------
+    #
+    # All pure jnp: callable inside jitted sweeps (no host sync —
+    # SPL003) and under donation (the layout itself is never donated).
+
+    def mode_ids(self, k: int) -> jax.Array:
+        """(nnz_pad,) int32 GLOBAL ids of mode `k` — v1 returns the
+        stored stream; v2 decodes ``local + base`` per block on the
+        fly (an XLA elementwise temp fused into the consuming gather,
+        not a stored rematerialization)."""
+        if self.base is None:
+            return self.inds[k]
+        loc = self.inds[k].reshape(self.nblocks, self.block)
+        return (loc.astype(jnp.int32) + self.base[k][:, None]).reshape(-1)
+
+    def blocked_locals(self) -> jax.Array:
+        """(nblocks, block) int32 within-block ids of the SORTED mode
+        — what the one-hot engines contract against.  v2 stores these
+        directly (the segment encoding), so the per-nnz subtraction of
+        the v1 path disappears from the hot loop."""
+        if self.base is None:
+            return (self.inds[self.mode].reshape(self.nblocks, self.block)
+                    - self.row_start[:, None])
+        return self.inds[self.mode].reshape(
+            self.nblocks, self.block).astype(jnp.int32)
+
+    def mode_streams(self):
+        """(per-mode index arrays, per-mode bases-or-None) — the raw
+        encoded streams for engines that decode per scan chunk
+        (ops/mttkrp._scan_fused) instead of whole-array."""
+        streams = [self.inds[k] for k in range(self.nmodes)]
+        bases = None if self.base is None else list(self.base)
+        return streams, bases
+
+    def idx_widths(self) -> List[str]:
+        """Per-mode stored index width ("u16"/"i32") — the ACHIEVED
+        encoding, next to the requested ``idx_width`` policy."""
+        names = {2: "u16", 4: "i32", 8: "i64"}
+        return [names.get(jnp.dtype(self.inds[k].dtype).itemsize, "i32")
+                for k in range(self.nmodes)]
+
+    def format_desc(self) -> str:
+        """Compact achieved-format summary, e.g. ``u16/seg/bf16`` (v2)
+        or ``i32/glob/f32`` (v1): index width(s) / mode-row encoding /
+        stored value dtype."""
+        widths = sorted(set(self.idx_widths()))
+        idx = widths[0] if len(widths) == 1 else "+".join(widths)
+        enc = "glob" if self.base is None else "seg"
+        val = _DTYPE_SHORT.get(jnp.dtype(self.vals.dtype).name,
+                               jnp.dtype(self.vals.dtype).name)
+        return f"{idx}/{enc}/{val}"
 
     def storage_bytes(self) -> int:
-        """≙ csf_storage (src/csf.c:729-767)."""
-        return (self.inds.size * self.inds.dtype.itemsize
-                + self.vals.size * self.vals.dtype.itemsize
+        """≙ csf_storage (src/csf.c:729-767) — ENCODED bytes: what the
+        stored streams actually occupy (narrow v2 indices, per-block
+        bases, bf16 values), so bench's bytes/iteration model reflects
+        the real format, not a fixed i32/f32 assumption."""
+        if self.base is None:
+            idx = self.inds.size * self.inds.dtype.itemsize
+        else:
+            idx = sum(a.size * a.dtype.itemsize for a in self.inds)
+            idx += sum(b.size * b.dtype.itemsize for b in self.base)
+        return (idx + self.vals.size * self.vals.dtype.itemsize
                 + self.row_start.size * self.row_start.dtype.itemsize)
 
     def __repr__(self) -> str:
-        # the EFFECTIVE block is load-bearing (build_layout clamps the
-        # requested one), so surface it instead of the dataclass default
-        # repr dumping whole device arrays
+        # the EFFECTIVE block and the achieved encoding are
+        # load-bearing (build_layout clamps the requested block and may
+        # degrade a failed v2 encode to v1), so surface both instead of
+        # the dataclass default repr dumping whole device arrays —
+        # demotion/tune log lines must distinguish v1 from v2 plans
         return (f"ModeLayout(mode={self.mode}, dim={self.dim}, "
                 f"block={self.block}, seg_width={self.seg_width}, "
                 f"nnz={self.nnz}, nnz_pad={self.nnz_pad}, "
-                f"nblocks={self.nblocks})")
+                f"nblocks={self.nblocks}, enc={self.encoding}"
+                f"[{self.format_desc()}])")
 
 
 def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
@@ -125,9 +226,63 @@ def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
     raise ValueError(f"unknown mode order {policy!r}")
 
 
+def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
+               block: int, nnz: int, fmt: LayoutFormat):
+    """Encode sorted+padded GLOBAL (nmodes, nnz_pad) int32 coordinates
+    into the v2 compact streams: per-mode LOCAL within-block indices at
+    the narrowest width that fits (uint16 when the mode's maximum
+    per-block extent allows, int32 otherwise — with ``fmt.idx ==
+    "u16"`` a non-fitting mode is an encode error) plus per-block int32
+    base offsets.  The sorted mode's base IS its run start, so its
+    stream holds segment ids (docs/format.md).
+
+    Pad entries decode to harmless rows (their values are zero): the
+    sorted mode's pads clamp to the block's last real segment id —
+    keeping the decoded stream nondecreasing for the
+    ``indices_are_sorted`` scatter hint — and other modes' pads decode
+    to the block base.
+    """
+    nmodes, nnz_pad = inds.shape
+    nb = nnz_pad // block
+    u16_max = int(np.iinfo(np.uint16).max)
+    real = np.zeros(nnz_pad, dtype=bool)
+    real[:nnz] = True
+    real = real.reshape(nb, block)
+    locs, bases = [], []
+    for k in range(nmodes):
+        rows = inds[k].reshape(nb, block)
+        if k == mode:
+            base = row_start.astype(np.int32).copy()
+        else:
+            masked = np.where(real, rows, np.iinfo(np.int32).max)
+            base = masked.min(axis=1)
+            base[base == np.iinfo(np.int32).max] = 0
+            base = base.astype(np.int32)
+        loc = rows - base[:, None]
+        if nnz < nnz_pad:
+            if k == mode:
+                # clamp pads to the block's max real segment id (0 for
+                # all-pad blocks, whose base is already the sentinel)
+                maxloc = np.where(real, loc, 0).max(axis=1)
+                loc = np.where(real, loc, maxloc[:, None])
+            else:
+                loc = np.where(real, loc, 0)
+        extent = int(loc.max()) if loc.size else 0
+        if fmt.idx == "u16" and extent > u16_max:
+            raise ValueError(
+                f"idx_width=u16 requested but mode {k}'s maximum "
+                f"per-block extent {extent} exceeds uint16; use "
+                f"idx_width=auto (which falls back to int32 per mode)")
+        width = np.uint16 if extent <= u16_max else np.int32
+        locs.append(loc.reshape(-1).astype(width))
+        bases.append(base)
+    return locs, bases
+
+
 def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
                  val_dtype=np.float32, mode_order=None,
-                 mode_order_custom=None, verbose: bool = False) -> ModeLayout:
+                 mode_order_custom=None, verbose: bool = False,
+                 fmt: Optional[LayoutFormat] = None) -> ModeLayout:
     """Sort, block and pad the tensor for output mode `mode`.
 
     ≙ csf_alloc's sort + fiber build (src/csf.c:613-726); the secondary
@@ -136,11 +291,18 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     requests may be clamped to the tensor size; the override is
     recorded in the run report (and printed when `verbose`) and the
     effective block is what :class:`ModeLayout` reports.
+
+    `fmt` picks the encoding (docs/format.md): the default v1 global
+    int32, or the compact v2 local-index/segment encoding.  A v2
+    encode that fails (the ``format.encode`` fault site, or a forced
+    u16 that does not fit) degrades CLASSIFIED to v1 — recorded as a
+    ``format_fallback`` run-report event, never a failed build.
     """
     nmodes, nnz = tt.nmodes, tt.nnz
     from splatt_tpu.utils.env import check_int32_dims
 
     check_int32_dims(tt.dims)
+    fmt = (fmt or LayoutFormat()).validate()
     others = secondary_order(tt.dims, mode, mode_order, mode_order_custom)
     order = [mode] + others
     perm = tt.sort_order(order)
@@ -152,21 +314,24 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     block = max(128, min(block, _ceil_to(max(nnz, 1), 128)))
     if block != requested:
         # a silent override of a caller-requested block made the
-        # effective plan unobservable (ISSUE 3 satellite): record it
+        # effective plan unobservable (ISSUE 3 satellite): record it —
+        # with the requested format, so clamp/demotion/tune log lines
+        # distinguish v1 from v2 plans
         from splatt_tpu import resilience
 
         resilience.run_report().add("block_clamp", mode=mode,
                                     requested=requested, effective=block,
-                                    nnz=nnz)
+                                    nnz=nnz, idx_width=fmt.idx,
+                                    val_storage=fmt.val)
         if verbose:
-            print(f"  layout mode{mode}: requested nnz_block {requested} "
-                  f"clamped to {block} (nnz={nnz})")
+            print(f"  layout mode{mode} [{fmt.idx}/{fmt.val}]: requested "
+                  f"nnz_block {requested} clamped to {block} (nnz={nnz})")
     nnz_pad = max(block, _ceil_to(nnz, block))
     nblocks = nnz_pad // block
     inds = np.zeros((nmodes, nnz_pad), dtype=np.int32)
     inds[:, :nnz] = tt.inds[:, perm]
     inds[mode, nnz:] = dim  # sentinel row for padding
-    vals = np.zeros(nnz_pad, dtype=val_dtype)
+    vals = np.zeros(nnz_pad, dtype=np.dtype(val_dtype))
     vals[:nnz] = tt.vals[perm]
 
     rows = inds[mode].reshape(nblocks, block)
@@ -177,6 +342,36 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     # clamp to the widest span a block of real rows can have.
     seg_width = _ceil_to(min(span, dim if dim > 0 else 1), 8)
 
+    if fmt.v2:
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        try:
+            faults.maybe_fail("format.encode")
+            locs, bases = _encode_v2(inds, row_start, mode, block, nnz,
+                                     fmt)
+            return ModeLayout(
+                inds=tuple(jnp.asarray(l) for l in locs),
+                vals=jnp.asarray(vals),
+                row_start=jnp.asarray(row_start),
+                mode=mode, dim=dim, block=block, seg_width=seg_width,
+                nnz=nnz,
+                base=tuple(jnp.asarray(b) for b in bases),
+                idx_width=fmt.idx, val_storage=fmt.val)
+        except Exception as e:
+            # a failed v2 encode must degrade the BUILD, not kill it:
+            # classify, report, and fall through to the v1 encoding the
+            # engines can always consume
+            cls = resilience.classify_failure(e)
+            resilience.run_report().add(
+                "format_fallback", mode=mode, idx_width=fmt.idx,
+                failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
+            if verbose:
+                print(f"  layout mode{mode}: v2 ({fmt.idx}) encode failed "
+                      f"({cls.value}); falling back to the v1 i32 "
+                      f"encoding")
+
     return ModeLayout(
         inds=jnp.asarray(inds),
         vals=jnp.asarray(vals),
@@ -186,7 +381,50 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
         block=block,
         seg_width=seg_width,
         nnz=nnz,
+        idx_width="i32",
+        val_storage=fmt.val,
     )
+
+
+def reencode_layout(layout: ModeLayout, fmt: LayoutFormat,
+                    val_dtype=None) -> ModeLayout:
+    """Re-encode an existing v1 layout under `fmt` (and optionally a
+    new stored value dtype) WITHOUT re-sorting — the autotuner derives
+    its format candidates from one sorted build per (mode, block)
+    instead of paying the host sort per candidate.  Same degradation
+    contract as :func:`build_layout`: a failed v2 encode (the
+    ``format.encode`` fault site) returns the v1 layout, classified
+    into the run report."""
+    fmt = fmt.validate()
+    if layout.encoding != "v1":
+        raise ValueError("reencode_layout expects a v1 source layout")
+    vals = (layout.vals if val_dtype is None
+            else layout.vals.astype(val_dtype))
+    if not fmt.v2:
+        return dataclasses.replace(layout, vals=vals, idx_width="i32",
+                                   val_storage=fmt.val)
+    from splatt_tpu import resilience
+    from splatt_tpu.utils import faults
+
+    try:
+        faults.maybe_fail("format.encode")
+        locs, bases = _encode_v2(np.asarray(layout.inds),
+                                 np.asarray(layout.row_start),
+                                 layout.mode, layout.block, layout.nnz,
+                                 fmt)
+        return dataclasses.replace(
+            layout, vals=vals,
+            inds=tuple(jnp.asarray(l) for l in locs),
+            base=tuple(jnp.asarray(b) for b in bases),
+            idx_width=fmt.idx, val_storage=fmt.val)
+    except Exception as e:
+        cls = resilience.classify_failure(e)
+        resilience.run_report().add(
+            "format_fallback", mode=layout.mode, idx_width=fmt.idx,
+            failure_class=cls.value,
+            error=resilience.failure_message(e)[:200])
+        return dataclasses.replace(layout, vals=vals, idx_width="i32",
+                                   val_storage=fmt.val)
 
 
 @dataclasses.dataclass
@@ -213,9 +451,19 @@ class BlockedSparse:
     def storage_bytes(self) -> int:
         return sum(l.storage_bytes() for l in self.layouts)
 
+    def format_summary(self) -> str:
+        """One-line achieved-format summary per build mode, e.g.
+        ``mode0=u16/seg/bf16 mode1=u16/seg/bf16`` — what bench and the
+        CLI print so the plan a run executed is observable."""
+        parts = []
+        for i, lay in enumerate(self.layouts):
+            parts.append(f"mode{lay.mode}={lay.format_desc()}")
+        return " ".join(parts)
+
     @staticmethod
     def from_coo(tt: SparseTensor, opts: Optional[Options] = None,
-                 tuned_blocks: Optional[Dict[int, int]] = None
+                 tuned_blocks: Optional[Dict[int, int]] = None,
+                 tuned_formats: Optional[Dict[int, LayoutFormat]] = None
                  ) -> "BlockedSparse":
         """Compile a COO tensor into blocked layouts per the alloc policy.
 
@@ -230,47 +478,107 @@ class BlockedSparse:
         `tuned_blocks` (mode -> nnz_block, from the autotuner's plan
         cache) overrides ``opts.nnz_block`` per build mode — the layout
         is built once at the tuned block instead of rebuilt when the
-        plan disagrees with the default.  :meth:`compile` fills it in.
+        plan disagrees with the default.  `tuned_formats` does the same
+        for the encoding (index width; docs/format.md).
+        :meth:`compile` fills both in.
+
+        Value STORAGE is resolved once for the whole tensor (every
+        layout must share one dtype — the CPD driver derives its
+        factor dtype from it): the explicit/env policy wins, else a
+        unanimous tuned-format verdict.
         """
         opts = (opts or default_opts()).validate()
         nmodes = tt.nmodes
-        tuned_blocks = tuned_blocks or {}
+        tuned_blocks = dict(tuned_blocks or {})
+        tuned_formats = dict(tuned_formats or {})
+        fmt_default = layout_format(opts)
+        # one storage dtype across layouts: pinned policy > unanimous
+        # tuned verdict > compute dtype
+        val_pol = fmt_default.val
+        if val_pol == "auto" and tuned_formats:
+            verdicts = {f.val for f in tuned_formats.values()}
+            if len(verdicts) == 1:
+                val_pol = verdicts.pop()
+        # a plan whose storage verdict cannot follow the resolved
+        # policy (non-unanimous modes, or a pinned knob overriding it)
+        # is dropped WHOLE — building its block/idx_width at a storage
+        # it was never measured with would make a configuration
+        # dispatch then silently rejects (_tuned_plan_for's strict
+        # match).  Observable, not silent: tuner_degraded per mode.
+        dropped = [m for m, f in tuned_formats.items() if f.val != val_pol]
+        if dropped:
+            from splatt_tpu import resilience
+
+            for m in sorted(dropped):
+                tuned_formats.pop(m)
+                tuned_blocks.pop(m, None)
+                resilience.run_report().add(
+                    "tuner_degraded", mode=m,
+                    reason=f"tuned val_storage could not apply under "
+                           f"the resolved storage policy {val_pol!r}; "
+                           f"mode keeps the default format and the "
+                           f"heuristic chain")
+        storage = resolve_storage_dtype(val_pol,
+                                        resolve_dtype(opts, tt.vals.dtype))
         # one selection rule shared with the distributed cell/shard
         # layout builders — they must never desynchronize
         from splatt_tpu.parallel.common import alloc_build_modes
 
         build_modes = alloc_build_modes(tt.dims, opts)
 
-        layouts = [build_layout(tt, m,
-                                block=tuned_blocks.get(m, opts.nnz_block),
-                                val_dtype=resolve_dtype(opts, tt.vals.dtype),
-                                mode_order=opts.mode_order,
-                                mode_order_custom=opts.mode_order_custom,
-                                verbose=opts.verbosity >= Verbosity.LOW)
+        layouts = [build_layout(
+                       tt, m,
+                       block=tuned_blocks.get(m, opts.nnz_block),
+                       val_dtype=storage,
+                       mode_order=opts.mode_order,
+                       mode_order_custom=opts.mode_order_custom,
+                       verbose=opts.verbosity >= Verbosity.LOW,
+                       fmt=LayoutFormat(
+                           idx=tuned_formats[m].idx if m in tuned_formats
+                           else fmt_default.idx,
+                           val=val_pol))
                    for m in build_modes]
         mode_map = {}
         for m in range(nmodes):
             mode_map[m] = build_modes.index(m) if m in build_modes else 0
-        return BlockedSparse(layouts=layouts, mode_map=mode_map,
-                             dims=tt.dims, nnz=tt.nnz, opts=opts)
+        bs = BlockedSparse(layouts=layouts, mode_map=mode_map,
+                           dims=tt.dims, nnz=tt.nnz, opts=opts)
+        if any(l.encoding == "v2" for l in layouts) or val_pol != "auto":
+            # the chosen encoding is part of the executed plan: record
+            # it (docs/format.md) like tuned_plan records dispatch
+            from splatt_tpu import resilience
+
+            resilience.run_report().add(
+                "format_v2",
+                modes={str(l.mode): l.format_desc() for l in layouts})
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  format: {bs.format_summary()}")
+        return bs
 
     @staticmethod
     def compile(tt: SparseTensor, opts: Optional[Options] = None,
                 rank: Optional[int] = None) -> "BlockedSparse":
         """:meth:`from_coo` + autotune: consult the tuner's plan cache
-        (splatt_tpu/tune.py) for each mode's winning ``nnz_block`` and
-        build the layouts at it directly.  `rank` keys the plan lookup
-        (the winning configuration is rank-dependent); without it, or
-        with autotune off, this is plain :meth:`from_coo`."""
+        (splatt_tpu/tune.py) for each mode's winning ``nnz_block`` AND
+        encoding (index width / value storage — docs/format.md) and
+        build the layouts at them directly.  `rank` keys the plan
+        lookup (the winning configuration is rank-dependent); without
+        it, or with autotune off, this is plain :meth:`from_coo`."""
         opts = (opts or default_opts()).validate()
         tuned_blocks = None
+        tuned_formats = None
         if rank is not None:
             from splatt_tpu import tune
 
             if tune.autotune_enabled(opts.autotune):
-                tuned_blocks = tune.tuned_blocks_for(
+                plans = tune.tuned_build_for(
                     tt.dims, tt.nnz, rank, resolve_dtype(opts, tt.vals.dtype))
-        return BlockedSparse.from_coo(tt, opts, tuned_blocks=tuned_blocks)
+                tuned_blocks = {m: p.nnz_block for m, p in plans.items()}
+                tuned_formats = {m: LayoutFormat(idx=p.idx_width,
+                                                 val=p.val_storage)
+                                 for m, p in plans.items()}
+        return BlockedSparse.from_coo(tt, opts, tuned_blocks=tuned_blocks,
+                                      tuned_formats=tuned_formats)
 
     def frobsq(self) -> float:
         """Squared Frobenius norm (≙ csf_frobsq, src/csf.c:828-851).
@@ -278,7 +586,8 @@ class BlockedSparse:
         Accumulated in f64 on host so both cpd_als drivers (COO via
         coo.normsq, blocked via this) share the same ⟨X,X⟩ to full
         precision — at 77M+ nnz an f32 accumulation loses digits in the
-        fit denominator.
+        fit denominator.  (bf16-stored values upcast first: numpy's dot
+        has no bfloat16 kernel.)
         """
-        v = np.asarray(self.layouts[0].vals, dtype=np.float64)
+        v = np.asarray(self.layouts[0].vals).astype(np.float64)
         return float(np.dot(v, v))

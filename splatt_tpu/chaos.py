@@ -217,6 +217,65 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
                        schedule=schedule)
 
 
+# -- bench regression gate (docs/format.md, ROADMAP open item 1) ------------
+#
+# `splatt chaos --smoke --bench-gate` folds the PR 6 bench regression
+# gate into the chaos smoke tier: a smoke-sized `python bench.py
+# --gate` run in a subprocess, so a format/engine change that regresses
+# >10% against the newest same-metric prior BENCH_*.json fails the PR
+# loudly next to the resilience invariant — not silently in a later
+# full-scale bench.
+
+def run_bench_gate(smoke: bool = True,
+                   timeout_s: Optional[float] = None) -> dict:
+    """Run ``python bench.py --gate`` as a subprocess (smoke-sized env
+    defaults unless the caller already pinned SPLATT_BENCH_* knobs) and
+    return ``{ok, returncode, record, stderr_tail}``.  The record is
+    the parsed headline JSON line — including the per-path achieved
+    bytes (``model_gb_per_path``) and format summaries the gate
+    compares.  The default timeout scales with the tier: the smoke
+    bench is seconds, the full-scale default bench (20M nnz + the
+    stream oracle) legitimately runs tens of minutes."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    if timeout_s is None:
+        timeout_s = 900.0 if smoke else 3 * 3600.0
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = os.path.join(repo, "bench.py")
+    # splint: ignore[SPL001] forwarding the whole environment to the
+    # bench subprocess, not reading config — no single ENV_VARS name
+    env = dict(os.environ)
+    if smoke:
+        # seconds-scale: small tensor, the two format rows the gate is
+        # really about; "tuned"/"stream" stay out of the smoke tier
+        env.setdefault("SPLATT_BENCH_NNZ", "60000")
+        env.setdefault("SPLATT_BENCH_RANK", "8")
+        env.setdefault("SPLATT_BENCH_ITERS", "2")
+        env.setdefault("SPLATT_BENCH_PATHS", "blocked,compact")
+    try:
+        p = subprocess.run([sys.executable, bench, "--gate"], env=env,
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.SubprocessError as e:
+        return dict(ok=False, returncode=-1, record=None,
+                    stderr_tail=str(e)[-400:])
+    record = None
+    for line in reversed(p.stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                record = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    return dict(ok=(p.returncode == 0 and record is not None),
+                returncode=p.returncode, record=record,
+                stderr_tail=p.stderr[-400:])
+
+
 # -- serve soak (docs/serve.md) ---------------------------------------------
 #
 # The single-run soak above cannot exercise the serve daemon's two

@@ -278,11 +278,28 @@ def cmd_chaos(args) -> int:
                           verbose=args.verbose > 0)
     for line in chaos.format_report(res):
         print(line)
+    gate_ok = True
+    if args.bench_gate:
+        # the PR 6 bench regression gate rides the chaos smoke tier
+        # (docs/format.md): a >10% time OR encoded-bytes regression
+        # against the newest same-metric prior fails the run loudly
+        gate = chaos.run_bench_gate(smoke=args.smoke)
+        gate_ok = gate["ok"]
+        verdict = "passed" if gate_ok else "FAILED"
+        print(f"bench gate: {verdict} (exit {gate['returncode']})")
+        if not gate_ok and gate.get("stderr_tail"):
+            print(gate["stderr_tail"])
+        if gate.get("record"):
+            rec = gate["record"]
+            print(f"bench gate: value={rec.get('value')} "
+                  f"{rec.get('unit')} "
+                  f"gb_per_path={rec.get('model_gb_per_path')} "
+                  f"format={rec.get('format')}")
     if args.json:
         import json as _json
 
         print(_json.dumps(res.to_json()))
-    return 0 if res.ok else 1
+    return 0 if (res.ok and gate_ok) else 1
 
 
 def cmd_serve(args) -> int:
@@ -567,6 +584,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--smoke", action="store_true",
                    help="seconds-scale seeded run on a tiny tensor "
                         "(the tier-1 CI entry)")
+    p.add_argument("--bench-gate", action="store_true",
+                   help="additionally run `python bench.py --gate` "
+                        "(smoke-sized under --smoke): a >10% time or "
+                        "encoded-bytes regression vs the newest "
+                        "same-metric BENCH_*.json prior fails the run "
+                        "(docs/format.md)")
     p.add_argument("--serve", action="store_true",
                    help="soak the serve daemon instead: SIGKILL a "
                         "real daemon mid-queue, restart it, and "

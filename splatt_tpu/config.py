@@ -91,6 +91,82 @@ class Verbosity(enum.IntEnum):
     MAX = 3
 
 
+# -- compact blocked format v2 (docs/format.md) -----------------------------
+#
+# The reference makes index width a build-time config (splatt_idx_t,
+# include/splatt/types_config.h:38-43).  Here it is a per-layout
+# *policy* the autotuner can choose per shape regime: "i32" keeps the
+# v1 global-int32 encoding, "auto"/"u16" switch to the v2 compact
+# encoding (per-block LOCAL indices at the narrowest width that fits,
+# plus int32 per-block base offsets; the sorted mode's row stream
+# becomes segment ids against the block's run start).  Value storage is
+# the companion knob: "bf16" stores nonzero values (and hence the
+# factors the CPD driver derives its dtype from) in bfloat16 with f32
+# accumulation — the MXU-native mixed pattern.
+
+#: legal index-width policies (SPLATT_IDX_WIDTH / Options.idx_width)
+IDX_WIDTHS = ("i32", "auto", "u16")
+
+#: legal value-storage policies (SPLATT_VAL_STORAGE /
+#: Options.val_storage); "auto" = the resolved compute dtype
+VAL_STORAGES = ("auto", "f32", "bf16")
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutFormat:
+    """One blocked-layout encoding request: index width x value
+    storage.  ``idx`` "i32" is the v1 global encoding; "auto" encodes
+    v2 local indices at the narrowest width that fits each mode's
+    per-block extent (uint16 where possible, int32 otherwise); "u16"
+    additionally *requires* every mode to fit uint16 (a mode that does
+    not is an encode failure, degraded classified to v1).  ``val``
+    picks the stored value dtype ("auto" = compute dtype)."""
+
+    idx: str = "i32"
+    val: str = "auto"
+
+    def validate(self) -> "LayoutFormat":
+        if self.idx not in IDX_WIDTHS:
+            raise ValueError(
+                f"idx_width must be one of {IDX_WIDTHS}, got {self.idx!r}")
+        if self.val not in VAL_STORAGES:
+            raise ValueError(
+                f"val_storage must be one of {VAL_STORAGES}, "
+                f"got {self.val!r}")
+        return self
+
+    @property
+    def v2(self) -> bool:
+        return self.idx != "i32"
+
+
+def layout_format(opts: "Options") -> LayoutFormat:
+    """Resolve the layout format for a run: explicit Options fields
+    win, else the SPLATT_IDX_WIDTH / SPLATT_VAL_STORAGE env defaults
+    (both conservative: v1 i32 indices, compute-dtype values)."""
+    from splatt_tpu.utils.env import read_env
+
+    idx = opts.idx_width if opts.idx_width is not None \
+        else str(read_env("SPLATT_IDX_WIDTH"))
+    val = opts.val_storage if opts.val_storage is not None \
+        else str(read_env("SPLATT_VAL_STORAGE"))
+    return LayoutFormat(idx=idx, val=val).validate()
+
+
+def resolve_storage_dtype(val_storage: str, compute_dtype):
+    """The on-device dtype layout values are STORED at: "auto" keeps
+    the resolved compute dtype, "f32"/"bf16" pin it.  Centralized here
+    (the config module owns dtype policy) so storage narrowing is one
+    decision, not a per-callsite literal."""
+    import jax.numpy as jnp
+
+    if val_storage == "bf16":
+        return jnp.dtype(jnp.bfloat16)
+    if val_storage == "f32":
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(compute_dtype)
+
+
 @dataclasses.dataclass
 class Options:
     """Run-time options (≙ splatt_default_opts, src/opts.c:10-47).
@@ -162,6 +238,14 @@ class Options:
     # arrays it passed in).
     donate_sweep: Optional[bool] = None
 
+    # Compact blocked format v2 (docs/format.md): index-width and
+    # value-storage policy for the blocked layouts.  None = env default
+    # (SPLATT_IDX_WIDTH / SPLATT_VAL_STORAGE, both conservative); the
+    # autotuner measures the format candidates and BlockedSparse.compile
+    # builds layouts at the winning encoding per mode.
+    idx_width: Optional[str] = None      # "i32" | "auto" | "u16"
+    val_storage: Optional[str] = None    # "auto" | "f32" | "bf16"
+
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM
     comm_pattern: CommPattern = CommPattern.ALL2ALL
@@ -192,6 +276,15 @@ class Options:
         if not 0 <= self.priv_threshold:
             raise ValueError(
                 f"priv_threshold must be >= 0, got {self.priv_threshold}")
+        if self.idx_width is not None and self.idx_width not in IDX_WIDTHS:
+            raise ValueError(
+                f"idx_width must be one of {IDX_WIDTHS}, "
+                f"got {self.idx_width!r}")
+        if (self.val_storage is not None
+                and self.val_storage not in VAL_STORAGES):
+            raise ValueError(
+                f"val_storage must be one of {VAL_STORAGES}, "
+                f"got {self.val_storage!r}")
         import jax.numpy as jnp
 
         if (self.val_dtype is not None

@@ -553,6 +553,7 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             if opts.verbosity >= Verbosity.LOW:
                 parts = [f"mode{m}={p['path']}/{p['engine']}"
                          f" b{p['nnz_block']} s{p['scan_target']}"
+                         f" {p['idx_width']}/{p['val_storage']}"
                          for m, p in sorted(tuned_plans.items())]
                 print("  tuned plan: " + " ".join(parts))
 

@@ -57,6 +57,22 @@ def _gather_prod(inds: jax.Array, vals: jax.Array,
     return prod
 
 
+def _gather_prod_layout(layout: ModeLayout, factors: Sequence[jax.Array],
+                        mode: int) -> jax.Array:
+    """:func:`_gather_prod` over a layout's ENCODED streams: v2 local
+    indices decode per mode (``local + base``, fused into the gather's
+    index computation) and bf16-stored values decode at the gather
+    (``astype`` to the factor dtype) — the layout never rematerializes
+    a global-i32/f32 copy of itself."""
+    dtype = factors[0].dtype
+    prod = layout.vals.astype(dtype)[:, None]
+    for k, U in enumerate(factors):
+        if k != mode:
+            prod = prod * jnp.take(U, layout.mode_ids(k), axis=0,
+                                   mode="clip", indices_are_sorted=False)
+    return prod
+
+
 def _acc_dtype(dtype):
     """Accumulate bf16/f16 operands in f32 (the MXU-native mixed
     pattern: low-precision reads, full-precision accumulation)."""
@@ -176,35 +192,63 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
     nsteps = -(-nb // C)
     nb_pad = nsteps * C
 
-    inds = layout.inds
+    # per-mode encoded streams: v1 = global i32 rows of one array, v2 =
+    # narrow local ids + per-block bases.  Decoding happens inside the
+    # scan step, one chunk at a time — the global-i32 form never exists
+    # whole in HBM for v2 layouts.
+    streams, bases = layout.mode_streams()
     vals = layout.vals
     row_start = layout.row_start
     if nb_pad != nb:
         # pad with whole sentinel blocks: mode index = dim (falls in the
-        # dropped tail rows), other indices 0, values 0
+        # dropped tail rows; for v2 the BASE carries the sentinel and
+        # the stored locals stay 0), other indices 0, values 0
         pad = (nb_pad - nb) * B
-        inds = jnp.pad(inds, ((0, 0), (0, pad)))
-        inds = inds.at[mode, nb * B:].set(layout.dim)
+        streams = [jnp.pad(s, (0, pad),
+                           constant_values=(layout.dim
+                                            if bases is None and k == mode
+                                            else 0))
+                   for k, s in enumerate(streams)]
         vals = jnp.pad(vals, (0, pad))
         row_start = jnp.pad(row_start, (0, nb_pad - nb),
                             constant_values=layout.dim)
+        if bases is not None:
+            bases = [jnp.pad(b, (0, nb_pad - nb),
+                             constant_values=(layout.dim if k == mode
+                                              else 0))
+                     for k, b in enumerate(bases)]
 
-    inds_s = inds.reshape(nmodes, nsteps, C, B).transpose(1, 0, 2, 3)
+    inds_s = tuple(s.reshape(nsteps, C, B) for s in streams)
     vals_s = vals.reshape(nsteps, C, B)
     rs_s = row_start.reshape(nsteps, C)
+    base_s = (None if bases is None
+              else tuple(b.reshape(nsteps, C) for b in bases))
 
     iota = jnp.arange(width, dtype=jnp.int32)
     acc = _acc_dtype(dtype)
 
     def step(carry, xs):
-        inds_c, vals_c, rs_c = xs          # (nmodes,C,B), (C,B), (C,)
+        # per-mode (C,B) chunks, (C,B) vals, (C,) run starts,
+        # per-mode (C,) bases (None for v1)
+        inds_c, vals_c, rs_c, base_c = xs
         prod = vals_c.astype(dtype)[..., None]
         for k in range(nmodes):
             if k != mode:
-                rows = jnp.take(factors[k], inds_c[k].reshape(-1), axis=0,
+                g = inds_c[k]
+                if base_c is not None:
+                    g = g.astype(jnp.int32) + base_c[k][:, None]
+                rows = jnp.take(factors[k], g.reshape(-1), axis=0,
                                 mode="clip").reshape(C, B, R)
                 prod = prod * rows
-        local = inds_c[mode] - rs_c[:, None] if not accumulate else inds_c[mode]
+        if accumulate:
+            local = inds_c[mode]
+            if base_c is not None:
+                local = local.astype(jnp.int32) + base_c[mode][:, None]
+        elif base_c is None:
+            local = inds_c[mode] - rs_c[:, None]
+        else:
+            # v2 segment encoding stores the within-block ids directly
+            local = inds_c[mode].astype(jnp.int32)
         onehot = (local[:, None, :] == iota[None, :, None]).astype(dtype)
         part = jnp.einsum("cwb,cbr->cwr", onehot, prod,
                           preferred_element_type=acc,
@@ -215,9 +259,9 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
 
     if accumulate:
         init = jnp.zeros((width, R), dtype=acc)
-        out, _ = jax.lax.scan(step, init, (inds_s, vals_s, rs_s))
+        out, _ = jax.lax.scan(step, init, (inds_s, vals_s, rs_s, base_s))
         return out
-    _, parts = jax.lax.scan(step, None, (inds_s, vals_s, rs_s))
+    _, parts = jax.lax.scan(step, None, (inds_s, vals_s, rs_s, base_s))
     return parts.reshape(nb_pad, width, R)[:nb]
 
 
@@ -243,7 +287,13 @@ def _tuned_plan_for(layout: ModeLayout, factors: Sequence[jax.Array],
     plan = tune.cached_plan([int(f.shape[0]) for f in factors],
                             nnz, mode, int(factors[0].shape[1]),
                             factors[0].dtype)
-    if plan is None or plan.path != path or plan.nnz_block != layout.block:
+    if (plan is None or plan.path != path
+            or plan.nnz_block != layout.block
+            or plan.idx_width != getattr(layout, "idx_width", "i32")
+            or plan.val_storage != getattr(layout, "val_storage", "auto")):
+        # the format is part of the measured configuration: a plan for
+        # the v2 encoding never steers a v1 layout's dispatch (and vice
+        # versa) — the tuner can make dispatch faster, never wronger
         return None
     # per-shape (OOM) demotions only match with the shape_key, so it
     # must be computed when the caller (engine_plan, the cpd_als plan
@@ -388,7 +438,6 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
 
     dim = int(factors[mode].shape[0])
     R = factors[mode].shape[1]
-    seg = layout.inds[mode]
     interpret = impl == "pallas_interpret"
 
     if path in ("scatter", "sorted_scatter") or engine == "xla":
@@ -400,12 +449,14 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
         # so this path has no (nnz, R) HBM intermediate either.  As the
         # `engine == "xla"` terminal-fallback of the blocked paths it is
         # the stream formulation over the layout's arrays: correct for
-        # any mode, no kernel or VMEM preconditions.
+        # any mode, no kernel or VMEM preconditions.  v2 layouts decode
+        # per mode inside the same fusion (mode_ids/_gather_prod_layout).
         sorted_seg = (path == "sorted_scatter"
                       or (path not in ("scatter",) and mode == layout.mode))
-        prod = _gather_prod(layout.inds, layout.vals, factors, mode)
+        prod = _gather_prod_layout(layout, factors, mode)
         nseg = dim + 1 if mode == layout.mode else dim
-        out = jax.ops.segment_sum(prod.astype(_acc_dtype(prod.dtype)), seg,
+        out = jax.ops.segment_sum(prod.astype(_acc_dtype(prod.dtype)),
+                                  layout.mode_ids(mode),
                                   num_segments=nseg,
                                   indices_are_sorted=sorted_seg)
         return out[:dim]
@@ -433,9 +484,9 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
                                 accumulate=True,
                                 interpret=interpret)[:dim]
         if plan == "unfused_pallas":
-            prod = _gather_prod(layout.inds, layout.vals, factors,
-                                mode).reshape(nb, B, R)
-            local = seg.reshape(nb, B)
+            prod = _gather_prod_layout(layout, factors,
+                                       mode).reshape(nb, B, R)
+            local = layout.mode_ids(mode).reshape(nb, B)
             return onehot_reduce_full(local, prod, width,
                                       interpret=interpret,
                                       chunk=vmem_chunk(width, B, int(R),
@@ -457,9 +508,9 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
             parts = fused_mttkrp(layout, factors, mode, S,
                                  accumulate=False, interpret=interpret)
         elif plan == "unfused_pallas":
-            prod = _gather_prod(layout.inds, layout.vals, factors,
-                                mode).reshape(nb, B, R)
-            local = seg.reshape(nb, B) - layout.row_start[:, None]
+            prod = _gather_prod_layout(layout, factors,
+                                       mode).reshape(nb, B, R)
+            local = layout.blocked_locals()
             parts = onehot_reduce_sorted(local, prod, S,
                                          interpret=interpret,
                                          chunk=vmem_chunk(S, B, int(R),
@@ -500,10 +551,20 @@ def _engine_shape_key(layout: ModeLayout, factors: Sequence[jax.Array],
     demotes the engine for shapes that fit.  The single owner of the
     key format: demotions recorded at dispatch and the chain pruning in
     engine_plan must agree on it.  `regime` skips recomputation when
-    the caller already classified the call."""
+    the caller already classified the call.
+
+    The v2 compact encoding is part of the scope (a ``:v2`` suffix;
+    v1 keys stay byte-identical to the pre-format-v2 era): an OOM under
+    a v2 plan demotes the engine for v2 dispatches only — the v1 path
+    keeps its standing, and vice versa."""
     if regime is None:
         regime = _chain_regime(layout, factors, mode)
-    return f"{regime}:b{layout.block}"
+    key = f"{regime}:b{layout.block}"
+    # getattr: gate-probing tests pass partial layout stand-ins
+    enc = getattr(layout, "encoding", "v1")
+    if enc != "v1":
+        key += f":{enc}"
+    return key
 
 
 def _engine_probed_ok(engine: str, regime: str, block: int,
@@ -637,6 +698,8 @@ def _native_runnable(layout: ModeLayout, factors: Sequence[jax.Array],
         return False  # explicit path = the caller wants that jit engine
     if any(isinstance(U, jax.core.Tracer) for U in factors):
         return False  # inside a jit trace (e.g. the fused sweep)
+    if layout.encoding != "v1":
+        return False  # the C++ ABI reads contiguous global i32 indices
     vdt = layout.vals.dtype
     if vdt not in (jnp.float32, jnp.float64):
         return False

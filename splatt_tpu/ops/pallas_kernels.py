@@ -303,11 +303,14 @@ def _prep_t_operands(layout, factors, mode: int, accumulate: bool):
     dtype = factors[0].dtype
     others = [k for k in range(layout.nmodes) if k != mode]
 
-    seg = layout.inds[mode]
+    # the layout decodes its own encoding (v2 local+base, bf16 values):
+    # mode_ids/blocked_locals are identity reads for v1 and trace-fused
+    # decodes for v2 — the kernel operands below are i32/compute-dtype
+    # either way, so the Mosaic kernels are format-agnostic
     if accumulate:
-        local = seg.reshape(nb, B)
+        local = layout.mode_ids(mode).reshape(nb, B)
     else:
-        local = seg.reshape(nb, B) - layout.row_start[:, None]
+        local = layout.blocked_locals()
     vals = layout.vals.reshape(nb, B).astype(dtype)
     local = local[:, None, :]
     vals = vals[:, None, :]
@@ -320,7 +323,7 @@ def _prep_t_operands(layout, factors, mode: int, accumulate: bool):
         u_t = factors[k].T
         uts.append(jnp.pad(u_t, ((0, R8 - R), (0, d_pad - d))))
         ck = -(-B // d_pad)
-        idx = jnp.minimum(layout.inds[k], d - 1).reshape(nb, B)
+        idx = jnp.minimum(layout.mode_ids(k), d - 1).reshape(nb, B)
         if ck * d_pad != B:
             idx = jnp.pad(idx, ((0, 0), (0, ck * d_pad - B)))
         gidxs.append(jnp.broadcast_to(idx.reshape(nb, ck, 1, d_pad),
@@ -1083,15 +1086,15 @@ def fused_mttkrp(layout, factors, mode: int, width: int,
     dtype = factors[0].dtype
     others = [k for k in range(nmodes) if k != mode]
 
-    seg = layout.inds[mode]
     if accumulate:
-        local = seg.reshape(nb, B)
+        local = layout.mode_ids(mode).reshape(nb, B)
     else:
-        local = seg.reshape(nb, B) - layout.row_start[:, None]
+        local = layout.blocked_locals()
     vals = layout.vals.reshape(nb, B).astype(dtype)
     # (nb, nother, B): blocks (chunk, nother, B) keep the last two dims
     # equal to the array dims, legal for any chunk under Mosaic's rule.
-    ginds = (layout.inds[jnp.asarray(others)]
+    # mode_ids decodes the v2 encoding per mode (identity for v1).
+    ginds = (jnp.stack([layout.mode_ids(k) for k in others])
              .reshape(len(others), nb, B).transpose(1, 0, 2))
 
     nb_pad = ceil_to(max(nb, 1), chunk)

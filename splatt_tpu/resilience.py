@@ -506,10 +506,22 @@ RUN_REPORT_EVENTS = {
     "tuner_negative": "an autotuner candidate failed to measure; "
                       "deterministic/resource failures persist as "
                       "negative plan-cache entries",
-    "tuner_degraded": "no autotuner candidate was measurable for a "
-                      "mode; dispatch keeps the heuristic chain",
+    "tuner_degraded": "a mode keeps the heuristic chain instead of a "
+                      "tuned plan: no candidate was measurable, or the "
+                      "plan's storage verdict could not apply under "
+                      "the resolved whole-tensor policy (blocked.py)",
     "block_clamp": "build_layout clamped the requested nnz block to "
-                   "the tensor's size (blocked.py)",
+                   "the tensor's size (blocked.py); carries the "
+                   "requested format so v1/v2 plans stay "
+                   "distinguishable in the log",
+    "format_v2": "blocked layouts were built at a non-default encoding "
+                 "(compact v2 local/segment indices and/or narrowed "
+                 "value storage, docs/format.md); carries the achieved "
+                 "per-mode format descriptions",
+    "format_fallback": "a v2 compact-format encode failed and the "
+                       "build degraded CLASSIFIED to the v1 i32 "
+                       "encoding (blocked.py, the format.encode fault "
+                       "site) — slower bytes, never a failed build",
     "env_platform_error": "JAX_PLATFORMS could not be mirrored into "
                           "jax.config (utils/env.py:"
                           "apply_env_platform); the run continues on "
@@ -632,9 +644,10 @@ class RunReport:
                          f"failed to measure (deterministic failures "
                          f"recorded as negative plan-cache entries)")
         for e in self.events("tuner_degraded"):
-            lines.append(f"  autotuner: no measurable candidate for "
-                         f"mode {e['mode']} — dispatch keeps the "
-                         f"heuristic chain")
+            why = e.get("reason") or ("no measurable candidate — "
+                                      "dispatch keeps the heuristic "
+                                      "chain")
+            lines.append(f"  autotuner: mode {e['mode']}: {why}")
         nonfinite = self.events("health_nonfinite")
         if nonfinite:
             its = sorted({e.get("iteration") for e in nonfinite})
@@ -658,6 +671,12 @@ class RunReport:
             lines.append(f"  bench path {e['path']} failed "
                          f"({e['failure_class']}: {e['error'][:80]}); "
                          f"remaining paths continued")
+        for e in self.events("format_fallback"):
+            lines.append(f"  compact-format encode failed for mode "
+                         f"{e.get('mode')} "
+                         f"(requested {e.get('idx_width')}; "
+                         f"{e['failure_class']}: {e['error'][:80]}); "
+                         f"degraded to the v1 i32 encoding")
         for e in self.events("bench_regression"):
             lines.append(f"  BENCH REGRESSION on {e['path']}: "
                          f"{e['sec']}s vs {e['prior_sec']}s in "

@@ -53,8 +53,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 #: bump when the plan schema or the measurement methodology changes —
-#: a cache written by an older tuner is re-tuned, not reinterpreted
-PLAN_CACHE_VERSION = 1
+#: a cache written by an older tuner is re-tuned, not reinterpreted.
+#: v2: plans carry the layout FORMAT (idx_width/val_storage,
+#: docs/format.md) and were measured per encoding.
+PLAN_CACHE_VERSION = 2
 
 #: candidate nnz blocks (build_layout clamps small tensors; duplicate
 #: effective blocks are measured once)
@@ -64,6 +66,10 @@ NNZ_BLOCKS = (1024, 2048, 4096, 8192, 16384)
 #: materialized per scan step); the middle rung is the static default
 SCAN_TARGETS = (1 << 21, 1 << 23, 1 << 25)
 
+#: candidate index widths when the policy is not pinned: the v1 global
+#: encoding and the compact v2 local/segment encoding (docs/format.md)
+IDX_CANDIDATES = ("i32", "auto")
+
 _AUTOTUNE_ENV = "SPLATT_AUTOTUNE"
 _CACHE_ENV = "SPLATT_TUNE_CACHE"
 
@@ -71,14 +77,19 @@ _CACHE_ENV = "SPLATT_TUNE_CACHE"
 @dataclasses.dataclass(frozen=True)
 class TunedPlan:
     """One persisted dispatch decision: the measured-fastest
-    (path, engine, nnz_block, scan_target) for a plan-cache key, plus
-    the winning median seconds per MTTKRP call as evidence."""
+    (path, engine, nnz_block, scan_target, layout format) for a
+    plan-cache key, plus the winning median seconds per MTTKRP call as
+    evidence.  ``idx_width``/``val_storage`` name the encoding the
+    winner was measured under (docs/format.md) — dispatch only applies
+    a plan to a layout built at exactly that format."""
 
     path: str
     engine: str
     nnz_block: int
     scan_target: int
     sec: float
+    idx_width: str = "i32"
+    val_storage: str = "auto"
 
 
 @dataclasses.dataclass
@@ -130,9 +141,9 @@ def plan_key(dims: Sequence[int], nnz: int, mode: int, rank: int,
             f":{jnp.dtype(dtype).name}")
 
 
-def _negative_key(key: str, engine: str, block: int,
-                  scan_target: int) -> str:
-    return f"neg:{key}:{engine}:b{block}:s{scan_target}"
+def _negative_key(key: str, engine: str, block: int, scan_target: int,
+                  fmt: str = "i32-auto") -> str:
+    return f"neg:{key}:{engine}:b{block}:s{scan_target}:{fmt}"
 
 
 # -- on-disk plan cache -----------------------------------------------------
@@ -298,24 +309,35 @@ def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,
         return TunedPlan(path=str(p["path"]), engine=str(p["engine"]),
                          nnz_block=int(p["nnz_block"]),
                          scan_target=int(p["scan_target"]),
-                         sec=float(p.get("sec", 0.0)))
+                         sec=float(p.get("sec", 0.0)),
+                         idx_width=str(p.get("idx_width", "i32")),
+                         val_storage=str(p.get("val_storage", "auto")))
     except (KeyError, TypeError, ValueError) as e:
         _cache_io_error("load", e)
         return None
 
 
-def tuned_blocks_for(dims: Sequence[int], nnz: int, rank: int,
-                     dtype) -> Dict[int, int]:
-    """Per-mode tuned nnz_block for every mode with a cached plan —
-    what :meth:`BlockedSparse.compile` builds layouts with, so the
-    layout is built once at the winning block instead of rebuilt when
-    the plan disagrees with the default."""
+def tuned_build_for(dims: Sequence[int], nnz: int, rank: int,
+                    dtype) -> Dict[int, TunedPlan]:
+    """Per-mode cached plans — what :meth:`BlockedSparse.compile`
+    builds layouts with (winning ``nnz_block`` AND encoding:
+    idx_width/val_storage, docs/format.md), so the layout is built once
+    at the tuned configuration instead of rebuilt when the plan
+    disagrees with the default."""
     out = {}
     for m in range(len(dims)):
         plan = cached_plan(dims, nnz, m, rank, dtype)
         if plan is not None:
-            out[m] = plan.nnz_block
+            out[m] = plan
     return out
+
+
+def tuned_blocks_for(dims: Sequence[int], nnz: int, rank: int,
+                     dtype) -> Dict[int, int]:
+    """Per-mode tuned nnz_block for every mode with a cached plan
+    (the block-only view of :func:`tuned_build_for`)."""
+    return {m: p.nnz_block
+            for m, p in tuned_build_for(dims, nnz, rank, dtype).items()}
 
 
 # -- measurement ------------------------------------------------------------
@@ -365,6 +387,39 @@ def _tune_impl(opts) -> str:
     return impl
 
 
+def _format_candidates(opts, dtype) -> List[Tuple[str, str]]:
+    """(idx_width, val_storage) format candidates (docs/format.md).
+
+    A pinned knob (an explicit ``Options.idx_width``/``val_storage``
+    or an explicitly-set SPLATT_IDX_WIDTH/SPLATT_VAL_STORAGE) is
+    measured alone; unpinned knobs span the candidate matrix — both
+    index encodings, and bf16 value storage next to the compute dtype
+    when computing in f32 (the only dtype a bf16 narrowing is a
+    *format* choice for rather than a numerics change the caller
+    already made).  The cheapest measured format wins per regime; the
+    bit-parity (u16/seg) and fit-parity (bf16) test suites are what
+    keep "cheapest" and "correct" the same set."""
+    import jax.numpy as jnp
+
+    from splatt_tpu.utils.env import env_is_set, read_env
+
+    if opts.idx_width is not None:
+        idx = (opts.idx_width,)
+    elif env_is_set("SPLATT_IDX_WIDTH"):
+        idx = (str(read_env("SPLATT_IDX_WIDTH")),)
+    else:
+        idx = IDX_CANDIDATES
+    if opts.val_storage is not None:
+        val = (opts.val_storage,)
+    elif env_is_set("SPLATT_VAL_STORAGE"):
+        val = (str(read_env("SPLATT_VAL_STORAGE")),)
+    elif jnp.dtype(dtype) == jnp.dtype("float32"):
+        val = ("auto", "bf16")
+    else:
+        val = ("auto",)
+    return [(i, v) for i in idx for v in val]
+
+
 def _candidates(layout, factors, mode: int, path: str, impl: str,
                 scan_targets: Sequence[int],
                 default_scan: int) -> List[Tuple[str, int]]:
@@ -386,9 +441,22 @@ def _candidates(layout, factors, mode: int, path: str, impl: str,
 def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
          blocks: Optional[Sequence[int]] = None,
          scan_targets: Optional[Sequence[int]] = None,
+         formats: Optional[Sequence[Tuple[str, str]]] = None,
          warm: int = 1, reps: int = 2, force: bool = False) -> TuneResult:
     """Tune the MTTKRP plan for each mode of `tt` at `rank` and persist
     the winners in the plan cache.
+
+    The candidate matrix is engine x nnz_block x scan_target x FORMAT
+    (docs/format.md): each (idx_width, val_storage) pair from
+    :func:`_format_candidates` (or an explicit `formats`) is measured
+    against the same sorted build — the v2/bf16 re-encodings are
+    derived without re-sorting — so the cheapest *correct* encoding
+    wins empirically per regime.  bf16-storage candidates are measured
+    with bf16 factors (the configuration that actually dispatches), and
+    a winner whose storage narrows the compute dtype is stored under
+    BOTH the requested dtype's key (for compile-time layout building)
+    and the storage dtype's key (for dispatch-time steering, where the
+    factors already carry the narrow dtype).
 
     Already-cached (unexpired) plans short-circuit their mode entirely
     — a warm cache runs ZERO measurements (``result.measured == 0``),
@@ -406,9 +474,12 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
     dispatch keeps the heuristic chain, recorded as a
     ``tuner_degraded`` run-report event.
     """
+    import jax.numpy as jnp
+
     from splatt_tpu import resilience
-    from splatt_tpu.blocked import build_layout
-    from splatt_tpu.config import Verbosity, default_opts, resolve_dtype
+    from splatt_tpu.blocked import build_layout, reencode_layout
+    from splatt_tpu.config import (LayoutFormat, Verbosity, default_opts,
+                                   resolve_dtype, resolve_storage_dtype)
     from splatt_tpu.cpd import init_factors
     from splatt_tpu.ops.mttkrp import _SCAN_TARGET, choose_path
     from splatt_tpu.utils.env import read_env_int
@@ -419,11 +490,22 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
     default_scan = read_env_int("SPLATT_SCAN_TARGET_ELEMS") or _SCAN_TARGET
     blocks = tuple(blocks) if blocks else NNZ_BLOCKS
     scan_targets = tuple(scan_targets) if scan_targets else SCAN_TARGETS
+    formats = (list(formats) if formats
+               else _format_candidates(opts, dtype))
     modes = range(tt.nmodes) if modes is None else modes
     loud = opts.verbosity >= Verbosity.LOW
     # plan-independent factor operands: the timing only needs shapes
-    # and a realistic dtype, not the caller's actual factors
+    # and a realistic dtype, not the caller's actual factors.  Narrow-
+    # storage candidates measure with matching narrow factors (memoized
+    # casts — the real dispatch they stand for runs that way).
     factors = init_factors(tt.dims, rank, seed=0, dtype=dtype)
+    facs_by_dtype = {jnp.dtype(dtype): factors}
+
+    def factors_for(storage):
+        sd = jnp.dtype(storage)
+        if sd not in facs_by_dtype:
+            facs_by_dtype[sd] = [f.astype(sd) for f in factors]
+        return facs_by_dtype[sd]
 
     result = TuneResult(plans={})
     for m in modes:
@@ -436,60 +518,85 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
                 if loud:
                     print(f"  tune mode {m}: plan cache hit "
                           f"({plan.engine} b{plan.nnz_block} "
-                          f"s{plan.scan_target}) — skipping measurement")
+                          f"s{plan.scan_target} "
+                          f"{plan.idx_width}/{plan.val_storage}) — "
+                          f"skipping measurement")
                 continue
         best: Optional[TunedPlan] = None
-        seen_blocks = set()
+        seen = set()
         for req_block in blocks:
-            layout = build_layout(tt, m, block=int(req_block),
-                                  val_dtype=np.dtype(dtype),
-                                  mode_order=opts.mode_order,
-                                  mode_order_custom=opts.mode_order_custom)
-            if layout.block in seen_blocks:
-                continue  # the clamp collapsed this block onto one done
-            seen_blocks.add(layout.block)
-            path = choose_path(layout, m, opts)
-            for engine, st in _candidates(layout, factors, m, path, impl,
-                                          scan_targets, default_scan):
-                neg = _entry_get(_negative_key(key, engine,
-                                               layout.block, st))
-                if neg is not None:
-                    result.skipped += 1
-                    continue
+            base_layout = build_layout(
+                tt, m, block=int(req_block), val_dtype=np.dtype(dtype),
+                mode_order=opts.mode_order,
+                mode_order_custom=opts.mode_order_custom)
+            path = choose_path(base_layout, m, opts)
+            for iw, vs in formats:
+                storage = resolve_storage_dtype(vs, dtype)
+                if (iw, vs) == ("i32", "auto"):
+                    layout = base_layout
+                else:
+                    # derive the candidate encoding from the one sorted
+                    # build (a failed v2 encode degrades classified to
+                    # v1 inside reencode_layout)
+                    layout = reencode_layout(
+                        base_layout, LayoutFormat(idx=iw, val=vs),
+                        val_dtype=(None if jnp.dtype(storage) ==
+                                   jnp.dtype(dtype) else storage))
+                cand_key = (layout.block, layout.idx_width,
+                            layout.val_storage)
+                if cand_key in seen:
+                    continue  # clamp/fallback collapsed this candidate
+                seen.add(cand_key)
+                fac = factors_for(storage)
+                fmt_tag = f"{layout.idx_width}-{layout.val_storage}"
+                for engine, st in _candidates(layout, fac, m, path, impl,
+                                              scan_targets, default_scan):
+                    neg = _entry_get(_negative_key(key, engine,
+                                                   layout.block, st,
+                                                   fmt_tag))
+                    if neg is not None:
+                        result.skipped += 1
+                        continue
 
-                def attempt(layout=layout, path=path, engine=engine,
-                            st=st):
-                    return _measure_candidate(layout, factors, m, path,
-                                              impl, engine, st,
-                                              warm=warm, reps=reps)
+                    def attempt(layout=layout, fac=fac, path=path,
+                                engine=engine, st=st):
+                        return _measure_candidate(layout, fac, m, path,
+                                                  impl, engine, st,
+                                                  warm=warm, reps=reps)
 
-                try:
-                    sec = resilience.retry_transient(
-                        attempt, label=f"tuner.{engine}")
-                except Exception as e:
-                    cls = resilience.classify_failure(e)
-                    if cls in (resilience.FailureClass.DETERMINISTIC,
-                               resilience.FailureClass.RESOURCE):
-                        # proven: never re-pay this candidate's compile
-                        _entry_store(
-                            _negative_key(key, engine, layout.block, st),
-                            {"state": cls.value,
-                             "error": resilience.failure_message(e)[:200]})
-                    resilience.run_report().add(
-                        "tuner_negative", key=key, engine=engine,
-                        block=layout.block, scan_target=st,
-                        failure_class=cls.value,
-                        error=resilience.failure_message(e)[:200])
-                    result.skipped += 1
-                    continue
-                result.measured += 1
-                if loud:
-                    print(f"  tune mode {m}: {path}/{engine} "
-                          f"b{layout.block} s{st}: {sec:.4f}s")
-                if best is None or sec < best.sec:
-                    best = TunedPlan(path=path, engine=engine,
-                                     nnz_block=layout.block,
-                                     scan_target=st, sec=sec)
+                    try:
+                        sec = resilience.retry_transient(
+                            attempt, label=f"tuner.{engine}")
+                    except Exception as e:
+                        cls = resilience.classify_failure(e)
+                        if cls in (resilience.FailureClass.DETERMINISTIC,
+                                   resilience.FailureClass.RESOURCE):
+                            # proven: never re-pay this candidate's
+                            # compile
+                            _entry_store(
+                                _negative_key(key, engine, layout.block,
+                                              st, fmt_tag),
+                                {"state": cls.value,
+                                 "error":
+                                 resilience.failure_message(e)[:200]})
+                        resilience.run_report().add(
+                            "tuner_negative", key=key, engine=engine,
+                            block=layout.block, scan_target=st,
+                            fmt=fmt_tag, failure_class=cls.value,
+                            error=resilience.failure_message(e)[:200])
+                        result.skipped += 1
+                        continue
+                    result.measured += 1
+                    if loud:
+                        print(f"  tune mode {m}: {path}/{engine} "
+                              f"b{layout.block} s{st} {fmt_tag}: "
+                              f"{sec:.4f}s")
+                    if best is None or sec < best.sec:
+                        best = TunedPlan(path=path, engine=engine,
+                                         nnz_block=layout.block,
+                                         scan_target=st, sec=sec,
+                                         idx_width=layout.idx_width,
+                                         val_storage=layout.val_storage)
         if best is None:
             # every candidate failed or was skipped: no plan — dispatch
             # keeps the heuristic chain (observable, not silent)
@@ -499,9 +606,17 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
                       f"dispatch keeps the heuristic chain")
             continue
         _entry_store(key, {"plan": dataclasses.asdict(best)})
+        storage = resolve_storage_dtype(best.val_storage, dtype)
+        if jnp.dtype(storage) != jnp.dtype(dtype):
+            # a storage-narrowing winner also steers dispatch, where
+            # the factors already carry the narrow dtype — alias the
+            # plan under that key so the steering is not lost
+            _entry_store(plan_key(tt.dims, tt.nnz, m, rank, storage),
+                         {"plan": dataclasses.asdict(best)})
         result.plans[m] = best
         if loud:
             print(f"  tune mode {m}: winner {best.path}/{best.engine} "
                   f"b{best.nnz_block} s{best.scan_target} "
+                  f"{best.idx_width}/{best.val_storage} "
                   f"({best.sec:.4f}s)")
     return result

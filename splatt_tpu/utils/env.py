@@ -84,6 +84,21 @@ ENV_VARS = {
                                        "fresh; <= 0 disables expiry "
                                        "(also the autotuner plan-cache "
                                        "TTL, docs/autotune.md)"),
+    "SPLATT_IDX_WIDTH": EnvVar("i32", "blocked-layout index-width "
+                               "policy (docs/format.md): i32 = v1 "
+                               "global int32 indices; auto = compact "
+                               "v2 encoding (per-block local indices, "
+                               "uint16 where each mode's block extent "
+                               "fits, int32 otherwise, plus int32 "
+                               "per-block bases); u16 = v2 requiring "
+                               "uint16 everywhere (encode failure "
+                               "degrades classified to v1)"),
+    "SPLATT_VAL_STORAGE": EnvVar("auto", "blocked-layout value-storage "
+                                 "dtype (docs/format.md): auto = the "
+                                 "resolved compute dtype; f32/bf16 pin "
+                                 "it — bf16 stores nonzero values (and "
+                                 "the factors derived from them) in "
+                                 "bfloat16 with f32 accumulation"),
     "SPLATT_AUTOTUNE": EnvVar("1", "MTTKRP dispatch consults the "
                               "autotuner's persisted plan cache "
                               "(docs/autotune.md) before the heuristic "
@@ -174,6 +189,18 @@ def _read_env_parsed(name: str, parse, kind: str):
                   f"using the default", file=sys.stderr)
             return ENV_VARS[name].default
     return val
+
+
+def env_is_set(name: str) -> bool:
+    """Whether the PROCESS environment explicitly sets a declared
+    variable (as opposed to the registered default applying).  The
+    autotuner uses this to tell a pinned format knob (measure only
+    that) from an untouched default (measure the candidate matrix)."""
+    if name not in ENV_VARS:
+        raise KeyError(
+            f"environment variable {name!r} is not declared in "
+            f"splatt_tpu.utils.env.ENV_VARS")
+    return name in os.environ
 
 
 def read_env_int(name: str) -> Optional[int]:

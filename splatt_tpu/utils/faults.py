@@ -142,6 +142,11 @@ SITES = {
     "checkpoint_torn": "consumed (not raised): the writer truncates "
                        "the bytes it just wrote, simulating a torn "
                        "write (cpd.py)",
+    "format.encode": "the compact-format v2 encode of one blocked "
+                     "layout (blocked.py build_layout/reencode_layout); "
+                     "a raised fault must degrade the build classified "
+                     "to the v1 i32 encoding (format_fallback event), "
+                     "never fail it",
     "tuner.measure": "one autotuner candidate measurement — warm + "
                      "timed MTTKRP runs of a forced engine (tune.py); "
                      "a crashing measurement must degrade dispatch to "

@@ -232,3 +232,45 @@ def test_serve_chaos_cli_flag_parses():
 
     args = build_parser().parse_args(["chaos", "--serve", "--smoke"])
     assert args.serve and args.smoke
+
+
+def test_bench_gate_cli_flag_parses():
+    from splatt_tpu.cli import build_parser
+
+    args = build_parser().parse_args(["chaos", "--smoke", "--bench-gate"])
+    assert args.smoke and args.bench_gate
+
+
+def test_chaos_smoke_bench_gate(tmp_path, monkeypatch):
+    """The bench regression gate rides the chaos --smoke tier
+    (docs/format.md): a smoke-sized `bench.py --gate` subprocess runs
+    to completion, reports per-path achieved bytes + format summaries,
+    and exits 0 when no same-metric prior regresses.  A format change
+    that re-inflated bytes >10% would fail this test loudly."""
+    from splatt_tpu import chaos
+
+    # a throwaway prior dir + plan cache: the smoke bench must neither
+    # compare against unlike full-scale priors nor dirty the real cache
+    monkeypatch.setenv("SPLATT_BENCH_PRIOR_DIR", str(tmp_path))
+    monkeypatch.setenv("SPLATT_TUNE_CACHE",
+                       str(tmp_path / "tune_cache.json"))
+    gate = chaos.run_bench_gate(smoke=True)
+    assert gate["ok"], gate["stderr_tail"]
+    rec = gate["record"]
+    assert rec["unit"] == "sec/iter" and rec["value"] > 0
+    # the format satellite: achieved bytes + encoding summary per path
+    assert "compact" in rec["model_gb_per_path"]
+    assert "bf16" in rec["format"]["compact"]
+    assert rec["model_gb_per_path"]["compact"] < \
+        rec["model_gb_per_path"]["blocked"]
+    # second run against a matching prior: the gate actually compares.
+    # Times in the prior are inflated 10x (smoke-scale wall clocks are
+    # noisy; the gate's time leg must not make this test flaky) — the
+    # BYTES leg stays exact, so a format re-inflation would still fail.
+    prior = dict(rec, value=rec["value"] * 10,
+                 timing_stats={k: {s: v[s] * 10 for s in v}
+                               for k, v in rec["timing_stats"].items()})
+    (tmp_path / "BENCH_r98.json").write_text(json.dumps(prior))
+    gate2 = chaos.run_bench_gate(smoke=True)
+    assert gate2["ok"], gate2["stderr_tail"]
+    assert gate2["record"].get("bench_regressions") is None
