@@ -156,9 +156,9 @@ class ModeLayout:
         return streams, bases
 
     def idx_widths(self) -> List[str]:
-        """Per-mode stored index width ("u16"/"i32") — the ACHIEVED
-        encoding, next to the requested ``idx_width`` policy."""
-        names = {2: "u16", 4: "i32", 8: "i64"}
+        """Per-mode stored index width ("u8"/"u16"/"i32") — the
+        ACHIEVED encoding, next to the requested ``idx_width`` policy."""
+        names = {1: "u8", 2: "u16", 4: "i32", 8: "i64"}
         return [names.get(jnp.dtype(self.inds[k].dtype).itemsize, "i32")
                 for k in range(self.nmodes)]
 
@@ -236,6 +236,14 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
     base offsets.  The sorted mode's base IS its run start, so its
     stream holds segment ids (docs/format.md).
 
+    ``fmt.idx == "u8"`` additionally narrows the SORTED mode's
+    segment-id stream to uint8 (ROADMAP open item 2: block spans are
+    ≤16 at production density, so the per-nnz row coordinate shrinks to
+    ONE byte); a block whose span exceeds 255 is an encode error,
+    degraded classified to v1 by the callers — the other modes keep the
+    "auto" u16/i32 widths (their extents are block-offset ranges, not
+    segment spans).
+
     Pad entries decode to harmless rows (their values are zero): the
     sorted mode's pads clamp to the block's last real segment id —
     keeping the decoded stream nondecreasing for the
@@ -244,6 +252,7 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
     """
     nmodes, nnz_pad = inds.shape
     nb = nnz_pad // block
+    u8_max = int(np.iinfo(np.uint8).max)
     u16_max = int(np.iinfo(np.uint16).max)
     real = np.zeros(nnz_pad, dtype=bool)
     real[:nnz] = True
@@ -273,7 +282,15 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
                 f"idx_width=u16 requested but mode {k}'s maximum "
                 f"per-block extent {extent} exceeds uint16; use "
                 f"idx_width=auto (which falls back to int32 per mode)")
-        width = np.uint16 if extent <= u16_max else np.int32
+        if fmt.idx == "u8" and k == mode and extent > u8_max:
+            raise ValueError(
+                f"idx_width=u8 requested but the sorted mode's maximum "
+                f"block span {extent} exceeds uint8; use idx_width=auto "
+                f"(u16/i32 segment ids)")
+        if fmt.idx == "u8" and k == mode:
+            width = np.uint8
+        else:
+            width = np.uint16 if extent <= u16_max else np.int32
         locs.append(loc.reshape(-1).astype(width))
         bases.append(base)
     return locs, bases

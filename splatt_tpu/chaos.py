@@ -39,14 +39,17 @@ import numpy as np
 
 #: default schedule: one of each guard's quarry — a NaN poisoning at a
 #: fixed iteration (sentinel + rollback), a slow tuner measurement
-#: under the deadline watchdog (TIMEOUT), and a transient relay
-#: failure at an engine's first compile (retry-with-backoff;
-#: ``engine.xla`` is the terminal engine, live on every backend).
+#: under the deadline watchdog (TIMEOUT), a transient relay failure at
+#: an engine's first compile (retry-with-backoff; ``engine.xla`` is
+#: the terminal engine, live on every backend), and a ring-exchange
+#: failure in the distributed comm drill (the async-ring sweep must
+#: degrade classified down the comm chain — docs/ring.md).
 #: Deterministic: every trigger is count- or iteration-keyed; add a
 #: probabilistic leg via --schedule 'site:kind:p=0.1:seed=N'.
 DEFAULT_SCHEDULE = ("cpd.sweep:nan:iter=2,"
                     "tuner.measure:slow:delay=1.5,"
-                    "engine.xla:internal:1")
+                    "engine.xla:internal:1,"
+                    "comm.ring_exchange:runtime:1")
 
 #: expected run-report evidence per fired fault kind: at least one of
 #: these event kinds must appear when a fault of that kind fired
@@ -55,17 +58,20 @@ _EVIDENCE = {
     "inf": ("health_nonfinite", "health_rollback", "health_degraded"),
     "slow": ("deadline_blown",),
     "http500": ("transient_retry", "engine_demotion",
-                "tuner_negative", "probe_downgrade"),
+                "tuner_negative", "probe_downgrade", "comm_fallback"),
     "internal": ("transient_retry", "engine_demotion",
-                 "tuner_negative", "probe_downgrade"),
+                 "tuner_negative", "probe_downgrade", "comm_fallback"),
     "unavailable": ("transient_retry", "engine_demotion",
-                    "tuner_negative", "probe_downgrade"),
+                    "tuner_negative", "probe_downgrade", "comm_fallback"),
     "timeout": ("transient_retry", "engine_demotion",
-                "tuner_negative", "probe_downgrade"),
-    "oom": ("engine_demotion", "tuner_negative", "probe_downgrade"),
-    "mosaic": ("engine_demotion", "tuner_negative", "probe_downgrade"),
+                "tuner_negative", "probe_downgrade", "comm_fallback"),
+    "oom": ("engine_demotion", "tuner_negative", "probe_downgrade",
+            "comm_fallback"),
+    "mosaic": ("engine_demotion", "tuner_negative", "probe_downgrade",
+               "comm_fallback"),
     "runtime": ("engine_demotion", "tuner_negative",
-                "checkpoint_recovery", "probe_downgrade"),
+                "checkpoint_recovery", "probe_downgrade",
+                "comm_fallback"),
 }
 
 
@@ -170,6 +176,25 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
                           scan_targets=(1 << 21,), reps=1)
             bs = BlockedSparse.from_coo(tt, opts)
             out = cpd_als(bs, rank=rank, opts=opts)
+            if "comm.ring_exchange" in specs:
+                # distributed comm drill (docs/ring.md): a small FINE
+                # async-ring CPD under the armed ring-exchange fault —
+                # the failure must degrade classified down the comm
+                # chain (async_ring -> ring -> all2all, comm_fallback
+                # evidence) and still converge, never escape
+                from splatt_tpu.config import CommPattern
+                from splatt_tpu.parallel.sharded import sharded_cpd_als
+
+                dopts = Options(random_seed=seed, max_iterations=3,
+                                verbosity=opts.verbosity,
+                                use_pallas=False, autotune=False,
+                                comm_pattern=CommPattern.ASYNC_RING)
+                dout = sharded_cpd_als(tt, rank=rank, opts=dopts,
+                                       measure_overlap=False)
+                if not all(np.isfinite(np.asarray(U)).all()
+                           for U in dout.factors):
+                    raise RuntimeError(
+                        "comm drill produced non-finite factors")
         fit = float(out.fit)
         finite = bool(all(np.isfinite(np.asarray(U)).all()
                           for U in out.factors)

@@ -125,11 +125,11 @@ def cmd_cpd(args) -> int:
             raise ValueError(
                 "-p/--partition is a FINE-decomposition input; combine it "
                 f"with --decomp fine, not {opts.decomposition.value}")
-        if (args.comm == "point2point"
+        if (args.comm in ("point2point", "async_ring")
                 and opts.decomposition is not Decomposition.FINE):
             raise ValueError(
-                "--comm point2point (ring) applies to the fine "
-                "decomposition only")
+                f"--comm {args.comm} (ring) applies to the fine "
+                f"decomposition only")
         if args.grid and opts.decomposition is not Decomposition.MEDIUM:
             raise ValueError(
                 "--grid applies to the medium decomposition only")
@@ -145,13 +145,18 @@ def cmd_cpd(args) -> int:
         print(f"DISTRIBUTED decomp={opts.decomposition.value} "
               f"devices={len(jax.devices())}"
               + (f" grid={args.grid}" if args.grid else ""))
+        # --json ring runs always carry the achieved-overlap metric
+        # (docs/ring.md); otherwise the driver's HIGH-verbosity auto
+        # gating applies (the measurement costs extra compiles)
         out = distributed_cpd_als(tt, rank=args.rank, opts=opts, grid=grid,
                                   partition=partition,
                                   row_distribute=args.rowdist,
                                   checkpoint_path=args.checkpoint,
                                   checkpoint_every=args.checkpoint_every,
                                   local_engine=args.local_engine,
-                                  out_dir=args.scratch_dir)
+                                  out_dir=args.scratch_dir,
+                                  measure_overlap=(True if args.json
+                                                   else None))
         bs = None
     else:
         if args.scratch_dir:
@@ -516,9 +521,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device grid for the medium decomposition")
     p.add_argument("-p", "--partition", metavar="FILE",
                    help="per-nonzero partition file (fine decomposition)")
-    p.add_argument("--comm", choices=["all2all", "point2point"],
+    p.add_argument("--comm", choices=["all2all", "point2point",
+                                      "async_ring"],
                    help="row-exchange pattern for --decomp fine "
-                        "(point2point = ppermute ring, memory-lean)")
+                        "(default: $SPLATT_COMM, else all2all): "
+                        "point2point = ppermute ring, memory-lean; "
+                        "async_ring = Pallas remote-copy ring that "
+                        "overlaps the exchange with compute on TPU "
+                        "and degrades classified to point2point then "
+                        "all2all on failure (docs/ring.md)")
     p.add_argument("--rowdist", choices=["greedy"],
                    help="comm-minimizing factor-row distribution for "
                         "--decomp fine (greedy row claiming, reference "

@@ -75,11 +75,39 @@ class CommPattern(enum.Enum):
     fit in HBM.  POINT2POINT: the ppermute ring variant
     (splatt_tpu.parallel.ring) — factor blocks travel the ICI ring and
     no device ever materializes a full factor, O(dim/ndev) peak memory
-    per factor (the ring-attention trade for huge modes).
+    per factor (the ring-attention trade for huge modes).  ASYNC_RING:
+    the same ring dataflow driven by Pallas ``make_async_remote_copy``
+    DMAs (splatt_tpu.parallel.ring_kernels, docs/ring.md) — block s+1
+    streams from the left neighbor while the local partial MTTKRP
+    consumes block s, hiding the exchange behind compute; off-TPU it
+    falls back to the ppermute hops (same math bit-for-bit), and a
+    failure degrades classified to POINT2POINT then ALL2ALL
+    (``comm_fallback``).
     """
 
     ALL2ALL = "all2all"
     POINT2POINT = "point2point"
+    ASYNC_RING = "async_ring"
+
+
+def resolve_comm_pattern(opts: "Options") -> CommPattern:
+    """Resolve the comm strategy for a distributed run: an explicit
+    ``Options.comm_pattern`` wins, else the ``SPLATT_COMM`` env default,
+    else ALL2ALL — the same explicit-beats-env layering as the format
+    knobs (:func:`layout_format`)."""
+    from splatt_tpu.utils.env import read_env
+
+    if opts.comm_pattern is not None:
+        return opts.comm_pattern
+    env = str(read_env("SPLATT_COMM") or "").strip().lower()
+    if env:
+        try:
+            return CommPattern(env)
+        except ValueError:
+            raise ValueError(
+                f"SPLATT_COMM must be one of "
+                f"{[c.value for c in CommPattern]}, got {env!r}")
+    return CommPattern.ALL2ALL
 
 
 class Verbosity(enum.IntEnum):
@@ -104,8 +132,12 @@ class Verbosity(enum.IntEnum):
 # factors the CPD driver derives its dtype from) in bfloat16 with f32
 # accumulation — the MXU-native mixed pattern.
 
-#: legal index-width policies (SPLATT_IDX_WIDTH / Options.idx_width)
-IDX_WIDTHS = ("i32", "auto", "u16")
+#: legal index-width policies (SPLATT_IDX_WIDTH / Options.idx_width).
+#: "u8" narrows the SORTED mode's segment-id stream to uint8 (legal
+#: when every block's sorted-mode extent fits 255 — a block span that
+#: does not is an encode failure, degraded classified to v1); the
+#: other modes encode at the "auto" u16/i32 widths.
+IDX_WIDTHS = ("i32", "auto", "u16", "u8")
 
 #: legal value-storage policies (SPLATT_VAL_STORAGE /
 #: Options.val_storage); "auto" = the resolved compute dtype
@@ -248,7 +280,10 @@ class Options:
 
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM
-    comm_pattern: CommPattern = CommPattern.ALL2ALL
+    # Row-exchange strategy for the FINE decomposition.  None = env
+    # default (SPLATT_COMM, else ALL2ALL) via resolve_comm_pattern —
+    # the distributed drivers resolve it once at entry.
+    comm_pattern: Optional[CommPattern] = None
 
     # Numerics: device compute dtype. None = auto (float32, upgraded to
     # float64 when host data is f64 and x64 is enabled).  An explicit

@@ -22,7 +22,9 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
                         checkpoint_every: int = 10,
                         resume: bool = True,
                         local_engine: Optional[str] = None,
-                        out_dir: Optional[str] = None) -> KruskalTensor:
+                        out_dir: Optional[str] = None,
+                        measure_overlap: Optional[bool] = None
+                        ) -> KruskalTensor:
     """Distributed CPD-ALS, dispatching on ``opts.decomposition``
     (≙ SPLATT_OPTION_DECOMP, types_config.h:179-190):
 
@@ -63,7 +65,8 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
     return sharded_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
                            partition=partition,
                            row_distribute=row_distribute,
-                           local_engine=local_engine, **ck)
+                           local_engine=local_engine,
+                           measure_overlap=measure_overlap, **ck)
 
 
 __all__ = [

@@ -570,22 +570,36 @@ def imbalance_report(counts: np.ndarray, label: str = "device") -> str:
             f"max={int(counts.max())} imbalance={imb:.2f}")
 
 
-def comm_volume_report(dims_pad: Sequence[int], rank: int, itemsize: int,
-                       *, ndev: int = None, grid: Sequence[int] = None,
-                       acc_itemsize: int = 4) -> list:
-    """Per-iteration per-device logical collective volume
-    (≙ mpi_send_recv_stats, src/splatt_mpi.h:453-463).
+def comm_volume_model(dims_pad: Sequence[int], rank: int, itemsize: int,
+                      *, ndev: int = None, grid: Sequence[int] = None,
+                      acc_itemsize: int = 4,
+                      variant: str = "all2all") -> dict:
+    """Per-iteration per-device wire model of the distributed sweep's
+    collectives (≙ mpi_send_recv_stats, src/splatt_mpi.h:453-463), as a
+    structured dict — the single source for the ``comm/iter/device``
+    log lines, the MULTICHIP JSON and the ring-overlap metric's bytes
+    denominator (docs/ring.md).
 
-    Volumes are the ring-algorithm lower bounds XLA's collectives
-    achieve on ICI: all_gather/psum_scatter of an (n, R) array move
-    ~(w-1)/w · n·R·itemsize bytes per device over a w-wide axis; a psum
-    (allreduce) moves ~2x that.  Gram/λ allreduces are R²-sized noise
-    but reported for parity with the reference's stats.
+    `variant` selects the leg set for the 1-D (FINE) sharding:
+    "all2all" models all_gather + psum_scatter at their ring-algorithm
+    lower bounds (~(w-1)/w · n·R·itemsize per device; psum ~2x);
+    "ring" models the ppermute ring — w hops per gather leg, each
+    moving one (dim/w, R) block, and the blockwise per-block psum
+    reduce; "async_ring" models the remote-copy ring — w-1 real hops
+    per leg (no wasted final permute) with the reduce travelling
+    point-to-point at accumulator width, plus the fields the overlap
+    report reads: ``per_hop_mb`` (the largest single hop) and
+    ``overlap_eligible_frac`` (the fraction of ring bytes the
+    double-buffer schedule can hide under compute — the pipeline-fill
+    hop is always exposed).
     """
     nmodes = len(dims_pad)
-    lines = []
-    gather = scatter = allred = 0.0
+    mb = 1.0 / (1 << 20)
+    out = dict(variant=variant if grid is None else "grid",
+               gather_mb=0.0, reduce_mb=0.0, allreduce_mb=0.0,
+               per_hop_mb=0.0, hops=0, overlap_eligible_frac=0.0)
     if grid is not None:
+        allred = 0.0
         # medium grid: per mode, one psum of the (block_rows, R) layer
         # block over the other axes + Gram/λ allreduce over axis m
         for m in range(nmodes):
@@ -594,25 +608,77 @@ def comm_volume_report(dims_pad: Sequence[int], rank: int, itemsize: int,
             if layer > 1:
                 allred += 2.0 * (layer - 1) / layer * block * rank * acc_itemsize
             allred += 2.0 * rank * rank * acc_itemsize  # gram psum
+        out["allreduce_mb"] = round(allred * mb, 4)
+        return out
+    w = max(int(ndev), 1)
+    gather = reduce_b = allred = hop = 0.0
+    for m in range(nmodes):
+        allred += 2.0 * rank * rank * acc_itemsize
+    if variant in ("ring", "async_ring"):
+        real_hops = (w - 1) if variant == "async_ring" else w
+        for m in range(nmodes):
+            for k in range(nmodes):
+                if k != m:
+                    blk = (dims_pad[k] // w) * rank * itemsize
+                    hop = max(hop, blk)
+                    gather += real_hops * blk
+            if variant == "async_ring":
+                blk = (dims_pad[m] // w) * rank * acc_itemsize
+                hop = max(hop, blk)
+                reduce_b += (w - 1) * blk
+            else:
+                # sync ring reduce: one (block, R) psum per row block
+                reduce_b += 2.0 * (w - 1) / w * dims_pad[m] * rank \
+                    * acc_itemsize
+        out["hops"] = int(real_hops)
+        out["per_hop_mb"] = round(hop * mb, 4)
+        if variant == "async_ring":
+            # every hop streams under a step's compute except the
+            # pipeline fill (the first block must arrive before any
+            # remote compute can start)
+            out["overlap_eligible_frac"] = round((w - 1) / w, 4)
     else:
-        # 1-D nnz sharding: per mode, all_gather every input factor and
-        # psum_scatter the output (the two row-exchange phases)
-        w = max(int(ndev), 1)
+        # 1-D nnz sharding collectives: per mode, all_gather every
+        # input factor and psum_scatter the output
         for m in range(nmodes):
             for k in range(nmodes):
                 if k != m:
                     gather += (w - 1) / w * dims_pad[k] * rank * itemsize
-            scatter += (w - 1) / w * dims_pad[m] * rank * acc_itemsize
-            allred += 2.0 * rank * rank * acc_itemsize
-    mb = 1.0 / (1 << 20)
-    if gather or scatter:
-        lines.append(f"  comm/iter/device: all_gather {gather * mb:.2f}MB  "
-                     f"psum_scatter {scatter * mb:.2f}MB  "
-                     f"allreduce {allred * mb:.2f}MB")
-    else:
-        lines.append(f"  comm/iter/device: layer psum + gram allreduce "
-                     f"{allred * mb:.2f}MB")
-    return lines
+            reduce_b += (w - 1) / w * dims_pad[m] * rank * acc_itemsize
+    out["gather_mb"] = round(gather * mb, 4)
+    out["reduce_mb"] = round(reduce_b * mb, 4)
+    out["allreduce_mb"] = round(allred * mb, 4)
+    return out
+
+
+def comm_volume_report(dims_pad: Sequence[int], rank: int, itemsize: int,
+                       *, ndev: int = None, grid: Sequence[int] = None,
+                       acc_itemsize: int = 4,
+                       variant: str = "all2all") -> list:
+    """Human-readable ``comm/iter/device`` lines over
+    :func:`comm_volume_model` — the model follows the SELECTED comm
+    strategy instead of assuming all2all (ISSUE 8 satellite)."""
+    model = comm_volume_model(dims_pad, rank, itemsize, ndev=ndev,
+                              grid=grid, acc_itemsize=acc_itemsize,
+                              variant=variant)
+    if grid is not None:
+        return [f"  comm/iter/device: layer psum + gram allreduce "
+                f"{model['allreduce_mb']:.2f}MB"]
+    if model["variant"] in ("ring", "async_ring"):
+        tag = ("async ring" if model["variant"] == "async_ring"
+               else "ppermute ring")
+        line = (f"  comm/iter/device [{tag}]: gather "
+                f"{model['gather_mb']:.2f}MB "
+                f"({model['hops']} hops x {model['per_hop_mb']:.2f}MB max) "
+                f" reduce {model['reduce_mb']:.2f}MB  allreduce "
+                f"{model['allreduce_mb']:.2f}MB")
+        if model["variant"] == "async_ring":
+            line += (f"  overlap-eligible "
+                     f"{100 * model['overlap_eligible_frac']:.0f}%")
+        return [line]
+    return [f"  comm/iter/device: all_gather {model['gather_mb']:.2f}MB  "
+            f"psum_scatter {model['reduce_mb']:.2f}MB  "
+            f"allreduce {model['allreduce_mb']:.2f}MB"]
 
 
 def mode_update_tail(M_l, grams_l, m: int, reg: float, first_flag,

@@ -38,6 +38,12 @@ def ring_gather_rows(U_l: jax.Array, idx: jax.Array, axis: str,
     U_l: (block, R) local shard (device d initially holds block d).
     After s forward ppermutes device d holds block (d - s) mod ndev.
     """
+    from splatt_tpu.utils import faults
+
+    # the ring row-exchange fault site covers the sync ring too: a
+    # drill armed past the async engine must land here next and degrade
+    # the sweep to all2all (docs/ring.md fallback ladder)
+    faults.maybe_fail("comm.ring_exchange")
     block = U_l.shape[0]
     my_id = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % ndev) for i in range(ndev)]

@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from splatt_tpu.utils.env import shard_map
 
 from splatt_tpu.config import (CommPattern, Options, Verbosity, default_opts,
-                               resolve_dtype)
+                               resolve_comm_pattern, resolve_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.cpd import init_factors
 from splatt_tpu.kruskal import KruskalTensor
@@ -287,7 +287,14 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
     compilation serves every iteration.  `variant` picks the comm
     primitives for the two row-exchange phases (≙ SPLATT_OPTION_COMM):
     "all2all" = all_gather + psum_scatter, "ring" = ppermute ring
-    (splatt_tpu.parallel.ring) with O(dim/ndev) peak factor memory.
+    (splatt_tpu.parallel.ring) with O(dim/ndev) peak factor memory,
+    "async_ring" = the Pallas remote-copy ring
+    (splatt_tpu.parallel.ring_kernels, docs/ring.md) that overlaps the
+    exchange with the local compute on TPU and keeps the ppermute
+    semantics bit-for-bit elsewhere.  "local_stub" is a TIMING-ONLY
+    variant (measure_ring_overlap): the exchanges are replaced by
+    local reads so a step costs exactly the compute — its outputs are
+    mathematically WRONG and must never reach a driver.
 
     `cells` (shard_blocked_layouts meta; all2all only): the local
     MTTKRP runs the single-chip blocked engine over each shard's
@@ -313,6 +320,48 @@ def make_sharded_sweep(mesh: Mesh, nmodes: int, reg: float,
         def reduce_rows(prod, idx, m):
             return blockwise_reduce_rows(prod, idx, axis, ndev,
                                          dims_pad[m] // ndev)
+    elif variant == "async_ring":
+        from splatt_tpu.parallel.ring_kernels import (
+            async_blockwise_reduce_rows, async_ring_gather_rows)
+
+        def gather_rows(U_l, idx):
+            return async_ring_gather_rows(U_l, idx, axis, ndev)
+
+        def reduce_rows(prod, idx, m):
+            return async_blockwise_reduce_rows(prod, idx, axis, ndev,
+                                               dims_pad[m] // ndev)
+    elif variant == "local_stub":
+        # compute-only baseline for the overlap metric: same per-step
+        # masked passes and reductions, zero inter-device traffic
+        def gather_rows(U_l, idx):
+            block = U_l.shape[0]
+            rows0 = jnp.zeros((idx.shape[0], U_l.shape[1]), U_l.dtype)
+            my_id = jax.lax.axis_index(axis)
+
+            def body(step, rows):
+                shard_id = jnp.mod(my_id - step, ndev)
+                mask = (idx // block) == shard_id
+                local = jnp.where(mask, jnp.mod(idx, block), 0)
+                picked = jnp.take(U_l, local, axis=0, mode="clip")
+                return rows + jnp.where(mask[:, None], picked, 0)
+
+            return jax.lax.fori_loop(0, ndev, body, rows0)
+
+        def reduce_rows(prod, idx, m):
+            block = dims_pad[m] // ndev
+            my_id = jax.lax.axis_index(axis)
+            out_dtype = acc_dtype(prod.dtype)
+
+            def body(jb, acc):
+                mask = (idx // block) == jb
+                p = jax.ops.segment_sum(
+                    (prod * mask[:, None]).astype(out_dtype),
+                    jnp.where(mask, jnp.mod(idx, block), 0),
+                    num_segments=block)
+                return jnp.where(jb == my_id, p, acc)
+
+            acc0 = jnp.zeros((block, prod.shape[1]), dtype=out_dtype)
+            return jax.lax.fori_loop(0, ndev, body, acc0)
     elif variant == "all2all":
         def gather_rows(U_l, idx):
             # ≙ mpi_update_rows: fetch the rows of the other factors
@@ -506,6 +555,203 @@ def make_sharded_profiled_sweep(mesh: Mesh, nmodes: int, reg: float,
     return sweep
 
 
+#: ordered comm-engine fallback chains (docs/ring.md): a failing
+#: strategy degrades CLASSIFIED to the next entry — async ring to the
+#: hop-barriered ppermute ring to the all2all collectives, which have
+#: no preconditions and cannot fail to apply (the terminal engine).
+_COMM_CHAINS = {
+    CommPattern.ALL2ALL: ("all2all",),
+    CommPattern.POINT2POINT: ("ring", "all2all"),
+    CommPattern.ASYNC_RING: ("async_ring", "ring", "all2all"),
+}
+
+
+def comm_chain(comm: CommPattern) -> tuple:
+    """The ordered comm-strategy fallback chain for a requested
+    pattern (best first, terminal last)."""
+    return _COMM_CHAINS[comm]
+
+
+def _comm_shape_key(dims_pad, ndev: int, rank: int, dtype) -> str:
+    """Demotion scope of a comm-engine failure — its own ``:comm``
+    suffix keeps ring demotions disjoint from the MTTKRP engine keys
+    (an async-ring OOM indicts the async ring at this shape, never the
+    all2all path or a compute engine)."""
+    dims = "x".join(str(int(d)) for d in dims_pad)
+    return f"d{dims}:w{ndev}:r{int(rank)}:{jnp.dtype(dtype).name}:comm"
+
+
+def _select_comm_sweep(chain, mesh, nmodes, reg, dims_pad, axis, cells_meta,
+                       inds, vals, cells_dev, factors, grams, dtype, opts):
+    """Build the sweep on the best LIVE comm strategy, probing each
+    non-terminal candidate with one discarded step invocation (the
+    sweep is pure, so the probe costs compute but never state).  A
+    probe failure is classified, demotes ``comm.<variant>`` under the
+    comm shape key (per-shape for RESOURCE/TIMEOUT, process-wide
+    otherwise) and falls to the next strategy with a ``comm_fallback``
+    run-report event — the ladder the ``comm.ring_exchange`` chaos
+    drills assert on.  Returns (variant, step)."""
+    from splatt_tpu import resilience
+
+    ndev = mesh.shape[axis]
+    rank = int(factors[0].shape[1])
+    ckey = _comm_shape_key(dims_pad, ndev, rank, dtype)
+    fallback = (opts.engine_fallback if opts.engine_fallback is not None
+                else resilience.fallback_enabled())
+    # demotion pruning: a previously indicted strategy is skipped, but
+    # the terminal all2all is always live
+    live = [v for v in chain
+            if v == chain[-1]
+            or not resilience.is_demoted(f"comm.{v}", ckey)]
+    for i, variant in enumerate(live):
+        sweep = make_sharded_sweep(mesh, nmodes, reg, dims_pad, axis=axis,
+                                   variant=variant, cells=cells_meta)
+
+        def step(f, g, flag, sweep=sweep):
+            return sweep(inds, vals, f, g, flag, cells_dev)
+
+        if i == len(live) - 1 or not fallback:
+            # terminal (or fallback disabled: fail loudly at the real
+            # first step, not a probe)
+            return variant, step
+        try:
+            probe = step(factors, grams, jnp.asarray(1.0, dtype=dtype))
+            # async failures surface at the fence, not the call
+            jax.block_until_ready(probe[2])
+            return variant, step
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            resilience.demote_engine(f"comm.{variant}", e, shape_key=ckey)
+            resilience.run_report().add(
+                "comm_fallback", strategy=variant, fallback_to=live[i + 1],
+                failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  comm engine {variant} failed ({cls.value}); "
+                      f"falling back to {live[i + 1]}")
+    raise AssertionError("unreachable: the terminal comm engine returns")
+
+
+def _make_exchange_only(mesh, nmodes, dims_pad, axis, rank, dtype,
+                        hops: int):
+    """A jitted program that performs EXACTLY one sweep's ring traffic
+    (every gather leg's hops + the reduce leg) with no MTTKRP compute —
+    the fully-exposed exchange time, i.e. the denominator of the
+    achieved-overlap metric (docs/ring.md).  `hops` follows the variant
+    as it actually runs: ndev ppermutes per leg for the sync ring (and
+    the async variant's CPU fallback), ndev-1 real RDMA hops for the
+    Pallas async ring — an overstated denominator would inflate the
+    reported overlap."""
+    ndev = mesh.shape[axis]
+    factor_specs = tuple([P(axis, None)] * nmodes)
+
+    @partial(shard_map, mesh=mesh, in_specs=(factor_specs,),
+             out_specs=P(axis, None), check_vma=False)
+    def exchange(factors_l):
+        perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+
+        def hop(_, U):
+            return jax.lax.ppermute(U, axis, perm)
+
+        tot = jnp.zeros((1, rank), dtype)
+        for m in range(nmodes):
+            for k in range(nmodes):
+                if k != m:
+                    U = jax.lax.fori_loop(0, hops, hop, factors_l[k])
+                    tot = tot + U[:1]
+            blk = jnp.zeros((dims_pad[m] // ndev, rank),
+                            acc_dtype(jnp.dtype(dtype)))
+            blk = jax.lax.fori_loop(0, hops, hop, blk)
+            tot = tot + blk[:1].astype(dtype)
+        return tot
+
+    return jax.jit(exchange)
+
+
+def measure_ring_overlap(mesh, nmodes, reg, dims_pad, axis, variant,
+                         inds, vals, factors, grams, dtype,
+                         reps: int = 3, step_fn=None) -> dict:
+    """Measure the ACHIEVED comm/compute overlap of a ring sweep
+    (docs/ring.md defines the metric):
+
+        exchange_s  — the sweep's ring traffic alone, fully exposed
+        compute_s   — a "local_stub" sweep step (identical compute,
+                      zero traffic; timing-only — its math is wrong)
+        step_s      — the real sweep step (comm + compute)
+
+        exposed = max(0, step_s - compute_s)
+        hidden  = max(0, exchange_s - exposed)
+        overlap_frac = hidden / exchange_s
+
+    All three run warm (compile excluded, median of `reps`).  The wire
+    model's per-device bytes ride along so MULTICHIP artifacts can put
+    the measured seconds next to the modeled traffic.  On CPU the
+    fallback engines expose every hop — overlap_frac near 0 is the
+    honest reading there, labelled by ``backend``/``engine``.
+
+    `step_fn(factors, grams, flag)`, when the caller already built and
+    compiled the production sweep (sharded_cpd_als did, for its comm
+    probe), is timed directly instead of re-tracing an identical sweep
+    — the real step's compile is not paid twice.
+    """
+    import time as _time
+
+    from splatt_tpu.parallel.common import comm_volume_model
+    from splatt_tpu.parallel.ring_kernels import async_ring_supported
+    from splatt_tpu.utils.env import host_fence
+
+    ndev = mesh.shape[axis]
+    rank = int(factors[0].shape[1])
+    flag = jnp.asarray(0.0, dtype=dtype)
+    if step_fn is None:
+        sweep = make_sharded_sweep(mesh, nmodes, reg, dims_pad, axis=axis,
+                                   variant=variant)
+
+        def step_fn(f, g, fl):
+            return sweep(inds, vals, f, g, fl, ())
+    stub = make_sharded_sweep(mesh, nmodes, reg, dims_pad, axis=axis,
+                              variant="local_stub")
+    rdma = (variant == "async_ring" and ndev >= 2
+            and async_ring_supported())
+    exchange = _make_exchange_only(mesh, nmodes, dims_pad, axis, rank,
+                                   dtype,
+                                   hops=(ndev - 1) if rdma else ndev)
+
+    def timed(fn) -> float:
+        host_fence(fn())  # warm: compile + first run excluded
+        ts = []
+        for _ in range(max(reps, 1)):
+            t0 = _time.perf_counter()
+            host_fence(fn())
+            ts.append(_time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2]
+
+    t_comm = timed(lambda: exchange(tuple(factors)))
+    t_comp = timed(lambda: stub(inds, vals, factors, grams, flag, ())[2])
+    t_step = timed(lambda: step_fn(factors, grams, flag)[2])
+    exposed = max(0.0, t_step - t_comp)
+    hidden = max(0.0, t_comm - exposed)
+    overlap = hidden / t_comm if t_comm > 0 else 0.0
+    model = comm_volume_model(
+        dims_pad, rank, jnp.dtype(dtype).itemsize, ndev=ndev,
+        variant=variant,
+        acc_itemsize=jnp.dtype(acc_dtype(jnp.dtype(dtype))).itemsize)
+    return dict(variant=variant,
+                backend=jax.default_backend(),
+                engine="pallas_rdma" if rdma else "ppermute_fallback",
+                step_s=round(t_step, 6), compute_s=round(t_comp, 6),
+                exchange_s=round(t_comm, 6),
+                exposed_comm_s=round(exposed, 6),
+                hidden_comm_s=round(hidden, 6),
+                overlap_frac=round(overlap, 4),
+                model_mb_per_device=round(
+                    model["gather_mb"] + model["reduce_mb"]
+                    + model["allreduce_mb"], 4),
+                per_hop_mb=model["per_hop_mb"],
+                overlap_eligible_frac=model["overlap_eligible_frac"])
+
+
 def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     opts: Optional[Options] = None,
                     init: Optional[List[jax.Array]] = None,
@@ -516,9 +762,19 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     out_dir: Optional[str] = None,
                     checkpoint_path: Optional[str] = None,
                     checkpoint_every: int = 10,
-                    resume: bool = True) -> KruskalTensor:
+                    resume: bool = True,
+                    measure_overlap: Optional[bool] = None
+                    ) -> KruskalTensor:
     """Distributed CPD-ALS over a device mesh (≙ the mpirun cpd path,
     src/cmds/mpi_cmd_cpd.c:175-338).
+
+    `opts.comm_pattern` (default: SPLATT_COMM, else ALL2ALL) picks the
+    row-exchange strategy; POINT2POINT/ASYNC_RING runs degrade
+    classified down the comm chain (docs/ring.md) and, unless
+    `measure_overlap` is False (None = auto at verbosity >= HIGH;
+    True forces it — the CLI does for --json ring runs), report the
+    achieved comm/compute overlap as a ``ring_overlap`` run-report
+    event.
 
     Results are rank-count invariant: the same seed gives the same
     factors at any device count (≙ mpi_mat_rand, src/splatt_mpi.h:368-386)
@@ -571,24 +827,27 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
     elif row_distribute is not None:
         raise ValueError(f"unknown row_distribute {row_distribute!r}")
 
-    variant = ("ring" if opts.comm_pattern is CommPattern.POINT2POINT
-               else "all2all")
+    comm = resolve_comm_pattern(opts)
+    chain = comm_chain(comm)
+    ring_family = chain[0] != "all2all"
     if local_engine is None:
         # shared auto policy, plus the FINE-only condition: the ring
-        # variant's blockwise reduce is stream-only
+        # variants' blockwise reduce is stream-only
         from splatt_tpu.parallel.common import auto_local_engine
 
-        local_engine = ("stream" if variant == "ring"
+        local_engine = ("stream" if ring_family
                         else auto_local_engine(tt, out_dir))
-    elif local_engine == "blocked" and variant == "ring":
+    elif local_engine == "blocked" and ring_family:
         # never silently ignore an explicit engine request (the ring
-        # sweep is stream-only; make_sharded_sweep has the same guard)
-        raise ValueError("local_engine='blocked' is not supported with "
-                         "the POINT2POINT (ring) comm pattern; use "
-                         "ALL2ALL or local_engine='stream'")
+        # sweeps are stream-only; make_sharded_sweep has the same
+        # guard) — and a comm fallback landing on all2all keeps the
+        # stream engine it started with rather than rebuilding layouts
+        raise ValueError(f"local_engine='blocked' is not supported with "
+                         f"the {comm.value} (ring) comm pattern; use "
+                         f"ALL2ALL or local_engine='stream'")
     cells_meta = None
     cells_dev = ()
-    if local_engine == "blocked" and variant == "all2all":
+    if local_engine == "blocked" and not ring_family:
         cells_meta, cells_dev = shard_blocked_layouts(
             tt, mesh, opts, dims_pad, axis=axis, val_dtype=dtype,
             partition=partition, out_dir=out_dir)
@@ -628,29 +887,68 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
             chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
             counts = np.clip(tt.nnz - chunk * np.arange(ndev), 0, chunk)
         print(imbalance_report(counts, "shard"))
-        for line in comm_volume_report(dims_pad, rank,
-                                       np.dtype(dtype).itemsize, ndev=ndev):
-            print(line)
-    profiled = (opts.verbosity >= Verbosity.HIGH and variant == "all2all")
+    profiled = (opts.verbosity >= Verbosity.HIGH and not ring_family)
     if profiled:
         # split-jit phases with blocking timers: measured gather/mttkrp/
-        # reduce/solve attribution (≙ mpi_time_stats)
+        # reduce/solve attribution (≙ mpi_time_stats); all2all only —
+        # the ring variants' overlap makes phase barriers meaningless,
+        # so they report the achieved-overlap metric instead
+        variant = "all2all"
         sweep = make_sharded_profiled_sweep(mesh, nmodes,
                                             opts.regularization, dims_pad,
                                             dtype, axis=axis,
                                             cells=cells_meta)
-    else:
-        sweep = make_sharded_sweep(mesh, nmodes, opts.regularization,
-                                   dims_pad, axis=axis, variant=variant,
-                                   cells=cells_meta)
 
-    def step(factors, grams, flag):
-        return sweep(inds, vals, factors, grams, flag, cells_dev)
+        def step(factors, grams, flag):
+            return sweep(inds, vals, factors, grams, flag, cells_dev)
 
-    if profiled:
         from splatt_tpu.parallel.common import wrap_profiled_step
 
         step = wrap_profiled_step(step)
+    else:
+        # comm-engine selection with the classified fallback ladder
+        # (docs/ring.md): async_ring -> ring -> all2all
+        variant, step = _select_comm_sweep(
+            chain, mesh, nmodes, opts.regularization, dims_pad, axis,
+            cells_meta, inds, vals, cells_dev, factors, grams, dtype,
+            opts)
+    if opts.verbosity >= Verbosity.HIGH:
+        # the wire model follows the SELECTED strategy, not an all2all
+        # assumption (ISSUE 8 satellite)
+        for line in comm_volume_report(dims_pad, rank,
+                                       np.dtype(dtype).itemsize, ndev=ndev,
+                                       variant=variant):
+            print(line)
+    if variant in ("ring", "async_ring") and measure_overlap is not False \
+            and (measure_overlap or opts.verbosity >= Verbosity.HIGH):
+        # achieved-overlap metric (docs/ring.md): exchange time hidden
+        # vs exposed, next to the wire model's per-device bytes —
+        # reported as a ring_overlap run-report event so `splatt cpd
+        # --json` distributed runs (the CLI passes measure_overlap=True
+        # there) and MULTICHIP artifacts carry the number.  Auto only
+        # at HIGH, like the other startup diagnostics: the measurement
+        # compiles two extra programs and runs ~a dozen step-scale
+        # invocations — not a cost every default run should pay.
+        # Best-effort: a measurement failure must never take down the
+        # run it measures.
+        from splatt_tpu import resilience
+
+        try:
+            ov = measure_ring_overlap(mesh, nmodes, opts.regularization,
+                                      dims_pad, axis, variant, inds, vals,
+                                      factors, grams, dtype, step_fn=step)
+            resilience.run_report().add("ring_overlap", **ov)
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  ring overlap [{ov['engine']}]: "
+                      f"exchange {ov['exchange_s']:.4f}s, "
+                      f"{100 * ov['overlap_frac']:.0f}% hidden "
+                      f"(exposed {ov['exposed_comm_s']:.4f}s of "
+                      f"step {ov['step_s']:.4f}s)")
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            if opts.verbosity >= Verbosity.LOW:
+                print(f"  ring overlap measurement skipped "
+                      f"({cls.value}: {resilience.failure_message(e)[:120]})")
 
     out = run_distributed_als(step, factors, grams, rank, opts, xnormsq,
                               orig_dims, dtype, row_select=relabels,

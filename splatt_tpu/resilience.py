@@ -565,6 +565,21 @@ RUN_REPORT_EVENTS = {
                     "classified error) instead of converging; the "
                     "job's own run report carries the evidence "
                     "(docs/serve.md)",
+    "comm_fallback": "a distributed comm engine failed its probe and "
+                     "the sweep degraded down the comm chain — "
+                     "async_ring -> ring -> all2all — with the failed "
+                     "strategy demoted under its own comm shape key "
+                     "(parallel/sharded.py, docs/ring.md)",
+    "ring_overlap": "achieved comm/compute overlap of a ring-variant "
+                    "distributed sweep: standalone exchange time vs "
+                    "the fraction hidden under the local MTTKRP, next "
+                    "to the wire model's per-device bytes "
+                    "(docs/ring.md; carried into MULTICHIP artifacts "
+                    "and `splatt cpd --json`)",
+    "bench_noisy": "a bench --gate timing comparison was too noisy to "
+                   "judge: the coefficient of variation of one side "
+                   "exceeded the threshold, so the slowdown is a "
+                   "warning, not a gate failure (bench.py)",
 }
 
 
@@ -590,6 +605,21 @@ def record_bench_regression(path: str, sec: float, prior_sec: float,
         "bench_regression", path=path, sec=round(float(sec), 4),
         prior_sec=round(float(prior_sec), 4), pct=round(float(pct), 1),
         prior_file=prior_file)
+
+
+def record_bench_noisy(path: str, cv: float, threshold: float,
+                       sec: float, prior_sec: float,
+                       prior_file: str) -> dict:
+    """Record a ``bench_noisy`` run-report event — the shared emission
+    point bench.py's gate uses when a would-be regression's timing
+    distribution is too noisy to trust (CV above `threshold` on either
+    side): the comparison becomes a loud warning instead of a hard
+    gate failure, so regression verdicts stay verdicts rather than
+    noise (ROADMAP open item 1 remnant)."""
+    return run_report().add(
+        "bench_noisy", path=path, cv=round(float(cv), 4),
+        threshold=round(float(threshold), 4), sec=round(float(sec), 4),
+        prior_sec=round(float(prior_sec), 4), prior_file=prior_file)
 
 
 class RunReport:
@@ -681,6 +711,21 @@ class RunReport:
             lines.append(f"  BENCH REGRESSION on {e['path']}: "
                          f"{e['sec']}s vs {e['prior_sec']}s in "
                          f"{e['prior_file']} (+{e['pct']}%)")
+        for e in self.events("bench_noisy"):
+            lines.append(f"  bench comparison on {e['path']} too noisy "
+                         f"to gate (CV {e['cv']} > {e['threshold']}): "
+                         f"{e['sec']}s vs {e['prior_sec']}s in "
+                         f"{e['prior_file']} — warning, not a verdict")
+        for e in self.events("comm_fallback"):
+            lines.append(f"  comm engine {e['strategy']} degraded to "
+                         f"{e['fallback_to']} ({e['failure_class']}: "
+                         f"{e['error'][:80]})")
+        for e in self.events("ring_overlap"):
+            lines.append(f"  ring overlap [{e.get('engine')}]: "
+                         f"{100 * e.get('overlap_frac', 0):.0f}% of "
+                         f"{e.get('exchange_s')}s exchange hidden under "
+                         f"compute ({e.get('model_mb_per_device')}MB/dev "
+                         f"modeled)")
         for e in self.events("queue_full"):
             lines.append(f"  job {e.get('job')} load-shed: the serve "
                          f"queue was full ({e.get('queue_max')} pending)")

@@ -67,8 +67,11 @@ NNZ_BLOCKS = (1024, 2048, 4096, 8192, 16384)
 SCAN_TARGETS = (1 << 21, 1 << 23, 1 << 25)
 
 #: candidate index widths when the policy is not pinned: the v1 global
-#: encoding and the compact v2 local/segment encoding (docs/format.md)
-IDX_CANDIDATES = ("i32", "auto")
+#: encoding, the compact v2 local/segment encoding, and the u8
+#: segment-id narrowing (docs/format.md) — when a regime's block spans
+#: exceed uint8 the u8 candidate's encode degrades to v1 and collapses
+#: into the i32 candidate (measured once via the seen-dedup)
+IDX_CANDIDATES = ("i32", "auto", "u8")
 
 _AUTOTUNE_ENV = "SPLATT_AUTOTUNE"
 _CACHE_ENV = "SPLATT_TUNE_CACHE"

@@ -75,6 +75,15 @@ ENV_VARS = {
                                     "no --schedule flag is given; same "
                                     "grammar as SPLATT_FAULTS "
                                     "(docs/guarded-als.md)"),
+    "SPLATT_COMM": EnvVar(None, "default row-exchange strategy for "
+                          "FINE-decomposition distributed runs "
+                          "(docs/ring.md): all2all (collectives), "
+                          "point2point (ppermute ring), async_ring "
+                          "(Pallas remote-copy ring with "
+                          "comm/compute overlap; degrades classified "
+                          "point2point -> all2all on failure); an "
+                          "explicit Options.comm_pattern / --comm "
+                          "wins"),
     "SPLATT_PROBE_CACHE": EnvVar(None, "path override for the "
                                  "persistent capability-probe cache "
                                  "(default: tools/probe_cache.json in "
@@ -91,8 +100,12 @@ ENV_VARS = {
                                "uint16 where each mode's block extent "
                                "fits, int32 otherwise, plus int32 "
                                "per-block bases); u16 = v2 requiring "
-                               "uint16 everywhere (encode failure "
-                               "degrades classified to v1)"),
+                               "uint16 everywhere; u8 = v2 with the "
+                               "sorted mode's segment-id stream at "
+                               "uint8 (legal when every block's span "
+                               "fits 255) and the other modes at the "
+                               "auto widths (encode failures degrade "
+                               "classified to v1)"),
     "SPLATT_VAL_STORAGE": EnvVar("auto", "blocked-layout value-storage "
                                  "dtype (docs/format.md): auto = the "
                                  "resolved compute dtype; f32/bf16 pin "

@@ -147,6 +147,15 @@ SITES = {
                      "a raised fault must degrade the build classified "
                      "to the v1 i32 encoding (format_fallback event), "
                      "never fail it",
+    "comm.ring_exchange": "the ring row-exchange of a distributed "
+                          "sweep (parallel/ring_kernels.py: the async "
+                          "remote-copy kernels and their ppermute "
+                          "fallback); a raised fault surfaces at the "
+                          "sweep's first invocation and must degrade "
+                          "CLASSIFIED down the comm chain — "
+                          "async_ring -> ring -> all2all "
+                          "(comm_fallback events, docs/ring.md) — "
+                          "never kill the run",
     "tuner.measure": "one autotuner candidate measurement — warm + "
                      "timed MTTKRP runs of a forced engine (tune.py); "
                      "a crashing measurement must degrade dispatch to "

@@ -22,3 +22,9 @@ def risky_serve():
     faults.maybe_fail("serve.submit")
     faults.maybe_fail("serve.journal_write")
     faults.maybe_fail("serve.job_run")
+
+
+def risky_ring_exchange():
+    # the distributed ring row-exchange hook (parallel/ring_kernels.py
+    # and the ppermute fallback in parallel/ring.py, docs/ring.md)
+    faults.maybe_fail("comm.ring_exchange")

@@ -8,3 +8,10 @@ def degrade_loudly(err):
     resilience.run_report().add(
         "engine_demotion", engine="example",
         failure_class="unknown", error=str(err))
+
+
+def degrade_comm(err):
+    # the comm-engine fallback ladder's evidence (docs/ring.md)
+    resilience.run_report().add(
+        "comm_fallback", strategy="async_ring", fallback_to="ring",
+        failure_class="unknown", error=str(err))

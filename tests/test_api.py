@@ -17,7 +17,12 @@ def test_default_opts_match_reference_defaults():
     assert o.block_alloc is BlockAlloc.TWOMODE
     assert o.priv_threshold == 0.02
     assert o.decomposition is Decomposition.MEDIUM
-    assert o.comm_pattern is CommPattern.ALL2ALL
+    # None = env default: ALL2ALL unless SPLATT_COMM overrides
+    # (docs/ring.md)
+    assert o.comm_pattern is None
+    from splatt_tpu.config import resolve_comm_pattern
+
+    assert resolve_comm_pattern(o) is CommPattern.ALL2ALL
     assert o.random_seed is None  # seed-from-time until resolved
 
 

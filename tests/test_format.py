@@ -355,7 +355,7 @@ def test_tuner_measures_format_candidates():
     finally:
         tune._measure_candidate = orig
     assert {"i32", "auto"} <= {iw for iw, _ in seen}
-    assert res.plans and all(p.idx_width in ("i32", "auto")
+    assert res.plans and all(p.idx_width in ("i32", "auto", "u8")
                              for p in res.plans.values())
 
 
@@ -496,3 +496,117 @@ def test_tuner_bf16_alias_key_written():
                             jnp.float32) is not None
     assert tune.cached_plan(tt.dims, tt.nnz, 0, 3,
                             jnp.bfloat16) is not None
+
+
+# -- u8 segment-id streams (ISSUE 8 satellite, ROADMAP open item 2) ----------
+
+
+def test_u8_segment_stream_bit_parity_all_engines():
+    """idx_width="u8" narrows the sorted mode's segment ids to uint8 —
+    a pure relabeling: bit-identical MTTKRP on every engine family."""
+    from splatt_tpu.config import LayoutFormat as LF
+
+    tt = _tensor()
+    facs = [jnp.asarray(f)
+            for f in init_factors(tt.dims, 5, 0, dtype=jnp.float64)]
+    v1 = build_layout(tt, 0, block=256, val_dtype=np.float64)
+    u8 = build_layout(tt, 0, block=256, val_dtype=np.float64,
+                      fmt=LF(idx="u8"))
+    assert u8.encoding == "v2"
+    assert u8.idx_widths()[0] == "u8"          # the segment stream
+    assert u8.inds[0].dtype == jnp.uint8
+    assert "u8" in u8.format_desc() and "/seg/" in u8.format_desc()
+    assert u8.storage_bytes() < v1.storage_bytes()
+    for path in ("sorted_onehot", "sorted_scatter", "scatter"):
+        a = mttkrp_blocked(v1, facs, 0, path=path, impl="xla")
+        b = mttkrp_blocked(u8, facs, 0, path=path, impl="xla")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for impl, engine in (("xla", "xla_scan"), ("xla", "xla"),
+                         ("pallas_interpret", "unfused_pallas")):
+        a = _mttkrp_blocked_jit(v1, facs, 0, "sorted_onehot", impl,
+                                1 << 21, engine)
+        b = _mttkrp_blocked_jit(u8, facs, 0, "sorted_onehot", impl,
+                                1 << 21, engine)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_u8_overflow_degrades_classified_to_v1():
+    """A block span > 255 under a forced u8 is an encode failure —
+    degraded CLASSIFIED to v1 (format_fallback event), never a crash;
+    "auto" keeps its u16/i32 widths for the same tensor."""
+    from splatt_tpu.config import LayoutFormat as LF
+
+    inds = np.stack([np.arange(1000)] * 3)
+    diag = SparseTensor(inds, np.ones(1000), (1000, 1000, 1000))
+    lay = build_layout(diag, 0, block=1024, val_dtype=np.float64,
+                       fmt=LF(idx="u8"))
+    assert lay.encoding == "v1"
+    evs = resilience.run_report().events("format_fallback")
+    assert evs and evs[-1]["idx_width"] == "u8"
+    auto = build_layout(diag, 0, block=1024, val_dtype=np.float64,
+                        fmt=LF(idx="auto"))
+    assert auto.encoding == "v2" and auto.idx_widths()[0] == "u16"
+
+
+def test_u8_reencode_and_plan_match():
+    """reencode_layout derives the u8 candidate without re-sorting, the
+    requested policy is part of the strict plan match, and the shape
+    key stays v2-scoped."""
+    from splatt_tpu.config import LayoutFormat as LF
+
+    tt = _tensor()
+    facs = [jnp.asarray(f)
+            for f in init_factors(tt.dims, 5, 0, dtype=jnp.float64)]
+    v1 = build_layout(tt, 0, block=256, val_dtype=np.float64)
+    u8 = reencode_layout(v1, LF(idx="u8"))
+    assert u8.encoding == "v2" and u8.idx_width == "u8"
+    assert u8.inds[0].dtype == jnp.uint8
+    np.testing.assert_array_equal(
+        np.asarray(mttkrp_blocked(v1, facs, 0, path="sorted_onehot",
+                                  impl="xla")),
+        np.asarray(mttkrp_blocked(u8, facs, 0, path="sorted_onehot",
+                                  impl="xla")))
+    # strict match: a u8 plan never steers an "auto" layout
+    mk = dict(path="sorted_onehot", engine="xla", scan_target=1 << 21,
+              sec=0.001)
+    plan = tune.TunedPlan(nnz_block=256, idx_width="u8",
+                          val_storage="auto", **mk)
+    auto = reencode_layout(v1, LF(idx="auto"))
+    assert _engine_shape_key(u8, facs, 0).endswith(":v2")
+    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 5, jnp.float64),
+                      {"plan": dataclasses.asdict(plan)})
+    assert _tuned_plan_for(u8, facs, 0, "sorted_onehot",
+                           autotune=True) is not None
+    assert _tuned_plan_for(auto, facs, 0, "sorted_onehot",
+                           autotune=True) is None
+
+
+def test_u8_tuner_candidate_and_compile():
+    """"u8" sits in the unpinned candidate matrix, a pinned u8 tune
+    stores a u8 plan, and BlockedSparse.compile builds at it."""
+    assert "u8" in tune.IDX_CANDIDATES
+    tt = _tensor()
+    opts = Options(random_seed=42, verbosity=Verbosity.NONE,
+                   val_dtype=np.float64, use_pallas=False,
+                   idx_width="u8", val_storage="auto")
+    res = tune.tune(tt, 3, opts=opts, modes=(0,), blocks=(256,),
+                    scan_targets=(1 << 21,), reps=1)
+    assert res.plans[0].idx_width == "u8"
+    bs = BlockedSparse.compile(tt, Options(
+        random_seed=42, verbosity=Verbosity.NONE, val_dtype=np.float64,
+        use_pallas=False, autotune=True, block_alloc=BlockAlloc.ALLMODE),
+        rank=3)
+    lay = bs.layout_for(0)
+    assert lay.idx_width == "u8" and lay.inds[0].dtype == jnp.uint8
+    evs = resilience.run_report().events("format_v2")
+    assert evs and "u8" in evs[-1]["modes"]["0"]
+
+
+def test_u8_registry_and_validation():
+    from splatt_tpu.config import IDX_WIDTHS
+
+    assert "u8" in IDX_WIDTHS
+    Options(idx_width="u8").validate()
+    from splatt_tpu.utils.env import ENV_VARS
+
+    assert "u8" in ENV_VARS["SPLATT_IDX_WIDTH"].doc
