@@ -19,16 +19,23 @@ for the reference's memory-lean policies), SPLATT_BENCH_JIT
 (auto|fused|phased — whole-sweep jit vs. per-phase jits; auto picks
 phased on TPU where the fused program wedges the remote compiler),
 SPLATT_BENCH_SHAPE (nell2 default | enron4 — the 4-mode Enron-shaped
-workload of BASELINE.md row 2), SPLATT_BENCH_PATHS
-("blocked,compact,tuned,stream" default — which representations to
-measure; "compact" is the format-v2 row: local narrow indices +
+workload of BASELINE.md row 2), SPLATT_BENCH_SCENARIO (uniform default
+| zipf:<a> | powerlaw | amazon-like — named nnz-distribution scenarios,
+docs/layout-balance.md; non-uniform scenarios tag the metric string and
+carry per-scenario imbalance stats), SPLATT_BENCH_PATHS
+("blocked,balanced,compact,tuned,stream" default — which
+representations to measure; "balanced" is the load-balanced row:
+nnz-packed fibers with long-fiber splitting (docs/layout-balance.md);
+"compact" is the format-v2 row: local narrow indices +
 segment encoding + bf16 storage (docs/format.md), timed with matching
 bf16 factors; "tuned" runs the splatt-tune autotuner (warm plan cache
-= zero measurement) and times the winning plan — now including format
-candidates — reported with the chosen
+= zero measurement) and times the winning plan — now including format,
+packing and reorder candidates — reported with the chosen
 engine/nnz_block/scan_target/format under "tuned_plan"; "blocked"
 alone skips the slow stream oracle on long-rank configs / scarce chip
-time).
+time), SPLATT_BENCH_GUARD_AB (1 = time cpd_als with the health
+sentinel on/off x donation on/off and record the legs under
+"guard_ab" — ROADMAP open item 1's explicit guard-cost measurement).
 
 Bytes are reported per path from the ENCODED layouts
 (bench_algs.mttkrp_bytes_encoded): ``model_gb_per_path`` carries each
@@ -82,10 +89,78 @@ SHAPES = {
     "enron4": (6066, 5699, 244268, 1176),
 }
 
+# scenario shape presets (docs/layout-balance.md): power-law MODE SIZES
+# (three orders of magnitude between dims) and an Amazon-reviews-like
+# (user x item x word) shape at 1/100 scale
+SCENARIO_SHAPES = {
+    "powerlaw": (131072, 4096, 128),
+    "amazon-like": (48212, 17742, 18051),
+}
+
+#: per-mode zipf exponents of the amazon-like scenario: reviews/user
+#: and reviews/item are heavy power-laws, word frequency is zipfian
+#: but flatter at this truncation
+_AMAZON_EXPONENTS = (1.5, 1.5, 1.2)
+
 
 def synthetic_nell2_like(nnz: int, seed: int = 0):
     """Power-law 3-mode tensor with NELL-2-ish dims (12k × 9k × 29k)."""
     return synthetic_tensor(SHAPES["nell2"], nnz, seed)
+
+
+def synthetic_zipf(dims, nnz: int, a=1.5, seed: int = 0,
+                   exponents=None):
+    """GENUINELY zipf-skewed synthetic tensor: slice popularity per
+    mode follows zipf(a) (the hottest slice holds a macroscopic share
+    of all nonzeros), with hot slices scattered across the index space
+    by a fixed permutation.  Unlike :func:`synthetic_tensor` — whose
+    per-nnz uniform offset destroys the zipf head, leaving an
+    effectively uniform tensor — this is the power-law input the
+    balanced layouts exist for (docs/layout-balance.md)."""
+    from splatt_tpu.coo import SparseTensor
+
+    rng = np.random.default_rng(seed)
+    inds = np.empty((len(dims), nnz), dtype=np.int64)
+    for m, d in enumerate(dims):
+        am = float(exponents[m]) if exponents is not None else float(a)
+        raw = (rng.zipf(am, size=nnz) - 1) % d
+        inds[m] = rng.permutation(d)[raw]
+    vals = rng.random(nnz)
+    return SparseTensor(inds, vals, dims)
+
+
+def scenario_tensor(scenario: str, shape: str, nnz: int, seed: int):
+    """Build the bench tensor for a named scenario → (tt, desc, label).
+
+    `desc` feeds the metric string; `label` is None for the default
+    uniform scenario (metric string byte-identical to prior BENCH
+    artifacts) and the scenario tag otherwise — the regression gate
+    compares same-metric priors only, so scenarios never gate against
+    unlike workloads."""
+    names = {"nell2": "NELL-2-shaped", "enron4": "Enron-shaped"}
+    if scenario in ("", "uniform", None):
+        return (synthetic_tensor(SHAPES[shape], nnz, seed),
+                names[shape], None)
+    if scenario == "zipf" or scenario.startswith("zipf:"):
+        # exact spellings only: a typo like "zipf1.8" must hit the
+        # unknown-scenario error below, not silently bench exponent 1.5
+        a = float(scenario.split(":", 1)[1]) if ":" in scenario else 1.5
+        if not 1.0 < a <= 4.0:
+            raise ValueError(f"zipf exponent must be in (1, 4], got {a}")
+        label = f"zipf{a:g}"
+        return (synthetic_zipf(SHAPES[shape], nnz, a=a, seed=seed),
+                f"{names[shape]} {label}-skewed", label)
+    if scenario == "powerlaw":
+        return (synthetic_zipf(SCENARIO_SHAPES["powerlaw"], nnz, a=1.3,
+                               seed=seed),
+                "power-law-mode-size", "powerlaw")
+    if scenario == "amazon-like":
+        return (synthetic_zipf(SCENARIO_SHAPES["amazon-like"], nnz,
+                               seed=seed, exponents=_AMAZON_EXPONENTS),
+                "Amazon-like review-tensor", "amazon-like")
+    raise ValueError(
+        f"unknown SPLATT_BENCH_SCENARIO {scenario!r}; want uniform, "
+        f"zipf:<a>, powerlaw or amazon-like")
 
 
 def _ref_sec_per_iter(measured: dict, shape: str, nnz: int, rank: int):
@@ -206,6 +281,62 @@ def _run_scaling(devices) -> None:
         raise SystemExit(1)
 
 
+def _guard_ab_legs(tt, rank: int, iters: int, bench_dtype, use_pallas,
+                   alloc) -> dict:
+    """Guard-cost A/B (ROADMAP open item 1): time the full cpd_als
+    driver — the layer the guards actually live in; the raw-sweep
+    timings above never execute them — with the health sentinel
+    on/off x donation on/off, over the same blocked layouts.
+    sec/iter per leg is the median of the per-iteration wall clocks
+    cpd_als prints (first two skipped: compile), recorded under
+    ``guard_ab`` in the bench JSON so the gate — and ROADMAP's r05
+    investigation — can see guard cost explicitly instead of inferring
+    it from cross-PR noise."""
+    import contextlib
+    import io
+    import re
+
+    from splatt_tpu import resilience
+    from splatt_tpu.blocked import BlockedSparse
+    from splatt_tpu.config import Options, Verbosity
+    from splatt_tpu.cpd import cpd_als
+
+    X = BlockedSparse.from_coo(
+        tt, Options(random_seed=7, verbosity=Verbosity.NONE,
+                    val_dtype=bench_dtype, use_pallas=use_pallas,
+                    block_alloc=alloc, autotune=False))
+    legs = {}
+    for retries in (3, 0):
+        for donate in (True, False):
+            label = (f"guard_{'on' if retries else 'off'}:"
+                     f"donate_{'on' if donate else 'off'}")
+            opts = Options(random_seed=7, verbosity=Verbosity.LOW,
+                           val_dtype=bench_dtype, use_pallas=use_pallas,
+                           block_alloc=alloc, autotune=False,
+                           donate_sweep=donate,
+                           max_iterations=iters + 2, tolerance=0.0,
+                           fit_check_every=1)
+            buf = io.StringIO()
+            # a scope per leg: the health budget override rides the
+            # scope (serve's mechanism), and leg demotions/events stay
+            # isolated from the main bench run
+            with resilience.scope(f"bench-{label}",
+                                  health_retries=retries):
+                with contextlib.redirect_stdout(buf):
+                    cpd_als(X, rank, opts=opts)
+            times = sorted(float(s) for s in re.findall(
+                r"its =\s*\d+ \(([0-9.]+)s\)", buf.getvalue())[2:])
+            legs[label] = (round(times[len(times) // 2], 4)
+                           if times else None)
+    on = legs.get("guard_on:donate_on")
+    off = legs.get("guard_off:donate_on")
+    # `on` may legitimately round to 0.0 at smoke scale — only a missing
+    # leg (None) or a zero denominator drops the headline ratio
+    if on is not None and off:
+        legs["guard_overhead_pct"] = round((on / off - 1.0) * 100, 1)
+    return legs
+
+
 #: slowdown threshold of the regression gate: >10% beyond the newest
 #: prior on the same metric flags a bench_regression
 REGRESSION_THRESHOLD = 0.10
@@ -296,6 +427,17 @@ def _bench_regressions(rec: dict, prior: dict,
     theirs_gb = prior.get("model_gb_per_path") or {}
     for path in sorted(set(mine_gb) & set(theirs_gb)):
         pairs.append((f"bytes:{path}", mine_gb[path], theirs_gb[path],
+                      None, None))
+    # achieved balance per path (docs/layout-balance.md): the one-hot
+    # work amplification of the built layouts — a packing/reorder
+    # change that silently re-inflates padded work is a regression
+    # like a bytes inflation, deterministic and never noisy
+    mine_b = (rec.get("imbalance") or {}).get("per_path") or {}
+    theirs_b = (prior.get("imbalance") or {}).get("per_path") or {}
+    for path in sorted(set(mine_b) & set(theirs_b)):
+        pairs.append((f"balance:{path}",
+                      (mine_b[path] or {}).get("work_amp"),
+                      (theirs_b[path] or {}).get("work_amp"),
                       None, None))
     for path, sec, prior_sec, cv_a, cv_b in pairs:
         if not sec or not prior_sec:
@@ -454,11 +596,18 @@ def main(gate: bool = False) -> None:
         print(f"bench: bad SPLATT_BENCH_SHAPE {shape!r}; using nell2",
               file=sys.stderr, flush=True)
         shape = "nell2"
+    scenario = os.environ.get("SPLATT_BENCH_SCENARIO", "uniform")
     _T0 = time.perf_counter()
     # seeds match the tensors the reference was measured on
     # (BASELINE_MEASURED.json description: nell2 seed 0, enron4 seed 1)
-    tt = synthetic_tensor(SHAPES[shape], nnz,
-                          seed=1 if shape == "enron4" else 0)
+    try:
+        tt, scen_desc, scen_label = scenario_tensor(
+            scenario, shape, nnz, seed=1 if shape == "enron4" else 0)
+    except ValueError as e:
+        print(f"bench: {e}; using the uniform scenario",
+              file=sys.stderr, flush=True)
+        tt, scen_desc, scen_label = scenario_tensor(
+            "uniform", shape, nnz, seed=1 if shape == "enron4" else 0)
 
     factors = init_factors(tt.dims, rank, 7, dtype=bench_dtype)
 
@@ -562,12 +711,13 @@ def main(gate: bool = False) -> None:
         jax.clear_caches()
 
     results = {}
-    default_paths = "blocked,compact,tuned,stream"
+    default_paths = "blocked,balanced,compact,tuned,stream"
     raw_paths = [p.strip() for p in
                  os.environ.get("SPLATT_BENCH_PATHS",
                                 default_paths).split(",") if p.strip()]
     paths = [p for p in raw_paths
-             if p in ("blocked", "compact", "stream", "tuned")]
+             if p in ("blocked", "balanced", "compact", "stream",
+                      "tuned")]
     if paths != raw_paths:
         # keep the valid subset rather than silently re-enabling the
         # slow paths the caller asked to skip — inside a hard-timeout
@@ -605,6 +755,11 @@ def main(gate: bool = False) -> None:
     # claim the compact format moves bytes it no longer does
     path_gb = {}
     path_fmt = {}
+    # per-path achieved balance (docs/layout-balance.md): max/mean nnz
+    # and row span per block (worst layout) + the summed one-hot work
+    # amplification — the quantities the balanced packing improves,
+    # and a deterministic --gate leg (balance:<path>) like bytes
+    path_imb = {}
     pallas_ran = (use_pallas is True
                   or (use_pallas is None
                       and jax.default_backend() == "tpu"))
@@ -625,8 +780,20 @@ def main(gate: bool = False) -> None:
         # 2-decimal round would blind the >10% bytes leg at smoke scale
         path_gb[label] = round(gb, 4)
         path_fmt[label] = X.format_summary()
+        per_mode = X.imbalance()
+        path_imb[label] = dict(
+            block_nnz_max_mean=max(d["block_nnz_max_mean"]
+                                   for d in per_mode.values()),
+            span_max_mean=max(d["span_max_mean"]
+                              for d in per_mode.values()),
+            work_amp=round(sum(d["work_amp"]
+                               for d in per_mode.values()), 2),
+            packing=sorted({d["packing"] for d in per_mode.values()}))
         note(f"format[{label}]: {path_fmt[label]} -> "
-             f"{path_gb[label]} GB/iter (achieved bytes)")
+             f"{path_gb[label]} GB/iter (achieved bytes); balance: "
+             f"block nnz max/mean "
+             f"{path_imb[label]['block_nnz_max_mean']}, one-hot work "
+             f"x{path_imb[label]['work_amp']}/nnz")
 
     def record_failure(label, e):
         from splatt_tpu import resilience
@@ -660,6 +827,24 @@ def main(gate: bool = False) -> None:
             results["blocked_xla"] = run(X)
         except Exception as e2:
             record_failure("blocked_xla", e2)
+        release()
+    if "balanced" in paths:
+        # the load-balanced row (docs/layout-balance.md): same sweep,
+        # layouts cut by nnz-balanced fiber packing with long-fiber
+        # splitting — on skewed scenarios the bounded per-block row
+        # span shrinks seg_width (and with it the one-hot work) that
+        # the fixed slicing lets one straggler block inflate
+        try:
+            note("building balanced (nnz-packed fibers) layouts")
+            opts_b = Options(random_seed=7, verbosity=Verbosity.NONE,
+                             val_dtype=bench_dtype, use_pallas=use_pallas,
+                             block_alloc=alloc, autotune=False,
+                             fiber_packing="balanced")
+            X = BlockedSparse.from_coo(tt, opts_b)
+            note_format("balanced", X)
+            results["balanced"] = run(X)
+        except Exception as e:
+            record_failure("balanced", e)
         release()
     if "compact" in paths:
         # the format-v2 row (docs/format.md): same sweep, layouts
@@ -736,10 +921,9 @@ def main(gate: bool = False) -> None:
     except (OSError, json.JSONDecodeError):
         pass
 
-    names = {"nell2": "NELL-2-shaped", "enron4": "Enron-shaped"}
     platform = jax.devices()[0].platform
     rec = {
-        "metric": f"CPD-ALS sec/iteration, synthetic {names[shape]} "
+        "metric": f"CPD-ALS sec/iteration, synthetic {scen_desc} "
                   f"({tt.nmodes}-mode, {nnz} nnz, rank {rank}, "
                   f"{jnp.dtype(factors[0].dtype).name}) on {platform}; "
                   f"baseline: reference 1-thread CPU same tensor",
@@ -755,6 +939,41 @@ def main(gate: bool = False) -> None:
                                        "cv") if s in v}
                          for k, v in results.items()},
     }
+    if scen_label is not None:
+        rec["scenario"] = scen_label
+    # per-scenario imbalance stats (docs/layout-balance.md): slice skew
+    # of the input, nnz per equal row fence at 8 shards (what a
+    # distributed run would see), and each path's achieved block
+    # balance — deterministic numbers the --gate compares via the
+    # balance:<path> legs.  per_path is recorded OUTSIDE the try: it
+    # arms the balance gate legs, and an unrelated skew-stat failure
+    # must not silently disarm a regression gate (the bytes-legs
+    # precedent)
+    rec["imbalance"] = {"per_path": dict(path_imb)} if path_imb else {}
+    try:
+        from splatt_tpu.stats import skew_stats
+        from splatt_tpu.utils.env import max_mean_ratio
+
+        st = skew_stats(tt)
+        shard8 = {}
+        for m in range(tt.nmodes):
+            hist = tt.mode_histogram(m)
+            cap = -(-tt.dims[m] // 8)
+            fences = np.add.reduceat(
+                np.concatenate([hist, np.zeros(cap * 8 - tt.dims[m],
+                                               dtype=hist.dtype)]),
+                np.arange(0, cap * 8, cap))
+            shard8[str(m)] = max_mean_ratio(fences)
+        rec["imbalance"].update(
+            slices={m: d["max_mean"] for m, d in st["modes"].items()},
+            slice_p99_median={m: d["p99_median"]
+                              for m, d in st["modes"].items()},
+            shard8_max_mean=shard8)
+    except Exception as e:
+        print(f"bench: imbalance stats skipped ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+    if not rec["imbalance"]:
+        del rec["imbalance"]
     if path_errors:
         # failed paths ride along classified: `{"error": <class>: msg}`
         # per path, so the artifact records WHY a row is missing
@@ -764,6 +983,18 @@ def main(gate: bool = False) -> None:
         # the tuner's chosen plan rides along with the "tuned" timing so
         # the BENCH trajectory can attribute wins to tuning
         rec["tuned_plan"] = tuned_plan_info
+    if os.environ.get("SPLATT_BENCH_GUARD_AB", "").strip() == "1":
+        # guard-cost A/B legs (ROADMAP open item 1; docs/guarded-als.md)
+        try:
+            note("guard A/B: timing cpd_als with health sentinel "
+                 "on/off x donation on/off")
+            rec["guard_ab"] = _guard_ab_legs(tt, rank, iters, bench_dtype,
+                                             use_pallas, alloc)
+            note(f"guard A/B: {rec['guard_ab']}")
+        except Exception as e:
+            print(f"bench: guard A/B skipped ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
+        release()
     try:
         # first-order roofline: one iteration = nmodes MTTKRPs' HBM
         # traffic against the measured sec/iter — shows headroom next

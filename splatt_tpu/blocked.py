@@ -28,7 +28,7 @@ mirroring CSF's root vs. internal/leaf mode traversals.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -101,6 +101,25 @@ class ModeLayout:
                                        metadata=dict(static=True))
     val_storage: str = dataclasses.field(default="auto",
                                          metadata=dict(static=True))
+    #: (nblocks,) int32 REAL nonzeros per block, or None for the fixed
+    #: packing (real entries are then the first ``nnz`` positions).
+    #: Balanced packing (docs/layout-balance.md) pads mid-stream blocks,
+    #: so the real-entry mask is per-block, not a prefix.
+    block_nnz: Optional[jax.Array] = None
+    #: fiber-packing policy this layout was built under ("fixed" |
+    #: "balanced") — part of the autotuner plan match, like idx_width
+    packing: str = dataclasses.field(default="fixed",
+                                     metadata=dict(static=True))
+    #: reorder recipe the tensor was relabeled with before this build
+    #: ("identity" when none, docs/layout-balance.md) — plan matching
+    #: and the demotion scope key both carry it
+    reorder: str = dataclasses.field(default="identity",
+                                     metadata=dict(static=True))
+    #: slice-skew bucket of the sorted mode (nnz_skew_bucket), part of
+    #: the autotuner's regime key so plans tuned on uniform inputs
+    #: never steer power-law ones ("" = unclassified legacy layout)
+    skew: str = dataclasses.field(default="",
+                                  metadata=dict(static=True))
 
     @property
     def nnz_pad(self) -> int:
@@ -155,6 +174,19 @@ class ModeLayout:
         bases = None if self.base is None else list(self.base)
         return streams, bases
 
+    def real_mask(self) -> np.ndarray:
+        """(nblocks, block) bool HOST mask of real (non-pad) entries —
+        the fixed packing's reals are the first ``nnz`` positions, the
+        balanced packing's are each block's first ``block_nnz[b]``
+        slots.  Host-side (encode/stats); the engines never need it
+        (pads are additive identities by construction)."""
+        nb, B = self.nblocks, self.block
+        if self.block_nnz is None:
+            real = np.zeros(nb * B, dtype=bool)
+            real[:self.nnz] = True
+            return real.reshape(nb, B)
+        return real_mask_from_counts(B, self.block_nnz)
+
     def idx_widths(self) -> List[str]:
         """Per-mode stored index width ("u8"/"u16"/"i32") — the
         ACHIEVED encoding, next to the requested ``idx_width`` policy."""
@@ -183,6 +215,8 @@ class ModeLayout:
         else:
             idx = sum(a.size * a.dtype.itemsize for a in self.inds)
             idx += sum(b.size * b.dtype.itemsize for b in self.base)
+        if self.block_nnz is not None:
+            idx += self.block_nnz.size * self.block_nnz.dtype.itemsize
         return (idx + self.vals.size * self.vals.dtype.itemsize
                 + self.row_start.size * self.row_start.dtype.itemsize)
 
@@ -192,11 +226,14 @@ class ModeLayout:
         # degrade a failed v2 encode to v1), so surface both instead of
         # the dataclass default repr dumping whole device arrays —
         # demotion/tune log lines must distinguish v1 from v2 plans
+        extra = "" if self.packing == "fixed" else f", pack={self.packing}"
+        if self.reorder != "identity":
+            extra += f", reorder={self.reorder}"
         return (f"ModeLayout(mode={self.mode}, dim={self.dim}, "
                 f"block={self.block}, seg_width={self.seg_width}, "
                 f"nnz={self.nnz}, nnz_pad={self.nnz_pad}, "
                 f"nblocks={self.nblocks}, enc={self.encoding}"
-                f"[{self.format_desc()}])")
+                f"[{self.format_desc()}]{extra})")
 
 
 def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
@@ -226,8 +263,161 @@ def secondary_order(dims, mode: int, policy: "ModeOrder" = None,
     raise ValueError(f"unknown mode order {policy!r}")
 
 
+def real_mask_from_counts(block: int, counts) -> np.ndarray:
+    """(nblocks, block) bool mask of real (non-pad) entries from
+    per-block real counts — THE pad contract of the balanced packing
+    (each block's reals are its first ``counts[b]`` slots,
+    docs/layout-balance.md), defined once so the encoder, the
+    build-time stats and :meth:`ModeLayout.real_mask` can never
+    disagree about which slots are padding."""
+    counts = np.asarray(counts, dtype=np.int64)
+    return np.arange(block, dtype=np.int64)[None, :] < counts[:, None]
+
+
+def nnz_skew_bucket(hist: np.ndarray) -> str:
+    """Power-of-two bucket of a mode's slice skew: ``k<n>`` where n =
+    bit_length of the max/mean nnz-per-nonempty-slice ratio.  k0/k1 ≈
+    uniform, k4+ ≈ power-law.  Coarse on purpose — it extends the
+    autotuner's shape regime (tune.shape_regime) so a plan measured on
+    a uniform tensor never steers a zipf one, without fragmenting the
+    cache per tensor."""
+    hist = np.asarray(hist)
+    hist = hist[hist > 0]
+    if hist.size == 0:
+        return "k0"
+    ratio = float(hist.max()) / float(hist.mean())
+    return f"k{int(max(ratio, 1.0)).bit_length()}"
+
+
+def plan_balanced_blocks(rows: np.ndarray, block: int, dim: int,
+                         span_caps: Optional[Sequence] = None):
+    """nnz-balanced fiber packing of a sorted row stream into fixed-size
+    blocks (docs/layout-balance.md).
+
+    The fixed policy cuts the sorted stream every `block` nonzeros, so
+    a block landing on a run of tiny fibers can span thousands of
+    output rows — and ``seg_width`` (a layout-wide max) then inflates
+    the one-hot contraction for EVERY block.  This planner instead cuts
+    at fiber boundaries under two caps — the nnz budget ``block`` and a
+    row-span cap — padding underfull blocks, and SPLITS any fiber
+    hotter than the budget across consecutive blocks (span 1 each); the
+    split partials are summed by the same block-level segmented
+    reduction that already combines straddling fibers, so no new
+    combine step exists (≙ chains-on-chains partitioning +
+    p_find_layer_boundaries of the reference; the nnz-balanced binning
+    of the GPU load-balancing line, PAPERS.md arXiv 1904.03329).
+
+    The span cap is chosen empirically from a cost model: total one-hot
+    work ∝ nblocks(W) x seg_width(W); candidates are powers of two
+    (plus uncapped pure-budget packing), cheapest wins.
+
+    Args: rows — (nnz,) nondecreasing sorted-mode row ids; block — nnz
+    budget B per block; dim — the mode's dimension.  Returns (starts,
+    counts, seg_span): per-block start positions into the sorted
+    stream, per-block real-nnz counts (<= B), and the max achieved
+    row span.
+    """
+    nnz = int(rows.shape[0])
+    B = int(block)
+    if nnz == 0:
+        return (np.zeros(1, dtype=np.int64), np.zeros(1, dtype=np.int64), 1)
+    rows = np.asarray(rows, dtype=np.int64)
+    starts_f = np.flatnonzero(
+        np.concatenate([[True], rows[1:] != rows[:-1]]))
+    run_rows = rows[starts_f]                       # row id per fiber
+    bounds = np.concatenate([starts_f, [nnz]])      # (nfibers + 1,)
+    nruns = int(run_rows.shape[0])
+
+    def simulate(W, materialize=False, max_blocks=None):
+        # Cut rule: a block fills to its full B budget — splitting the
+        # straddling fiber, which adds NO rows to either block — unless
+        # the span cap closes it first at a fiber boundary.  W=None is
+        # therefore exactly the fixed slicing (the balance baseline);
+        # tighter caps trade padding (only where runs of distinct tiny
+        # fibers hit the cap) for span.  `max_blocks` aborts a cap the
+        # MIN_FILL floor will discard anyway (fill can no longer reach
+        # it) — without this, a tight cap over ~1-nnz-per-row data
+        # walks a Python loop step per ~W nonzeros at full-tensor
+        # scale just to produce a plan the floor rejects.
+        pos = 0
+        nb = 0
+        max_span = 1
+        out_starts = [] if materialize else None
+        out_counts = [] if materialize else None
+        while pos < nnz:
+            row0 = int(rows[pos])
+            # furthest position the span cap allows: the start of the
+            # first fiber whose row falls outside [row0, row0 + W)
+            if W is None:
+                e_span = nnz
+            else:
+                rj = int(np.searchsorted(run_rows, row0 + W, side="left"))
+                e_span = int(bounds[rj]) if rj < nruns else nnz
+            # e_span > pos always: the fiber at pos has row row0 < row0+W
+            end = min(pos + B, e_span)
+            nb += 1
+            if max_blocks is not None and nb > max_blocks:
+                return None, None  # infeasible: fill cannot reach the floor
+            max_span = max(max_span, int(rows[end - 1]) - row0 + 1)
+            if materialize:
+                out_starts.append(pos)
+                out_counts.append(end - pos)
+            pos = end
+        if materialize:
+            return (np.asarray(out_starts, dtype=np.int64),
+                    np.asarray(out_counts, dtype=np.int64), max_span)
+        return nb, max_span
+
+    if span_caps is None:
+        fixed_span = int(rows[-1]) - int(rows[0]) + 1
+        # None (pure fiber-aligned budget packing, fewest blocks) first
+        # and caps descending: on a cost TIE the fewer-block plan wins
+        # — same one-hot MACs, less index/value padding traffic
+        caps, W = [None], 8
+        while W < min(fixed_span, dim if dim > 0 else 1):
+            caps.insert(1, W)
+            W *= 2
+    else:
+        caps = list(span_caps)
+    # Feasibility floor: blocks must stay >= MIN_FILL full — the
+    # balance CONTRACT (max/mean real nnz per block <= ~1.1, since
+    # max = B and mean = fill x B) and the bytes bound (padding
+    # inflates every stream by < 1/MIN_FILL).  A span cap so tight
+    # that runs of 1-nnz fibers leave blocks mostly padding is
+    # infeasible, however small its one-hot work looks — the padded
+    # gather/Hadamard lanes and the inflated streams would eat the
+    # win.  Within the feasible caps, minimize the one-hot work:
+    # blocks x (padded span + a per-block overhead pricing the B-wide
+    # pad-lane traffic).
+    MIN_FILL = 0.91
+    # a cap producing more blocks than this can never meet the floor;
+    # W=None is exempt (fewest blocks possible — it IS the fallback)
+    feasible_nb = int(nnz / (MIN_FILL * B)) + 1
+    best_cap, best_cost, best_fill_cap, best_fill = None, None, None, -1.0
+    for W in caps:
+        nb, span = simulate(
+            W, max_blocks=None if W is None else feasible_nb)
+        if nb is None:
+            continue  # aborted: provably under the fill floor
+        fill = nnz / float(nb * B)
+        if fill > best_fill:
+            best_fill_cap, best_fill = W, fill
+        if fill < MIN_FILL:
+            continue
+        cost = nb * (_ceil_to(min(span, dim if dim > 0 else 1), 8) + 8)
+        if best_cost is None or cost < best_cost:
+            best_cap, best_cost = W, cost
+    if best_cost is None:
+        # no cap meets the fill floor (pathological fiber sizes, or a
+        # block budget dwarfing the tensor): take the fullest plan —
+        # balance degrades toward the fixed slicing, never below it
+        best_cap = best_fill_cap
+    return simulate(best_cap, materialize=True)
+
+
 def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
-               block: int, nnz: int, fmt: LayoutFormat):
+               block: int, nnz: int, fmt: LayoutFormat,
+               real: Optional[np.ndarray] = None):
     """Encode sorted+padded GLOBAL (nmodes, nnz_pad) int32 coordinates
     into the v2 compact streams: per-mode LOCAL within-block indices at
     the narrowest width that fits (uint16 when the mode's maximum
@@ -254,9 +444,16 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
     nb = nnz_pad // block
     u8_max = int(np.iinfo(np.uint8).max)
     u16_max = int(np.iinfo(np.uint16).max)
-    real = np.zeros(nnz_pad, dtype=bool)
-    real[:nnz] = True
-    real = real.reshape(nb, block)
+    if real is None:
+        # fixed packing: real entries are the stream prefix.  Balanced
+        # layouts pad mid-stream blocks, so callers pass the per-block
+        # mask (ModeLayout.real_mask) instead.
+        real = np.zeros(nnz_pad, dtype=bool)
+        real[:nnz] = True
+        real = real.reshape(nb, block)
+    else:
+        real = np.asarray(real, dtype=bool).reshape(nb, block)
+    any_pad = not real.all()
     locs, bases = [], []
     for k in range(nmodes):
         rows = inds[k].reshape(nb, block)
@@ -268,7 +465,7 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
             base[base == np.iinfo(np.int32).max] = 0
             base = base.astype(np.int32)
         loc = rows - base[:, None]
-        if nnz < nnz_pad:
+        if any_pad:
             if k == mode:
                 # clamp pads to the block's max real segment id (0 for
                 # all-pad blocks, whose base is already the sentinel)
@@ -296,10 +493,73 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
     return locs, bases
 
 
+def _pack_balanced(sinds: np.ndarray, svals: np.ndarray, mode: int,
+                   block: int, dim: int, val_dtype):
+    """Materialize the balanced packing of an already-sorted nonzero
+    stream: padded (nmodes, nblocks*block) global int32 indices, vals,
+    row_start and per-block real counts (docs/layout-balance.md).
+
+    Pad slots are additive identities placed to keep every engine
+    contract truthful: the sorted mode's pads repeat the block's LAST
+    real row (the global stream stays nondecreasing for
+    ``indices_are_sorted``, and the one-hot matches a lane whose value
+    is zero), other modes' pads point at row 0 with value 0.
+    """
+    nmodes, nnz = sinds.shape
+    starts, counts, span = plan_balanced_blocks(sinds[mode], block, dim)
+    nb = int(starts.shape[0])
+    offs = np.arange(block, dtype=np.int64)[None, :]
+    sel = starts[:, None] + offs                      # (nb, B) positions
+    valid = offs < counts[:, None]
+    take = np.clip(np.where(valid, sel, 0), 0, max(nnz - 1, 0)).reshape(-1)
+    mask = valid.reshape(-1)
+    inds = sinds[:, take].astype(np.int32)
+    last_row = sinds[mode][starts + counts - 1]       # (nb,) last real row
+    for k in range(nmodes):
+        pad_val = np.repeat(last_row, block) if k == mode else 0
+        inds[k] = np.where(mask, inds[k], pad_val)
+    vals = np.where(mask, svals[take], 0).astype(np.dtype(val_dtype))
+    row_start = sinds[mode][starts].astype(np.int32)
+    return inds, vals, row_start, counts.astype(np.int32), span
+
+
+def _record_imbalance(mode: int, packing: str, block: int, seg_width: int,
+                      hist: np.ndarray, counts: np.ndarray,
+                      spans: np.ndarray, nnz: int, verbose: bool) -> None:
+    """One ``layout_imbalance`` run-report event per layout build: the
+    achieved balance of the layout (max/mean real nnz per block and
+    row span per block), the input's slice skew, and the one-hot work
+    amplification (padded MACs per real nonzero) — the quantities the
+    balanced packing exists to improve, made observable next to the
+    plan (``splatt cpd --json`` / bench carry them)."""
+    from splatt_tpu import resilience
+
+    from splatt_tpu.utils.env import max_mean_ratio as max_mean
+
+    hist = hist[hist > 0]
+    counts = np.asarray(counts)
+    spans = np.asarray(spans)
+    work_amp = (len(counts) * seg_width * block / max(nnz, 1))
+    resilience.run_report().add(
+        "layout_imbalance", mode=mode, packing=packing, block=block,
+        seg_width=seg_width, nblocks=len(counts),
+        slice_max_mean=max_mean(hist),
+        block_nnz_max_mean=max_mean(counts),
+        span_max_mean=max_mean(spans),
+        work_amp=round(work_amp, 2))
+    if verbose:
+        print(f"  layout mode{mode} [{packing}]: block nnz max/mean "
+              f"{max_mean(counts)}, span max/mean {max_mean(spans)}, "
+              f"seg_width {seg_width}, one-hot work x{work_amp:.1f}/nnz")
+
+
 def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
                  val_dtype=np.float32, mode_order=None,
                  mode_order_custom=None, verbose: bool = False,
-                 fmt: Optional[LayoutFormat] = None) -> ModeLayout:
+                 fmt: Optional[LayoutFormat] = None,
+                 packing: str = "fixed",
+                 reorder_label: str = "identity",
+                 record_stats: bool = True) -> ModeLayout:
     """Sort, block and pad the tensor for output mode `mode`.
 
     ≙ csf_alloc's sort + fiber build (src/csf.c:613-726); the secondary
@@ -314,16 +574,29 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     encode that fails (the ``format.encode`` fault site, or a forced
     u16 that does not fit) degrades CLASSIFIED to v1 — recorded as a
     ``format_fallback`` run-report event, never a failed build.
+
+    `packing` picks the block-cut policy (docs/layout-balance.md):
+    "fixed" slices the sorted stream every `block` nonzeros; "balanced"
+    bin-packs fibers by nnz weight with long-fiber splitting, bounding
+    each block's row span.  A failed balanced pack (the ``layout.pack``
+    fault site) degrades CLASSIFIED to the fixed slicing
+    (``packing_fallback`` event) — never a failed build.
+    `reorder_label` stamps the relabeling recipe the caller applied
+    before this build (plan matching and demotion scoping carry it).
     """
     nmodes, nnz = tt.nmodes, tt.nnz
     from splatt_tpu.utils.env import check_int32_dims
 
     check_int32_dims(tt.dims)
     fmt = (fmt or LayoutFormat()).validate()
+    if packing not in ("fixed", "balanced"):
+        raise ValueError(f"unknown packing {packing!r}")
     others = secondary_order(tt.dims, mode, mode_order, mode_order_custom)
     order = [mode] + others
     perm = tt.sort_order(order)
     dim = tt.dims[mode]
+    hist = tt.mode_histogram(mode)
+    skew = nnz_skew_bucket(hist)
 
     # Don't let the block dwarf a small tensor: clamp to the padded nnz
     # count (kept a multiple of 128 for lane alignment).
@@ -343,38 +616,93 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
         if verbose:
             print(f"  layout mode{mode} [{fmt.idx}/{fmt.val}]: requested "
                   f"nnz_block {requested} clamped to {block} (nnz={nnz})")
-    nnz_pad = max(block, _ceil_to(nnz, block))
-    nblocks = nnz_pad // block
-    inds = np.zeros((nmodes, nnz_pad), dtype=np.int32)
-    inds[:, :nnz] = tt.inds[:, perm]
-    inds[mode, nnz:] = dim  # sentinel row for padding
-    vals = np.zeros(nnz_pad, dtype=np.dtype(val_dtype))
-    vals[:nnz] = tt.vals[perm]
 
-    rows = inds[mode].reshape(nblocks, block)
-    row_start = rows[:, 0].astype(np.int32)
-    span = int((rows[:, -1] - rows[:, 0]).max()) + 1 if nnz else 1
+    block_nnz = None
+    if packing == "balanced" and nnz > 0:
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        try:
+            faults.maybe_fail("layout.pack")
+            sinds = tt.inds[:, perm].astype(np.int64)
+            svals = np.asarray(tt.vals)[perm]
+            inds, vals, row_start, block_nnz, span = _pack_balanced(
+                sinds, svals, mode, block, dim, val_dtype)
+            nblocks = int(row_start.shape[0])
+        except Exception as e:
+            # a failed balanced pack must degrade the BUILD, not kill
+            # it: classify, report, fall back to the fixed slicing
+            cls = resilience.classify_failure(e)
+            resilience.run_report().add(
+                "packing_fallback", mode=mode, failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
+            if verbose:
+                print(f"  layout mode{mode}: balanced packing failed "
+                      f"({cls.value}); falling back to fixed slicing")
+            packing, block_nnz = "fixed", None
+    elif packing == "balanced":
+        packing = "fixed"  # empty tensor: nothing to balance
+
+    if block_nnz is None:
+        nnz_pad = max(block, _ceil_to(nnz, block))
+        nblocks = nnz_pad // block
+        inds = np.zeros((nmodes, nnz_pad), dtype=np.int32)
+        inds[:, :nnz] = tt.inds[:, perm]
+        inds[mode, nnz:] = dim  # sentinel row for padding
+        vals = np.zeros(nnz_pad, dtype=np.dtype(val_dtype))
+        vals[:nnz] = tt.vals[perm]
+        rows = inds[mode].reshape(nblocks, block)
+        row_start = rows[:, 0].astype(np.int32)
+        span = int((rows[:, -1] - rows[:, 0]).max()) + 1 if nnz else 1
     # Padding sentinels in the last real block can inflate its span; the
     # one-hot simply never matches those lanes (vals are zero anyway), so
     # clamp to the widest span a block of real rows can have.
     seg_width = _ceil_to(min(span, dim if dim > 0 else 1), 8)
 
+    if record_stats:
+        # the autotuner's candidate builds skip this (record_stats=
+        # False): dozens of throwaway layouts per tune would bury the
+        # production builds' balance evidence in the run report
+        rows_b = inds[mode].reshape(nblocks, block)
+        counts_b = (np.asarray(block_nnz) if block_nnz is not None
+                    else np.minimum(np.maximum(
+                        nnz - block * np.arange(nblocks), 0), block))
+        # spans over REAL entries only (each block's reals are its
+        # prefix under both packings): pad sentinels carry row id
+        # `dim`, which would inflate the reported span by orders of
+        # magnitude on a tensor occupying a small prefix of its index
+        # space — imbalance() masks the same way, and the two
+        # advertised-as-identical stats must agree
+        realm = real_mask_from_counts(block, counts_b)
+        hi = np.where(realm, rows_b, -1).max(axis=1)
+        lo = np.where(realm, rows_b, dim).min(axis=1)
+        spans_b = np.where(counts_b > 0, hi - lo + 1, 1)
+        _record_imbalance(mode, packing, block, seg_width, hist, counts_b,
+                          np.minimum(spans_b, dim if dim > 0 else 1), nnz,
+                          verbose)
+
+    statics = dict(mode=mode, dim=dim, block=block, seg_width=seg_width,
+                   nnz=nnz, packing=packing, reorder=reorder_label,
+                   skew=skew)
+    bnz = None if block_nnz is None else jnp.asarray(block_nnz)
     if fmt.v2:
         from splatt_tpu import resilience
         from splatt_tpu.utils import faults
 
         try:
             faults.maybe_fail("format.encode")
+            real = None
+            if block_nnz is not None:
+                real = real_mask_from_counts(block, block_nnz)
             locs, bases = _encode_v2(inds, row_start, mode, block, nnz,
-                                     fmt)
+                                     fmt, real=real)
             return ModeLayout(
                 inds=tuple(jnp.asarray(l) for l in locs),
                 vals=jnp.asarray(vals),
                 row_start=jnp.asarray(row_start),
-                mode=mode, dim=dim, block=block, seg_width=seg_width,
-                nnz=nnz,
                 base=tuple(jnp.asarray(b) for b in bases),
-                idx_width=fmt.idx, val_storage=fmt.val)
+                idx_width=fmt.idx, val_storage=fmt.val,
+                block_nnz=bnz, **statics)
         except Exception as e:
             # a failed v2 encode must degrade the BUILD, not kill it:
             # classify, report, and fall through to the v1 encoding the
@@ -393,13 +721,10 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
         inds=jnp.asarray(inds),
         vals=jnp.asarray(vals),
         row_start=jnp.asarray(row_start),
-        mode=mode,
-        dim=dim,
-        block=block,
-        seg_width=seg_width,
-        nnz=nnz,
         idx_width="i32",
         val_storage=fmt.val,
+        block_nnz=bnz,
+        **statics,
     )
 
 
@@ -428,7 +753,7 @@ def reencode_layout(layout: ModeLayout, fmt: LayoutFormat,
         locs, bases = _encode_v2(np.asarray(layout.inds),
                                  np.asarray(layout.row_start),
                                  layout.mode, layout.block, layout.nnz,
-                                 fmt)
+                                 fmt, real=layout.real_mask())
         return dataclasses.replace(
             layout, vals=vals,
             inds=tuple(jnp.asarray(l) for l in locs),
@@ -457,6 +782,12 @@ class BlockedSparse:
     dims: Tuple[int, ...]
     nnz: int
     opts: Options
+    #: the relabeling applied before the layouts were built (None =
+    #: identity; docs/layout-balance.md).  Factors computed over this
+    #: BlockedSparse live in RELABELED row space — cpd_als restores
+    #: original order on output via Permutation.undo_factors.
+    perm: Optional[object] = None     # reorder.Permutation
+    reorder: str = "identity"         # the recipe perm was computed by
 
     @property
     def nmodes(self) -> int:
@@ -477,10 +808,44 @@ class BlockedSparse:
             parts.append(f"mode{lay.mode}={lay.format_desc()}")
         return " ".join(parts)
 
+    def imbalance(self) -> Dict[str, dict]:
+        """Per-build-mode achieved-balance stats recomputed from the
+        layouts (host copies — bench-time cost): real nnz per block and
+        row span per block as max/mean, plus the one-hot work
+        amplification.  The same quantities ``layout_imbalance``
+        events record at build time (docs/layout-balance.md)."""
+        out = {}
+        for lay in self.layouts:
+            real = lay.real_mask()
+            counts = real.sum(axis=1)
+            # lay.inds[lay.mode] is one stream under BOTH encodings (a
+            # device slice for v1, a tuple entry for v2) — only the
+            # sorted mode's stream crosses to host
+            rows = np.asarray(lay.inds[lay.mode]).reshape(
+                lay.nblocks, lay.block).astype(np.int64)
+            if lay.encoding == "v2":
+                rows = rows + np.asarray(lay.base[lay.mode])[:, None]
+            rows = np.where(real, rows, rows.min(axis=1, keepdims=True))
+            spans = np.minimum(rows.max(axis=1) - rows.min(axis=1) + 1,
+                               lay.dim if lay.dim > 0 else 1)
+
+            from splatt_tpu.utils.env import max_mean_ratio as mm
+
+            out[f"mode{lay.mode}"] = dict(
+                packing=lay.packing, nblocks=lay.nblocks,
+                seg_width=lay.seg_width,
+                block_nnz_max_mean=mm(counts),
+                span_max_mean=mm(spans),
+                work_amp=round(lay.nblocks * lay.seg_width * lay.block
+                               / max(lay.nnz, 1), 2))
+        return out
+
     @staticmethod
     def from_coo(tt: SparseTensor, opts: Optional[Options] = None,
                  tuned_blocks: Optional[Dict[int, int]] = None,
-                 tuned_formats: Optional[Dict[int, LayoutFormat]] = None
+                 tuned_formats: Optional[Dict[int, LayoutFormat]] = None,
+                 tuned_packings: Optional[Dict[int, str]] = None,
+                 reorder_label: str = "identity"
                  ) -> "BlockedSparse":
         """Compile a COO tensor into blocked layouts per the alloc policy.
 
@@ -504,11 +869,15 @@ class BlockedSparse:
         factor dtype from it): the explicit/env policy wins, else a
         unanimous tuned-format verdict.
         """
+        from splatt_tpu.config import resolve_packing
+
         opts = (opts or default_opts()).validate()
         nmodes = tt.nmodes
         tuned_blocks = dict(tuned_blocks or {})
         tuned_formats = dict(tuned_formats or {})
+        tuned_packings = dict(tuned_packings or {})
         fmt_default = layout_format(opts)
+        packing_default = resolve_packing(opts)
         # one storage dtype across layouts: pinned policy > unanimous
         # tuned verdict > compute dtype
         val_pol = fmt_default.val
@@ -529,6 +898,7 @@ class BlockedSparse:
             for m in sorted(dropped):
                 tuned_formats.pop(m)
                 tuned_blocks.pop(m, None)
+                tuned_packings.pop(m, None)
                 resilience.run_report().add(
                     "tuner_degraded", mode=m,
                     reason=f"tuned val_storage could not apply under "
@@ -553,13 +923,16 @@ class BlockedSparse:
                        fmt=LayoutFormat(
                            idx=tuned_formats[m].idx if m in tuned_formats
                            else fmt_default.idx,
-                           val=val_pol))
+                           val=val_pol),
+                       packing=tuned_packings.get(m, packing_default),
+                       reorder_label=reorder_label)
                    for m in build_modes]
         mode_map = {}
         for m in range(nmodes):
             mode_map[m] = build_modes.index(m) if m in build_modes else 0
         bs = BlockedSparse(layouts=layouts, mode_map=mode_map,
-                           dims=tt.dims, nnz=tt.nnz, opts=opts)
+                           dims=tt.dims, nnz=tt.nnz, opts=opts,
+                           reorder=reorder_label)
         if any(l.encoding == "v2" for l in layouts) or val_pol != "auto":
             # the chosen encoding is part of the executed plan: record
             # it (docs/format.md) like tuned_plan records dispatch
@@ -577,25 +950,114 @@ class BlockedSparse:
                 rank: Optional[int] = None) -> "BlockedSparse":
         """:meth:`from_coo` + autotune: consult the tuner's plan cache
         (splatt_tpu/tune.py) for each mode's winning ``nnz_block`` AND
-        encoding (index width / value storage — docs/format.md) and
-        build the layouts at them directly.  `rank` keys the plan
-        lookup (the winning configuration is rank-dependent); without
-        it, or with autotune off, this is plain :meth:`from_coo`."""
+        encoding (index width / value storage — docs/format.md) AND
+        layout-balance axes (fiber packing / reorder recipe —
+        docs/layout-balance.md) and build the layouts at them directly.
+        `rank` keys the plan lookup (the winning configuration is
+        rank-dependent); without it, or with autotune off, this is
+        plain :meth:`from_coo` under the pinned/env policies.
+
+        Reorder resolution is WHOLE-TENSOR (one permutation relabels
+        every mode — the factors are shared across the per-mode
+        layouts, so per-mode recipes cannot mix): a pinned policy
+        (``Options.reorder`` / SPLATT_REORDER) wins, else a unanimous
+        tuned verdict, else identity; plans whose recipe cannot apply
+        are dropped WHOLE with a ``tuner_degraded`` event (the
+        val_storage precedent).  The permutation is computed and
+        applied under the ``reorder.apply`` fault site and ANY failure
+        degrades CLASSIFIED to identity order (``reorder_fallback``
+        event) — a bad reorder heuristic can cost speed, never the
+        run.  The resulting :class:`BlockedSparse` carries the
+        :class:`Permutation` so cpd_als restores original factor row
+        order on output."""
+        from splatt_tpu.config import resolve_reorder
+
         opts = (opts or default_opts()).validate()
-        tuned_blocks = None
-        tuned_formats = None
+        tuned_blocks = {}
+        tuned_formats = {}
+        tuned_packings = {}
+        plans = {}
         if rank is not None:
             from splatt_tpu import tune
 
             if tune.autotune_enabled(opts.autotune):
                 plans = tune.tuned_build_for(
-                    tt.dims, tt.nnz, rank, resolve_dtype(opts, tt.vals.dtype))
-                tuned_blocks = {m: p.nnz_block for m, p in plans.items()}
-                tuned_formats = {m: LayoutFormat(idx=p.idx_width,
-                                                 val=p.val_storage)
-                                 for m, p in plans.items()}
-        return BlockedSparse.from_coo(tt, opts, tuned_blocks=tuned_blocks,
-                                      tuned_formats=tuned_formats)
+                    tt, rank, resolve_dtype(opts, tt.vals.dtype))
+        how = resolve_reorder(opts)
+        if how is None:
+            verdicts = {p.reorder for p in plans.values()}
+            how = verdicts.pop() if len(verdicts) == 1 else "identity"
+        dropped = [m for m, p in plans.items() if p.reorder != how]
+        if dropped:
+            from splatt_tpu import resilience
+
+            for m in sorted(dropped):
+                plans.pop(m)
+                resilience.run_report().add(
+                    "tuner_degraded", mode=m,
+                    reason=f"tuned reorder recipe could not apply under "
+                           f"the resolved whole-tensor recipe {how!r}; "
+                           f"mode keeps the default layout policy")
+        # a pinned fiber-packing policy beats a cached tuned verdict
+        # (same precedence val_storage and reorder enforce above):
+        # plans measured under the other policy are dropped WHOLE —
+        # their block/idx_width was never measured at the pinned
+        # packing, and dispatch's strict match would reject them anyway
+        from splatt_tpu.config import packing_pinned
+
+        pinned_pack = packing_pinned(opts)
+        if pinned_pack is not None:
+            dropped_p = [m for m, p in plans.items()
+                         if p.packing != pinned_pack]
+            if dropped_p:
+                from splatt_tpu import resilience
+
+                for m in sorted(dropped_p):
+                    plans.pop(m)
+                    resilience.run_report().add(
+                        "tuner_degraded", mode=m,
+                        reason=f"tuned fiber packing could not apply "
+                               f"under the pinned policy "
+                               f"{pinned_pack!r}; mode keeps the "
+                               f"default layout policy")
+        perm = None
+        if how != "identity":
+            from splatt_tpu.reorder import apply_reorder
+
+            tt, perm = apply_reorder(tt, how)
+            if perm is None:
+                # classified degrade inside apply_reorder: the recipe
+                # could not apply, so plans MEASURED under it must go
+                # too (dropped WHOLE, the val_storage precedent) —
+                # half-building their block/format at identity order
+                # would execute a configuration the tuner never
+                # measured and dispatch's strict match then rejects
+                failed = how
+                how = "identity"
+                stale = [m for m, p in plans.items()
+                         if p.reorder != "identity"]
+                if stale:
+                    from splatt_tpu import resilience
+
+                    for m in sorted(stale):
+                        plans.pop(m)
+                        resilience.run_report().add(
+                            "tuner_degraded", mode=m,
+                            reason=f"tuned plan was measured under "
+                                   f"reorder {failed!r}, which degraded "
+                                   f"to identity; mode keeps the "
+                                   f"default layout policy")
+        tuned_blocks = {m: p.nnz_block for m, p in plans.items()}
+        tuned_formats = {m: LayoutFormat(idx=p.idx_width,
+                                         val=p.val_storage)
+                         for m, p in plans.items()}
+        tuned_packings = {m: p.packing for m, p in plans.items()}
+        bs = BlockedSparse.from_coo(tt, opts, tuned_blocks=tuned_blocks,
+                                    tuned_formats=tuned_formats,
+                                    tuned_packings=tuned_packings,
+                                    reorder_label=how)
+        bs.perm = perm
+        return bs
 
     def frobsq(self) -> float:
         """Squared Frobenius norm (≙ csf_frobsq, src/csf.c:828-851).

@@ -464,7 +464,8 @@ def cmd_stats(args) -> int:
     """≙ splatt_stats_cmd (src/cmds/cmd_stats.c; -p gives the hypergraph
     partition-quality stats, src/stats.c:53-170)."""
     from splatt_tpu.io import load, read_permutation
-    from splatt_tpu.stats import partition_quality_text, tensor_stats
+    from splatt_tpu.stats import (partition_quality_text, skew_stats_text,
+                                  tensor_stats)
 
     tt = load(args.tensor)
     print(tensor_stats(tt, args.tensor))
@@ -477,6 +478,9 @@ def cmd_stats(args) -> int:
               f"nnz/slice min={nz.min() if nz.size else 0} "
               f"avg={tt.nnz / max(nz.size, 1):.1f} "
               f"max={nz.max() if nz.size else 0}")
+    # slice/fiber skew (docs/layout-balance.md): uniform vs power-law
+    # is the first question the layout/tuner answer depends on
+    print(skew_stats_text(tt))
     return 0
 
 
@@ -530,10 +534,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlaps the exchange with compute on TPU "
                         "and degrades classified to point2point then "
                         "all2all on failure (docs/ring.md)")
-    p.add_argument("--rowdist", choices=["greedy"],
-                   help="comm-minimizing factor-row distribution for "
-                        "--decomp fine (greedy row claiming, reference "
-                        "mpi_mat_distribute semantics)")
+    p.add_argument("--rowdist", choices=["greedy", "balanced"],
+                   help="factor-row distribution: greedy = comm-"
+                        "minimizing row claiming for --decomp fine "
+                        "(reference mpi_mat_distribute semantics); "
+                        "balanced = nnz-weighted fences (chains-on-"
+                        "chains LPT, docs/layout-balance.md) for fine "
+                        "and coarse, so a device owning hot slices no "
+                        "longer gates the exchange")
     p.add_argument("--local-engine", choices=["blocked", "stream"],
                    dest="local_engine",
                    help="per-device MTTKRP engine for distributed runs "

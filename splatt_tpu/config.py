@@ -143,6 +143,71 @@ IDX_WIDTHS = ("i32", "auto", "u16", "u8")
 #: Options.val_storage); "auto" = the resolved compute dtype
 VAL_STORAGES = ("auto", "f32", "bf16")
 
+#: legal fiber-packing policies (SPLATT_FIBER_PACKING /
+#: Options.fiber_packing, docs/layout-balance.md): "fixed" slices the
+#: sorted stream every nnz_block nonzeros regardless of where fibers
+#: fall (the original policy); "balanced" bin-packs fibers into blocks
+#: by nnz weight with long-fiber splitting, bounding each block's
+#: output-row span so one straggler block cannot inflate seg_width —
+#: and with it the one-hot contraction cost — for every block
+#: (≙ the chains-on-chains partitioner, src/thread_partition.c:156-195)
+PACKINGS = ("fixed", "balanced")
+
+#: legal reorder policies (SPLATT_REORDER / Options.reorder,
+#: docs/layout-balance.md): "identity" keeps original index labels;
+#: the rest are the relabeling strategies of splatt_tpu.reorder
+#: (≙ splatt_perm_type, src/reorder.h:15-22).  Resolution is
+#: whole-tensor: one permutation relabels every mode before the
+#: layouts are built, and the CPD driver restores original row order
+#: on output via Permutation.undo.
+REORDERS = ("identity", "random", "graph", "hgraph", "fibsched")
+
+
+def resolve_packing(opts: "Options") -> str:
+    """Resolve the fiber-packing policy for a run: the explicit
+    Options field wins, else the SPLATT_FIBER_PACKING env default
+    ("fixed" — the conservative original policy)."""
+    from splatt_tpu.utils.env import read_env
+
+    pol = (opts.fiber_packing if opts.fiber_packing is not None
+           else str(read_env("SPLATT_FIBER_PACKING")))
+    if pol not in PACKINGS:
+        raise ValueError(
+            f"fiber_packing must be one of {PACKINGS}, got {pol!r}")
+    return pol
+
+
+def packing_pinned(opts: "Options") -> Optional[str]:
+    """The EXPLICITLY pinned fiber-packing policy — a set
+    ``Options.fiber_packing`` or an explicitly-set SPLATT_FIBER_PACKING
+    env — validated through :func:`resolve_packing`; None when the user
+    left the knob to the tuner.  Pinned beats any cached tuned verdict
+    (the val_storage precedent): the tuner measures a pinned policy
+    alone, and the builder drops stale plans that disagree."""
+    from splatt_tpu.utils.env import env_is_set
+
+    if opts.fiber_packing is None and not env_is_set("SPLATT_FIBER_PACKING"):
+        return None
+    return resolve_packing(opts)
+
+
+def resolve_reorder(opts: "Options") -> Optional[str]:
+    """Resolve the PINNED reorder policy: the explicit Options field
+    wins, else a non-empty SPLATT_REORDER env value; None means
+    "unpinned" — BlockedSparse.compile then consults the autotuner's
+    unanimous verdict (docs/layout-balance.md), defaulting to
+    identity."""
+    from splatt_tpu.utils.env import read_env
+
+    how = opts.reorder
+    if how is None:
+        env = str(read_env("SPLATT_REORDER") or "").strip().lower()
+        how = env or None
+    if how is not None and how not in REORDERS:
+        raise ValueError(
+            f"reorder must be one of {REORDERS}, got {how!r}")
+    return how
+
 
 @dataclasses.dataclass(frozen=True)
 class LayoutFormat:
@@ -278,6 +343,16 @@ class Options:
     idx_width: Optional[str] = None      # "i32" | "auto" | "u16"
     val_storage: Optional[str] = None    # "auto" | "f32" | "bf16"
 
+    # Load-balanced layouts (docs/layout-balance.md): fiber-packing
+    # policy for the blocked layouts (None = env default
+    # SPLATT_FIBER_PACKING, "fixed") and the index-relabeling reorder
+    # applied before layout build (None = unpinned: SPLATT_REORDER if
+    # set, else the autotuner's unanimous verdict, else identity).
+    # Both are autotuner candidate axes.
+    fiber_packing: Optional[str] = None  # "fixed" | "balanced"
+    reorder: Optional[str] = None        # "identity" | "random" |
+                                         # "graph" | "hgraph" | "fibsched"
+
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM
     # Row-exchange strategy for the FINE decomposition.  None = env
@@ -320,6 +395,14 @@ class Options:
             raise ValueError(
                 f"val_storage must be one of {VAL_STORAGES}, "
                 f"got {self.val_storage!r}")
+        if (self.fiber_packing is not None
+                and self.fiber_packing not in PACKINGS):
+            raise ValueError(
+                f"fiber_packing must be one of {PACKINGS}, "
+                f"got {self.fiber_packing!r}")
+        if self.reorder is not None and self.reorder not in REORDERS:
+            raise ValueError(
+                f"reorder must be one of {REORDERS}, got {self.reorder!r}")
         import jax.numpy as jnp
 
         if (self.val_dtype is not None

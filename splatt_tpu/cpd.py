@@ -340,13 +340,19 @@ def _checkpoint_digest(payload: dict) -> str:
     return h.hexdigest()
 
 
-def _save_checkpoint(path: str, factors, lam, it: int, fit: float) -> None:
+def _save_checkpoint(path: str, factors, lam, it: int, fit: float,
+                     reorder: str = "identity") -> None:
     """Atomic .npz checkpoint (write + rename) with integrity data.
 
     The previous generation is kept as `<path>.bak` before the rename:
     if this write is torn (power loss mid-replace is atomic, but a torn
     write through a dying NFS mount is not) the resilient loader falls
     back one generation instead of losing the run.
+
+    `reorder` stamps the row-label space the factors live in
+    (docs/layout-balance.md): a reordered run checkpoints RELABELED
+    factors, and a resume under a different resolved recipe must not
+    silently mix row spaces — the loader refuses on mismatch.
     """
     import os
 
@@ -360,7 +366,8 @@ def _save_checkpoint(path: str, factors, lam, it: int, fit: float) -> None:
                    dims=np.asarray([U.shape[0] for U in factors]),
                    rank=int(factors[0].shape[1]))
     digest = _checkpoint_digest(payload)
-    np.savez(tmp, schema=_CKPT_SCHEMA, checksum=digest, **payload)
+    np.savez(tmp, schema=_CKPT_SCHEMA, checksum=digest,
+             reorder=np.str_(reorder), **payload)
     if faults.consume("checkpoint_torn"):
         # injected torn write: drop the tail of the bytes just written,
         # as a crashed writer or dying mount would
@@ -372,7 +379,8 @@ def _save_checkpoint(path: str, factors, lam, it: int, fit: float) -> None:
     os.replace(tmp, path)
 
 
-def load_checkpoint(path: str, verify: bool = True):
+def load_checkpoint(path: str, verify: bool = True,
+                    expect_reorder: Optional[str] = None):
     """Load a mid-run ALS checkpoint → (factors, lam, it, fit).
 
     Schema-v2 checkpoints are checksum-verified (`verify=False` skips);
@@ -380,6 +388,12 @@ def load_checkpoint(path: str, verify: bool = True):
     truncated, or checksum-failing file raises :class:`CheckpointError`
     — use :func:`load_checkpoint_resilient` on resume paths, which
     degrades to the `.bak` generation instead of dying mid-resume.
+
+    `expect_reorder` guards the row-label space: when given, a file
+    stamped with a DIFFERENT reorder recipe (files predating the stamp
+    count as "identity") raises :class:`CheckpointError` — resuming
+    relabeled factors under another recipe would silently permute
+    every factor against the tensor (docs/layout-balance.md).
     """
     try:
         with np.load(path) as z:
@@ -392,6 +406,14 @@ def load_checkpoint(path: str, verify: bool = True):
             dims = np.asarray(z["dims"])
             rank = int(z["rank"])
             stored = str(z["checksum"]) if "checksum" in z.files else None
+            ck_reorder = (str(z["reorder"]) if "reorder" in z.files
+                          else "identity")
+        if expect_reorder is not None and ck_reorder != expect_reorder:
+            raise CheckpointError(
+                f"checkpoint {path} stores factors in "
+                f"reorder={ck_reorder!r} row space but this run "
+                f"resolved reorder={expect_reorder!r}; resuming would "
+                f"mix row labelings (pass resume=False to overwrite)")
         if verify and stored is not None:
             payload = {f"factor{m}": factors_np[m] for m in range(nmodes)}
             payload.update(nmodes=nmodes, it=it, fit=fit, lam=lam,
@@ -410,25 +432,29 @@ def load_checkpoint(path: str, verify: bool = True):
             f"({type(e).__name__}: {e})") from e
 
 
-def load_checkpoint_resilient(path: str):
+def load_checkpoint_resilient(path: str,
+                              expect_reorder: Optional[str] = None):
     """Resume-path checkpoint load: try `path`, fall back to the
     previous `.bak` generation on corruption, and return None (start
     fresh) when neither is usable — a corrupt checkpoint must degrade
-    the resume, not kill it.  Recoveries are logged to stderr and
-    recorded in the resilience run report."""
+    the resume, not kill it.  A reorder row-space mismatch
+    (`expect_reorder`, docs/layout-balance.md) degrades the same way:
+    losing the checkpointed iterations beats silently resuming
+    factors whose rows are permuted against the tensor.  Recoveries
+    are logged to stderr and recorded in the resilience run report."""
     import os
     import sys
 
     from splatt_tpu import resilience
 
     try:
-        return load_checkpoint(path)
+        return load_checkpoint(path, expect_reorder=expect_reorder)
     except CheckpointError as e:
         first_err = str(e)
     bak = path + ".bak"
     if os.path.exists(bak):
         try:
-            out = load_checkpoint(bak)
+            out = load_checkpoint(bak, expect_reorder=expect_reorder)
             resilience.run_report().add(
                 "checkpoint_recovery", path=path, error=first_err,
                 action=f"resumed from previous generation {bak}")
@@ -477,6 +503,18 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         dims, nmodes = X.dims, X.nmodes
         xnormsq = X.frobsq()
         dtype = X.layouts[0].vals.dtype
+    # a reordered BlockedSparse (docs/layout-balance.md) computes in
+    # RELABELED row space: caller-supplied init moves in through the
+    # permutation here, and the output factors move back out below.
+    # Checkpoints stay in relabeled space (the recipe is deterministic,
+    # so a resume under the same plan sees consistent labels) — only
+    # the caller-visible boundary translates.
+    reorder_perm = getattr(X, "perm", None)
+    reorder_label = (getattr(X, "reorder", "identity")
+                     if reorder_perm is not None else "identity")
+    if reorder_perm is not None and init is not None:
+        init = [reorder_perm.permute_factor(U, m)
+                for m, U in enumerate(init)]
 
     start_it = 0
     ck_lam = None
@@ -495,7 +533,8 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             # resilient load: a corrupt/truncated file degrades to the
             # previous .bak generation, or to a fresh start — never a
             # crash mid-resume
-            loaded = load_checkpoint_resilient(checkpoint_path)
+            loaded = load_checkpoint_resilient(
+                checkpoint_path, expect_reorder=reorder_label)
             if loaded is not None:
                 ck_factors, ck_lam, start_it, ck_fit = loaded
                 ck_dims = tuple(int(U.shape[0]) for U in ck_factors)
@@ -554,6 +593,7 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                 parts = [f"mode{m}={p['path']}/{p['engine']}"
                          f" b{p['nnz_block']} s{p['scan_target']}"
                          f" {p['idx_width']}/{p['val_storage']}"
+                         f" {p['packing']}/{p['reorder']}"
                          for m, p in sorted(tuned_plans.items())]
                 print("  tuned plan: " + " ".join(parts))
 
@@ -763,7 +803,8 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
                 # not the iteration the blowup was detected at — a
                 # resume must redo the rolled-back window, not skip it
                 _save_checkpoint(checkpoint_path, factors, lam,
-                                 last_check_it, fit_prev)
+                                 last_check_it, fit_prev,
+                                 reorder=reorder_label)
                 action += f"; checkpointed to {checkpoint_path}"
             _resilience.run_report().add(
                 "health_degraded", iteration=it + 1, action=action)
@@ -791,7 +832,8 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"
                   f"  delta = {fitval - fit_prev:+0.4e}")
         if checkpoint_due:
-            _save_checkpoint(checkpoint_path, factors, lam, it + 1, fitval)
+            _save_checkpoint(checkpoint_path, factors, lam, it + 1, fitval,
+                             reorder=reorder_label)
         if stop is not None and stop():
             # cooperative interruption (serve drain): the state just
             # committed is checkpointed so a later resume redoes
@@ -799,7 +841,7 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             # means (the fit so far is a truthful partial result)
             if checkpoint_path is not None and not checkpoint_due:
                 _save_checkpoint(checkpoint_path, factors, lam, it + 1,
-                                 fitval)
+                                 fitval, reorder=reorder_label)
             fit_prev = fitval
             break
         # tolerance scales with the *actual* delta window: k sweeps
@@ -813,4 +855,11 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         fit_prev = fitval
     timers.stop("cpd")
 
-    return post_process(factors, lam, jnp.asarray(fit_prev, dtype=dtype))
+    out = post_process(factors, lam, jnp.asarray(fit_prev, dtype=dtype))
+    if reorder_perm is not None:
+        # restore ORIGINAL row labels on every factor (Permutation.undo
+        # round-trip, docs/layout-balance.md): the relabeling is an
+        # internal layout optimization, invisible at the API boundary
+        out = dataclasses.replace(
+            out, factors=reorder_perm.undo_factors(out.factors))
+    return out

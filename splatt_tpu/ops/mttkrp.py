@@ -286,14 +286,20 @@ def _tuned_plan_for(layout: ModeLayout, factors: Sequence[jax.Array],
         return None  # partial layout (gate-probing tests): no plan key
     plan = tune.cached_plan([int(f.shape[0]) for f in factors],
                             nnz, mode, int(factors[0].shape[1]),
-                            factors[0].dtype)
+                            factors[0].dtype,
+                            skew=getattr(layout, "skew", ""))
     if (plan is None or plan.path != path
             or plan.nnz_block != layout.block
             or plan.idx_width != getattr(layout, "idx_width", "i32")
-            or plan.val_storage != getattr(layout, "val_storage", "auto")):
-        # the format is part of the measured configuration: a plan for
-        # the v2 encoding never steers a v1 layout's dispatch (and vice
-        # versa) — the tuner can make dispatch faster, never wronger
+            or plan.val_storage != getattr(layout, "val_storage", "auto")
+            or plan.packing != getattr(layout, "packing", "fixed")
+            or plan.reorder != getattr(layout, "reorder", "identity")):
+        # the format AND the layout-balance axes (packing, reorder —
+        # docs/layout-balance.md) are part of the measured
+        # configuration: a plan for the v2 encoding never steers a v1
+        # layout's dispatch, a balanced-packing plan never steers a
+        # fixed layout (and vice versa) — the tuner can make dispatch
+        # faster, never wronger
         return None
     # per-shape (OOM) demotions only match with the shape_key, so it
     # must be computed when the caller (engine_plan, the cpd_als plan
@@ -564,6 +570,15 @@ def _engine_shape_key(layout: ModeLayout, factors: Sequence[jax.Array],
     enc = getattr(layout, "encoding", "v1")
     if enc != "v1":
         key += f":{enc}"
+    # layout-balance axes scope their own demotions exactly like :v2
+    # (docs/layout-balance.md): an OOM under a balanced/reordered
+    # layout never demotes the engine for the default layouts, and
+    # vice versa — default-layout keys stay byte-identical to the
+    # pre-balance era
+    if getattr(layout, "packing", "fixed") != "fixed":
+        key += ":bal"
+    if getattr(layout, "reorder", "identity") != "identity":
+        key += ":ro"
     return key
 
 
@@ -700,6 +715,12 @@ def _native_runnable(layout: ModeLayout, factors: Sequence[jax.Array],
         return False  # inside a jit trace (e.g. the fused sweep)
     if layout.encoding != "v1":
         return False  # the C++ ABI reads contiguous global i32 indices
+    if getattr(layout, "block_nnz", None) is not None:
+        # balanced packing pads mid-stream: the native engine reads the
+        # first `nnz` positions as the real prefix, which no longer
+        # holds (docs/layout-balance.md) — the XLA paths decode pads as
+        # additive identities instead
+        return False
     vdt = layout.vals.dtype
     if vdt not in (jnp.float32, jnp.float64):
         return False

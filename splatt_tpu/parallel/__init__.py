@@ -51,17 +51,19 @@ def distributed_cpd_als(tt: SparseTensor, rank: int,
     # O(nnz) in-RAM sorted copies for exactly the inputs that can't.
     if opts.decomposition is Decomposition.MEDIUM and partition is None:
         if row_distribute is not None:
-            raise ValueError("row_distribute applies to the FINE "
-                             "decomposition (the medium grid's layer "
-                             "fences already localize inputs)")
+            raise ValueError("row_distribute applies to the FINE and "
+                             "COARSE decompositions (the medium grid's "
+                             "layer fences already localize inputs)")
         return grid_cpd_als(tt, rank, grid=grid, mesh=mesh, opts=opts,
                             init=init, local_engine=local_engine, **ck)
     if opts.decomposition is Decomposition.COARSE:
-        if row_distribute is not None:
-            raise ValueError("row_distribute applies to the FINE "
-                             "decomposition, not COARSE")
+        if row_distribute not in (None, "balanced"):
+            raise ValueError("COARSE supports row_distribute='balanced' "
+                             "(nnz-weighted fences, docs/layout-"
+                             "balance.md); 'greedy' is FINE-only")
         return coarse_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
-                              local_engine=local_engine, **ck)
+                              local_engine=local_engine,
+                              row_distribute=row_distribute, **ck)
     return sharded_cpd_als(tt, rank, mesh=mesh, opts=opts, init=init,
                            partition=partition,
                            row_distribute=row_distribute,

@@ -84,10 +84,19 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                    axis: str = "d",
                    local_engine: Optional[str] = None,
                    out_dir: Optional[str] = None,
+                   row_distribute: Optional[str] = None,
                    checkpoint_path: Optional[str] = None,
                    checkpoint_every: int = 10,
                    resume: bool = True) -> KruskalTensor:
     """Distributed CPD-ALS, coarse-grained owner-computes.
+
+    `row_distribute="balanced"` (docs/layout-balance.md): nnz-weighted
+    row relabeling per mode (chains-on-chains style — the
+    capacity-constrained LPT pack of balanced_relabel) before the
+    equal fences are cut, so a hot slice no longer fattens one rank's
+    bucket — every per-mode cell is padded to the FULLEST bucket, so
+    bucket imbalance is wasted compute on every device.  Original row
+    order is restored on gather (run_distributed_als row_select).
 
     `local_engine`: "blocked" (the default) sorts each per-mode bucket
     and runs the single-chip blocked MTTKRP engine inside the sweep
@@ -117,6 +126,32 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         raise ValueError(f"unknown local_engine {local_engine!r}")
     blocked = local_engine == "blocked"
 
+    orig_dims = tt.dims
+    relabels = None
+    if row_distribute == "balanced":
+        # nnz-weighted per-mode relabeling (docs/layout-balance.md):
+        # rows LPT-packed into the equal fences by slice weight, so
+        # every rank's bucket — and with it the pad-to-fullest cell —
+        # balances.  All modes relabel at once: mode k's indices feed
+        # the gathered factor-k lookups inside every other mode's
+        # update, so the labeling must be globally consistent.
+        from splatt_tpu.parallel.common import (balanced_relabel,
+                                                relabel_tensor)
+
+        relabels = []
+        for m in range(nmodes):
+            dim_pad = ceil_to(max(tt.dims[m], ndev), ndev)
+            relabels.append(
+                balanced_relabel(tt.mode_histogram(m), ndev,
+                                 dim_pad // ndev)
+                if ndev > 1 else None)
+        tt = relabel_tensor(
+            tt, relabels, tuple(ceil_to(max(d, ndev), ndev)
+                                for d in tt.dims))
+    elif row_distribute is not None:
+        raise ValueError(f"unknown row_distribute {row_distribute!r} "
+                         f"(coarse supports 'balanced')")
+
     # one sorted+bucketed copy per mode (≙ per-mode tensors + ALLMODE);
     # per-mode out_dir subdirs: the memmap file names inside are fixed
     per_mode = [_bucket_by_mode(
@@ -126,6 +161,14 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         for m in range(nmodes)]
     blocks = tuple(b for (_, _, b, _) in per_mode)
     dims_pad = tuple(b * ndev for b in blocks)
+    # achieved bucket balance per mode (pad-to-fullest makes max/mean
+    # exactly the wasted-compute factor): recorded for --json /
+    # MULTICHIP (docs/layout-balance.md)
+    from splatt_tpu.parallel.common import record_shard_imbalance
+
+    for m, (_, _, _, counts) in enumerate(per_mode):
+        record_shard_imbalance("coarse_bucket", counts,
+                               policy=row_distribute or "equal", mode=m)
     nnz_sharding = NamedSharding(mesh, P(None, axis, None))
     val_sharding = NamedSharding(mesh, P(axis, None))
     if blocked:
@@ -154,13 +197,19 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                     for (_, v, _, _) in per_mode]
         rs_dev = []
 
+    # init in the ORIGINAL row space (rank-count/distribution
+    # invariance); relabels only affect placement
     factors_host = (init if init is not None
-                    else init_factors(tt.dims, rank, opts.seed(),
+                    else init_factors(orig_dims, rank, opts.seed(),
                                       dtype=dtype))
     factors = []
     for m, U in enumerate(factors_host):
         U_pad = jnp.zeros((dims_pad[m], U.shape[1]), dtype=dtype)
-        U_pad = U_pad.at[:tt.dims[m]].set(jnp.asarray(U, dtype=dtype))
+        U = jnp.asarray(U, dtype=dtype)[:orig_dims[m]]
+        if relabels is not None and relabels[m] is not None:
+            U_pad = U_pad.at[jnp.asarray(relabels[m])].set(U)
+        else:
+            U_pad = U_pad.at[:orig_dims[m]].set(U)
         factors.append(jax.device_put(
             U_pad, NamedSharding(mesh, P(axis, None))))
     factors = tuple(factors)
@@ -233,7 +282,7 @@ def coarse_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
                      factors, grams, flag)
 
     return run_distributed_als(step, factors, grams, rank, opts, xnormsq,
-                               tt.dims, dtype,
+                               orig_dims, dtype, row_select=relabels,
                                checkpoint_path=checkpoint_path,
                                checkpoint_every=checkpoint_every,
                                resume=resume)

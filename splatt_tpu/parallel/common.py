@@ -552,6 +552,44 @@ def balanced_relabel(hist: np.ndarray, nparts: int, cap: int) -> np.ndarray:
     return relabel
 
 
+def relabel_tensor(tt, relabels: Sequence[Optional[np.ndarray]],
+                   dims_pad: Sequence[int]):
+    """Rebuild `tt` with every mode's indices mapped through its
+    relabel array (None = identity) at the padded dims — the one
+    rebuild step every row-distribute policy (greedy, balanced) shares,
+    kept here so the identity handling and dims padding cannot drift
+    between the fine and coarse drivers."""
+    from splatt_tpu.coo import SparseTensor
+
+    inds = np.stack([relabels[m][np.asarray(tt.inds[m])]
+                     if relabels[m] is not None
+                     else np.asarray(tt.inds[m])
+                     for m in range(tt.nmodes)])
+    return SparseTensor(inds, tt.vals, tuple(dims_pad))
+
+
+def record_shard_imbalance(scope: str, counts: np.ndarray,
+                           policy: str = "equal", **extra) -> dict:
+    """Record a distributed sharding's achieved nnz balance as a
+    ``layout_imbalance`` run-report event (docs/layout-balance.md):
+    max/mean nnz per shard/bucket/cell next to the partitioning policy
+    that produced it — what ``splatt cpd --json`` and the MULTICHIP
+    artifacts carry so a device owning hot slices is observable, not
+    just slow.  Returns the recorded stats dict."""
+    from splatt_tpu import resilience
+
+    from splatt_tpu.utils.env import max_mean_ratio
+
+    counts = np.asarray(counts, dtype=np.int64).ravel()
+    stats = dict(scope=scope, policy=policy, shards=int(counts.size),
+                 shard_max_mean=max_mean_ratio(counts),
+                 min=int(counts.min()) if counts.size else 0,
+                 mean=round(float(counts.mean()), 1) if counts.size else 0,
+                 max=int(counts.max()) if counts.size else 0, **extra)
+    resilience.run_report().add("layout_imbalance", **stats)
+    return stats
+
+
 def imbalance_report(counts: np.ndarray, label: str = "device") -> str:
     """nnz-per-worker balance line (≙ thd_time_stats imbalance,
     src/thd_info.c, and mpi_rank_stats, src/stats.c:298-457).

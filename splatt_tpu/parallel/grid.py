@@ -670,6 +670,16 @@ def grid_cpd_als(tt: SparseTensor, rank: int,
     mesh = mesh or decomp.make_mesh(devices=devices)
     xnormsq = tt.normsq()
 
+    # achieved cell balance, always recorded (layout_imbalance rides
+    # --json / MULTICHIP — docs/layout-balance.md): every cell is
+    # padded to the fullest, so max/mean IS the wasted-compute factor
+    from splatt_tpu.parallel.common import record_shard_imbalance
+
+    record_shard_imbalance(
+        "grid_cell", decomp.cell_counts,
+        policy=("balanced" if decomp.relabels is not None else "equal"),
+        fill=round(float(decomp.fill), 3))
+
     if opts.verbosity >= Verbosity.HIGH:
         # ≙ mpi_rank_stats + mpi_send_recv_stats (src/stats.c:298-457,
         # src/splatt_mpi.h:453-463)

@@ -820,10 +820,28 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
             for st in dstats:
                 print(f"  rowdist mode {st['mode']}: local touches "
                       f"{st['local_before']:.1%} -> {st['local_after']:.1%}")
-        tt = SparseTensor(
-            np.stack([relabels[m][np.asarray(tt.inds[m])]
-                      for m in range(nmodes)]),
-            tt.vals, dims_pad)
+        from splatt_tpu.parallel.common import relabel_tensor
+
+        tt = relabel_tensor(tt, relabels, dims_pad)
+    elif row_distribute == "balanced":
+        # nnz-weighted factor-row relabeling (≙ the chains-on-chains
+        # p_find_layer_boundaries, docs/layout-balance.md): hot slices
+        # are spread across the equal-width row fences by a
+        # capacity-constrained LPT pack, so no device's fence owns a
+        # disproportionate share of the gather/reduce row traffic — the
+        # balanced-sharding leg of the skewed-tensor playbook
+        from splatt_tpu.parallel.common import balanced_relabel
+
+        relabels = [balanced_relabel(tt.mode_histogram(m), ndev,
+                                     dims_pad[m] // ndev)
+                    if ndev > 1 else None
+                    for m in range(nmodes)]
+        # (the achieved fence balance is computed once, post-relabel,
+        # by the fence_mm block below — which also prints the HIGH-
+        # verbosity per-mode report, so no second full-tensor pass)
+        from splatt_tpu.parallel.common import relabel_tensor
+
+        tt = relabel_tensor(tt, relabels, dims_pad)
     elif row_distribute is not None:
         raise ValueError(f"unknown row_distribute {row_distribute!r}")
 
@@ -877,15 +895,45 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         jax.device_put(gram(U), gram_sharding) for U in factors
     )
 
+    # ≙ mpi_rank_stats + mpi_send_recv_stats.  Measured occupancy,
+    # not the equal-chunk assumption: padding trails, so the last
+    # chunk(s) hold the shortfall.  Always RECORDED (the
+    # layout_imbalance event rides `splatt cpd --json` and MULTICHIP
+    # artifacts — docs/layout-balance.md); printed at HIGH.
+    if partition is not None:
+        counts = np.bincount(np.asarray(partition), minlength=ndev)
+    else:
+        chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
+        counts = np.clip(tt.nnz - chunk * np.arange(ndev), 0, chunk)
+    from splatt_tpu.parallel.common import record_shard_imbalance
+
+    # per-mode factor-row fence weights: the row traffic the balanced
+    # rowdist exists to even out — a device whose fence owns hot
+    # slices gates the gather/reduce legs of the ring.  The fence
+    # histogram is a full O(nnz) host pass per mode (sequential reads
+    # of a memmapped index stream on the out-of-core path), so it is
+    # only paid when a rowdist policy makes it the evidence, or at
+    # HIGH verbosity as a diagnostic — never as unconditional startup
+    # cost on every sharded run
+    fence_mm = None
+    if row_distribute is not None or opts.verbosity >= Verbosity.HIGH:
+        from splatt_tpu.utils.env import max_mean_ratio
+
+        fence_mm = {}
+        for m in range(nmodes):
+            fences = np.add.reduceat(
+                np.bincount(np.asarray(tt.inds[m]), minlength=dims_pad[m]),
+                np.arange(0, dims_pad[m], dims_pad[m] // ndev))
+            fence_mm[str(m)] = max_mean_ratio(fences)
+            if opts.verbosity >= Verbosity.HIGH:
+                print(imbalance_report(fences, f"mode{m} row-fence"))
+    record_shard_imbalance(
+        "shard", counts,
+        policy=row_distribute or ("partition" if partition is not None
+                                  else "equal"),
+        **({"row_fence_max_mean": fence_mm} if fence_mm is not None
+           else {}))
     if opts.verbosity >= Verbosity.HIGH:
-        # ≙ mpi_rank_stats + mpi_send_recv_stats.  Measured occupancy,
-        # not the equal-chunk assumption: padding trails, so the last
-        # chunk(s) hold the shortfall.
-        if partition is not None:
-            counts = np.bincount(np.asarray(partition), minlength=ndev)
-        else:
-            chunk = max(ndev, _pad_to(tt.nnz, ndev)) // ndev
-            counts = np.clip(tt.nnz - chunk * np.arange(ndev), 0, chunk)
         print(imbalance_report(counts, "shard"))
     profiled = (opts.verbosity >= Verbosity.HIGH and not ring_family)
     if profiled:

@@ -66,6 +66,58 @@ class Permutation:
             return U
         return U[p]
 
+    def permute_factor(self, U: np.ndarray, mode: int) -> np.ndarray:
+        """The FORWARD direction of :meth:`apply_to_factor`: a factor
+        indexed by original labels, moved into relabeled row space
+        (row ``perms[mode][old]`` of the result is row `old` of U) —
+        what a caller-supplied init must go through before a CPD over
+        a reordered tensor consumes it."""
+        p = self.iperms[mode]
+        if p is None:
+            return U
+        return U[p]
+
+    def undo_factors(self, factors: Sequence) -> List:
+        """Restore ORIGINAL row order on every factor of a CPD computed
+        over the relabeled tensor (the output side of the reorder
+        round-trip, docs/layout-balance.md; ≙ perm applied to the
+        final matrices in the reference's cpd driver)."""
+        return [self.apply_to_factor(U, m) for m, U in enumerate(factors)]
+
+
+#: the fixed seed production reorders are computed under: the recipe
+#: string alone must determine the permutation (plans persist recipes,
+#: not arrays, and a checkpoint written mid-run in relabeled space must
+#: resume under the SAME labels — docs/layout-balance.md)
+REORDER_SEED = 0
+
+
+def apply_reorder(tt: SparseTensor, how: str,
+                  seed: int = REORDER_SEED):
+    """Compute and apply a relabeling for the production layout path
+    (docs/layout-balance.md) → (relabeled tensor, Permutation), or
+    ``(tt, None)`` unchanged on ANY failure: the permutation compute +
+    apply runs under the ``reorder.apply`` fault site and degrades
+    CLASSIFIED to identity order (``reorder_fallback`` run-report
+    event) — a bad reorder heuristic may cost locality, never the run.
+
+    ``how == "identity"`` is the explicit no-op."""
+    if how in (None, "", "identity"):
+        return tt, None
+    from splatt_tpu import resilience
+    from splatt_tpu.utils import faults
+
+    try:
+        faults.maybe_fail("reorder.apply")
+        perm = reorder(tt, how, seed=seed)
+        return perm.apply(tt), perm
+    except Exception as e:
+        cls = resilience.classify_failure(e)
+        resilience.run_report().add(
+            "reorder_fallback", how=how, failure_class=cls.value,
+            error=resilience.failure_message(e)[:200])
+        return tt, None
+
 
 def reorder(tt: SparseTensor, how: str = "graph",
             seed: int = 0) -> Permutation:

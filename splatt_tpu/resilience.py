@@ -522,6 +522,23 @@ RUN_REPORT_EVENTS = {
                        "build degraded CLASSIFIED to the v1 i32 "
                        "encoding (blocked.py, the format.encode fault "
                        "site) — slower bytes, never a failed build",
+    "packing_fallback": "a balanced fiber pack failed and the build "
+                        "degraded CLASSIFIED to the fixed slicing "
+                        "(blocked.py, the layout.pack fault site; "
+                        "docs/layout-balance.md) — worse balance, "
+                        "never a failed build",
+    "reorder_fallback": "a reorder recipe's permutation compute/apply "
+                        "failed and the layout build degraded "
+                        "CLASSIFIED to identity order (reorder.py "
+                        "apply_reorder, the reorder.apply fault site; "
+                        "docs/layout-balance.md) — worse locality, "
+                        "never a failed run",
+    "layout_imbalance": "achieved load-balance of a built layout or "
+                        "distributed sharding (max/mean nnz per "
+                        "block/span/shard, one-hot work "
+                        "amplification; docs/layout-balance.md) — "
+                        "carried by splatt cpd --json, bench and "
+                        "MULTICHIP artifacts",
     "env_platform_error": "JAX_PLATFORMS could not be mirrored into "
                           "jax.config (utils/env.py:"
                           "apply_env_platform); the run continues on "
@@ -707,6 +724,28 @@ class RunReport:
                          f"(requested {e.get('idx_width')}; "
                          f"{e['failure_class']}: {e['error'][:80]}); "
                          f"degraded to the v1 i32 encoding")
+        for e in self.events("packing_fallback"):
+            lines.append(f"  balanced fiber pack failed for mode "
+                         f"{e.get('mode')} ({e['failure_class']}: "
+                         f"{e['error'][:80]}); degraded to fixed "
+                         f"slicing")
+        for e in self.events("reorder_fallback"):
+            lines.append(f"  reorder recipe {e.get('how')!r} failed "
+                         f"({e['failure_class']}: {e['error'][:80]}); "
+                         f"degraded to identity order")
+        for e in self.events("layout_imbalance"):
+            # only imbalanced layouts/shards are worth a summary line;
+            # the full stats always ride in the --json events
+            worst = max(e.get("block_nnz_max_mean", 1.0) or 1.0,
+                        e.get("shard_max_mean", 1.0) or 1.0)
+            if worst > 1.5:
+                where = (f"{e.get('scope', 'layout')} mode {e['mode']}"
+                         if "mode" in e else e.get("scope", "sharding"))
+                lines.append(f"  load imbalance at {where} "
+                             f"[{e.get('packing', e.get('policy', '?'))}]"
+                             f": max/mean {worst} "
+                             f"(seg_width {e.get('seg_width', '-')}, "
+                             f"work x{e.get('work_amp', '-')}/nnz)")
         for e in self.events("bench_regression"):
             lines.append(f"  BENCH REGRESSION on {e['path']}: "
                          f"{e['sec']}s vs {e['prior_sec']}s in "

@@ -39,6 +39,71 @@ def tensor_stats(tt: SparseTensor, name: str = "tensor") -> str:
     return "\n".join(lines)
 
 
+def skew_stats(tt: SparseTensor) -> dict:
+    """Per-mode slice/fiber skew metrics (docs/layout-balance.md): how
+    power-law an input is, as numbers.  Per mode: max/mean and
+    p99/median nnz per nonempty slice, the hottest slice's share of all
+    nonzeros, and the skew regime bucket the autotuner keys plans by
+    (blocked.nnz_skew_bucket).  ``fiber_max_mean`` is the same ratio
+    over the mode-rooted fibers of the smallest mode — fiber weight is
+    what the balanced packing bin-packs."""
+    from splatt_tpu.blocked import nnz_skew_bucket
+
+    out = {"modes": {}}
+    for m in range(tt.nmodes):
+        hist = tt.mode_histogram(m)
+        nz = hist[hist > 0]
+        if nz.size == 0:
+            out["modes"][str(m)] = dict(max_mean=1.0, p99_median=1.0,
+                                        top_share=0.0, bucket="k0")
+            continue
+        med = float(np.median(nz))
+        out["modes"][str(m)] = dict(
+            max_mean=round(float(nz.max()) / float(nz.mean()), 3),
+            p99_median=round(float(np.percentile(nz, 99))
+                             / max(med, 1.0), 3),
+            top_share=round(float(nz.max()) / max(tt.nnz, 1), 4),
+            nonempty=int(nz.size),
+            bucket=nnz_skew_bucket(hist))
+    if tt.nnz and tt.nmodes > 1:
+        # fiber weights of the smallest mode's fibers (all coordinates
+        # but `root` shared): the unit the balanced packer weighs.
+        # 1-mode tensors have no other coordinates to key fibers by —
+        # the slice stats above are the whole story there.
+        root = int(np.argmin(tt.dims))
+        others = [m for m in range(tt.nmodes) if m != root]
+        keys = np.stack([np.asarray(tt.inds[m]) for m in others])
+        order = np.lexsort(keys[::-1])
+        sk = keys[:, order]
+        new_fiber = np.ones(tt.nnz, dtype=bool)
+        if tt.nnz > 1:
+            new_fiber[1:] = np.any(sk[:, 1:] != sk[:, :-1], axis=0)
+        sizes = np.diff(np.concatenate(
+            [np.flatnonzero(new_fiber), [tt.nnz]]))
+        out["fiber_max_mean"] = round(float(sizes.max())
+                                      / float(sizes.mean()), 3)
+        out["fiber_count"] = int(sizes.size)
+    return out
+
+
+def skew_stats_text(tt: SparseTensor) -> str:
+    """Human-readable skew report (the `splatt stats` view of
+    :func:`skew_stats`) — lets a user (and the log reader) tell a
+    uniform tensor from a power-law one before picking layouts."""
+    st = skew_stats(tt)
+    lines = ["Slice skew -----------------------------------------"]
+    for m, d in st["modes"].items():
+        lines.append(
+            f"  mode {m}: nnz/slice max/mean={d['max_mean']} "
+            f"p99/median={d['p99_median']} top-slice "
+            f"{100 * d['top_share']:.1f}% of nnz [{d['bucket']}]")
+    if "fiber_max_mean" in st:
+        lines.append(f"  fibers (smallest-mode-rooted): "
+                     f"{st['fiber_count']} fibers, nnz/fiber "
+                     f"max/mean={st['fiber_max_mean']}")
+    return "\n".join(lines)
+
+
 def grid_stats_text(decomp) -> str:
     """Distributed decomposition stats (≙ mpi_global_stats /
     mpi_rank_stats / mpi_cpd_stats, src/stats.c:298-457)."""

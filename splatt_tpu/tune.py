@@ -56,7 +56,11 @@ import numpy as np
 #: a cache written by an older tuner is re-tuned, not reinterpreted.
 #: v2: plans carry the layout FORMAT (idx_width/val_storage,
 #: docs/format.md) and were measured per encoding.
-PLAN_CACHE_VERSION = 2
+#: v3: plans carry the layout-BALANCE axes (fiber packing / reorder
+#: recipe, docs/layout-balance.md) and the plan key gains a slice-skew
+#: regime component so uniform-tuned plans never steer power-law
+#: tensors.
+PLAN_CACHE_VERSION = 3
 
 #: candidate nnz blocks (build_layout clamps small tensors; duplicate
 #: effective blocks are measured once)
@@ -72,6 +76,23 @@ SCAN_TARGETS = (1 << 21, 1 << 23, 1 << 25)
 #: exceed uint8 the u8 candidate's encode degrades to v1 and collapses
 #: into the i32 candidate (measured once via the seen-dedup)
 IDX_CANDIDATES = ("i32", "auto", "u8")
+
+#: candidate fiber-packing policies when the knob is not pinned
+#: (docs/layout-balance.md): the fixed slicing and the nnz-balanced
+#: fiber packing with long-fiber splitting.  A balanced pack that
+#: degrades to fixed at build time collapses into the fixed candidate
+#: via the seen-dedup (measured once).
+PACKING_CANDIDATES = ("fixed", "balanced")
+
+#: candidate reorder recipes when the knob is not pinned: identity plus
+#: the relabeling strategies of splatt_tpu.reorder.  "random" is
+#: deliberately not a default candidate (it exists to DESTROY locality
+#: — a useful control, available pinned via Options.reorder /
+#: SPLATT_REORDER).  Each recipe's permutation is computed once per
+#: tune call and every candidate axis is measured over the relabeled
+#: tensor; the verdict is whole-tensor at compile time
+#: (BlockedSparse.compile resolves a unanimous winner).
+REORDER_CANDIDATES = ("identity", "graph", "hgraph", "fibsched")
 
 _AUTOTUNE_ENV = "SPLATT_AUTOTUNE"
 _CACHE_ENV = "SPLATT_TUNE_CACHE"
@@ -93,6 +114,11 @@ class TunedPlan:
     sec: float
     idx_width: str = "i32"
     val_storage: str = "auto"
+    #: layout-balance axes (docs/layout-balance.md): the fiber-packing
+    #: policy and reorder recipe the winner was measured under —
+    #: dispatch only applies a plan to a layout built at exactly them
+    packing: str = "fixed"
+    reorder: str = "identity"
 
 
 @dataclasses.dataclass
@@ -133,15 +159,38 @@ def shape_regime(dims: Sequence[int], nnz: int) -> str:
     return f"m{len(dims)}:d{db}:z{int(max(nnz, 1)).bit_length()}"
 
 
+def skew_regime(bucket: str) -> str:
+    """The regime component of a slice-skew bucket
+    (blocked.nnz_skew_bucket): near-uniform buckets (max/mean < 8)
+    collapse to "" so uniform-tensor plan keys stay byte-identical to
+    the pre-balance cache era; heavier skew keys its own regime — the
+    winning layout on a zipf tensor (balanced packing, small
+    seg_width) is a different animal from the uniform winner
+    (docs/layout-balance.md)."""
+    return "" if bucket in ("", "k0", "k1", "k2", "k3") else bucket
+
+
+def skew_of(tt, mode: int) -> str:
+    """The slice-skew bucket of one mode of a COO tensor — what
+    build_layout stamps into ModeLayout.skew (permutation-invariant:
+    relabeling shuffles the histogram, not its multiset)."""
+    from splatt_tpu.blocked import nnz_skew_bucket
+
+    return nnz_skew_bucket(tt.mode_histogram(mode))
+
+
 def plan_key(dims: Sequence[int], nnz: int, mode: int, rank: int,
-             dtype) -> str:
+             dtype, skew: str = "") -> str:
     """The cache key of one tuned dispatch site.  Device kind and
     kernel-source hash live in the environment key (shared with the
-    probe cache), so this only carries the workload shape."""
+    probe cache), so this only carries the workload shape — plus the
+    mode's slice-skew regime (:func:`skew_regime`; "" for
+    near-uniform, keeping legacy keys byte-identical)."""
     import jax.numpy as jnp
 
+    sk = skew_regime(skew)
     return (f"{shape_regime(dims, nnz)}:mode{mode}:r{int(rank)}"
-            f":{jnp.dtype(dtype).name}")
+            f":{jnp.dtype(dtype).name}" + (f":{sk}" if sk else ""))
 
 
 def _negative_key(key: str, engine: str, block: int, scan_target: int,
@@ -301,10 +350,10 @@ def _entry_store(key: str, value: dict) -> None:
 
 
 def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,
-                dtype) -> Optional[TunedPlan]:
+                dtype, skew: str = "") -> Optional[TunedPlan]:
     """The persisted winning plan for this dispatch site, or None
     (never tuned, expired, negative-only, or unreadable cache)."""
-    entry = _entry_get(plan_key(dims, nnz, mode, rank, dtype))
+    entry = _entry_get(plan_key(dims, nnz, mode, rank, dtype, skew=skew))
     if not entry or "plan" not in entry:
         return None
     p = entry["plan"]
@@ -314,33 +363,37 @@ def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,
                          scan_target=int(p["scan_target"]),
                          sec=float(p.get("sec", 0.0)),
                          idx_width=str(p.get("idx_width", "i32")),
-                         val_storage=str(p.get("val_storage", "auto")))
+                         val_storage=str(p.get("val_storage", "auto")),
+                         packing=str(p.get("packing", "fixed")),
+                         reorder=str(p.get("reorder", "identity")))
     except (KeyError, TypeError, ValueError) as e:
         _cache_io_error("load", e)
         return None
 
 
-def tuned_build_for(dims: Sequence[int], nnz: int, rank: int,
-                    dtype) -> Dict[int, TunedPlan]:
+def tuned_build_for(tt, rank: int, dtype) -> Dict[int, TunedPlan]:
     """Per-mode cached plans — what :meth:`BlockedSparse.compile`
     builds layouts with (winning ``nnz_block`` AND encoding:
-    idx_width/val_storage, docs/format.md), so the layout is built once
-    at the tuned configuration instead of rebuilt when the plan
-    disagrees with the default."""
+    idx_width/val_storage, docs/format.md, AND the layout-balance axes:
+    packing/reorder, docs/layout-balance.md), so the layout is built
+    once at the tuned configuration instead of rebuilt when the plan
+    disagrees with the default.  Takes the COO tensor (not just
+    dims/nnz): the plan key's skew component needs the mode
+    histograms."""
     out = {}
-    for m in range(len(dims)):
-        plan = cached_plan(dims, nnz, m, rank, dtype)
+    for m in range(tt.nmodes):
+        plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype,
+                           skew=skew_of(tt, m))
         if plan is not None:
             out[m] = plan
     return out
 
 
-def tuned_blocks_for(dims: Sequence[int], nnz: int, rank: int,
-                     dtype) -> Dict[int, int]:
+def tuned_blocks_for(tt, rank: int, dtype) -> Dict[int, int]:
     """Per-mode tuned nnz_block for every mode with a cached plan
     (the block-only view of :func:`tuned_build_for`)."""
     return {m: p.nnz_block
-            for m, p in tuned_build_for(dims, nnz, rank, dtype).items()}
+            for m, p in tuned_build_for(tt, rank, dtype).items()}
 
 
 # -- measurement ------------------------------------------------------------
@@ -423,6 +476,29 @@ def _format_candidates(opts, dtype) -> List[Tuple[str, str]]:
     return [(i, v) for i in idx for v in val]
 
 
+def _packing_candidates(opts) -> Tuple[str, ...]:
+    """Fiber-packing candidates: a pinned knob (explicit
+    ``Options.fiber_packing`` or an explicitly-set
+    SPLATT_FIBER_PACKING) is measured alone; unpinned spans both
+    policies (docs/layout-balance.md).  Resolution goes through
+    config.packing_pinned so a typo'd policy fails with its clear
+    message up front, not deep inside a mid-tune build."""
+    from splatt_tpu.config import packing_pinned
+
+    pinned = packing_pinned(opts)
+    return (pinned,) if pinned is not None else PACKING_CANDIDATES
+
+
+def _reorder_candidates(opts) -> Tuple[str, ...]:
+    """Reorder-recipe candidates: a pinned knob (``Options.reorder`` /
+    a set SPLATT_REORDER) is measured alone; unpinned spans
+    :data:`REORDER_CANDIDATES`."""
+    from splatt_tpu.config import resolve_reorder
+
+    pinned = resolve_reorder(opts)
+    return (pinned,) if pinned is not None else REORDER_CANDIDATES
+
+
 def _candidates(layout, factors, mode: int, path: str, impl: str,
                 scan_targets: Sequence[int],
                 default_scan: int) -> List[Tuple[str, int]]:
@@ -445,21 +521,33 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
          blocks: Optional[Sequence[int]] = None,
          scan_targets: Optional[Sequence[int]] = None,
          formats: Optional[Sequence[Tuple[str, str]]] = None,
+         packings: Optional[Sequence[str]] = None,
+         reorders: Optional[Sequence[str]] = None,
          warm: int = 1, reps: int = 2, force: bool = False) -> TuneResult:
     """Tune the MTTKRP plan for each mode of `tt` at `rank` and persist
     the winners in the plan cache.
 
-    The candidate matrix is engine x nnz_block x scan_target x FORMAT
-    (docs/format.md): each (idx_width, val_storage) pair from
-    :func:`_format_candidates` (or an explicit `formats`) is measured
-    against the same sorted build — the v2/bf16 re-encodings are
-    derived without re-sorting — so the cheapest *correct* encoding
-    wins empirically per regime.  bf16-storage candidates are measured
-    with bf16 factors (the configuration that actually dispatches), and
-    a winner whose storage narrows the compute dtype is stored under
-    BOTH the requested dtype's key (for compile-time layout building)
-    and the storage dtype's key (for dispatch-time steering, where the
-    factors already carry the narrow dtype).
+    The candidate matrix is reorder x packing x engine x nnz_block x
+    scan_target x FORMAT (docs/format.md, docs/layout-balance.md): each
+    (idx_width, val_storage) pair from :func:`_format_candidates` (or
+    an explicit `formats`) is measured against the same sorted build —
+    the v2/bf16 re-encodings are derived without re-sorting — so the
+    cheapest *correct* encoding wins empirically per regime.
+    bf16-storage candidates are measured with bf16 factors (the
+    configuration that actually dispatches), and a winner whose storage
+    narrows the compute dtype is stored under BOTH the requested
+    dtype's key (for compile-time layout building) and the storage
+    dtype's key (for dispatch-time steering, where the factors already
+    carry the narrow dtype).
+
+    Layout-balance axes: each reorder recipe's permutation is computed
+    ONCE per tune call (a failed recipe degrades classified via
+    apply_reorder and is skipped); each (block, packing) pair is one
+    sorted build, with a balanced pack that degraded to fixed
+    collapsing into the fixed candidate via the seen-dedup.  Plans
+    record the recipe, not the permutation — BlockedSparse.compile
+    recomputes it deterministically (reorder.REORDER_SEED) and
+    resolves a whole-tensor verdict.
 
     Already-cached (unexpired) plans short-circuit their mode entirely
     — a warm cache runs ZERO measurements (``result.measured == 0``),
@@ -495,8 +583,38 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
     scan_targets = tuple(scan_targets) if scan_targets else SCAN_TARGETS
     formats = (list(formats) if formats
                else _format_candidates(opts, dtype))
+    packings = tuple(packings) if packings else _packing_candidates(opts)
+    reorders = tuple(reorders) if reorders else _reorder_candidates(opts)
     modes = range(tt.nmodes) if modes is None else modes
     loud = opts.verbosity >= Verbosity.LOW
+    # one relabeled tensor per recipe, computed once (a recipe whose
+    # permutation fails degrades classified inside apply_reorder — its
+    # candidates are skipped, identity keeps the floor).  Shapes, nnz
+    # and per-mode skew are permutation-invariant, so every recipe
+    # shares the same plan key and factor operands.
+    from splatt_tpu.reorder import apply_reorder
+
+    tensors = None
+
+    def reorder_tensors():
+        # lazy, built on the FIRST cache miss only: a fully-warm tune()
+        # must stay free (result.measured == 0 AND no O(nnz) permutation
+        # builds or relabeled index copies) — serve's Nth same-regime
+        # job and the bench tuned path rely on that contract.
+        nonlocal tensors
+        if tensors is None:
+            tensors = {}
+            for how in reorders:
+                if how == "identity":
+                    tensors[how] = tt
+                else:
+                    tt_r, rperm = apply_reorder(tt, how)
+                    if rperm is not None:
+                        tensors[how] = tt_r
+                    elif loud:
+                        print(f"  tune: reorder recipe {how!r} failed "
+                              f"(classified); skipping its candidates")
+        return tensors
     # plan-independent factor operands: the timing only needs shapes
     # and a realistic dtype, not the caller's actual factors.  Narrow-
     # storage candidates measure with matching narrow factors (memoized
@@ -512,9 +630,10 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
 
     result = TuneResult(plans={})
     for m in modes:
-        key = plan_key(tt.dims, tt.nnz, m, rank, dtype)
+        skew = skew_of(tt, m)
+        key = plan_key(tt.dims, tt.nnz, m, rank, dtype, skew=skew)
         if not force:
-            plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype)
+            plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype, skew=skew)
             if plan is not None:
                 result.cache_hits += 1
                 result.plans[m] = plan
@@ -522,84 +641,103 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
                     print(f"  tune mode {m}: plan cache hit "
                           f"({plan.engine} b{plan.nnz_block} "
                           f"s{plan.scan_target} "
-                          f"{plan.idx_width}/{plan.val_storage}) — "
+                          f"{plan.idx_width}/{plan.val_storage} "
+                          f"{plan.packing}/{plan.reorder}) — "
                           f"skipping measurement")
                 continue
         best: Optional[TunedPlan] = None
         seen = set()
-        for req_block in blocks:
-            base_layout = build_layout(
-                tt, m, block=int(req_block), val_dtype=np.dtype(dtype),
-                mode_order=opts.mode_order,
-                mode_order_custom=opts.mode_order_custom)
-            path = choose_path(base_layout, m, opts)
-            for iw, vs in formats:
-                storage = resolve_storage_dtype(vs, dtype)
-                if (iw, vs) == ("i32", "auto"):
-                    layout = base_layout
-                else:
-                    # derive the candidate encoding from the one sorted
-                    # build (a failed v2 encode degrades classified to
-                    # v1 inside reencode_layout)
-                    layout = reencode_layout(
-                        base_layout, LayoutFormat(idx=iw, val=vs),
-                        val_dtype=(None if jnp.dtype(storage) ==
-                                   jnp.dtype(dtype) else storage))
-                cand_key = (layout.block, layout.idx_width,
-                            layout.val_storage)
-                if cand_key in seen:
-                    continue  # clamp/fallback collapsed this candidate
-                seen.add(cand_key)
-                fac = factors_for(storage)
-                fmt_tag = f"{layout.idx_width}-{layout.val_storage}"
-                for engine, st in _candidates(layout, fac, m, path, impl,
-                                              scan_targets, default_scan):
-                    neg = _entry_get(_negative_key(key, engine,
-                                                   layout.block, st,
-                                                   fmt_tag))
-                    if neg is not None:
-                        result.skipped += 1
-                        continue
+        for how, tt_how in reorder_tensors().items():
+            for req_block in blocks:
+                for pack in packings:
+                    base_layout = build_layout(
+                        tt_how, m, block=int(req_block),
+                        val_dtype=np.dtype(dtype),
+                        mode_order=opts.mode_order,
+                        mode_order_custom=opts.mode_order_custom,
+                        packing=pack, reorder_label=how,
+                        record_stats=False)
+                    path = choose_path(base_layout, m, opts)
+                    for iw, vs in formats:
+                        storage = resolve_storage_dtype(vs, dtype)
+                        if (iw, vs) == ("i32", "auto"):
+                            layout = base_layout
+                        else:
+                            # derive the candidate encoding from the one
+                            # sorted build (a failed v2 encode degrades
+                            # classified to v1 inside reencode_layout)
+                            layout = reencode_layout(
+                                base_layout, LayoutFormat(idx=iw, val=vs),
+                                val_dtype=(None if jnp.dtype(storage) ==
+                                           jnp.dtype(dtype) else storage))
+                        cand_key = (layout.block, layout.idx_width,
+                                    layout.val_storage, layout.packing,
+                                    how)
+                        if cand_key in seen:
+                            continue  # clamp/fallback collapsed this one
+                        seen.add(cand_key)
+                        fac = factors_for(storage)
+                        fmt_tag = (f"{layout.idx_width}-"
+                                   f"{layout.val_storage}-"
+                                   f"{layout.packing}-{how}")
+                        for engine, st in _candidates(layout, fac, m,
+                                                      path, impl,
+                                                      scan_targets,
+                                                      default_scan):
+                            neg = _entry_get(_negative_key(
+                                key, engine, layout.block, st, fmt_tag))
+                            if neg is not None:
+                                result.skipped += 1
+                                continue
 
-                    def attempt(layout=layout, fac=fac, path=path,
-                                engine=engine, st=st):
-                        return _measure_candidate(layout, fac, m, path,
-                                                  impl, engine, st,
-                                                  warm=warm, reps=reps)
+                            def attempt(layout=layout, fac=fac,
+                                        path=path, engine=engine, st=st):
+                                return _measure_candidate(
+                                    layout, fac, m, path, impl, engine,
+                                    st, warm=warm, reps=reps)
 
-                    try:
-                        sec = resilience.retry_transient(
-                            attempt, label=f"tuner.{engine}")
-                    except Exception as e:
-                        cls = resilience.classify_failure(e)
-                        if cls in (resilience.FailureClass.DETERMINISTIC,
-                                   resilience.FailureClass.RESOURCE):
-                            # proven: never re-pay this candidate's
-                            # compile
-                            _entry_store(
-                                _negative_key(key, engine, layout.block,
-                                              st, fmt_tag),
-                                {"state": cls.value,
-                                 "error":
-                                 resilience.failure_message(e)[:200]})
-                        resilience.run_report().add(
-                            "tuner_negative", key=key, engine=engine,
-                            block=layout.block, scan_target=st,
-                            fmt=fmt_tag, failure_class=cls.value,
-                            error=resilience.failure_message(e)[:200])
-                        result.skipped += 1
-                        continue
-                    result.measured += 1
-                    if loud:
-                        print(f"  tune mode {m}: {path}/{engine} "
-                              f"b{layout.block} s{st} {fmt_tag}: "
-                              f"{sec:.4f}s")
-                    if best is None or sec < best.sec:
-                        best = TunedPlan(path=path, engine=engine,
-                                         nnz_block=layout.block,
-                                         scan_target=st, sec=sec,
-                                         idx_width=layout.idx_width,
-                                         val_storage=layout.val_storage)
+                            try:
+                                sec = resilience.retry_transient(
+                                    attempt, label=f"tuner.{engine}")
+                            except Exception as e:
+                                cls = resilience.classify_failure(e)
+                                if cls in (
+                                        resilience.FailureClass
+                                        .DETERMINISTIC,
+                                        resilience.FailureClass.RESOURCE):
+                                    # proven: never re-pay this
+                                    # candidate's compile
+                                    _entry_store(
+                                        _negative_key(key, engine,
+                                                      layout.block, st,
+                                                      fmt_tag),
+                                        {"state": cls.value,
+                                         "error":
+                                         resilience
+                                         .failure_message(e)[:200]})
+                                resilience.run_report().add(
+                                    "tuner_negative", key=key,
+                                    engine=engine, block=layout.block,
+                                    scan_target=st, fmt=fmt_tag,
+                                    failure_class=cls.value,
+                                    error=resilience
+                                    .failure_message(e)[:200])
+                                result.skipped += 1
+                                continue
+                            result.measured += 1
+                            if loud:
+                                print(f"  tune mode {m}: {path}/{engine} "
+                                      f"b{layout.block} s{st} {fmt_tag}: "
+                                      f"{sec:.4f}s")
+                            if best is None or sec < best.sec:
+                                best = TunedPlan(
+                                    path=path, engine=engine,
+                                    nnz_block=layout.block,
+                                    scan_target=st, sec=sec,
+                                    idx_width=layout.idx_width,
+                                    val_storage=layout.val_storage,
+                                    packing=layout.packing,
+                                    reorder=how)
         if best is None:
             # every candidate failed or was skipped: no plan — dispatch
             # keeps the heuristic chain (observable, not silent)
@@ -614,12 +752,14 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
             # a storage-narrowing winner also steers dispatch, where
             # the factors already carry the narrow dtype — alias the
             # plan under that key so the steering is not lost
-            _entry_store(plan_key(tt.dims, tt.nnz, m, rank, storage),
+            _entry_store(plan_key(tt.dims, tt.nnz, m, rank, storage,
+                                  skew=skew),
                          {"plan": dataclasses.asdict(best)})
         result.plans[m] = best
         if loud:
             print(f"  tune mode {m}: winner {best.path}/{best.engine} "
                   f"b{best.nnz_block} s{best.scan_target} "
                   f"{best.idx_width}/{best.val_storage} "
+                  f"{best.packing}/{best.reorder} "
                   f"({best.sec:.4f}s)")
     return result

@@ -112,6 +112,32 @@ ENV_VARS = {
                                  "it — bf16 stores nonzero values (and "
                                  "the factors derived from them) in "
                                  "bfloat16 with f32 accumulation"),
+    "SPLATT_FIBER_PACKING": EnvVar("fixed", "blocked-layout fiber-"
+                                   "packing policy (docs/layout-"
+                                   "balance.md): fixed = slice the "
+                                   "sorted stream every nnz_block "
+                                   "nonzeros (the original policy); "
+                                   "balanced = nnz-weighted fiber bin "
+                                   "packing with long-fiber splitting, "
+                                   "bounding each block's output-row "
+                                   "span so skewed tensors stop "
+                                   "inflating seg_width (a failed pack "
+                                   "degrades classified to fixed).  An "
+                                   "explicit Options.fiber_packing "
+                                   "wins; unset, both are autotuner "
+                                   "candidates"),
+    "SPLATT_REORDER": EnvVar(None, "index-relabeling reorder applied "
+                             "before blocked layouts are built (docs/"
+                             "layout-balance.md): identity | random | "
+                             "graph | hgraph | fibsched.  One whole-"
+                             "tensor permutation relabels every mode; "
+                             "factors are restored to original row "
+                             "order on output (Permutation.undo).  An "
+                             "explicit Options.reorder wins; unset, "
+                             "the recipes are autotuner candidates and "
+                             "compile applies a unanimous verdict.  "
+                             "Any reorder failure degrades classified "
+                             "to identity (reorder_fallback)"),
     "SPLATT_AUTOTUNE": EnvVar("1", "MTTKRP dispatch consults the "
                               "autotuner's persisted plan cache "
                               "(docs/autotune.md) before the heuristic "
@@ -166,6 +192,29 @@ ENV_VARS = {
     "SPLATT_BENCH_ALLOC": EnvVar("allmode", "bench.py: BlockAlloc "
                                  "layout policy"),
     "SPLATT_BENCH_JIT": EnvVar("auto", "bench.py: sweep jit mode"),
+    "SPLATT_BENCH_SCENARIO": EnvVar("uniform", "bench.py: named nnz-"
+                                    "distribution scenario (docs/"
+                                    "layout-balance.md): uniform "
+                                    "(default — hash-scattered, "
+                                    "metric string unchanged), "
+                                    "zipf:<a> (zipf-skewed slice "
+                                    "popularity at exponent a, e.g. "
+                                    "zipf:1.5), powerlaw (power-law "
+                                    "mode sizes), amazon-like (scaled "
+                                    "review-tensor shape preset).  "
+                                    "Non-uniform scenarios tag the "
+                                    "metric string so the regression "
+                                    "gate only compares like "
+                                    "workloads, and the JSON carries "
+                                    "per-scenario imbalance stats"),
+    "SPLATT_BENCH_GUARD_AB": EnvVar(None, "bench.py: 1 = run the "
+                                    "guard-cost A/B legs (ROADMAP "
+                                    "open item 1): cpd_als timed with "
+                                    "SPLATT_HEALTH_RETRIES on/off x "
+                                    "donation on/off, recorded under "
+                                    "guard_ab in the bench JSON so "
+                                    "the gate can see guard overhead "
+                                    "explicitly"),
     "SPLATT_BENCH_DEVICES": EnvVar(None, "bench.py: comma-separated "
                                    "device counts for the scaling "
                                    "sweep"),
@@ -229,6 +278,19 @@ def read_env_float(name: str) -> Optional[float]:
 def ceil_to(x: int, mult: int) -> int:
     """Round x up to a multiple of mult."""
     return ((x + mult - 1) // mult) * mult
+
+
+def max_mean_ratio(a) -> float:
+    """round(max/mean, 3) of a nonnegative weight array — THE imbalance
+    convention every layout/shard balance stat reports
+    (docs/layout-balance.md); 1.0 means perfectly balanced (or empty).
+    One definition so the slice/block/span/shard numbers in the run
+    report, ``splatt cpd --json``, bench and MULTICHIP never drift."""
+    import numpy as np
+
+    a = np.asarray(a)
+    mean = float(a.mean()) if a.size else 0.0
+    return round(float(a.max()) / mean, 3) if mean > 0 else 1.0
 
 
 def check_int32_dims(dims) -> None:

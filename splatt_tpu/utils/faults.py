@@ -147,6 +147,15 @@ SITES = {
                      "a raised fault must degrade the build classified "
                      "to the v1 i32 encoding (format_fallback event), "
                      "never fail it",
+    "layout.pack": "the balanced fiber packing of one blocked layout "
+                   "(blocked.py build_layout, docs/layout-balance.md); "
+                   "a raised fault must degrade the build classified "
+                   "to the fixed slicing (packing_fallback event), "
+                   "never fail it",
+    "reorder.apply": "the reorder permutation compute + apply "
+                     "(reorder.py apply_reorder); a raised fault must "
+                     "degrade the run classified to identity order "
+                     "(reorder_fallback event), never fail it",
     "comm.ring_exchange": "the ring row-exchange of a distributed "
                           "sweep (parallel/ring_kernels.py: the async "
                           "remote-copy kernels and their ppermute "

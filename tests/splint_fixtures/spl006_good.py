@@ -28,3 +28,11 @@ def risky_ring_exchange():
     # the distributed ring row-exchange hook (parallel/ring_kernels.py
     # and the ppermute fallback in parallel/ring.py, docs/ring.md)
     faults.maybe_fail("comm.ring_exchange")
+
+
+def risky_layout_balance():
+    # the load-balanced layout hooks (docs/layout-balance.md): the
+    # balanced fiber pack (blocked.py) and the reorder permutation
+    # compute+apply (reorder.py) — both degrade classified, never fail
+    faults.maybe_fail("layout.pack")
+    faults.maybe_fail("reorder.apply")

@@ -1,0 +1,764 @@
+"""Load-balanced layouts (docs/layout-balance.md): nnz-balanced fiber
+packing with long-fiber splitting, reorder recipes in production, the
+skew-aware tuner axes, and the nnz-weighted distributed sharding.
+
+Contract under test:
+- parity of balanced / split / reordered layouts against the v1 fixed
+  path across every engine (xla, xla_scan, interpret-Pallas): the
+  scatter-family engines are BIT-identical (pads are additive
+  identities appended in sorted order); the one-hot engines regroup
+  block summation, so they match to accumulation tolerance;
+- the balance contract: block budget respected, every nonzero placed
+  exactly once, fill >= ~0.9 so max/mean real nnz per block <= ~1.1;
+- degenerate inputs (one slice holding 50% of nnz, a single-fiber
+  tensor, empty/tiny tensors);
+- classified degrade drills: layout.pack -> fixed (packing_fallback),
+  reorder.apply -> identity (reorder_fallback) — never a failed run;
+- Permutation apply/undo round-trips on factors and checkpoints;
+- tuner integration: packing/reorder candidates, strict plan match,
+  whole-tensor reorder unanimity, skew-keyed regimes, demotion scope
+  suffixes;
+- balanced distributed sharding (fine + coarse) parity and the
+  layout_imbalance evidence trail.
+"""
+
+import contextlib
+import io
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import splatt_tpu.tune as tune
+from splatt_tpu import resilience
+from splatt_tpu.blocked import (BlockedSparse, build_layout,
+                                nnz_skew_bucket, plan_balanced_blocks,
+                                reencode_layout)
+from splatt_tpu.config import (LayoutFormat, Options, Verbosity,
+                               default_opts)
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.cpd import cpd_als, init_factors
+from splatt_tpu.ops.mttkrp import (_engine_shape_key, _mttkrp_blocked_jit,
+                                   _tuned_plan_for, mttkrp_blocked,
+                                   mttkrp_stream)
+from splatt_tpu.reorder import Permutation, apply_reorder, reorder
+from splatt_tpu.utils import faults
+from tests import gen
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.setenv(tune._CACHE_ENV, str(tmp_path / "tune_cache.json"))
+    monkeypatch.setattr(resilience.time, "sleep", lambda s: None)
+    tune.reset_memo()
+    resilience.reset_demotions()
+    resilience.run_report().clear()
+    yield
+    tune.reset_memo()
+    resilience.reset_demotions()
+    resilience.run_report().clear()
+    faults.reset()
+
+
+def _zipf_tensor(dims=(60, 44, 52), nnz=4000, a=1.5, seed=0):
+    rng = np.random.default_rng(seed)
+    inds = np.stack([(rng.zipf(a, nnz) - 1) % d for d in dims])
+    return SparseTensor(inds, np.round(rng.random(nnz), 3) + 0.1, dims)
+
+
+def _opts(**kw):
+    kw.setdefault("random_seed", 42)
+    kw.setdefault("verbosity", Verbosity.NONE)
+    kw.setdefault("val_dtype", np.float64)
+    kw.setdefault("use_pallas", False)
+    kw.setdefault("autotune", False)
+    return Options(**kw)
+
+
+# -- the packer itself ------------------------------------------------------
+
+def test_balanced_blocks_budget_and_coverage():
+    """Every block holds <= B real nonzeros, the blocks tile the sorted
+    stream exactly (no nonzero lost or duplicated), and the fill floor
+    keeps max/mean real nnz per block <= ~1.1."""
+    tt = _zipf_tensor()
+    rows = np.sort(tt.inds[0])
+    B = 256
+    starts, counts, span = plan_balanced_blocks(rows, B, tt.dims[0])
+    assert counts.max() <= B
+    # exact tiling: consecutive, disjoint, covering
+    assert starts[0] == 0
+    assert np.all(starts[1:] == starts[:-1] + counts[:-1])
+    assert starts[-1] + counts[-1] == rows.shape[0]
+    assert counts.min() >= 1
+    fill = rows.shape[0] / (len(counts) * B)
+    assert fill >= 0.9  # the MIN_FILL contract: max/mean <= ~1.1
+    assert counts.max() / counts.mean() <= 1.12
+    assert span >= 1
+
+
+def test_balanced_improves_span_on_skew():
+    """On a zipf input the balanced layout's seg_width (and with it the
+    one-hot work per nonzero) improves on the fixed slicing while the
+    block-nnz balance stays within the ~1.1 contract."""
+    tt = _zipf_tensor(dims=(120, 90, 100), nnz=12000, a=1.5)
+    fixed = build_layout(tt, 0, block=512, record_stats=False)
+    bal = build_layout(tt, 0, block=512, packing="balanced",
+                       record_stats=False)
+    assert bal.packing == "balanced" and bal.block_nnz is not None
+    assert bal.seg_width <= fixed.seg_width
+    # W=None is in the candidate set and IS the fixed slicing, so the
+    # packer's cost (one-hot lanes + per-block overhead) never regresses
+    cost_fixed = fixed.nblocks * (fixed.seg_width + 8)
+    cost_bal = bal.nblocks * (bal.seg_width + 8)
+    assert cost_bal <= cost_fixed
+    counts = np.asarray(bal.block_nnz)
+    assert counts.max() / counts.mean() <= 1.12
+
+
+# -- engine parity ----------------------------------------------------------
+
+ENGINES = ("xla", "xla_scan")
+
+
+def _forced(layout, facs, mode, path, engine, impl="xla"):
+    return np.asarray(_mttkrp_blocked_jit(layout, facs, mode, path, impl,
+                                          1 << 21, engine))
+
+
+def test_balanced_parity_every_engine():
+    """Balanced vs fixed across engines: scatter paths bit-identical,
+    one-hot paths within accumulation tolerance of the stream oracle,
+    and the balanced layout bit-identical across ITS OWN engines."""
+    tt = _zipf_tensor()
+    facs = init_factors(tt.dims, 5, 1, dtype=jnp.float64)
+    oracle = {m: np.asarray(mttkrp_stream(jnp.asarray(tt.inds),
+                                          jnp.asarray(tt.vals), facs, m,
+                                          tt.dims[m]))
+              for m in range(tt.nmodes)}
+    fixed = build_layout(tt, 0, block=256, val_dtype=np.float64,
+                         record_stats=False)
+    bal = build_layout(tt, 0, block=256, val_dtype=np.float64,
+                       packing="balanced", record_stats=False)
+    # scatter family: pads are additive identities in sorted order ->
+    # bit parity with the fixed layout
+    for path, mode in (("sorted_scatter", 0), ("scatter", 1),
+                       ("scatter", 2)):
+        a = _forced(fixed, facs, mode, path, "xla")
+        b = _forced(bal, facs, mode, path, "xla")
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_allclose(b, oracle[mode], rtol=1e-10, atol=1e-10)
+    # one-hot family: block regrouping changes summation association
+    outs = {}
+    for engine in ENGINES:
+        outs[engine] = _forced(bal, facs, 0, "sorted_onehot", engine)
+        np.testing.assert_allclose(outs[engine], oracle[0], rtol=1e-8,
+                                   atol=1e-8)
+    fx = _forced(fixed, facs, 0, "sorted_onehot", "xla")
+    np.testing.assert_allclose(outs["xla"], fx, rtol=1e-8, atol=1e-8)
+
+
+def test_balanced_parity_interpret_pallas():
+    """The interpret-mode Pallas engines consume balanced layouts
+    through the same decode contract."""
+    tt = _zipf_tensor(dims=(48, 40, 44), nnz=2500)
+    facs = init_factors(tt.dims, 4, 2, dtype=jnp.float32)
+    bal = build_layout(tt, 0, block=256, val_dtype=np.float32,
+                       packing="balanced", record_stats=False)
+    want = _forced(bal, facs, 0, "sorted_onehot", "xla")
+    got = _forced(bal, facs, 0, "sorted_onehot", "unfused_pallas",
+                  impl="pallas_interpret")
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_balanced_v2_and_u8_bitexact():
+    """The v2 compact encodings of a balanced layout (auto and u8
+    segment ids) decode bit-identically to its v1 form, via direct
+    build AND reencode."""
+    tt = _zipf_tensor()
+    facs = init_factors(tt.dims, 4, 3, dtype=jnp.float32)
+    v1 = build_layout(tt, 0, block=256, val_dtype=np.float32,
+                      packing="balanced", record_stats=False)
+    want = _forced(v1, facs, 0, "sorted_onehot", "xla")
+    for idx in ("auto", "u8"):
+        direct = build_layout(tt, 0, block=256, val_dtype=np.float32,
+                              packing="balanced", record_stats=False,
+                              fmt=LayoutFormat(idx=idx))
+        assert direct.encoding == "v2" and direct.packing == "balanced"
+        re = reencode_layout(v1, LayoutFormat(idx=idx))
+        assert re.packing == "balanced" and re.block_nnz is not None
+        for lay in (direct, re):
+            for engine in ENGINES:
+                got = _forced(lay, facs, 0, "sorted_onehot", engine)
+                np.testing.assert_array_equal(
+                    got, _forced(v1, facs, 0, "sorted_onehot", engine))
+            np.testing.assert_array_equal(
+                _forced(lay, facs, 1, "scatter", "xla"),
+                _forced(v1, facs, 1, "scatter", "xla"))
+        assert want is not None
+
+
+# -- degenerate inputs ------------------------------------------------------
+
+def test_hot_slice_long_fiber_split():
+    """One slice holding 50% of all nonzeros: the hot fiber is split
+    across blocks (span 1 each), the result matches the oracle, and
+    seg_width collapses versus the fixed slicing."""
+    rng = np.random.default_rng(5)
+    dims = (80, 50, 60)
+    nnz = 6000
+    hot = nnz // 2
+    i0 = np.concatenate([np.full(hot, 7), rng.integers(0, 80, nnz - hot)])
+    inds = np.stack([i0, rng.integers(0, 50, nnz),
+                     rng.integers(0, 60, nnz)])
+    tt = SparseTensor(inds, rng.random(nnz), dims)
+    bal = build_layout(tt, 0, block=256, val_dtype=np.float64,
+                       packing="balanced", record_stats=False)
+    counts = np.asarray(bal.block_nnz)
+    # the hot fiber alone fills >= hot // 256 whole blocks
+    full = int((counts == 256).sum())
+    assert full >= hot // 256
+    facs = init_factors(dims, 4, 0, dtype=jnp.float64)
+    got = _forced(bal, facs, 0, "sorted_onehot", "xla")
+    want = np.asarray(mttkrp_stream(jnp.asarray(tt.inds),
+                                    jnp.asarray(tt.vals), facs, 0,
+                                    dims[0]))
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-8)
+
+
+def test_single_fiber_tensor():
+    """Every nonzero in one slice: balanced packing is pure splitting
+    — span 1, minimal seg_width — and still exact."""
+    rng = np.random.default_rng(6)
+    nnz = 900
+    dims = (10, 30, 40)
+    inds = np.stack([np.full(nnz, 3), rng.integers(0, 30, nnz),
+                     rng.integers(0, 40, nnz)])
+    tt = SparseTensor(inds, rng.random(nnz), dims)
+    bal = build_layout(tt, 0, block=128, val_dtype=np.float64,
+                       packing="balanced", record_stats=False)
+    assert bal.seg_width == 8  # span 1, rounded to the sublane
+    facs = init_factors(dims, 3, 0, dtype=jnp.float64)
+    got = _forced(bal, facs, 0, "sorted_onehot", "xla")
+    want = np.asarray(mttkrp_stream(jnp.asarray(tt.inds),
+                                    jnp.asarray(tt.vals), facs, 0,
+                                    dims[0]))
+    np.testing.assert_allclose(got, want, rtol=1e-8, atol=1e-8)
+
+
+def test_empty_and_tiny_tensors():
+    tt0 = SparseTensor(np.zeros((3, 0), dtype=np.int64),
+                       np.zeros(0), (4, 5, 6))
+    lay = build_layout(tt0, 0, block=256, packing="balanced",
+                       record_stats=False)
+    assert lay.packing == "fixed"  # nothing to balance: degrades clean
+    tt1 = SparseTensor(np.array([[1], [2], [3]]), np.array([2.0]),
+                       (4, 5, 6))
+    lay1 = build_layout(tt1, 0, block=256, packing="balanced",
+                        record_stats=False)
+    facs = init_factors((4, 5, 6), 3, 0, dtype=jnp.float64)
+    got = _forced(lay1, facs, 0, "sorted_onehot", "xla")
+    want = np.asarray(mttkrp_stream(jnp.asarray(tt1.inds),
+                                    jnp.asarray(tt1.vals), facs, 0, 4))
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+
+# -- classified degrade drills ----------------------------------------------
+
+def test_packing_fault_degrades_classified():
+    """A crashing balanced pack (the layout.pack fault site) degrades
+    the BUILD to the fixed slicing with a packing_fallback event —
+    never a failed run."""
+    tt = _zipf_tensor()
+    with faults.inject("layout.pack", "runtime", times=1):
+        lay = build_layout(tt, 0, block=256, packing="balanced",
+                           record_stats=False)
+    assert lay.packing == "fixed" and lay.block_nnz is None
+    evs = resilience.run_report().events("packing_fallback")
+    assert evs and evs[0]["failure_class"]
+    assert any("balanced fiber pack failed" in ln
+               for ln in resilience.run_report().summary())
+    # the degraded layout still computes
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float64)
+    assert np.isfinite(_forced(lay, facs, 0, "sorted_scatter",
+                               "xla")).all()
+
+
+def test_reorder_fault_degrades_to_identity():
+    """Chaos drill: a crashing reorder.apply degrades CLASSIFIED to
+    identity order (reorder_fallback event) and the CPD still
+    converges — the acceptance drill of docs/layout-balance.md."""
+    tt = _zipf_tensor()
+    opts = _opts(reorder="hgraph", max_iterations=4, tolerance=0.0)
+    with faults.inject("reorder.apply", "runtime", times=1):
+        bs = BlockedSparse.compile(tt, opts, rank=3)
+    assert bs.reorder == "identity" and bs.perm is None
+    assert all(l.reorder == "identity" for l in bs.layouts)
+    evs = resilience.run_report().events("reorder_fallback")
+    assert evs and evs[0]["how"] == "hgraph" and evs[0]["failure_class"]
+    assert any("degraded to identity order" in ln
+               for ln in resilience.run_report().summary())
+    out = cpd_als(bs, 3, opts=opts)
+    assert np.isfinite(float(out.fit))
+
+
+# -- reorder round-trips ----------------------------------------------------
+
+def test_permutation_factor_roundtrip():
+    tt = _zipf_tensor()
+    perm = reorder(tt, "hgraph")
+    U = [np.asarray(u) for u in init_factors(tt.dims, 4, 0)]
+    fwd = [perm.permute_factor(u, m) for m, u in enumerate(U)]
+    back = perm.undo_factors(fwd)
+    for a, b in zip(back, U):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # undo really relabels: a non-identity mode moves rows
+    assert any(not np.array_equal(np.asarray(f), u)
+               for f, u in zip(fwd, U))
+
+
+@pytest.mark.parametrize("how", ["hgraph", "fibsched", "graph"])
+def test_reordered_cpd_matches_identity(how):
+    """CPD over a reordered+balanced BlockedSparse returns factors in
+    ORIGINAL row order (Permutation.undo on output), matching the
+    unreordered run to iteration tolerance."""
+    tt = _zipf_tensor(dims=(30, 24, 28), nnz=1500, seed=3)
+    init = init_factors(tt.dims, 3, 7)
+    base_opts = _opts(max_iterations=6, tolerance=0.0, val_dtype=np.float64)
+    ref = cpd_als(BlockedSparse.compile(tt, base_opts, rank=3), 3,
+                  opts=base_opts, init=init)
+    ro = _opts(max_iterations=6, tolerance=0.0, val_dtype=np.float64,
+               reorder=how, fiber_packing="balanced")
+    bs = BlockedSparse.compile(tt, ro, rank=3)
+    assert bs.reorder == how and bs.perm is not None
+    assert all(l.reorder == how for l in bs.layouts)
+    out = cpd_als(bs, 3, opts=ro, init=init)
+    assert abs(float(out.fit) - float(ref.fit)) < 1e-6
+    for m in range(tt.nmodes):
+        np.testing.assert_allclose(np.asarray(out.factors[m]),
+                                   np.asarray(ref.factors[m]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_checkpoint_reorder_mismatch_degrades_to_fresh(tmp_path):
+    """A checkpoint written in one reorder row space must NOT be
+    resumed under another recipe: the loader refuses (CheckpointError
+    on the direct path) and the resilient resume degrades to a fresh
+    start with a checkpoint_recovery event — never silently permuted
+    factors."""
+    from splatt_tpu.cpd import (CheckpointError, load_checkpoint,
+                                load_checkpoint_resilient)
+
+    tt = _zipf_tensor(dims=(30, 24, 28), nnz=1500, seed=5)
+    init = init_factors(tt.dims, 3, 7)
+    ro = _opts(max_iterations=3, tolerance=0.0, val_dtype=np.float64,
+               reorder="hgraph")
+    ck = str(tmp_path / "ck.npz")
+    cpd_als(BlockedSparse.compile(tt, ro, rank=3), 3, opts=ro, init=init,
+            checkpoint_path=ck, checkpoint_every=3)
+    # same recipe: loads fine; other recipe (incl. identity): refused
+    load_checkpoint(ck, expect_reorder="hgraph")
+    with pytest.raises(CheckpointError, match="row space"):
+        load_checkpoint(ck, expect_reorder="identity")
+    resilience.run_report().clear()
+    assert load_checkpoint_resilient(ck, expect_reorder="graph") is None
+    assert resilience.run_report().events("checkpoint_recovery")
+    # end-to-end: an identity-order resume over the stale reordered
+    # checkpoint starts fresh and still matches the reference run
+    base = _opts(max_iterations=3, tolerance=0.0, val_dtype=np.float64)
+    ref = cpd_als(BlockedSparse.compile(tt, base, rank=3), 3, opts=base,
+                  init=init)
+    res = cpd_als(BlockedSparse.compile(tt, base, rank=3), 3, opts=base,
+                  init=init, checkpoint_path=ck)
+    assert abs(float(res.fit) - float(ref.fit)) < 1e-6
+
+
+def test_reordered_checkpoint_resume_roundtrip(tmp_path):
+    """Checkpoints written mid-run live in RELABELED space; a resume
+    under the same recipe continues them, and the final output is back
+    in original row order — equal to the uninterrupted run."""
+    tt = _zipf_tensor(dims=(30, 24, 28), nnz=1500, seed=4)
+    init = init_factors(tt.dims, 3, 7)
+
+    def opts(iters):
+        return _opts(max_iterations=iters, tolerance=0.0,
+                     val_dtype=np.float64, reorder="hgraph")
+
+    full = cpd_als(BlockedSparse.compile(tt, opts(6), rank=3), 3,
+                   opts=opts(6), init=init)
+    ck = str(tmp_path / "ck.npz")
+    cpd_als(BlockedSparse.compile(tt, opts(3), rank=3), 3, opts=opts(3),
+            init=init, checkpoint_path=ck, checkpoint_every=3)
+    resumed = cpd_als(BlockedSparse.compile(tt, opts(6), rank=3), 3,
+                      opts=opts(6), init=init, checkpoint_path=ck,
+                      checkpoint_every=3)
+    assert abs(float(resumed.fit) - float(full.fit)) < 1e-6
+    for m in range(tt.nmodes):
+        np.testing.assert_allclose(np.asarray(resumed.factors[m]),
+                                   np.asarray(full.factors[m]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# -- tuner integration ------------------------------------------------------
+
+def test_tune_measures_packing_and_reorder():
+    tt = gen.fixture_tensor("med")
+    res = tune.tune(tt, 3, opts=_opts(autotune=True), blocks=(512,),
+                    scan_targets=(1 << 21,), formats=[("i32", "auto")],
+                    packings=("fixed", "balanced"),
+                    reorders=("identity", "hgraph"), reps=1)
+    assert res.measured > 0
+    for p in res.plans.values():
+        assert p.packing in ("fixed", "balanced")
+        assert p.reorder in ("identity", "hgraph")
+
+
+def test_pinned_packing_and_reorder_measured_alone():
+    tt = gen.fixture_tensor("med")
+    opts = _opts(autotune=True, fiber_packing="balanced",
+                 reorder="identity")
+    res = tune.tune(tt, 3, opts=opts, modes=(0,), blocks=(512,),
+                    scan_targets=(1 << 21,), formats=[("i32", "auto")],
+                    reps=1)
+    assert res.plans[0].packing == "balanced"
+    assert res.plans[0].reorder == "identity"
+
+
+def test_plan_strict_match_on_packing_and_reorder():
+    """A plan measured under one (packing, reorder) never steers a
+    layout built under another."""
+    import dataclasses
+
+    tt = gen.fixture_tensor("med")
+    facs = init_factors(tt.dims, 4, 0, dtype=jnp.float64)
+    plan = tune.TunedPlan(path="sorted_scatter", engine="xla",
+                          nnz_block=512, scan_target=1 << 21, sec=0.001,
+                          packing="balanced", reorder="identity")
+    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 4, jnp.float64,
+                                    skew=tune.skew_of(tt, 0)),
+                      {"plan": dataclasses.asdict(plan)})
+    fixed = build_layout(tt, 0, block=512, val_dtype=np.float64,
+                         record_stats=False)
+    bal = build_layout(tt, 0, block=512, val_dtype=np.float64,
+                       packing="balanced", record_stats=False)
+    assert _tuned_plan_for(fixed, facs, 0, "sorted_scatter",
+                           autotune=True) is None
+    assert _tuned_plan_for(bal, facs, 0, "sorted_scatter",
+                           autotune=True) is not None
+    ro = build_layout(tt, 0, block=512, val_dtype=np.float64,
+                      packing="balanced", reorder_label="hgraph",
+                      record_stats=False)
+    assert _tuned_plan_for(ro, facs, 0, "sorted_scatter",
+                           autotune=True) is None
+
+
+def test_compile_reorder_unanimity_and_drop():
+    """Mixed tuned reorder verdicts: compile resolves identity and
+    drops the non-conforming plans WHOLE with tuner_degraded."""
+    import dataclasses
+
+    tt = gen.fixture_tensor("med")
+    mk = dict(path="sorted_scatter", engine="xla", scan_target=1 << 21,
+              sec=0.001, idx_width="i32", val_storage="auto")
+    plans = {0: tune.TunedPlan(nnz_block=512, reorder="hgraph", **mk),
+             1: tune.TunedPlan(nnz_block=1024, reorder="identity", **mk),
+             2: tune.TunedPlan(nnz_block=1024, reorder="identity", **mk)}
+    for m, p in plans.items():
+        tune._entry_store(
+            tune.plan_key(tt.dims, tt.nnz, m, 4, jnp.float64,
+                          skew=tune.skew_of(tt, m)),
+            {"plan": dataclasses.asdict(p)})
+    from splatt_tpu.config import BlockAlloc
+
+    bs = BlockedSparse.compile(
+        tt, _opts(autotune=True, block_alloc=BlockAlloc.ALLMODE), rank=4)
+    assert bs.reorder == "identity" and bs.perm is None
+    # mode 0's hgraph plan was dropped whole: default block applies
+    assert bs.layout_for(0).block != 512
+    assert bs.layout_for(1).block == 1024
+    assert resilience.run_report().events("tuner_degraded")
+
+
+def test_compile_pinned_packing_beats_cached_plan():
+    """An explicitly pinned fiber_packing wins over a stale cached
+    tuned verdict (the val_storage/reorder precedence): disagreeing
+    plans are dropped WHOLE with tuner_degraded, and the build honors
+    the pin."""
+    import dataclasses
+
+    tt = gen.fixture_tensor("med")
+    mk = dict(path="sorted_scatter", engine="xla", scan_target=1 << 21,
+              sec=0.001, idx_width="i32", val_storage="auto",
+              packing="balanced")
+    for m in range(tt.nmodes):
+        tune._entry_store(
+            tune.plan_key(tt.dims, tt.nnz, m, 4, jnp.float64,
+                          skew=tune.skew_of(tt, m)),
+            {"plan": dataclasses.asdict(
+                tune.TunedPlan(nnz_block=512, **mk))})
+    from splatt_tpu.config import BlockAlloc
+
+    # unpinned: the cached balanced verdict applies
+    bs = BlockedSparse.compile(
+        tt, _opts(autotune=True, block_alloc=BlockAlloc.ALLMODE), rank=4)
+    assert all(l.packing == "balanced" for l in bs.layouts)
+    resilience.run_report().clear()
+    # pinned fixed: every balanced plan is dropped whole, build is fixed
+    bs = BlockedSparse.compile(
+        tt, _opts(autotune=True, block_alloc=BlockAlloc.ALLMODE,
+                  fiber_packing="fixed"), rank=4)
+    assert all(l.packing == "fixed" for l in bs.layouts)
+    assert all(l.block != 512 for l in bs.layouts)
+    assert resilience.run_report().events("tuner_degraded")
+
+
+def test_compile_applies_unanimous_reorder():
+    import dataclasses
+
+    tt = gen.fixture_tensor("med")
+    mk = dict(path="sorted_scatter", engine="xla", scan_target=1 << 21,
+              sec=0.001, idx_width="i32", val_storage="auto",
+              packing="balanced", reorder="hgraph")
+    for m in range(tt.nmodes):
+        tune._entry_store(
+            tune.plan_key(tt.dims, tt.nnz, m, 4, jnp.float64,
+                          skew=tune.skew_of(tt, m)),
+            {"plan": dataclasses.asdict(
+                tune.TunedPlan(nnz_block=512, **mk))})
+    from splatt_tpu.config import BlockAlloc
+
+    bs = BlockedSparse.compile(
+        tt, _opts(autotune=True, block_alloc=BlockAlloc.ALLMODE), rank=4)
+    assert bs.reorder == "hgraph" and bs.perm is not None
+    assert all(l.packing == "balanced" and l.reorder == "hgraph"
+               for l in bs.layouts)
+
+
+def test_compile_reorder_degrade_drops_measured_plans():
+    """When apply_reorder degrades classified to identity inside
+    compile, plans MEASURED under the failed recipe are dropped WHOLE
+    (tuner_degraded) — never half-built at identity order in a
+    configuration the tuner never measured."""
+    import dataclasses
+
+    tt = gen.fixture_tensor("med")
+    mk = dict(path="sorted_scatter", engine="xla", scan_target=1 << 21,
+              sec=0.001, idx_width="i32", val_storage="auto",
+              packing="fixed", reorder="hgraph")
+    for m in range(tt.nmodes):
+        tune._entry_store(
+            tune.plan_key(tt.dims, tt.nnz, m, 4, jnp.float64,
+                          skew=tune.skew_of(tt, m)),
+            {"plan": dataclasses.asdict(
+                tune.TunedPlan(nnz_block=512, **mk))})
+    from splatt_tpu.config import BlockAlloc
+
+    resilience.run_report().clear()
+    with faults.inject("reorder.apply", "runtime", times=1):
+        bs = BlockedSparse.compile(
+            tt, _opts(autotune=True, block_alloc=BlockAlloc.ALLMODE),
+            rank=4)
+    assert bs.reorder == "identity" and bs.perm is None
+    assert all(l.reorder == "identity" for l in bs.layouts)
+    # the hgraph-measured plans went with the recipe: default block
+    assert all(l.block != 512 for l in bs.layouts)
+    assert resilience.run_report().events("reorder_fallback")
+    assert resilience.run_report().events("tuner_degraded")
+
+
+def test_skew_regime_keys():
+    """Uniform buckets collapse ("" — legacy keys byte-identical);
+    heavy skew keys its own regime; the bucket is permutation-
+    invariant."""
+    assert tune.skew_regime("k1") == "" and tune.skew_regime("") == ""
+    assert tune.skew_regime("k6") == "k6"
+    legacy = tune.plan_key((64, 64, 64), 4096, 0, 8, jnp.float32)
+    assert tune.plan_key((64, 64, 64), 4096, 0, 8, jnp.float32,
+                         skew="k2") == legacy
+    assert tune.plan_key((64, 64, 64), 4096, 0, 8, jnp.float32,
+                         skew="k6") != legacy
+    tt = _zipf_tensor()
+    tt2, perm = apply_reorder(tt, "hgraph")
+    assert perm is not None
+    for m in range(tt.nmodes):
+        assert tune.skew_of(tt, m) == tune.skew_of(tt2, m)
+    # and a genuinely skewed tensor classifies above the uniform band
+    assert nnz_skew_bucket(tt.mode_histogram(0)) not in ("k0", "k1")
+
+
+def test_shape_key_suffixes_scope_demotions():
+    tt = gen.fixture_tensor("med")
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float64)
+    fixed = build_layout(tt, 0, block=512, val_dtype=np.float64,
+                         record_stats=False)
+    bal = build_layout(tt, 0, block=512, val_dtype=np.float64,
+                       packing="balanced", record_stats=False)
+    ro = build_layout(tt, 0, block=512, val_dtype=np.float64,
+                      packing="balanced", reorder_label="graph",
+                      record_stats=False)
+    k_fixed = _engine_shape_key(fixed, facs, 0)
+    k_bal = _engine_shape_key(bal, facs, 0)
+    k_ro = _engine_shape_key(ro, facs, 0)
+    assert ":bal" not in k_fixed and ":ro" not in k_fixed
+    assert k_bal == k_fixed + ":bal"
+    assert k_ro == k_fixed + ":bal:ro"
+    # an OOM-style demotion under the balanced scope never touches the
+    # fixed layout's dispatch
+    resilience.demote_engine("xla_scan", MemoryError("OOM"),
+                             shape_key=k_bal)
+    assert resilience.is_demoted("xla_scan", k_bal)
+    assert not resilience.is_demoted("xla_scan", k_fixed)
+
+
+# -- imbalance evidence -----------------------------------------------------
+
+def test_layout_imbalance_event_recorded():
+    tt = _zipf_tensor()
+    BlockedSparse.from_coo(tt, _opts(fiber_packing="balanced"))
+    evs = resilience.run_report().events("layout_imbalance")
+    assert evs
+    e = evs[0]
+    for k in ("packing", "block_nnz_max_mean", "span_max_mean",
+              "work_amp", "seg_width", "slice_max_mean"):
+        assert k in e, k
+    assert e["packing"] == "balanced"
+
+
+def test_blockedsparse_imbalance_summary():
+    tt = _zipf_tensor()
+    bs = BlockedSparse.from_coo(tt, _opts(fiber_packing="balanced"))
+    imb = bs.imbalance()
+    for d in imb.values():
+        assert d["packing"] == "balanced"
+        assert d["block_nnz_max_mean"] <= 1.15
+        assert d["work_amp"] > 0
+
+
+def test_skew_stats_distinguish_uniform_from_powerlaw():
+    from splatt_tpu.stats import skew_stats, skew_stats_text
+
+    rng = np.random.default_rng(0)
+    uni = SparseTensor(np.stack([rng.integers(0, d, 4000)
+                                 for d in (60, 44, 52)]),
+                       rng.random(4000), (60, 44, 52))
+    zipf = _zipf_tensor()
+    su, sz = skew_stats(uni), skew_stats(zipf)
+    for m in ("0", "1", "2"):
+        assert sz["modes"][m]["max_mean"] > su["modes"][m]["max_mean"]
+        assert sz["modes"][m]["p99_median"] >= su["modes"][m]["p99_median"]
+    assert "fiber_max_mean" in sz
+    txt = skew_stats_text(zipf)
+    assert "max/mean" in txt and "top-slice" in txt
+
+
+# -- distributed balanced sharding ------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs >=4 devices")
+@pytest.mark.parametrize("decomp", ["fine", "coarse"])
+def test_balanced_rowdist_parity_and_evidence(decomp):
+    """row_distribute='balanced' (fine + coarse): same factors as the
+    plain run, with layout_imbalance evidence carrying the policy."""
+    from splatt_tpu.config import Decomposition
+    from splatt_tpu.parallel import distributed_cpd_als
+
+    tt = _zipf_tensor(dims=(64, 48, 56), nnz=3000, seed=2)
+    init = init_factors(tt.dims, 3, 7)
+
+    def run(rowdist):
+        resilience.run_report().clear()
+        o = Options(random_seed=3, max_iterations=4, tolerance=0.0,
+                    verbosity=Verbosity.NONE, autotune=False,
+                    decomposition=Decomposition(decomp))
+        out = distributed_cpd_als(tt, 3, opts=o, init=init,
+                                  row_distribute=rowdist)
+        return out, resilience.run_report().events("layout_imbalance")
+
+    plain, _ = run(None)
+    bal, evs = run("balanced")
+    assert evs and any(e.get("policy") == "balanced" for e in evs)
+    assert abs(float(plain.fit) - float(bal.fit)) < 1e-4
+    for m in range(tt.nmodes):
+        np.testing.assert_allclose(np.asarray(bal.factors[m]),
+                                   np.asarray(plain.factors[m]),
+                                   rtol=5e-3, atol=1e-4)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs >=4 devices")
+def test_balanced_rowdist_improves_fence_balance():
+    from splatt_tpu.parallel.common import balanced_relabel
+
+    tt = _zipf_tensor(dims=(64, 48, 56), nnz=4000, seed=0)
+    ndev = len(jax.devices())
+    for m in range(tt.nmodes):
+        dim_pad = -(-tt.dims[m] // ndev) * ndev
+        cap = dim_pad // ndev
+        hist = tt.mode_histogram(m)
+
+        def fence_ratio(labels):
+            w = np.zeros(ndev, dtype=np.int64)
+            np.add.at(w, labels // cap, hist)
+            return w.max() / max(w.mean(), 1e-12)
+
+        plain = fence_ratio(np.arange(tt.dims[m]))
+        bal = fence_ratio(balanced_relabel(hist, ndev, cap))
+        assert bal <= plain + 1e-9
+
+
+# -- bench integration ------------------------------------------------------
+
+def test_bench_balance_gate_leg():
+    """The --gate comparison flags a work-amplification inflation on
+    the balance:<path> leg exactly like a bytes inflation."""
+    import bench
+
+    base = {"metric": "m", "value": 1.0, "unit": "sec/iter",
+            "imbalance": {"per_path": {"balanced": {"work_amp": 100.0}}}}
+    worse = {"metric": "m", "value": 1.0, "unit": "sec/iter",
+             "imbalance": {"per_path": {"balanced": {"work_amp": 130.0}}}}
+    regs = bench._bench_regressions(worse, base)
+    assert any(r["path"] == "balance:balanced" for r in regs)
+    assert not bench._bench_regressions(base, base)
+
+
+def test_bench_guard_ab_legs():
+    """The guard A/B helper measures all four legs (health sentinel
+    on/off x donation on/off) on a real cpd_als run."""
+    import bench
+
+    tt = _zipf_tensor(dims=(24, 20, 22), nnz=800, seed=1)
+    from splatt_tpu.config import BlockAlloc
+
+    legs = bench._guard_ab_legs(tt, 3, 2, jnp.float32, False,
+                                BlockAlloc.TWOMODE)
+    for retries in ("on", "off"):
+        for donate in ("on", "off"):
+            key = f"guard_{retries}:donate_{donate}"
+            assert key in legs
+            assert legs[key] is None or legs[key] >= 0.0
+
+
+def test_bench_scenarios_generate():
+    import bench
+
+    tt, desc, label = bench.scenario_tensor("zipf:1.5", "nell2", 2000, 0)
+    assert label == "zipf1.5" and "zipf1.5" in desc
+    assert tt.nnz == 2000
+    tt2, desc2, label2 = bench.scenario_tensor("amazon-like", "nell2",
+                                               2000, 0)
+    assert label2 == "amazon-like" and tt2.dims == \
+        bench.SCENARIO_SHAPES["amazon-like"]
+    tt3, desc3, label3 = bench.scenario_tensor("uniform", "nell2", 2000, 0)
+    assert label3 is None and desc3 == "NELL-2-shaped"
+    with pytest.raises(ValueError):
+        bench.scenario_tensor("zipf:0.5", "nell2", 100, 0)
+    with pytest.raises(ValueError):
+        bench.scenario_tensor("bogus", "nell2", 100, 0)
+    # the zipf generator is genuinely skewed where the uniform one
+    # is not (its hash-scatter destroys the head)
+    from splatt_tpu.stats import skew_stats
+
+    z = skew_stats(tt)["modes"]["0"]["max_mean"]
+    u = skew_stats(tt3)["modes"]["0"]["max_mean"]
+    assert z > 4 * u

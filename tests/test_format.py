@@ -394,7 +394,8 @@ def test_v2_plan_never_steers_v1_layout():
     plan = tune.TunedPlan(path="sorted_scatter", engine="xla",
                           nnz_block=512, scan_target=1 << 21, sec=0.001,
                           idx_width="auto", val_storage="auto")
-    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 4, jnp.float64),
+    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 4, jnp.float64,
+                                    skew=tune.skew_of(tt, 0)),
                       {"plan": dataclasses.asdict(plan)})
     assert _tuned_plan_for(lay_v2, facs, 0, "sorted_scatter",
                            autotune=True) is not None
@@ -429,7 +430,8 @@ def test_compile_builds_layouts_at_tuned_format():
                           idx_width="auto", val_storage="bf16")
     for m in range(tt.nmodes):
         tune._entry_store(
-            tune.plan_key(tt.dims, tt.nnz, m, 4, jnp.float32),
+            tune.plan_key(tt.dims, tt.nnz, m, 4, jnp.float32,
+                          skew=tune.skew_of(tt, m)),
             {"plan": dataclasses.asdict(plan)})
     opts = Options(random_seed=42, verbosity=Verbosity.NONE,
                    val_dtype=np.float32, use_pallas=False, autotune=True)
@@ -463,7 +465,8 @@ def test_mixed_storage_verdicts_drop_plan_whole():
                                val_storage="auto", **mk)}
     for m, p in plans.items():
         tune._entry_store(tune.plan_key(tt.dims, tt.nnz, m, 4,
-                                        jnp.float32),
+                                        jnp.float32,
+                                        skew=tune.skew_of(tt, m)),
                           {"plan": dataclasses.asdict(p)})
     opts = Options(random_seed=42, verbosity=Verbosity.NONE,
                    val_dtype=np.float32, use_pallas=False, autotune=True,
@@ -492,10 +495,10 @@ def test_tuner_bf16_alias_key_written():
     res = tune.tune(tt, 3, opts=opts, modes=(0,), blocks=(512,),
                     scan_targets=(1 << 21,), reps=1)
     assert res.plans[0].val_storage == "bf16"
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, 3,
-                            jnp.float32) is not None
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, 3,
-                            jnp.bfloat16) is not None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, 3, jnp.float32,
+                            skew=tune.skew_of(tt, 0)) is not None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, 3, jnp.bfloat16,
+                            skew=tune.skew_of(tt, 0)) is not None
 
 
 # -- u8 segment-id streams (ISSUE 8 satellite, ROADMAP open item 2) ----------
@@ -573,7 +576,8 @@ def test_u8_reencode_and_plan_match():
                           val_storage="auto", **mk)
     auto = reencode_layout(v1, LF(idx="auto"))
     assert _engine_shape_key(u8, facs, 0).endswith(":v2")
-    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 5, jnp.float64),
+    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 5, jnp.float64,
+                                    skew=tune.skew_of(tt, 0)),
                       {"plan": dataclasses.asdict(plan)})
     assert _tuned_plan_for(u8, facs, 0, "sorted_onehot",
                            autotune=True) is not None

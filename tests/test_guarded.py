@@ -293,9 +293,11 @@ def test_tuner_deadline_skips_but_never_persists(tmp_path):
     tt = gen.fixture_tensor("med")
     tune.set_cache_path(str(tmp_path / "tc.json"))
     resilience.set_deadline(0.2)
-    # pinned format: exactly ONE candidate (the sorted_scatter chain is
-    # ["xla"]), so the single blown measurement leaves the mode planless
-    opts = _opts(use_pallas=False, idx_width="i32", val_storage="auto")
+    # pinned format + packing + reorder: exactly ONE candidate (the
+    # sorted_scatter chain is ["xla"]), so the single blown measurement
+    # leaves the mode planless
+    opts = _opts(use_pallas=False, idx_width="i32", val_storage="auto",
+                 fiber_packing="fixed", reorder="identity")
     with faults.inject("tuner.measure", "slow", delay=0.7, times=1):
         res = tune.tune(tt, rank=3, opts=opts, modes=[0],
                         blocks=(256,), reps=1)

@@ -89,7 +89,8 @@ def test_plan_survives_process_restart():
     tt = _tensor()
     res = tune.tune(tt, RANK, opts=_opts(), reps=1)
     tune.reset_memo()
-    plan = tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64)
+    plan = tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64,
+                            skew=tune.skew_of(tt, 0))
     assert plan == res.plans[0]
 
 
@@ -104,7 +105,8 @@ def test_ttl_expiry_retunes(monkeypatch):
             entry["ts"] = 1.0  # the distant past
     _cache_file().write_text(json.dumps(data))
     tune.reset_memo()
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64) is None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64,
+                            skew=tune.skew_of(tt, 0)) is None
     res = tune.tune(tt, RANK, opts=_opts(), reps=1)
     assert res.measured > 0, "expired plans must be re-earned"
 
@@ -116,7 +118,8 @@ def test_kernel_source_hash_invalidates_plans(monkeypatch):
     tune.tune(tt, RANK, opts=_opts(), reps=1)
     tune.reset_memo()
     monkeypatch.setattr(pk, "_kernel_src_hash", lambda: "edited123456")
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64) is None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64,
+                            skew=tune.skew_of(tt, 0)) is None
 
 
 def test_corrupt_cache_degrades_to_retune():
@@ -125,14 +128,15 @@ def test_corrupt_cache_degrades_to_retune():
     tt = _tensor()
     _cache_file().parent.mkdir(parents=True, exist_ok=True)
     _cache_file().write_text("{ not json")
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64) is None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64,
+                            skew=tune.skew_of(tt, 0)) is None
     assert resilience.run_report().events("tune_cache_io_error")
     res = tune.tune(tt, RANK, opts=_opts(), reps=1)
     assert res.plans and res.measured > 0
     # the re-tune replaced the corrupt file with a valid one
     tune.reset_memo()
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK,
-                            jnp.float64) is not None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64,
+                            skew=tune.skew_of(tt, 0)) is not None
 
 
 def test_foreign_cache_version_is_retuned():
@@ -144,7 +148,8 @@ def test_foreign_cache_version_is_retuned():
     data["version"] = tune.PLAN_CACHE_VERSION + 1
     _cache_file().write_text(json.dumps(data))
     tune.reset_memo()
-    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64) is None
+    assert tune.cached_plan(tt.dims, tt.nnz, 0, RANK, jnp.float64,
+                            skew=tune.skew_of(tt, 0)) is None
 
 
 def test_plan_key_is_shape_regime_scoped():
@@ -259,7 +264,8 @@ def test_fault_drill_env_armed_tuner_crash(monkeypatch):
 
 def _store_plan(tt, mode, rank, dtype, **plan):
     plan.setdefault("sec", 0.001)
-    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, mode, rank, dtype),
+    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, mode, rank, dtype,
+                                    skew=tune.skew_of(tt, mode)),
                       {"plan": plan})
 
 

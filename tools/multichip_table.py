@@ -69,6 +69,12 @@ for name in DIST_TIMER_NAMES:
 rec = dict(
     sec_per_iter=steady[len(steady) // 2] if steady else None,
     phases=phases, fit=float(res.fit), wall=round(wall, 1))
+imb = [{{k: v for k, v in e.items() if k != "ts"}}
+       for e in resilience.run_report().events("layout_imbalance")]
+if imb:
+    # achieved shard/cell balance (docs/layout-balance.md): max/mean
+    # nnz per worker next to the measured seconds
+    rec["imbalance"] = imb
 if comm is not None:
     # the achieved-overlap metric the driver measured (docs/ring.md)
     # + the wire model of the SELECTED strategy — MULTICHIP artifacts
