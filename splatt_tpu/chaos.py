@@ -121,14 +121,23 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
               dims=(40, 32, 24), nnz: int = 3000, rank: int = 4,
               iters: int = 8, deadline_s: float = 0.5,
               tune_first: bool = True, smoke: bool = False,
-              verbose: bool = False) -> ChaosResult:
+              verbose: bool = False,
+              trace_path: Optional[str] = None) -> ChaosResult:
     """Run one seeded CPD soak under a chaos schedule and check the
     guarded-execution invariant.  Owns process-global resilience state
     (faults, demotions, the run report, the deadline override): a chaos
     run is a diagnostic, not a library call — it resets that state on
     entry and disarms on exit.
+
+    With `trace_path` the soak additionally exercises the exporter end
+    to end (docs/observability.md): span recording is enabled for the
+    run, the recorder is exported to a Chrome trace-event file at
+    `trace_path`, and the invariant gains two legs — the export must
+    succeed (a ``trace_written`` ok event), and every fired fault's
+    run-report evidence must ALSO appear as point events on the trace
+    (the event-on-span wiring cannot silently rot).
     """
-    from splatt_tpu import resilience, tune
+    from splatt_tpu import resilience, trace, tune
     from splatt_tpu.blocked import BlockedSparse
     from splatt_tpu.config import Options, Verbosity
     from splatt_tpu.cpd import cpd_als
@@ -145,6 +154,11 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
     faults.reset()
     resilience.reset_demotions()
     resilience.run_report().clear()
+    if trace_path:
+        # the exporter leg: a fresh recorder, spans ON for the whole
+        # soak (the guards' own spans included), exported in `finally`
+        trace.reset()
+        trace.set_enabled(True)
     # 0 = explicit disable (beats an exported SPLATT_DEADLINE_S); the
     # probe's own always-on default survives either way
     resilience.set_deadline(deadline_s if deadline_s > 0 else 0.0)
@@ -207,6 +221,12 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
         faults.reset()
         resilience.set_deadline(None)
         tune.set_cache_path(None)
+        trace_ev = None
+        trace_points: List[dict] = []
+        if trace_path:
+            trace_points = trace.points()
+            trace_ev = trace.write_chrome_trace(trace_path)
+            trace.set_enabled(None)
 
     report = resilience.run_report()
     events = report.events()
@@ -216,6 +236,35 @@ def run_chaos(schedule: Optional[str] = None, seed: int = 0,
     if error is not None:
         violations.append(f"unhandled exception escaped the guarded "
                           f"drivers: {error}")
+    if trace_path:
+        # the exporter legs of the invariant (docs/observability.md)
+        if not (trace_ev and trace_ev.get("ok")):
+            violations.append(
+                f"trace export to {trace_path} failed: "
+                f"{(trace_ev or {}).get('failure_class')}: "
+                f"{(trace_ev or {}).get('error')}")
+        else:
+            try:
+                exported = trace.load_trace(trace_path)
+                if not any(e.get("ph") == "X" for e in exported):
+                    violations.append(
+                        f"exported trace {trace_path} holds no spans — "
+                        f"the soak ran with recording on")
+            except (OSError, ValueError) as e:
+                violations.append(
+                    f"exported trace {trace_path} is not loadable "
+                    f"Chrome trace-event JSON: {e}")
+        point_kinds = {p["name"] for p in trace_points}
+        for site, spec in specs.items():
+            if fired.get(site, 0) == 0:
+                continue
+            want = _EVIDENCE.get(spec.kind, ())
+            if want and not point_kinds & set(want):
+                violations.append(
+                    f"fault {site}:{spec.kind} fired but none of its "
+                    f"evidence events {list(want)} reached the trace "
+                    f"as point events — the event-on-span wiring is "
+                    f"broken")
     for site, spec in specs.items():
         if fired.get(site, 0) == 0:
             continue

@@ -36,6 +36,14 @@ def _common_opts(p: argparse.ArgumentParser) -> None:
                    help="increase verbosity (repeatable)")
 
 
+def _trace_opt(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trace", metavar="OUT_JSON",
+                   help="record structured spans for this run and "
+                        "export a perfetto-loadable Chrome trace-event "
+                        "JSON file on exit (docs/observability.md); "
+                        "summarize it with `splatt trace OUT_JSON`")
+
+
 def _build_opts(args) -> "Options":
     from splatt_tpu.config import BlockAlloc, Options, Verbosity
 
@@ -280,7 +288,8 @@ def cmd_chaos(args) -> int:
                           rank=args.rank, iters=args.iters,
                           deadline_s=args.deadline,
                           smoke=args.smoke,
-                          verbose=args.verbose > 0)
+                          verbose=args.verbose > 0,
+                          trace_path=args.trace)
     for line in chaos.format_report(res):
         print(line)
     gate_ok = True
@@ -331,6 +340,11 @@ def cmd_serve(args) -> int:
                        verbose=args.verbose > 0)
     srv.install_signal_handlers()
     summary = srv.run_once() if args.once else srv.serve_forever()
+    if args.once:
+        # batch mode exits without the daemon loop's exit snapshot:
+        # force one here so SPLATT_METRICS_PATH always holds the final
+        # registry state (docs/observability.md)
+        srv.write_metrics_now()
     from splatt_tpu import resilience
 
     lines = resilience.run_report().summary()
@@ -390,6 +404,26 @@ def cmd_bench(args) -> int:
         if dev > tol:
             print(f"error: algorithms disagree beyond tolerance {tol}")
             return 1
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """`splatt trace <file>` — summarize a Chrome trace-event JSON file
+    written by ``--trace <path>`` (docs/observability.md): top spans by
+    self-time, the per-iteration breakdown, the guard-overhead share,
+    and point-event counts."""
+    from splatt_tpu import trace
+
+    s = trace.summarize_file(args.file)
+    if args.json:
+        import json as _json
+
+        # tuples JSON-serialize as lists; drop the redundant "top"
+        # ordering (recoverable from "names") for a stable schema
+        print(_json.dumps({k: v for k, v in s.items() if k != "top"}))
+        return 0
+    for line in trace.format_summary(s, top_n=args.top):
+        print(line)
     return 0
 
 
@@ -584,6 +618,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also print a machine-readable JSON run "
                         "summary (fit, run-report events including "
                         "health rollbacks, engine demotions)")
+    _trace_opt(p)
     p.set_defaults(fn=cmd_cpd)
 
     p = sub.add_parser(
@@ -623,6 +658,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "slow fault kind blows it deliberately)")
     p.add_argument("--json", action="store_true",
                    help="also print the full ChaosResult as JSON")
+    p.add_argument("--trace", metavar="OUT_JSON",
+                   help="run the soak with span tracing on, export the "
+                        "Chrome trace to OUT_JSON, and additionally "
+                        "assert that every fired fault left a matching "
+                        "point event ON THE TRACE (the exporter leg of "
+                        "the invariant; docs/observability.md)")
     p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser(
@@ -667,6 +708,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "state (and result, when terminal) as JSON")
     p.add_argument("--json", action="store_true",
                    help="print the full per-job state map on exit")
+    _trace_opt(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
@@ -685,6 +727,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "holds an unexpired winner")
     p.add_argument("--alloc", choices=["onemode", "twomode", "allmode"])
     p.add_argument("--f64", action="store_true")
+    _trace_opt(p)
     p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
@@ -714,7 +757,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--check", action="store_true",
                    help="cross-validate algorithm outputs against stream "
                         "(≙ the reference's --write dumps)")
+    _trace_opt(p)
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "trace", help="summarize a recorded span-trace file",
+        epilog="Reads a Chrome trace-event JSON file (the --trace "
+               "<path> export of cpd/tune/bench/serve/chaos) and "
+               "prints top spans by self-time, the per-iteration "
+               "breakdown, the guard-overhead share, and point-event "
+               "counts.  Load the same file in ui.perfetto.dev for the "
+               "interactive view (docs/observability.md).")
+    p.add_argument("file", help="Chrome trace-event JSON written by "
+                                "--trace")
+    p.add_argument("--top", type=int, default=12, metavar="N",
+                   help="rows in the top-spans table (default 12)")
+    p.add_argument("--json", action="store_true",
+                   help="print the aggregate summary as JSON instead")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("check", help="check for duplicates/empty slices")
     _common_opts(p)
@@ -760,11 +820,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"splatt-tpu: error: rank must be >= 1 (got {args.rank})",
               file=sys.stderr)
         return 2
+    # --trace <path> (docs/observability.md): enable span recording
+    # process-wide for this invocation — timers, build, cpd/serve spans
+    # all land in one tree — and export on the way out, success or
+    # error (a crash's partial trace is exactly when you want one).
+    # The chaos verb owns its own trace leg (run_chaos arms, exports
+    # and ASSERTS on the trace), so it is excluded here.
+    trace_out = (getattr(args, "trace", None)
+                 if getattr(args, "cmd", "") != "chaos" else None)
+    if trace_out:
+        from splatt_tpu import trace
+
+        trace.set_enabled(True)
     try:
         return args.fn(args)
     except (OSError, ValueError) as e:
         print(f"splatt-tpu: error: {e}", file=sys.stderr)
         return 1
+    finally:
+        if trace_out:
+            ev = trace.write_chrome_trace(trace_out)
+            trace.set_enabled(None)
+            print(f"splatt-tpu: trace "
+                  + (f"written to {trace_out} ({ev.get('spans')} spans, "
+                     f"{ev.get('events')} point events); summarize "
+                     f"with: splatt trace {trace_out}"
+                     if ev.get("ok") else
+                     f"export to {trace_out} FAILED "
+                     f"({ev.get('failure_class')}: {ev.get('error')})"),
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -360,6 +360,14 @@ class Options:
     # the distributed drivers resolve it once at entry.
     comm_pattern: Optional[CommPattern] = None
 
+    # Structured span tracing (splatt_tpu/trace.py,
+    # docs/observability.md): None = env default (SPLATT_TRACE, off);
+    # True records host-side spans (cpd → sweep → guard, dispatch,
+    # comm) for the Chrome-trace exporter; False pins tracing off for
+    # this run even when the process enables it.  Point-event metrics
+    # are always on regardless — only span recording is gated.
+    trace: Optional[bool] = None
+
     # Numerics: device compute dtype. None = auto (float32, upgraded to
     # float64 when host data is f64 and x64 is enabled).  An explicit
     # dtype — including an explicit float32 — is respected as-is, so a

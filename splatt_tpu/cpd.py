@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from splatt_tpu import trace
 from splatt_tpu.blocked import BlockedSparse
 from splatt_tpu.config import Options, Verbosity, default_opts, resolve_dtype
 from splatt_tpu.coo import SparseTensor
@@ -358,25 +359,27 @@ def _save_checkpoint(path: str, factors, lam, it: int, fit: float,
 
     from splatt_tpu.utils import faults
 
-    faults.maybe_fail("checkpoint_write")
-    tmp = path + ".tmp.npz"
-    payload = {f"factor{m}": np.asarray(U) for m, U in enumerate(factors)}
-    payload.update(nmodes=len(factors), it=it, fit=fit,
-                   lam=np.asarray(lam),
-                   dims=np.asarray([U.shape[0] for U in factors]),
-                   rank=int(factors[0].shape[1]))
-    digest = _checkpoint_digest(payload)
-    np.savez(tmp, schema=_CKPT_SCHEMA, checksum=digest,
-             reorder=np.str_(reorder), **payload)
-    if faults.consume("checkpoint_torn"):
-        # injected torn write: drop the tail of the bytes just written,
-        # as a crashed writer or dying mount would
-        size = os.path.getsize(tmp)
-        with open(tmp, "r+b") as f:
-            f.truncate(max(size // 2, 1))
-    if os.path.exists(path):
-        os.replace(path, path + ".bak")
-    os.replace(tmp, path)
+    with trace.span("cpd.checkpoint", path=path, it=int(it)):
+        faults.maybe_fail("checkpoint_write")
+        tmp = path + ".tmp.npz"
+        payload = {f"factor{m}": np.asarray(U)
+                   for m, U in enumerate(factors)}
+        payload.update(nmodes=len(factors), it=it, fit=fit,
+                       lam=np.asarray(lam),
+                       dims=np.asarray([U.shape[0] for U in factors]),
+                       rank=int(factors[0].shape[1]))
+        digest = _checkpoint_digest(payload)
+        np.savez(tmp, schema=_CKPT_SCHEMA, checksum=digest,
+                 reorder=np.str_(reorder), **payload)
+        if faults.consume("checkpoint_torn"):
+            # injected torn write: drop the tail of the bytes just
+            # written, as a crashed writer or dying mount would
+            size = os.path.getsize(tmp)
+            with open(tmp, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+        if os.path.exists(path):
+            os.replace(path, path + ".bak")
+        os.replace(tmp, path)
 
 
 def load_checkpoint(path: str, verify: bool = True,
@@ -495,6 +498,24 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     checkpoints running jobs instead of abandoning or outliving them.
     """
     opts = (opts or default_opts()).validate()
+    # structured tracing (docs/observability.md): Options.trace pins
+    # recording on/off for this run (None defers to the process/env
+    # default), and every span below nests under the cpd.als root —
+    # the tree the Chrome-trace exporter and `splatt trace` summarize
+    with trace.enabling(opts.trace):
+        with trace.span("cpd.als", rank=int(rank),
+                        donate=opts.donate_sweep,
+                        max_iterations=int(opts.max_iterations)):
+            return _cpd_als_traced(X, rank, opts, init, checkpoint_path,
+                                   checkpoint_every, resume, stop)
+
+
+def _cpd_als_traced(X: Union[SparseTensor, BlockedSparse], rank: int,
+                    opts: Options, init, checkpoint_path,
+                    checkpoint_every: int, resume: bool,
+                    stop) -> KruskalTensor:
+    """:func:`cpd_als` body, running inside the ``cpd.als`` root span
+    (and the run's tracing override) the public wrapper opened."""
     if isinstance(X, SparseTensor):
         dims, nmodes = X.dims, X.nmodes
         xnormsq = X.normsq()
@@ -620,10 +641,12 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
         # health rollback's regularization bump) the sweep must be
         # REBUILT — the old jit wrapper may hold a compiled executable
         # with the demoted engine (or a fault-poisoned trace) inlined
-        if profiled:
-            return _make_profiled_sweep(X, nmodes, reg)
-        return (_make_phased_sweep if phased
-                else _make_sweep)(X, nmodes, reg, donate=donate)
+        with trace.span("cpd.build_sweep", regularization=float(reg),
+                        phased=phased, profiled=profiled):
+            if profiled:
+                return _make_profiled_sweep(X, nmodes, reg)
+            return (_make_phased_sweep if phased
+                    else _make_sweep)(X, nmodes, reg, donate=donate)
 
     sweep = build_sweep()
     if profiled:
@@ -657,16 +680,21 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     snap = None
 
     def snapshot():
-        if consumes_inputs:
-            # the donated sweep will CONSUME these buffers: only a
-            # host copy survives as a rollback target
-            return ([np.asarray(u) for u in factors],
-                    [np.asarray(g) for g in grams],
-                    np.asarray(lam))
-        # non-donating sweeps never consume their inputs: holding the
-        # committed device arrays IS the snapshot — no transfer, just
-        # one older generation of factors+grams kept alive per check
-        return (list(factors), list(grams), lam)
+        # guard work, explicitly attributed: under the donated fused
+        # sweep each refresh is a full host copy of every factor — the
+        # prime suspect of ROADMAP open item 1, now a trace query
+        with trace.span("cpd.guard.snapshot", host_copy=consumes_inputs):
+            if consumes_inputs:
+                # the donated sweep will CONSUME these buffers: only a
+                # host copy survives as a rollback target
+                return ([np.asarray(u) for u in factors],
+                        [np.asarray(g) for g in grams],
+                        np.asarray(lam))
+            # non-donating sweeps never consume their inputs: holding
+            # the committed device arrays IS the snapshot — no
+            # transfer, just one older generation of factors+grams
+            # kept alive per check
+            return (list(factors), list(grams), lam)
 
     if (consumes_inputs and can_rescue) or guard > 0:
         snap = snapshot()
@@ -678,181 +706,217 @@ def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
     from splatt_tpu.utils import faults as _faults
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
-        # fetch the fit to host only at check iterations: on remote/
-        # tunneled devices each fetch is a costly sync, and k sweeps
-        # queue back-to-back between checks (k=1 ≙ the reference).
-        # A due checkpoint forces a check — the checkpoint_every
-        # contract outranks sync batching.
-        checkpoint_due = (checkpoint_path is not None
-                          and (it + 1) % checkpoint_every == 0)
-        check = ((it + 1) % k == 0 or it + 1 == opts.max_iterations
-                 or checkpoint_due)
-        # runtime graceful degradation: a sweep-level failure (an engine
-        # dying at outer-jit compile time, or an async runtime failure
-        # surfacing at the next sync) demotes the implicated engine and
-        # retries THIS iteration on a rebuilt sweep — the run degrades
-        # to the next engine in the chain instead of crashing.  Failures
-        # inside mttkrp_blocked's own dispatch are already handled one
-        # level down; this catches what escapes it.  The host fetch of
-        # the fit is where ASYNC device failures actually surface, so
-        # it lives INSIDE the rescued scope — and the sweep outputs are
-        # committed to factors/grams only after it succeeds, so a
-        # rescued retry re-runs from the pre-sweep state instead of
-        # carrying a failed program's poisoned outputs forward.  (On a
-        # deferred iteration — fit_check_every > 1, no sync — an async
-        # failure can still land one iteration late; that is the
-        # documented trade of batching host syncs.)
-        rescue_attempts = 0
-        while True:
-            try:
-                f_new, g_new, lam_new, znormsq, inner = sweep(
-                    factors, grams, it == 0)
-                # chaos hook: a poison-armed cpd.sweep fault corrupts
-                # one sweep's factor output with non-finite values —
-                # the silent blowup the sentinel exists to catch.  The
-                # LAST factor: every next-sweep MTTKRP reads it, so an
-                # unguarded run genuinely diverges (a poisoned FIRST
-                # factor would be silently recomputed by mode 0's own
-                # update before anything reads it)
-                f_new[-1] = _faults.poison("cpd.sweep", f_new[-1])
-                fit = _fit(xnormsq, znormsq, inner)
-                if check and guard > 0:
-                    # numerical-health sentinel: the finite-check
-                    # reduction rides the fit fetch (ONE host sync)
-                    fitval, offending, healthy = _health_verdict(
-                        np.asarray(_health_pack(f_new, lam_new, fit)),
-                        nmodes)
-                    if not healthy:
-                        err = _resilience.NumericalHealthError(
-                            f"non-finite sweep outputs at iteration "
-                            f"{it + 1} (factor modes "
-                            f"{offending or 'none'}; λ/fit "
-                            f"{'finite' if offending else 'non-finite'})")
-                        err.offending = offending
-                        raise err
-                else:
-                    fitval = float(fit) if check else None
-                break
-            except _resilience.NumericalHealthError as e:
-                health_attempts += 1
-                offending = getattr(e, "offending", [])
-                _resilience.run_report().add(
-                    "health_nonfinite", iteration=it + 1,
-                    modes=offending,
-                    error=_resilience.failure_message(e)[:200])
-                if health_attempts > guard:
-                    # budget exhausted: degrade to checkpoint-and-abort
-                    # — return the last-good snapshot instead of
-                    # diverging or crashing (docs/guarded-als.md)
-                    degraded = True
+        # one span per iteration (docs/observability.md): sweep
+        # dispatch through the commit — the unit whose span sums the
+        # `splatt trace` summarizer reconciles with the printed
+        # sec/iter.  begin/end (not `with`) keeps the guarded body at
+        # its natural indentation; every exit path funnels through the
+        # finally.
+        it_span = trace.begin("cpd.iter", it=it + 1)
+        try:
+            # fetch the fit to host only at check iterations: on remote/
+            # tunneled devices each fetch is a costly sync, and k sweeps
+            # queue back-to-back between checks (k=1 ≙ the reference).
+            # A due checkpoint forces a check — the checkpoint_every
+            # contract outranks sync batching.
+            checkpoint_due = (checkpoint_path is not None
+                              and (it + 1) % checkpoint_every == 0)
+            check = ((it + 1) % k == 0 or it + 1 == opts.max_iterations
+                     or checkpoint_due)
+            # runtime graceful degradation: a sweep-level failure (an
+            # engine dying at outer-jit compile time, or an async
+            # runtime failure surfacing at the next sync) demotes the
+            # implicated engine and retries THIS iteration on a rebuilt
+            # sweep — the run degrades to the next engine in the chain
+            # instead of crashing.  Failures inside mttkrp_blocked's own
+            # dispatch are already handled one level down; this catches
+            # what escapes it.  The host fetch of the fit is where ASYNC
+            # device failures actually surface, so it lives INSIDE the
+            # rescued scope — and the sweep outputs are committed to
+            # factors/grams only after it succeeds, so a rescued retry
+            # re-runs from the pre-sweep state instead of carrying a
+            # failed program's poisoned outputs forward.  (On a deferred
+            # iteration — fit_check_every > 1, no sync — an async
+            # failure can still land one iteration late; that is the
+            # documented trade of batching host syncs.)
+            rescue_attempts = 0
+            while True:
+                try:
+                    # host-side dispatch only: the device completes
+                    # asynchronously and lands in the fit-check span
+                    with trace.span("cpd.sweep"):
+                        f_new, g_new, lam_new, znormsq, inner = sweep(
+                            factors, grams, it == 0)
+                    # chaos hook: a poison-armed cpd.sweep fault
+                    # corrupts one sweep's factor output with
+                    # non-finite values — the silent blowup the
+                    # sentinel exists to catch.  The LAST factor: every
+                    # next-sweep MTTKRP reads it, so an unguarded run
+                    # genuinely diverges (a poisoned FIRST factor would
+                    # be silently recomputed by mode 0's own update
+                    # before anything reads it)
+                    f_new[-1] = _faults.poison("cpd.sweep", f_new[-1])
+                    fit = _fit(xnormsq, znormsq, inner)
+                    if check and guard > 0:
+                        # numerical-health sentinel: the finite-check
+                        # reduction rides the fit fetch (ONE host sync).
+                        # The fit_check span is that sync; the guard's
+                        # incremental work on top of it — building and
+                        # fetching the packed vector — is attributed to
+                        # its own cpd.guard.health_pack child
+                        with trace.span("cpd.fit_check", it=it + 1):
+                            with trace.span("cpd.guard.health_pack"):
+                                packed = np.asarray(
+                                    _health_pack(f_new, lam_new, fit))
+                        fitval, offending, healthy = _health_verdict(
+                            packed, nmodes)
+                        if not healthy:
+                            err = _resilience.NumericalHealthError(
+                                f"non-finite sweep outputs at iteration "
+                                f"{it + 1} (factor modes "
+                                f"{offending or 'none'}; λ/fit "
+                                f"{'finite' if offending else 'non-finite'})")
+                            err.offending = offending
+                            raise err
+                    elif check:
+                        # the one existing host sync batched device
+                        # work drains into (SPL003's sanctioned point)
+                        with trace.span("cpd.fit_check", it=it + 1):
+                            fitval = float(fit)
+                    else:
+                        fitval = None
                     break
-                # rollback: restore the last-good host snapshot, bump
-                # regularization (re-conditioning the normal equations)
-                # and re-randomize the offending factor(s); the sweep is
-                # REBUILT so a fault-poisoned trace cannot survive
+                except _resilience.NumericalHealthError as e:
+                    health_attempts += 1
+                    offending = getattr(e, "offending", [])
+                    _resilience.run_report().add(
+                        "health_nonfinite", iteration=it + 1,
+                        modes=offending,
+                        error=_resilience.failure_message(e)[:200])
+                    if health_attempts > guard:
+                        # budget exhausted: degrade to checkpoint-and-
+                        # abort — return the last-good snapshot instead
+                        # of diverging or crashing (docs/guarded-als.md)
+                        degraded = True
+                        break
+                    # rollback: restore the last-good host snapshot,
+                    # bump regularization (re-conditioning the normal
+                    # equations) and re-randomize the offending
+                    # factor(s); the sweep is REBUILT so a
+                    # fault-poisoned trace cannot survive
+                    with trace.span("cpd.guard.rollback", it=it + 1,
+                                    attempt=health_attempts):
+                        factors = [jnp.asarray(u) for u in snap[0]]
+                        grams = [jnp.asarray(g) for g in snap[1]]
+                        lam = jnp.asarray(snap[2])
+                        reg = ((opts.regularization
+                                if opts.regularization > 0 else 1e-6)
+                               * (10.0 ** health_attempts))
+                        key = jax.random.PRNGKey(opts.seed() + 7919)
+                        for m in offending:
+                            factors[m] = jax.random.uniform(
+                                jax.random.fold_in(
+                                    key, health_attempts * 64 + m),
+                                factors[m].shape, dtype=factors[m].dtype)
+                            grams[m] = gram(factors[m])
+                    _resilience.run_report().add(
+                        "health_rollback", iteration=it + 1,
+                        attempt=health_attempts, regularization=reg,
+                        rerandomized=offending)
+                    if opts.verbosity >= Verbosity.LOW:
+                        print(f"  non-finite sweep outputs at iteration "
+                              f"{it + 1}; rolled back to the last-good "
+                              f"snapshot (attempt {health_attempts}/"
+                              f"{guard}: reg={reg:g}, re-randomized modes "
+                              f"{offending})")
+                    sweep = build_sweep(reg)
+                except Exception as e:
+                    rescue_attempts += 1
+                    if (rescue_attempts > 6
+                            or not _try_engine_rescue(X, opts, e)):
+                        raise
+                    sweep = build_sweep()
+                    if snap is not None and any(
+                            getattr(a, "is_deleted", lambda: False)()
+                            for a in [*factors, *grams]):
+                        # the failed program consumed the donated
+                        # inputs: re-materialize the retry state from
+                        # the host snapshot (ALS is self-correcting, so
+                        # restarting from the last checked iterate just
+                        # continues the same optimization)
+                        factors = [jnp.asarray(u) for u in snap[0]]
+                        grams = [jnp.asarray(g) for g in snap[1]]
+            if degraded:
+                # the result is the last-good state; persist it so a
+                # later resume (perhaps with more retries or a fixed
+                # input) continues from here instead of redoing the work
                 factors = [jnp.asarray(u) for u in snap[0]]
                 grams = [jnp.asarray(g) for g in snap[1]]
                 lam = jnp.asarray(snap[2])
-                reg = ((opts.regularization
-                        if opts.regularization > 0 else 1e-6)
-                       * (10.0 ** health_attempts))
-                key = jax.random.PRNGKey(opts.seed() + 7919)
-                for m in offending:
-                    factors[m] = jax.random.uniform(
-                        jax.random.fold_in(key,
-                                           health_attempts * 64 + m),
-                        factors[m].shape, dtype=factors[m].dtype)
-                    grams[m] = gram(factors[m])
+                action = "stopped early with the last-good factors"
+                if checkpoint_path is not None:
+                    # the snapshot corresponds to the LAST HEALTHY
+                    # check, not the iteration the blowup was detected
+                    # at — a resume must redo the rolled-back window,
+                    # not skip it
+                    _save_checkpoint(checkpoint_path, factors, lam,
+                                     last_check_it, fit_prev,
+                                     reorder=reorder_label)
+                    action += f"; checkpointed to {checkpoint_path}"
                 _resilience.run_report().add(
-                    "health_rollback", iteration=it + 1,
-                    attempt=health_attempts, regularization=reg,
-                    rerandomized=offending)
+                    "health_degraded", iteration=it + 1, action=action)
                 if opts.verbosity >= Verbosity.LOW:
-                    print(f"  non-finite sweep outputs at iteration "
-                          f"{it + 1}; rolled back to the last-good "
-                          f"snapshot (attempt {health_attempts}/"
-                          f"{guard}: reg={reg:g}, re-randomized modes "
-                          f"{offending})")
-                sweep = build_sweep(reg)
-            except Exception as e:
-                rescue_attempts += 1
-                if (rescue_attempts > 6
-                        or not _try_engine_rescue(X, opts, e)):
-                    raise
-                sweep = build_sweep()
-                if snap is not None and any(
-                        getattr(a, "is_deleted", lambda: False)()
-                        for a in [*factors, *grams]):
-                    # the failed program consumed the donated inputs:
-                    # re-materialize the retry state from the host
-                    # snapshot (ALS is self-correcting, so restarting
-                    # from the last checked iterate just continues the
-                    # same optimization)
-                    factors = [jnp.asarray(u) for u in snap[0]]
-                    grams = [jnp.asarray(g) for g in snap[1]]
-        if degraded:
-            # the result is the last-good state; persist it so a later
-            # resume (perhaps with more retries or a fixed input)
-            # continues from here instead of redoing the work
-            factors = [jnp.asarray(u) for u in snap[0]]
-            grams = [jnp.asarray(g) for g in snap[1]]
-            lam = jnp.asarray(snap[2])
-            action = "stopped early with the last-good factors"
-            if checkpoint_path is not None:
-                # the snapshot corresponds to the LAST HEALTHY check,
-                # not the iteration the blowup was detected at — a
-                # resume must redo the rolled-back window, not skip it
-                _save_checkpoint(checkpoint_path, factors, lam,
-                                 last_check_it, fit_prev,
-                                 reorder=reorder_label)
-                action += f"; checkpointed to {checkpoint_path}"
-            _resilience.run_report().add(
-                "health_degraded", iteration=it + 1, action=action)
+                    print(f"  health-retry budget ({guard}) exhausted at "
+                          f"iteration {it + 1}; {action}")
+                break
+            factors, grams, lam = f_new, g_new, lam_new
+            if not check:
+                if opts.verbosity >= Verbosity.HIGH:
+                    print(f"  its = {it + 1:3d} (deferred fit check)")
+                continue
+            it_span.set(fit=fitval)
+            elapsed = time.perf_counter() - t0
+            if snap is not None and guard > 0:
+                # refresh the rollback target only after a
+                # verified-finite check.  With the sentinel disabled
+                # (guard == 0) the refresh is SKIPPED entirely — guards
+                # must be free when off, and for the donated fused sweep
+                # each refresh is a full host copy of every factor.  The
+                # initial snapshot is kept for the (rare) engine rescue,
+                # which then re-materializes the pre-run state: ALS is
+                # self-correcting, so the retry re-converges, just from
+                # further back.
+                snap = snapshot()
             if opts.verbosity >= Verbosity.LOW:
-                print(f"  health-retry budget ({guard}) exhausted at "
-                      f"iteration {it + 1}; {action}")
-            break
-        factors, grams, lam = f_new, g_new, lam_new
-        if not check:
-            if opts.verbosity >= Verbosity.HIGH:
-                print(f"  its = {it + 1:3d} (deferred fit check)")
-            continue
-        elapsed = time.perf_counter() - t0
-        if snap is not None and guard > 0:
-            # refresh the rollback target only after a verified-finite
-            # check.  With the sentinel disabled (guard == 0) the
-            # refresh is SKIPPED entirely — guards must be free when
-            # off, and for the donated fused sweep each refresh is a
-            # full host copy of every factor.  The initial snapshot is
-            # kept for the (rare) engine rescue, which then
-            # re-materializes the pre-run state: ALS is self-correcting,
-            # so the retry re-converges, just from further back.
-            snap = snapshot()
-        if opts.verbosity >= Verbosity.LOW:
-            print(f"  its = {it + 1:3d} ({elapsed:.3f}s)  fit = {fitval:0.5f}"
-                  f"  delta = {fitval - fit_prev:+0.4e}")
-        if checkpoint_due:
-            _save_checkpoint(checkpoint_path, factors, lam, it + 1, fitval,
-                             reorder=reorder_label)
-        if stop is not None and stop():
-            # cooperative interruption (serve drain): the state just
-            # committed is checkpointed so a later resume redoes
-            # nothing, and the caller decides what the early return
-            # means (the fit so far is a truthful partial result)
-            if checkpoint_path is not None and not checkpoint_due:
+                print(f"  its = {it + 1:3d} ({elapsed:.3f}s)"
+                      f"  fit = {fitval:0.5f}"
+                      f"  delta = {fitval - fit_prev:+0.4e}")
+            if checkpoint_due:
                 _save_checkpoint(checkpoint_path, factors, lam, it + 1,
                                  fitval, reorder=reorder_label)
+            if stop is not None and stop():
+                # cooperative interruption (serve drain): the state just
+                # committed is checkpointed so a later resume redoes
+                # nothing, and the caller decides what the early return
+                # means (the fit so far is a truthful partial result)
+                if checkpoint_path is not None and not checkpoint_due:
+                    _save_checkpoint(checkpoint_path, factors, lam,
+                                     it + 1, fitval,
+                                     reorder=reorder_label)
+                fit_prev = fitval
+                break
+            # tolerance scales with the *actual* delta window: k sweeps
+            # between regular checks, but a checkpoint-forced check can
+            # land mid-window (≙ the k=1 per-iteration test,
+            # src/cpd.c:368-370)
+            window = (it + 1) - last_check_it
+            last_check_it = it + 1
+            if it > 0 and abs(fitval - fit_prev) < opts.tolerance * window:
+                fit_prev = fitval
+                break
             fit_prev = fitval
-            break
-        # tolerance scales with the *actual* delta window: k sweeps
-        # between regular checks, but a checkpoint-forced check can land
-        # mid-window (≙ the k=1 per-iteration test, src/cpd.c:368-370)
-        window = (it + 1) - last_check_it
-        last_check_it = it + 1
-        if it > 0 and abs(fitval - fit_prev) < opts.tolerance * window:
-            fit_prev = fitval
-            break
-        fit_prev = fitval
+        finally:
+            trace.end(it_span)
     timers.stop("cpd")
 
     out = post_process(factors, lam, jnp.asarray(fit_prev, dtype=dtype))

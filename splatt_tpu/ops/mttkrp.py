@@ -421,9 +421,16 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             # transient HTTP 500 at compile time would demote the
             # flagship engine for the whole run, the PR 1 bug class at
             # run scope.  Deterministic/resource/unknown failures
-            # propagate immediately to the demotion below.
-            return resilience.retry_transient(attempt,
-                                              label=f"engine.{engine}")
+            # propagate immediately to the demotion below.  The span
+            # records host-side dispatch cost with the CHOSEN engine;
+            # under a jitted sweep it fires at trace time, once per
+            # compilation (docs/observability.md).
+            from splatt_tpu import trace
+
+            with trace.span("mttkrp.dispatch", mode=int(mode), path=path,
+                            engine=engine, block=int(layout.block)):
+                return resilience.retry_transient(attempt,
+                                                  label=f"engine.{engine}")
         except Exception as e:
             if not fallback or i == last:
                 raise

@@ -691,16 +691,23 @@ def probe_cache_load(state_key: str):
     re-earned occasionally on drifting infrastructure."""
     import time
 
+    from splatt_tpu import trace
+
     data = _json_cache_load(_cache_path())
     if data is None:
+        trace.metric_inc("splatt_probe_cache_total", outcome="miss")
         return None
     try:
         entry = data.get(_cache_env_key(), {}).get(state_key)
         if not entry:
+            trace.metric_inc("splatt_probe_cache_total", outcome="miss")
             return None
         ttl = probe_cache_ttl()
         if ttl > 0 and time.time() - float(entry.get("ts", 0)) > ttl:
+            trace.metric_inc("splatt_probe_cache_total",
+                             outcome="expired")
             return None
+        trace.metric_inc("splatt_probe_cache_total", outcome="hit")
         return entry["state"]
     except Exception as e:
         # a malformed entry (hand-edited file, schema drift) is an

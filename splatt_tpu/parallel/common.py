@@ -809,9 +809,32 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     (re-placed into the current run's shardings, Grams recomputed);
     pass resume=False to overwrite.
     """
+    from splatt_tpu import trace
+
+    # structured tracing (docs/observability.md): same pattern as
+    # cpd_als — Options.trace pins recording for this run, and every
+    # dist.step span below nests under the dist.als root the exporter
+    # and `splatt trace` summarize
+    with trace.enabling(opts.trace):
+        with trace.span("dist.als", rank=int(rank),
+                        max_iterations=int(opts.max_iterations)):
+            return _run_distributed_als_traced(
+                step, factors, grams, rank, opts, xnormsq, dims, dtype,
+                row_select, checkpoint_path, checkpoint_every, resume)
+
+
+def _run_distributed_als_traced(step, factors, grams, rank: int,
+                                opts: Options, xnormsq: float,
+                                dims: Sequence[int], dtype, row_select,
+                                checkpoint_path: str,
+                                checkpoint_every: int,
+                                resume: bool) -> KruskalTensor:
+    """:func:`run_distributed_als` body, running inside the ``dist.als``
+    root span (and the run's tracing override) the public wrapper
+    opened."""
     import os
 
-    from splatt_tpu import resilience
+    from splatt_tpu import resilience, trace
     from splatt_tpu.cpd import (_health_pack, _health_verdict,
                                 _save_checkpoint, health_retries,
                                 load_checkpoint_resilient)
@@ -875,7 +898,12 @@ def run_distributed_als(step: Callable, factors, grams, rank: int,
     for it in range(start_it, opts.max_iterations):
         t0 = time.perf_counter()
         flag = jnp.asarray(1.0 if it == 0 else 0.0, dtype=dtype)
-        factors, grams, lam, znormsq, inner = step(factors, grams, flag)
+        # one span per distributed step invocation (host-side dispatch;
+        # device completion lands in the fit fetch below) — the
+        # `splatt trace` per-iteration breakdown reads these
+        with trace.span("dist.step", it=it + 1):
+            factors, grams, lam, znormsq, inner = step(factors, grams,
+                                                       flag)
         # chaos hook: a poison-armed cpd.sweep fault corrupts one
         # sweep's LAST factor output (the one every next-sweep MTTKRP
         # reads — see cpd_als; container type preserved, since

@@ -694,6 +694,21 @@ def measure_ring_overlap(mesh, nmodes, reg, dims_pad, axis, variant,
     probe), is timed directly instead of re-tracing an identical sweep
     — the real step's compile is not paid twice.
     """
+    from splatt_tpu import trace
+
+    # the measurement pays extra compiles (stub + exchange-only
+    # programs) — attribute it so a traced distributed run shows the
+    # overlap probe's cost next to the sweep it instruments
+    with trace.span("dist.measure_overlap", variant=variant):
+        return _measure_ring_overlap(
+            mesh, nmodes, reg, dims_pad, axis, variant, inds, vals,
+            factors, grams, dtype, reps, step_fn)
+
+
+def _measure_ring_overlap(mesh, nmodes, reg, dims_pad, axis, variant,
+                          inds, vals, factors, grams, dtype, reps,
+                          step_fn) -> dict:
+    """:func:`measure_ring_overlap` body, inside its span."""
     import time as _time
 
     from splatt_tpu.parallel.common import comm_volume_model
@@ -955,11 +970,19 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         step = wrap_profiled_step(step)
     else:
         # comm-engine selection with the classified fallback ladder
-        # (docs/ring.md): async_ring -> ring -> all2all
-        variant, step = _select_comm_sweep(
-            chain, mesh, nmodes, opts.regularization, dims_pad, axis,
-            cells_meta, inds, vals, cells_dev, factors, grams, dtype,
-            opts)
+        # (docs/ring.md): async_ring -> ring -> all2all.  This (and the
+        # overlap probe below) runs BEFORE run_distributed_als opens
+        # its enabling scope, so the Options.trace per-run pin must be
+        # honored here too
+        from splatt_tpu import trace
+
+        with trace.enabling(opts.trace):
+            with trace.span("dist.comm_select") as _sp:
+                variant, step = _select_comm_sweep(
+                    chain, mesh, nmodes, opts.regularization, dims_pad,
+                    axis, cells_meta, inds, vals, cells_dev, factors,
+                    grams, dtype, opts)
+                _sp.set(variant=variant)
     if opts.verbosity >= Verbosity.HIGH:
         # the wire model follows the SELECTED strategy, not an all2all
         # assumption (ISSUE 8 satellite)
@@ -979,12 +1002,14 @@ def sharded_cpd_als(tt: SparseTensor, rank: int, mesh: Optional[Mesh] = None,
         # invocations — not a cost every default run should pay.
         # Best-effort: a measurement failure must never take down the
         # run it measures.
-        from splatt_tpu import resilience
+        from splatt_tpu import resilience, trace
 
         try:
-            ov = measure_ring_overlap(mesh, nmodes, opts.regularization,
-                                      dims_pad, axis, variant, inds, vals,
-                                      factors, grams, dtype, step_fn=step)
+            with trace.enabling(opts.trace):
+                ov = measure_ring_overlap(
+                    mesh, nmodes, opts.regularization, dims_pad, axis,
+                    variant, inds, vals, factors, grams, dtype,
+                    step_fn=step)
             resilience.run_report().add("ring_overlap", **ov)
             if opts.verbosity >= Verbosity.LOW:
                 print(f"  ring overlap [{ov['engine']}]: "

@@ -429,9 +429,16 @@ def deadline(site: str, seconds: Optional[float] = None):
 
                 _thread.interrupt_main()
 
-    timer = threading.Timer(seconds, fire)
-    timer.daemon = True
-    timer.start()
+    # guard work is explicitly attributed (docs/observability.md):
+    # arming the watchdog gets its own span so ROADMAP open item 1's
+    # "are the guards taxing the hot loop?" is a trace query
+    from splatt_tpu import trace
+
+    with trace.span("guard.deadline.arm", site=site,
+                    seconds=float(seconds)):
+        timer = threading.Timer(seconds, fire)
+        timer.daemon = True
+        timer.start()
 
     def blew() -> "DeadlineExceeded":
         run_report().add("deadline_blown", site=site,
@@ -444,18 +451,19 @@ def deadline(site: str, seconds: Optional[float] = None):
         try:
             yield
         finally:
-            with lock:
-                state["done"] = True
-            timer.cancel()
-            if state["fired"] and on_main:
-                # the timer fired (possibly while we were already
-                # exiting): absorb the pending interrupt_main HERE,
-                # inside the guarded region, so it cannot escape as a
-                # bare KeyboardInterrupt after the with-block
-                try:
-                    time.sleep(0.05)
-                except KeyboardInterrupt:
-                    pass
+            with trace.span("guard.deadline.disarm", site=site):
+                with lock:
+                    state["done"] = True
+                timer.cancel()
+                if state["fired"] and on_main:
+                    # the timer fired (possibly while we were already
+                    # exiting): absorb the pending interrupt_main HERE,
+                    # inside the guarded region, so it cannot escape as
+                    # a bare KeyboardInterrupt after the with-block
+                    try:
+                        time.sleep(0.05)
+                    except KeyboardInterrupt:
+                        pass
     except KeyboardInterrupt:
         # covers both the yield and the cleanup above: an interrupt
         # delivered mid-finally (lock acquire, timer.cancel) still
@@ -597,6 +605,19 @@ RUN_REPORT_EVENTS = {
                    "judge: the coefficient of variation of one side "
                    "exceeded the threshold, so the slowdown is a "
                    "warning, not a gate failure (bench.py)",
+    "trace_written": "a Chrome trace-event JSON export "
+                     "(trace.write_chrome_trace, the --trace <path> "
+                     "flag; docs/observability.md) was written, or "
+                     "failed classified — losing the trace must never "
+                     "lose the run; ok=False with path '(annotation)' "
+                     "records a degraded TPU trace-annotation probe",
+    "metrics_snapshot": "the metrics registry was snapshotted to a "
+                        "Prometheus text file (trace.write_metrics — "
+                        "the serve cadence via SPLATT_METRICS_PATH / "
+                        "SPLATT_METRICS_INTERVAL_S; "
+                        "docs/observability.md); a write failure "
+                        "degrades classified, never kills the daemon "
+                        "it observes",
 }
 
 
@@ -656,6 +677,13 @@ class RunReport:
         if self.job_id is not None and "job" not in ev:
             ev["job"] = self.job_id
         self._events.append(ev)
+        # every emission is ALSO a timestamped point event attached to
+        # the enclosing trace span (and feeds the always-on metrics
+        # registry): demotions, fallbacks and rollbacks become visible
+        # in time order on the exported trace (docs/observability.md)
+        from splatt_tpu import trace
+
+        trace.point(kind, ev)
         return ev
 
     def events(self, kind: Optional[str] = None) -> List[dict]:
@@ -775,6 +803,25 @@ class RunReport:
             lines.append(f"  job {e.get('job')} finished degraded "
                          f"({e.get('failure_class')}: "
                          f"{str(e.get('error', ''))[:80]})")
+        for e in self.events("trace_written"):
+            if e.get("ok"):
+                lines.append(f"  trace written to {e.get('path')} "
+                             f"({e.get('spans')} spans, "
+                             f"{e.get('events')} point events)")
+            else:
+                lines.append(f"  trace export {e.get('path')} degraded "
+                             f"({e.get('failure_class')}: "
+                             f"{str(e.get('error', ''))[:80]})")
+        snaps = self.events("metrics_snapshot")
+        ok_snaps = [e for e in snaps if e.get("ok")]
+        if ok_snaps:
+            lines.append(f"  {len(ok_snaps)} metrics snapshot(s) "
+                         f"written to {ok_snaps[-1].get('path')}")
+        for e in snaps:
+            if not e.get("ok"):
+                lines.append(f"  metrics snapshot to {e.get('path')} "
+                             f"FAILED ({e.get('failure_class')}: "
+                             f"{str(e.get('error', ''))[:80]})")
         return lines
 
 

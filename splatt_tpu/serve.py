@@ -186,6 +186,16 @@ class Server:
         self.job_deadline_s = float(
             job_deadline_s if job_deadline_s is not None
             else read_env_float("SPLATT_SERVE_JOB_DEADLINE_S"))
+        # metrics cadence (docs/observability.md): with a path set, the
+        # registry is snapshotted in Prometheus text format every
+        # interval seconds and at daemon exit; interval <= 0 snapshots
+        # at exit only
+        from splatt_tpu.utils.env import read_env
+
+        self.metrics_path = read_env("SPLATT_METRICS_PATH") or None
+        self.metrics_interval_s = float(
+            read_env_float("SPLATT_METRICS_INTERVAL_S"))
+        self._metrics_last = 0.0
         self.verbose = verbose
         self._lock = threading.Lock()
         #: id -> {"spec": dict|None, "state": str, "status": str|None,
@@ -237,6 +247,8 @@ class Server:
                 # lineage entry only — the ACCEPTED record already
                 # guarantees a later replay re-finds this job
                 self._warn_journal("resume", jid, e)
+        if self._queue:
+            self._queue_metric(len(self._queue))
 
     # -- submission / job API ----------------------------------------------
 
@@ -304,6 +316,10 @@ class Server:
         with self._lock:
             self._jobs[jid]["state"] = ACCEPTED
             self._queue.append(jid)
+            # gauge published under the lock: concurrent workers'
+            # pop/publish pairs stay ordered, so the depth is
+            # monotone-consistent with the queue
+            self._queue_metric(len(self._queue))
         self._log(f"job {jid}: accepted")
         return {"job": jid, "state": ACCEPTED}
 
@@ -326,6 +342,10 @@ class Server:
             self._warn_journal("reject", jid, e)
         self._write_result(jid, {"job": jid, "status": "rejected",
                                  "reason": reason})
+        from splatt_tpu import trace
+
+        trace.metric_inc("splatt_serve_jobs_total", status="rejected",
+                         job=jid)
         self._log(f"job {jid}: rejected ({reason})")
         return {"job": jid, "state": REJECTED, "reason": reason}
 
@@ -399,7 +419,16 @@ class Server:
 
     def _next(self) -> Optional[str]:
         with self._lock:
-            return self._queue.popleft() if self._queue else None
+            jid = self._queue.popleft() if self._queue else None
+            if jid is not None:
+                self._queue_metric(len(self._queue))
+        return jid
+
+    @staticmethod
+    def _queue_metric(depth: int) -> None:
+        from splatt_tpu import trace
+
+        trace.metric_set("splatt_serve_queue_depth", float(depth))
 
     def run_once(self) -> dict:
         """Ingest the spool, then run every queued job to a terminal
@@ -461,8 +490,34 @@ class Server:
         :meth:`drain`).  Returns the final :meth:`summary`."""
         while not self._draining.is_set():
             self.run_once()
+            self._maybe_write_metrics()
             self._draining.wait(self.poll_s)
+        self.write_metrics_now()
         return self.summary()
+
+    # -- metrics snapshots (docs/observability.md) ---------------------------
+
+    def _maybe_write_metrics(self) -> None:
+        """One cadence tick: snapshot the registry to
+        ``SPLATT_METRICS_PATH`` when the interval elapsed (interval
+        <= 0 means exit-only snapshots)."""
+        if not self.metrics_path or self.metrics_interval_s <= 0:
+            return
+        now = time.monotonic()
+        if now - self._metrics_last >= self.metrics_interval_s:
+            self.write_metrics_now()
+
+    def write_metrics_now(self) -> Optional[dict]:
+        """Force one Prometheus-text snapshot (atomic replace; a write
+        failure degrades classified inside write_metrics — metrics must
+        never kill the daemon they observe).  No-op without
+        ``SPLATT_METRICS_PATH``."""
+        if not self.metrics_path:
+            return None
+        from splatt_tpu import trace
+
+        self._metrics_last = time.monotonic()
+        return trace.write_metrics(self.metrics_path)
 
     def drain(self) -> None:
         """Begin a graceful drain: stop pulling queued jobs, interrupt
@@ -493,7 +548,13 @@ class Server:
             # re-run cheap
             self._warn_journal("start", jid, e)
         self._log(f"job {jid}: started" + (" (resumed)" if resumed else ""))
-        record = self._execute(jid, spec, resumed)
+        from splatt_tpu import trace
+
+        # one span per supervised job (docs/observability.md): with
+        # tracing on, a tenant's whole run — cpd.als and its guard
+        # spans nested under it — carries the job id
+        with trace.span("serve.job", job=jid, resumed=resumed):
+            record = self._execute(jid, spec, resumed)
         if record is None:
             # drain interrupt: NOT terminal — the job already
             # checkpointed via the stop hook; journal the interruption
@@ -625,6 +686,17 @@ class Server:
                            for d in resilience.demotions()])
             if fired:
                 record["faults_fired"] = fired
+            # terminal-job metrics, recorded INSIDE the scope so every
+            # sample carries this tenant's job label, then the job's
+            # own cut of the registry embedded in its result — a
+            # neighbor's counters never appear (docs/observability.md)
+            from splatt_tpu import trace
+
+            trace.metric_inc("splatt_serve_jobs_total",
+                             status=record["status"])
+            trace.metric_observe("splatt_job_seconds",
+                                 float(record["seconds"]))
+            record["metrics"] = trace.metrics_snapshot(job=jid)
         return record
 
     def _run_cpd(self, jid: str, spec: dict, stop: Callable[[], bool]):

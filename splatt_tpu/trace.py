@@ -1,0 +1,796 @@
+"""splatt trace: structured span tracing + a metrics registry.
+
+Observability used to be scattered — a global wall-clock timer array
+(utils/timers.py, ≙ the reference's src/timer.h), run-report events
+(resilience.py), and per-driver bench JSON — so when a hot path slipped
+(ROADMAP open item 1: the r05 guard-regression question) nobody could
+say *where the time went*.  This module is the unifying layer:
+
+Span tree
+    :func:`span` opens one named host-side span (a context manager);
+    spans nest — ``cpd.als`` → ``cpd.iter`` → ``cpd.sweep`` /
+    ``mttkrp.dispatch`` / ``cpd.guard.*`` — each carrying start,
+    duration and attributes (engine, plan, block, iteration, job id
+    from the active :func:`resilience.scope <splatt_tpu.resilience.scope>`).
+    Guard work (health-pack fetch, snapshot refresh, rollback, deadline
+    arm/disarm) gets its OWN spans, so guard overhead becomes a query
+    over the trace instead of a cross-PR bench hunt.  On TPU each span
+    additionally enters a ``jax.profiler.TraceAnnotation`` so device
+    traces line up with the host spans.
+
+Point events
+    Every run-report emission (``resilience.RunReport.add``) flows
+    through :func:`point`: demotions, fallbacks, rollbacks and the
+    ``comm_fallback``/``format_fallback`` ladders become timestamped
+    instant events attached to the enclosing span — visible in time
+    order on the exported trace.
+
+Metrics registry
+    Counters/gauges/histograms declared in :data:`METRICS` (the
+    SPL007/SPL012-style name registry): cache hits vs misses, retries,
+    demotions by class, health rollbacks, serve queue depth, per-job
+    latency.  Event-driven metrics are ALWAYS collected (increments on
+    rare events cost nothing measurable); ``splatt serve`` snapshots
+    them to a Prometheus-text file on a cadence (``SPLATT_METRICS_PATH``
+    / ``SPLATT_METRICS_INTERVAL_S``) and embeds each job's own samples
+    in its result JSON (per-job isolation via the ``job`` label).
+
+Exporters
+    :func:`write_chrome_trace` writes Chrome trace-event JSON
+    (perfetto-loadable) — ``--trace <path>`` on the ``cpd``/``bench``/
+    ``tune``/``serve`` CLI verbs; :func:`summarize`/:func:`format_summary`
+    power the ``splatt trace <file>`` verb (top spans by self-time,
+    per-iteration breakdown, guard-overhead %).
+
+Overhead contract
+    Spans are NO-OPS unless enabled (``SPLATT_TRACE`` /
+    ``Options.trace`` / :func:`set_enabled`): one boolean check, no
+    allocation.  Enabled spans never sync the device (SPL003-clean —
+    they read ``perf_counter`` only; host blocking stays at the
+    existing fit-check syncs), and the bench trace A/B leg
+    (bench.py ``trace_ab``) measures enabled-but-unexported tracing on
+    the blocked path — the <2 % budget docs/observability.md documents.
+
+Span names are a registry (:data:`SPANS`), statically checked by
+splint rule SPL013 exactly like fault sites (SPL006) and run-report
+events (SPL012): an undeclared ``trace.span("...")`` literal — or a
+declared name no production code opens — is a finding.
+
+This module imports nothing heavy at import time (no jax, no numpy);
+jax is touched lazily only for the optional TPU trace annotation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+#: Every span name production code opens, name -> one-line doc — the
+#: authoritative catalog of the tracing surface (docs/observability.md
+#: renders from it).  ``splint`` rule SPL013 statically checks every
+#: ``trace.span("<name>")``/``trace.begin("<name>")`` literal against
+#: this registry in both directions, mirroring SPL006 (fault sites) and
+#: SPL012 (run-report events).  A trailing ``.*`` declares an f-string
+#: family (``trace.span(f"timer.{name}")``).
+SPANS = {
+    "cpd.als": "one cpd_als run end-to-end (attrs: rank, guard budget, "
+               "donation; the root every single-chip span nests under)",
+    "cpd.iter": "one ALS iteration — sweep dispatch through the commit "
+                "(attrs: it, fit at check iterations); per-iteration "
+                "sums reconcile with the driver's printed sec/iter",
+    "cpd.sweep": "the sweep invocation of one iteration (host-side "
+                 "dispatch; device completion lands in the fit fetch)",
+    "cpd.build_sweep": "(re)building + jit-wrapping the sweep callable "
+                       "(paid at start, after an engine demotion, and "
+                       "on a health rollback's regularization bump)",
+    "cpd.fit_check": "the fit host fetch at a check iteration — the "
+                     "one existing device sync batched work drains "
+                     "into",
+    "cpd.checkpoint": "one atomic .npz checkpoint write",
+    "cpd.guard.health_pack": "numerical-health sentinel: building and "
+                             "fetching the packed finite-check vector "
+                             "(rides the fit-check sync)",
+    "cpd.guard.snapshot": "refreshing the last-good rollback snapshot "
+                          "(a host copy only under the donated fused "
+                          "sweep)",
+    "cpd.guard.rollback": "one health rollback: restore the last-good "
+                          "snapshot, bump reg, re-randomize offenders",
+    "guard.deadline.arm": "arming the deadline watchdog timer for one "
+                          "guarded host-side call",
+    "guard.deadline.disarm": "cancelling the watchdog timer (and "
+                             "absorbing a raced interrupt) on exit",
+    "mttkrp.dispatch": "one blocked-MTTKRP engine-chain dispatch "
+                       "(attrs: mode, path, block, chosen engine); "
+                       "under a jitted sweep this records trace-time, "
+                       "once per compilation",
+    "tune.measure": "one autotuner candidate measurement (warm + "
+                    "timed forced-engine MTTKRP calls)",
+    "dist.als": "one distributed convergence loop (run_distributed_als)",
+    "dist.step": "one distributed sweep step invocation",
+    "dist.comm_select": "comm-strategy selection: probing the fallback "
+                        "chain (async_ring -> ring -> all2all)",
+    "dist.measure_overlap": "the achieved-overlap measurement of a "
+                            "ring-variant sweep (docs/ring.md)",
+    "serve.job": "one supervised serve job end-to-end (attrs: job, "
+                 "resumed)",
+    "trace.export": "writing one Chrome-trace JSON file",
+    "timer.*": "legacy utils/timers.py brackets routed through the "
+               "span layer (timer.cpd, timer.mttkrp, ...)",
+}
+
+#: Every metric the code records, name -> (type, doc) — the Prometheus
+#: surface, rendered into docs/observability.md.  Recording an
+#: undeclared name raises (the ENV_VARS/SITES registry discipline).
+METRICS = {
+    "splatt_events_total": (
+        "counter", "run-report events by kind (and job, inside a "
+                   "serve scope) — every resilience event increments "
+                   "this"),
+    "splatt_retries_total": (
+        "counter", "transient failures retried in place with backoff"),
+    "splatt_demotions_total": (
+        "counter", "engine demotions by failure class"),
+    "splatt_health_rollbacks_total": (
+        "counter", "numerical-health rollbacks to the last-good "
+                   "snapshot"),
+    "splatt_health_degraded_total": (
+        "counter", "runs that exhausted the health budget and degraded "
+                   "to checkpoint-and-abort"),
+    "splatt_probe_cache_total": (
+        "counter", "capability-probe cache lookups by outcome "
+                   "(hit/miss/expired)"),
+    "splatt_tune_cache_total": (
+        "counter", "autotuner plan-cache consults by outcome "
+                   "(hit/miss), one per tuned mode"),
+    "splatt_serve_queue_depth": (
+        "gauge", "serve: pending jobs in the bounded queue"),
+    "splatt_serve_jobs_total": (
+        "counter", "serve: terminal jobs by status "
+                   "(converged/degraded/failed/rejected)"),
+    "splatt_job_seconds": (
+        "histogram", "serve: per-job wall seconds accepted-to-terminal"),
+}
+
+#: histogram bucket upper bounds (seconds); +Inf is implicit
+HIST_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0)
+
+_TRACE_ENV = "SPLATT_TRACE"
+
+# -- enablement --------------------------------------------------------------
+
+_enabled_override: Optional[bool] = None
+_CTX_ENABLED: contextvars.ContextVar = contextvars.ContextVar(
+    "splatt_trace_enabled", default=None)
+#: memoized SPLATT_TRACE verdict (None = not read yet): the disabled
+#: hot path must be the promised single boolean test, not a registry
+#: lookup per span open.  :func:`set_enabled` clears it, so tests (and
+#: anyone genuinely flipping the env mid-process) re-earn the verdict
+#: with ``set_enabled(None)``.
+_env_verdict: Optional[bool] = None
+
+
+def _env_enabled() -> bool:
+    global _env_verdict
+    if _env_verdict is None:
+        from splatt_tpu.utils.env import read_env
+
+        _env_verdict = str(read_env(_TRACE_ENV) or "").lower() in (
+            "1", "on", "true", "yes")
+    return _env_verdict
+
+
+def enabled() -> bool:
+    """Whether spans are recorded: a per-run :func:`enabling` override
+    (``Options.trace``) wins, else the process override
+    (:func:`set_enabled` — the CLI ``--trace`` flag), else the
+    ``SPLATT_TRACE`` env default (off).  This is THE hot-path check:
+    when it returns False, :func:`span` costs one boolean test and
+    returns a shared no-op."""
+    ctx = _CTX_ENABLED.get()
+    if ctx is not None:
+        return ctx
+    if _enabled_override is not None:
+        return _enabled_override
+    return _env_enabled()
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Process-wide tracing override (None restores the env default,
+    re-read fresh — the memoized verdict is cleared)."""
+    global _enabled_override, _env_verdict
+    _enabled_override = value
+    _env_verdict = None
+
+
+@contextlib.contextmanager
+def enabling(value: Optional[bool]):
+    """Scoped tracing override for one run (``Options.trace``): None is
+    a no-op (the process/env resolution applies), True/False pin
+    tracing on/off inside the block only — contextvars-backed, so
+    concurrent serve jobs do not fight over a global."""
+    if value is None:
+        yield
+        return
+    token = _CTX_ENABLED.set(bool(value))
+    try:
+        yield
+    finally:
+        _CTX_ENABLED.reset(token)
+
+
+# -- span recorder -----------------------------------------------------------
+
+_LOCK = threading.Lock()
+_SIDS = itertools.count(1)
+_DONE: List[dict] = []
+_OPEN: Dict[int, dict] = {}
+_POINTS: List[dict] = []
+#: (wall-clock, perf_counter) anchor pair: spans time with the
+#: monotonic perf_counter and the exporter maps onto the epoch once
+_ANCHOR: Tuple[float, float] = (time.time(), time.perf_counter())
+_STACK: contextvars.ContextVar = contextvars.ContextVar(
+    "splatt_trace_stack", default=())
+
+#: memoized "emit jax.profiler.TraceAnnotation?" verdict: None =
+#: undecided, False = no (CPU, or jax unhappy), True = TPU backend
+_annotate_verdict: Optional[bool] = None
+
+
+def _should_annotate() -> bool:
+    global _annotate_verdict
+    if _annotate_verdict is None:
+        try:
+            import jax
+
+            _annotate_verdict = jax.default_backend() == "tpu"
+        except Exception as e:
+            # no jax / backend init failure: host spans still work —
+            # classify once so the degradation is observable, then
+            # never retry (the verdict cannot change mid-process)
+            from splatt_tpu import resilience
+
+            resilience.run_report().add(
+                "trace_written", path="(annotation)", ok=False,
+                failure_class=resilience.classify_failure(e).value,
+                error=resilience.failure_message(e)[:120])
+            _annotate_verdict = False
+    return _annotate_verdict
+
+
+def _job() -> Optional[str]:
+    from splatt_tpu import resilience
+
+    return resilience.current_job()
+
+
+class _NoopSpan:
+    """The disabled-path span: a shared singleton whose every method is
+    a no-op — `with trace.span(...)` costs one enabled() check."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP = _NoopSpan()
+
+
+class SpanHandle:
+    """One live span: context manager; :meth:`set` attaches attributes
+    mid-flight (the fit at a check iteration, the chosen engine)."""
+
+    __slots__ = ("rec", "_ann")
+
+    def __init__(self, name: str, attrs: dict):
+        job = attrs.pop("job", None) or _job()
+        self.rec = {"name": name, "sid": next(_SIDS), "parent": None,
+                    "t0": 0.0, "dur": None, "args": attrs,
+                    "tid": threading.get_ident(), "job": job}
+        self._ann = None
+
+    def set(self, **attrs):
+        self.rec["args"].update(attrs)
+        return self
+
+    def __enter__(self):
+        stack = _STACK.get()
+        self.rec["parent"] = stack[-1] if stack else None
+        _STACK.set(stack + (self.rec["sid"],))
+        with _LOCK:
+            _OPEN[self.rec["sid"]] = self.rec
+        if _should_annotate():
+            try:
+                import jax
+
+                self._ann = jax.profiler.TraceAnnotation(self.rec["name"])
+                self._ann.__enter__()
+            except Exception:  # splint: ignore[SPL002] annotation is
+                # cosmetic device-trace alignment; a failure here must
+                # never fail the traced work, and the _should_annotate
+                # verdict already reported jax-side degradation once
+                self._ann = None
+        self.rec["t0"] = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.rec["dur"] = time.perf_counter() - self.rec["t0"]
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:  # splint: ignore[SPL002] see __enter__ —
+                # the annotation is cosmetic, the span record is not
+                pass
+            self._ann = None
+        sid = self.rec["sid"]
+        stack = _STACK.get()
+        if sid in stack:
+            # tolerate mis-nested legacy timers (start A, start B,
+            # stop A): drop OUR sid wherever it sits; leaked children
+            # clean themselves up on their own exit
+            _STACK.set(tuple(s for s in stack if s != sid))
+        with _LOCK:
+            _OPEN.pop(sid, None)
+            _DONE.append(self.rec)
+        return False
+
+
+def span(name: str, **attrs):
+    """Open one named span (context manager).  A no-op singleton when
+    tracing is disabled — the overhead contract of the module
+    docstring.  `name` must be declared in :data:`SPANS` (splint
+    SPL013 checks the literals; dynamic names use a declared ``x.*``
+    family)."""
+    if not enabled():
+        return NOOP
+    return SpanHandle(name, attrs)
+
+
+def begin(name: str, **attrs):
+    """:func:`span` + immediate enter — for regions whose open/close
+    straddle statement structure (a driver's root span around a loop
+    with multiple exits).  Close with :func:`end`; a span left open at
+    export rides along marked ``open`` (crash diagnostics)."""
+    h = span(name, **attrs)
+    h.__enter__()
+    return h
+
+
+def end(handle) -> None:
+    """Close a :func:`begin` span (no-op for the disabled singleton)."""
+    handle.__exit__(None, None, None)
+
+
+def point(kind: str, info: Optional[dict] = None) -> None:
+    """Record one instant event attached to the enclosing span — the
+    hook every ``run_report().add`` emission flows through, so
+    demotions/fallbacks/rollbacks appear in time order on the trace.
+    Event-derived METRICS are updated even when span recording is off
+    (metrics are always-on; spans are the gated part)."""
+    info = {k: v for k, v in (info or {}).items()
+            if k not in ("ts", "kind")}
+    _event_metrics(kind, info)
+    if not enabled():
+        return
+    stack = _STACK.get()
+    rec = {"name": kind, "t": time.perf_counter(),
+           "parent": stack[-1] if stack else None,
+           "tid": threading.get_ident(), "args": info}
+    with _LOCK:
+        _POINTS.append(rec)
+
+
+def spans(name: Optional[str] = None) -> List[dict]:
+    """Finished span records (tests; the exporter's source)."""
+    with _LOCK:
+        out = list(_DONE)
+    if name is not None:
+        out = [s for s in out if s["name"] == name]
+    return out
+
+
+def points(kind: Optional[str] = None) -> List[dict]:
+    """Recorded point events (tests)."""
+    with _LOCK:
+        out = list(_POINTS)
+    if kind is not None:
+        out = [p for p in out if p["name"] == kind]
+    return out
+
+
+def reset() -> None:
+    """Drop every recorded span/point (a fresh run in one process;
+    tests).  Open handles close harmlessly into the cleared recorder.
+    Metrics are NOT cleared — use :func:`reset_metrics`."""
+    with _LOCK:
+        _DONE.clear()
+        _OPEN.clear()
+        _POINTS.clear()
+
+
+# -- metrics registry --------------------------------------------------------
+
+_MET_LOCK = threading.Lock()
+#: (name, ((label, value), ...)) -> float | histogram-state dict
+_SAMPLES: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+
+
+def _declared(name: str, want: str) -> None:
+    spec = METRICS.get(name)
+    if spec is None:
+        raise KeyError(
+            f"metric {name!r} is not declared in splatt_tpu.trace."
+            f"METRICS; register it (with a type and doc) before "
+            f"recording it")
+    if spec[0] != want:
+        raise TypeError(
+            f"metric {name!r} is declared as a {spec[0]}, not a {want}")
+
+
+def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
+    if "job" not in labels:
+        job = _job()
+        if job is not None:
+            labels = dict(labels, job=job)
+    return tuple(sorted((k, str(v)) for k, v in labels.items()
+                        if v is not None))
+
+
+def metric_inc(name: str, value: float = 1.0, **labels) -> None:
+    """Increment a declared counter (labels become Prometheus labels;
+    the active serve job's id is stamped as ``job`` automatically)."""
+    _declared(name, "counter")
+    key = (name, _label_key(labels))
+    with _MET_LOCK:
+        _SAMPLES[key] = float(_SAMPLES.get(key, 0.0)) + float(value)
+
+
+def metric_set(name: str, value: float, **labels) -> None:
+    """Set a declared gauge to `value`."""
+    _declared(name, "gauge")
+    with _MET_LOCK:
+        _SAMPLES[(name, _label_key(labels))] = float(value)
+
+
+def metric_observe(name: str, value: float, **labels) -> None:
+    """Record one observation into a declared histogram."""
+    _declared(name, "histogram")
+    key = (name, _label_key(labels))
+    with _MET_LOCK:
+        h = _SAMPLES.get(key)
+        if not isinstance(h, dict):
+            h = {"buckets": [0] * (len(HIST_BUCKETS) + 1),
+                 "sum": 0.0, "count": 0}
+            _SAMPLES[key] = h
+        i = len(HIST_BUCKETS)
+        for j, le in enumerate(HIST_BUCKETS):
+            if value <= le:
+                i = j
+                break
+        h["buckets"][i] += 1
+        h["sum"] += float(value)
+        h["count"] += 1
+
+
+def reset_metrics() -> None:
+    with _MET_LOCK:
+        _SAMPLES.clear()
+
+
+def _event_metrics(kind: str, info: dict) -> None:
+    """Event-kind -> metric mapping: every run-report event counts into
+    ``splatt_events_total``; load-bearing kinds get their own series."""
+    labels = {}
+    job = info.get("job")
+    if job is not None:
+        labels["job"] = job
+    metric_inc("splatt_events_total", kind=kind, **labels)
+    if kind == "transient_retry":
+        metric_inc("splatt_retries_total", **labels)
+    elif kind == "engine_demotion":
+        metric_inc("splatt_demotions_total",
+                   failure_class=info.get("failure_class", "unknown"),
+                   **labels)
+    elif kind == "health_rollback":
+        metric_inc("splatt_health_rollbacks_total", **labels)
+    elif kind == "health_degraded":
+        metric_inc("splatt_health_degraded_total", **labels)
+
+
+def _fmt_labels(lk: Tuple[Tuple[str, str], ...]) -> str:
+    if not lk:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\")
+                         .replace('"', '\\"').replace("\n", " "))
+        for k, v in lk)
+    return "{" + inner + "}"
+
+
+def _job_match(lk: Tuple[Tuple[str, str], ...],
+               job: Optional[str]) -> bool:
+    if job is None:
+        return True
+    return dict(lk).get("job") == job
+
+
+def metrics_text(job: Optional[str] = None) -> str:
+    """The registry in Prometheus text exposition format.  With `job`,
+    only samples carrying that job label are emitted — the per-tenant
+    isolation cut a serve job's result embeds (a neighbor's counters
+    never appear)."""
+    with _MET_LOCK:
+        samples = dict(_SAMPLES)
+    lines: List[str] = []
+    for name in METRICS:
+        typ, doc = METRICS[name]
+        mine = sorted((lk, v) for (n, lk), v in samples.items()
+                      if n == name and _job_match(lk, job))
+        if not mine:
+            continue
+        lines.append(f"# HELP {name} {doc}")
+        lines.append(f"# TYPE {name} {typ}")
+        for lk, v in mine:
+            if typ == "histogram" and isinstance(v, dict):
+                cum = 0
+                for j, le in enumerate(HIST_BUCKETS):
+                    cum += v["buckets"][j]
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_labels(lk + (('le', str(le)),))} {cum}")
+                cum += v["buckets"][-1]
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_fmt_labels(lk + (('le', '+Inf'),))} {cum}")
+                lines.append(f"{name}_sum{_fmt_labels(lk)} "
+                             f"{round(v['sum'], 6)}")
+                lines.append(f"{name}_count{_fmt_labels(lk)} "
+                             f"{v['count']}")
+            else:
+                out = v if isinstance(v, (int, float)) else 0.0
+                lines.append(f"{name}{_fmt_labels(lk)} {round(out, 6)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def metrics_snapshot(job: Optional[str] = None) -> dict:
+    """JSON-embeddable view of the registry (the serve job-result
+    form): ``{metric{labels}: value}`` for counters/gauges, histogram
+    state dicts for histograms.  `job` cuts to that tenant's samples."""
+    with _MET_LOCK:
+        samples = dict(_SAMPLES)
+    out: Dict[str, object] = {}
+    for (name, lk), v in sorted(samples.items(),
+                                key=lambda kv: (kv[0][0], kv[0][1])):
+        if not _job_match(lk, job):
+            continue
+        out[f"{name}{_fmt_labels(lk)}"] = (dict(v) if isinstance(v, dict)
+                                           else v)
+    return out
+
+
+def write_metrics(path: str, job: Optional[str] = None) -> dict:
+    """Atomically write :func:`metrics_text` to `path` (tmp + rename —
+    a scraper never reads a torn file) and record a
+    ``metrics_snapshot`` run-report event.  A write failure degrades
+    classified (the event carries the error) — metrics must never kill
+    the daemon they observe."""
+    import os
+
+    from splatt_tpu import resilience
+
+    text = metrics_text(job=job)
+    try:
+        tmp = str(path) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, str(path))
+    except Exception as e:
+        cls = resilience.classify_failure(e)
+        return resilience.run_report().add(
+            "metrics_snapshot", path=str(path), ok=False,
+            failure_class=cls.value,
+            error=resilience.failure_message(e)[:200])
+    return resilience.run_report().add(
+        "metrics_snapshot", path=str(path), ok=True,
+        samples=text.count("\n"))
+
+
+# -- Chrome trace-event export -----------------------------------------------
+
+def chrome_events() -> List[dict]:
+    """The recorder as Chrome trace-event dicts: one complete event
+    (``ph: "X"``) per finished span — still-open spans ride along with
+    their duration-so-far and ``open: true`` (crash diagnostics) — and
+    one instant event (``ph: "i"``) per point event.  ``args`` carries
+    the span attributes plus ``sid``/``parent`` so the summarizer (and
+    perfetto queries) can rebuild the tree without guessing from
+    timestamps."""
+    import os
+
+    wall0, perf0 = _ANCHOR
+
+    def us(t: float) -> int:
+        return int((wall0 + (t - perf0)) * 1e6)
+
+    now = time.perf_counter()
+    with _LOCK:
+        done = list(_DONE)
+        still_open = [dict(rec, dur=now - rec["t0"],
+                           args=dict(rec["args"], open=True))
+                      for rec in _OPEN.values()]
+        pts = list(_POINTS)
+    pid = os.getpid()
+    evs = []
+    for rec in done + still_open:
+        args = dict(rec["args"], sid=rec["sid"])
+        if rec["parent"] is not None:
+            args["parent"] = rec["parent"]
+        if rec["job"] is not None:
+            args["job"] = rec["job"]
+        evs.append({"name": rec["name"], "cat": "span", "ph": "X",
+                    "ts": us(rec["t0"]),
+                    "dur": max(int((rec["dur"] or 0.0) * 1e6), 1),
+                    "pid": pid, "tid": rec["tid"], "args": args})
+    for p in pts:
+        args = dict(p["args"])
+        if p["parent"] is not None:
+            args["parent"] = p["parent"]
+        evs.append({"name": p["name"], "cat": "event", "ph": "i",
+                    "s": "t", "ts": us(p["t"]), "pid": pid,
+                    "tid": p["tid"], "args": args})
+    evs.sort(key=lambda e: e["ts"])
+    return evs
+
+
+def write_chrome_trace(path: str) -> dict:
+    """Export the recorder to a perfetto-loadable Chrome trace-event
+    JSON file (atomic tmp + rename) and record a ``trace_written``
+    run-report event.  A write failure degrades classified — losing
+    the trace must never lose the run (the ``trace.export`` fault site
+    drills exactly that)."""
+    import os
+
+    from splatt_tpu import resilience
+    from splatt_tpu.utils import faults
+
+    evs = chrome_events()
+    with span("trace.export", path=str(path)):
+        try:
+            faults.maybe_fail("trace.export")
+            tmp = str(path) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"traceEvents": evs, "displayTimeUnit": "ms"},
+                          f)
+            os.replace(tmp, str(path))
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            return resilience.run_report().add(
+                "trace_written", path=str(path), ok=False,
+                failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
+    return resilience.run_report().add(
+        "trace_written", path=str(path), ok=True,
+        spans=sum(1 for e in evs if e["ph"] == "X"),
+        events=sum(1 for e in evs if e["ph"] == "i"))
+
+
+# -- trace summarization (`splatt trace <file>`) -----------------------------
+
+def load_trace(path: str) -> List[dict]:
+    """Parse a Chrome trace-event file → its event list (accepts both
+    the ``{"traceEvents": [...]}`` object form we write and a bare
+    array, which the format also permits)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("traceEvents", [])
+    if not isinstance(data, list):
+        raise ValueError(f"{path} is not a Chrome trace-event file")
+    return data
+
+
+def _is_guard(name: str) -> bool:
+    return name.startswith("cpd.guard.") or name.startswith("guard.")
+
+
+def summarize(events: List[dict]) -> dict:
+    """Aggregate a trace: per-name totals and SELF time (duration minus
+    enclosed child spans — the honest 'where did the time go' number),
+    the per-iteration breakdown (``cpd.iter``/``dist.step`` spans), the
+    guard-overhead share, and point-event counts by kind."""
+    sp = [e for e in events if e.get("ph") == "X"]
+    pts = [e for e in events if e.get("ph") == "i"]
+    child_us: Dict[object, int] = {}
+    for e in sp:
+        parent = (e.get("args") or {}).get("parent")
+        if parent is not None:
+            child_us[parent] = child_us.get(parent, 0) + int(e["dur"])
+    names: Dict[str, dict] = {}
+    iters: List[dict] = []
+    guard_self_us = 0
+    root_us = 0
+    for e in sp:
+        args = e.get("args") or {}
+        self_us = max(int(e["dur"]) - child_us.get(args.get("sid"), 0), 0)
+        agg = names.setdefault(
+            e["name"], {"count": 0, "total_us": 0, "self_us": 0})
+        agg["count"] += 1
+        agg["total_us"] += int(e["dur"])
+        agg["self_us"] += self_us
+        if _is_guard(e["name"]):
+            guard_self_us += self_us
+        if e["name"] in ("cpd.als", "dist.als"):
+            # SUM across driver runs (a serve trace holds one cpd.als
+            # per job; bench A/B legs invoke the driver repeatedly) —
+            # guard_self_us accumulates across all of them, so a max
+            # here would overstate guard_pct by ~the number of runs
+            root_us += int(e["dur"])
+        if e["name"] in ("cpd.iter", "dist.step"):
+            iters.append({"it": args.get("it"), "us": int(e["dur"]),
+                          "fit": args.get("fit")})
+    iters.sort(key=lambda r: (r["it"] is None, r["it"]))
+    if root_us == 0:
+        # no driver root span in the file: fall back to top-level spans
+        root_us = sum(int(e["dur"]) for e in sp
+                      if (e.get("args") or {}).get("parent") is None)
+    kinds: Dict[str, int] = {}
+    for p in pts:
+        kinds[p["name"]] = kinds.get(p["name"], 0) + 1
+    return {"spans": sum(a["count"] for a in names.values()),
+            "names": names,
+            "top": sorted(names.items(), key=lambda kv: -kv[1]["self_us"]),
+            "iters": iters,
+            "iter_total_us": sum(r["us"] for r in iters),
+            "guard_self_us": guard_self_us,
+            "root_us": root_us,
+            "guard_pct": round(100.0 * guard_self_us / root_us, 2)
+            if root_us else 0.0,
+            "points": kinds}
+
+
+def summarize_file(path: str) -> dict:
+    return summarize(load_trace(path))
+
+
+def format_summary(s: dict, top_n: int = 12) -> List[str]:
+    """Human-readable summary lines for the ``splatt trace`` verb."""
+    lines = [f"trace: {s['spans']} spans, "
+             f"{sum(s['points'].values())} point events, "
+             f"root {s['root_us'] / 1e6:.3f}s"]
+    lines.append("top spans by self-time:")
+    lines.append(f"  {'span':<26s} {'count':>6s} {'self':>10s} "
+                 f"{'total':>10s}")
+    for name, agg in s["top"][:top_n]:
+        lines.append(f"  {name:<26s} {agg['count']:>6d} "
+                     f"{agg['self_us'] / 1e6:>9.4f}s "
+                     f"{agg['total_us'] / 1e6:>9.4f}s")
+    if s["iters"]:
+        n = len(s["iters"])
+        mean = s["iter_total_us"] / n / 1e6
+        lines.append(f"iterations: {n} spans, {mean:.4f}s mean "
+                     f"({s['iter_total_us'] / 1e6:.3f}s total)")
+        for r in s["iters"][:8]:
+            fit = (f"  fit={r['fit']:.5f}"
+                   if isinstance(r.get("fit"), float) else "")
+            lines.append(f"  it {r['it']}: {r['us'] / 1e6:.4f}s{fit}")
+        if n > 8:
+            lines.append(f"  ... {n - 8} more")
+    lines.append(f"guard overhead: {s['guard_self_us'] / 1e6:.4f}s "
+                 f"self-time = {s['guard_pct']}% of the run "
+                 f"(cpd.guard.* + guard.* spans)")
+    if s["points"]:
+        evs = ", ".join(f"{k}x{v}"
+                        for k, v in sorted(s["points"].items()))
+        lines.append(f"point events: {evs}")
+    return lines

@@ -417,15 +417,20 @@ def _measure_candidate(layout, factors, mode: int, path: str, impl: str,
     # candidate's compile must not wedge the whole tune; a blown
     # deadline classifies TIMEOUT — skipped this session, never
     # persisted as a negative entry (slow today may be fine tomorrow)
-    with resilience.deadline("tuner.measure"):
-        faults.maybe_fail("tuner.measure")
-        for _ in range(max(warm, 1)):
-            host_fence(call())
-        times = []
-        for _ in range(max(reps, 1)):
-            t0 = time.perf_counter()
-            host_fence(call())
-            times.append(time.perf_counter() - t0)
+    from splatt_tpu import trace
+
+    with trace.span("tune.measure", mode=int(mode), path=path,
+                    engine=engine, block=int(layout.block),
+                    scan_target=int(scan_target)):
+        with resilience.deadline("tuner.measure"):
+            faults.maybe_fail("tuner.measure")
+            for _ in range(max(warm, 1)):
+                host_fence(call())
+            times = []
+            for _ in range(max(reps, 1)):
+                t0 = time.perf_counter()
+                host_fence(call())
+                times.append(time.perf_counter() - t0)
     times.sort()
     return times[len(times) // 2]
 
@@ -567,7 +572,7 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
     """
     import jax.numpy as jnp
 
-    from splatt_tpu import resilience
+    from splatt_tpu import resilience, trace
     from splatt_tpu.blocked import build_layout, reencode_layout
     from splatt_tpu.config import (LayoutFormat, Verbosity, default_opts,
                                    resolve_dtype, resolve_storage_dtype)
@@ -634,6 +639,11 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
         key = plan_key(tt.dims, tt.nnz, m, rank, dtype, skew=skew)
         if not force:
             plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype, skew=skew)
+            # always-on metrics (docs/observability.md): the serve
+            # fleet's warm-cache payoff as a Prometheus series
+            trace.metric_inc("splatt_tune_cache_total",
+                             outcome="hit" if plan is not None
+                             else "miss")
             if plan is not None:
                 result.cache_hits += 1
                 result.plans[m] = plan

@@ -147,6 +147,39 @@ ENV_VARS = {
                                 "autotuner's persistent plan cache "
                                 "(default: tune_cache.json next to the "
                                 "probe cache)"),
+    # structured tracing + metrics (splatt_tpu/trace.py,
+    # docs/observability.md)
+    "SPLATT_TRACE": EnvVar(None, "1/on/true/yes enables structured "
+                           "span recording (docs/observability.md): "
+                           "host-side spans (cpd -> sweep -> guard, "
+                           "dispatch, comm) exportable as Chrome "
+                           "trace-event JSON via --trace <path>.  Off "
+                           "by default: disabled spans are no-ops "
+                           "(one boolean check); an explicit "
+                           "Options.trace / CLI --trace wins.  "
+                           "Event-derived metrics are always on "
+                           "regardless"),
+    "SPLATT_METRICS_PATH": EnvVar(None, "serve: when set, the metrics "
+                                  "registry (trace.METRICS) is "
+                                  "snapshotted to this file in "
+                                  "Prometheus text exposition format "
+                                  "on a cadence "
+                                  "(SPLATT_METRICS_INTERVAL_S) and at "
+                                  "daemon exit — atomic replace, so a "
+                                  "scraper never reads a torn file "
+                                  "(docs/observability.md)"),
+    "SPLATT_METRICS_INTERVAL_S": EnvVar(30.0, "serve: seconds between "
+                                        "metrics snapshots to "
+                                        "SPLATT_METRICS_PATH; <= 0 "
+                                        "snapshots only at daemon "
+                                        "exit"),
+    "SPLATT_BENCH_TRACE_AB": EnvVar(None, "bench.py: 1 = time cpd_als "
+                                    "with span recording enabled-but-"
+                                    "unexported vs off over the same "
+                                    "blocked layouts and record the "
+                                    "legs under 'trace_ab' "
+                                    "(trace_overhead_pct vs the <2% "
+                                    "budget of docs/observability.md)"),
     # serve daemon knobs (splatt_tpu/serve.py, docs/serve.md)
     "SPLATT_SERVE_WORKERS": EnvVar(1, "serve: concurrent job-supervisor "
                                    "threads; each job runs under its "

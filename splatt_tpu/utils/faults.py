@@ -187,6 +187,11 @@ SITES = {
                      "'slow' holds the job open (blowing a per-job "
                      "deadline, or pinning it for kill-and-restart "
                      "soaks)",
+    "trace.export": "the Chrome trace-event JSON export "
+                    "(trace.write_chrome_trace); a raised fault must "
+                    "degrade classified to a trace_written ok=False "
+                    "event — losing the trace must never lose the run "
+                    "it observed (docs/observability.md)",
 }
 
 

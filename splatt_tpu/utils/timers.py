@@ -5,6 +5,21 @@ gating which are reported (timers[TIMER_NTIMERS], src/timer.h:36-85;
 report_times, src/timer.c:67-90).  Same idea here: a process-global
 registry, `timers.start/stop(name)` brackets, and a leveled report.
 
+Since the trace layer landed (splatt_tpu/trace.py,
+docs/observability.md) every timer bracket is ALSO a ``timer.<name>``
+span: with tracing enabled the exact same brackets this module
+aggregates appear on the exported Chrome trace, so the leveled text
+report is a view over the trace rather than a second, driftable
+accounting.  With tracing disabled the span handles are shared no-ops
+and only the wall-clock totals below exist.
+
+Report honesty: a started-but-never-stopped timer used to report its
+stale accumulated total with no hint that the bracket was still open
+(the pre-trace double-report drift).  :meth:`Timer.current` now folds
+the running interval in, and :meth:`TimerRegistry.report` marks such
+timers ``(running)`` — the printed number is the time actually spent,
+not the total as of the last stop().
+
 JAX note: device work is asynchronous — wrap regions whose cost you want
 attributed with ``block=True`` (calls ``block_until_ready`` on a token) or
 time whole steps; fine-grained on-device attribution belongs to the JAX
@@ -34,7 +49,7 @@ _DEFAULT_LEVELS = {
 
 
 class Timer:
-    __slots__ = ("name", "seconds", "_t0", "running", "level")
+    __slots__ = ("name", "seconds", "_t0", "running", "level", "_span")
 
     def __init__(self, name: str, level: int = 2) -> None:
         self.name = name
@@ -42,20 +57,47 @@ class Timer:
         self._t0 = 0.0
         self.running = False
         self.level = level
+        self._span = None
 
     def start(self) -> None:
         if not self.running:
             self.running = True
+            # the bracket is also a timer.<name> span (a shared no-op
+            # when tracing is off) — one accounting, two views
+            from splatt_tpu import trace
+
+            self._span = trace.begin(f"timer.{self.name}")
             self._t0 = time.perf_counter()
 
     def stop(self) -> None:
         if self.running:
             self.seconds += time.perf_counter() - self._t0
             self.running = False
+            if self._span is not None:
+                from splatt_tpu import trace
+
+                trace.end(self._span)
+                self._span = None
+
+    def current(self) -> float:
+        """Accumulated seconds INCLUDING the still-running interval —
+        the honest total a report must print (the old `.seconds` read
+        went stale the moment a bracket was left open)."""
+        if self.running:
+            return self.seconds + (time.perf_counter() - self._t0)
+        return self.seconds
 
     def reset(self) -> None:
         self.seconds = 0.0
         self.running = False
+        if self._span is not None:
+            # close (not drop) a still-open bracket's span: a leaked
+            # open handle would stay in the recorder forever and
+            # mis-parent every later span in this context
+            from splatt_tpu import trace
+
+            trace.end(self._span)
+            self._span = None
 
 
 class TimerRegistry:
@@ -80,7 +122,7 @@ class TimerRegistry:
             t.reset()
 
     def __getitem__(self, name: str) -> float:
-        return self.get(name).seconds
+        return self.get(name).current()
 
     class _Bracket:
         def __init__(self, timer: Timer) -> None:
@@ -98,11 +140,14 @@ class TimerRegistry:
         return self._Bracket(self.get(name))
 
     def report(self, level: int = 1) -> str:
-        """≙ report_times (src/timer.c:67-90)."""
+        """≙ report_times (src/timer.c:67-90).  Running (never-stopped)
+        timers report their live total, marked ``(running)``."""
         lines = ["", "Timing information ---------------------------------"]
         for t in self._timers.values():
-            if t.seconds > 0 and t.level <= level:
-                lines.append(f"  {t.name + ':':<16s} {t.seconds:0.3f}s")
+            secs = t.current()
+            if secs > 0 and t.level <= level:
+                mark = "  (running)" if t.running else ""
+                lines.append(f"  {t.name + ':':<16s} {secs:0.3f}s{mark}")
         return "\n".join(lines)
 
 

@@ -30,6 +30,13 @@ def risky_ring_exchange():
     faults.maybe_fail("comm.ring_exchange")
 
 
+def risky_trace_export():
+    # the Chrome trace-event exporter hook (trace.py,
+    # docs/observability.md) — a raised fault degrades classified to a
+    # trace_written ok=False event, never fails the traced run
+    faults.maybe_fail("trace.export")
+
+
 def risky_layout_balance():
     # the load-balanced layout hooks (docs/layout-balance.md): the
     # balanced fiber pack (blocked.py) and the reorder permutation

@@ -1,0 +1,9 @@
+"""SPL013 bad: opening a trace span under a name the SPANS registry
+never declared."""
+
+from splatt_tpu import trace
+
+
+def rogue_region():
+    with trace.span("spl013_fixture_undeclared_span"):
+        pass

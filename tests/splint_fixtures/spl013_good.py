@@ -1,0 +1,17 @@
+"""SPL013 good: span-opening sites name spans declared in
+trace.py:SPANS (literals, and f-strings under a declared ``x.*``
+family)."""
+
+from splatt_tpu import trace
+
+
+def traced_rebuild():
+    # a declared literal span name (the sweep-rebuild region of cpd.py)
+    with trace.span("cpd.build_sweep"):
+        pass
+
+
+def traced_bracket(name):
+    # f-string under the declared ``timer.*`` family (utils/timers.py)
+    handle = trace.begin(f"timer.{name}")
+    trace.end(handle)

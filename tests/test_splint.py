@@ -106,7 +106,7 @@ def test_baseline_has_no_stale_or_overcounted_entries():
 
 RULE_IDS = ["SPL000", "SPL001", "SPL002", "SPL003", "SPL004", "SPL005",
             "SPL006", "SPL007", "SPL008", "SPL009", "SPL010", "SPL011",
-            "SPL012"]
+            "SPL012", "SPL013"]
 
 
 @pytest.mark.parametrize("rule", RULE_IDS)
@@ -194,6 +194,71 @@ def test_baseline_workflow_roundtrip(tmp_path):
     rewritten = update_baseline(bl_path, shrunk)
     assert rewritten["SPL005:pkg/m.py"] == {
         "count": 1, "reason": "fixture justification"}
+
+
+def test_spl013_declaration_drift(tmp_path):
+    """Both span-drift directions, on a mini-project: an undeclared
+    opened name fires at the call site, a declared-but-never-opened
+    name fires at the registry, and a declared ``x.*`` family matches
+    f-string opens."""
+    (tmp_path / "pkg").mkdir()
+    trace_mod = tmp_path / "pkg" / "trace.py"
+    trace_mod.write_text(
+        "SPANS = {'used.span': 'doc', 'fam.*': 'doc', "
+        "'dead.span': 'doc'}\n"
+        "def span(name, **attrs): ...\n"
+        "def begin(name, **attrs): ...\n")
+    (tmp_path / "pkg" / "prod.py").write_text(
+        "from pkg import trace\n"
+        "def f(k):\n"
+        "    with trace.span('used.span'):\n"
+        "        pass\n"
+        "    trace.begin(f'fam.{k}')\n"
+        "    with trace.span('rogue.span'):\n"
+        "        pass\n")
+    cfg = Config(root=tmp_path, paths=["pkg"],
+                 trace_module="pkg/trace.py")
+    msgs = [f.message for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL013"]
+    assert any("rogue.span" in m and "not declared" in m for m in msgs)
+    assert any("dead.span" in m and "never opened" in m for m in msgs)
+    assert not any("used.span" in m or "fam." in m for m in msgs)
+    # opening the dead span and declaring the rogue one clears the drift
+    trace_mod.write_text(
+        "SPANS = {'used.span': 'doc', 'fam.*': 'doc', "
+        "'dead.span': 'doc', 'rogue.span': 'doc'}\n"
+        "def span(name, **attrs): ...\n"
+        "def begin(name, **attrs): ...\n")
+    (tmp_path / "pkg" / "prod.py").write_text(
+        "from pkg import trace\n"
+        "def f(k):\n"
+        "    with trace.span('used.span'):\n"
+        "        pass\n"
+        "    trace.begin(f'fam.{k}')\n"
+        "    with trace.span('rogue.span'):\n"
+        "        pass\n"
+        "    with trace.span('dead.span'):\n"
+        "        pass\n")
+    assert not [f for f in run(cfg, baseline={}).findings
+                if f.rule == "SPL013"]
+
+
+def test_spl013_span_registry_matches_runtime():
+    """The SPANS registry is importable, documented, and every name the
+    summarizer special-cases (roots, iteration spans, the guard family)
+    is declared — the static check and the runtime summary read the
+    same surface."""
+    from splatt_tpu.trace import METRICS, SPANS
+
+    assert {"cpd.als", "cpd.iter", "dist.als", "dist.step",
+            "cpd.guard.health_pack", "cpd.guard.snapshot",
+            "cpd.guard.rollback", "serve.job", "trace.export",
+            "timer.*"} <= set(SPANS)
+    for name, doc in SPANS.items():
+        assert isinstance(doc, str) and len(doc) > 10, name
+    for name, (typ, doc) in METRICS.items():
+        assert typ in ("counter", "gauge", "histogram"), name
+        assert isinstance(doc, str) and len(doc) > 10, name
 
 
 def test_spl006_declaration_drift(tmp_path):
@@ -641,6 +706,8 @@ def test_config_matches_pyproject():
     assert cfg.resolve(cfg.baseline).exists()
     assert "_cache_io_error" in cfg.resilience_routers
     assert cfg.resilience_module == "splatt_tpu/resilience.py"
+    assert cfg.trace_module == "splatt_tpu/trace.py"
+    assert "SPL013" in cfg.zero_rules
     assert set(cfg.cache_path_functions) == {"_cache_path", "cache_path"}
     assert "_json_cache_update" in cfg.cache_io_helpers
     assert "_json_cache_load" in cfg.cache_io_helpers

@@ -46,6 +46,8 @@ class Config:
     resilience_routers: List[str] = dataclasses.field(default_factory=list)
     #: the resilience module declaring RUN_REPORT_EVENTS (SPL012)
     resilience_module: str = "splatt_tpu/resilience.py"
+    #: the trace module declaring the SPANS name registry (SPL013)
+    trace_module: str = "splatt_tpu/trace.py"
     #: functions returning shared-cache file paths; values derived
     #: from them must only reach IO through the locked helpers (SPL011)
     cache_path_functions: List[str] = dataclasses.field(

@@ -1181,6 +1181,105 @@ class RunReportEventDrift(Rule):
         return out
 
 
+# -- SPL013 -----------------------------------------------------------------
+
+_SPAN_FNS = {"span", "begin"}
+
+
+def _span_opens(ctx: FileCtx, is_trace_module: bool
+                ) -> List[Tuple[Optional[str], int]]:
+    """(name, lineno) for every span-opening call in `ctx`: the literal
+    string, 'prefix.*' for an f-string with a literal prefix, or None
+    when not statically resolvable.  ``trace.span(...)``/
+    ``trace.begin(...)`` everywhere; inside the trace module itself the
+    bare ``span(...)``/``begin(...)`` spellings count too (the module
+    opens its own ``trace.export`` span)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        tail = dotted.split(".")[-1]
+        if tail not in _SPAN_FNS:
+            continue
+        if not ("trace" in dotted.split(".")[:-1]
+                or (is_trace_module and dotted == tail)):
+            continue
+        arg = node.args[0] if node.args else None
+        name: Optional[str] = None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+        elif isinstance(arg, ast.Name):
+            name = ctx.str_consts.get(arg.id)
+        elif isinstance(arg, ast.JoinedStr) and arg.values:
+            first = arg.values[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) and first.value:
+                name = first.value + "*"
+        out.append((name, node.lineno))
+    return out
+
+
+class SpanNameDrift(Rule):
+    """Span-name drift: every name production code opens a trace span
+    under (``trace.span("...")`` / ``trace.begin("...")``) must be
+    declared in the trace module's SPANS registry — the catalog
+    docs/observability.md renders and ``splatt trace`` summaries are
+    read against — and every declared name must still be opened
+    somewhere in production.  A renamed span otherwise silently orphans
+    the queries and dashboards built on it, exactly like a renamed
+    fault site (SPL006) or run-report event (SPL012).  A trailing
+    ``.*`` declares an f-string family (``trace.span(f"timer.{n}")``
+    matches a declared ``timer.*``)."""
+
+    id = "SPL013"
+    title = "span-name drift against trace.py:SPANS"
+    hint = ("declare the span name (with a one-line doc) in "
+            "splatt_tpu/trace.py:SPANS; docs/observability.md renders "
+            "from that registry")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        trace_ctx = project.ctx_for(cfg.trace_module)
+        if trace_ctx is None:
+            return []
+        declared = _declared_registry(trace_ctx, "SPANS")
+        if not declared:
+            return []  # registry-less mini-projects: nothing to check
+        out: List[Finding] = []
+        used: Set[str] = set()
+        ctxs = project.files + ([trace_ctx]
+                                if trace_ctx not in project.files else [])
+        for ctx in ctxs:
+            in_trace = ctx.relpath == cfg.trace_module
+            for name, line in _span_opens(ctx, in_trace):
+                if name is None:
+                    # the trace module's own API helpers forward the
+                    # caller's name (begin() -> span(name)); those are
+                    # the sanctioned chokepoints, not open sites
+                    if not in_trace:
+                        out.append(self.finding(
+                            ctx, line,
+                            "span name is not statically resolvable — "
+                            "splint cannot check it against "
+                            "trace.SPANS"))
+                    continue
+                used.add(name)
+                if not any(_site_matches(d, name) for d in declared) \
+                        and ctx in project.files:
+                    out.append(self.finding(
+                        ctx, line,
+                        f"span name '{name}' is not declared in "
+                        f"{cfg.trace_module}:SPANS"))
+        for d, line in declared.items():
+            if not any(_site_matches(d, u) for u in used):
+                out.append(self.finding(
+                    trace_ctx, line,
+                    f"declared span name '{d}' is never opened — dead "
+                    f"declaration or renamed span"))
+        return out
+
+
 def _dedupe(findings: List[Finding]) -> List[Finding]:
     seen = set()
     out = []
@@ -1205,4 +1304,5 @@ RULES: List[Rule] = [
     RecompileTrigger(),
     CacheLockDiscipline(),
     RunReportEventDrift(),
+    SpanNameDrift(),
 ]
