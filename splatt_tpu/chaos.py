@@ -529,6 +529,378 @@ def run_serve_chaos(seed: int = 0, smoke: bool = True,
                             error=error)
 
 
+# -- fleet soak (docs/fleet.md) ---------------------------------------------
+#
+# The single-daemon soak above proves kill-and-RESTART; the fleet's
+# promise is kill-and-FAILOVER: with N replicas over one spool, a
+# SIGKILLed replica's accepted jobs must be adopted by live peers
+# (lease takeover after expiry), finish exactly once, and — when the
+# adopted job shares a shape regime with work a peer already ran —
+# hit the warm shared caches (the Nth-request-is-free property
+# surviving the failover).  This soak drives a REAL fleet of daemon
+# subprocesses under multi-tenant load and audits all of it from the
+# shared journal, the per-replica Prometheus snapshots and the
+# per-replica span traces.
+
+@dataclasses.dataclass
+class FleetChaosResult:
+    """One fleet kill-and-failover soak's verdict and evidence."""
+
+    verdict: str                  # "survived" | "violated"
+    jobs: Dict[str, str]          # job id -> terminal status
+    replicas: List[str]           # replica ids (incl. the restart)
+    victim: Optional[str]         # the SIGKILLed replica
+    adopted: List[str]            # jobs that changed hands
+    affinity: Dict[str, dict]     # adopted tune jobs' warm-cache stats
+    violations: List[str]         # invariant breaches (empty = pass)
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _fleet_lineage_violations(recs: List[dict]) -> List[str]:
+    """Audit the shared journal's per-job ownership lineage: a job may
+    only start on a second replica after an ``adopted`` takeover (or a
+    clean ``interrupted`` handback), and reaches at most one terminal
+    record — the journal-level face of 'no job runs on two replicas
+    at once'."""
+    from splatt_tpu import serve
+
+    out: List[str] = []
+    by_job: Dict[str, List[dict]] = {}
+    for r in recs:
+        if r.get("job") and r.get("rec"):
+            by_job.setdefault(r["job"], []).append(r)
+    for jid, rl in sorted(by_job.items()):
+        owner = None
+        terminals = 0
+        for r in rl:
+            k, rid = r["rec"], r.get("replica")
+            if k == serve.STARTED:
+                if owner is not None and rid != owner:
+                    out.append(
+                        f"job {jid} started on {rid} while owned by "
+                        f"{owner} with no adoption/interruption "
+                        f"between — double execution")
+                owner = rid
+            elif k == serve.ADOPTED:
+                owner = rid
+            elif k == serve.INTERRUPTED:
+                owner = None
+            elif k in (serve.DONE, serve.FAILED):
+                terminals += 1
+        if terminals > 1:
+            out.append(f"job {jid} reached {terminals} terminal "
+                       f"records — committed more than once")
+    return out
+
+
+def run_fleet_chaos(seed: int = 0, smoke: bool = True,
+                    replicas: Optional[int] = None,
+                    verbose: bool = False) -> FleetChaosResult:
+    """Kill-and-failover soak of a serve fleet (docs/fleet.md).
+
+    Starts N ``splatt serve --fleet`` replica daemons over one shared
+    spool (short leases, shared warm caches, per-replica metrics and
+    traces), warms one shape regime, then files multi-tenant load
+    including a same-regime job pinned open by a slow fault.  SIGKILLs
+    the replica that claimed the pinned job mid-run, restarts a
+    replacement, and checks:
+
+    1. every accepted job reaches a terminal state (zero jobs lost to
+       the kill);
+    2. the pinned job changed hands: an ``adopted`` journal record
+       from the victim, its terminal record on a survivor, and the
+       single-owner lineage audit clean for EVERY job (no job ever
+       ran on two replicas at once);
+    3. the adopted same-regime job hit the warm shared caches
+       (``tune.cache_hits > 0`` with zero measurements) — affinity
+       evidence surviving failover;
+    4. per-tenant isolation: the NaN tenant rolled back/degraded with
+       zero demotions, every clean tenant finished converged with no
+       health events and no demotions;
+    5. the fleet's observability accounts for the failover: the
+       adopter's Prometheus snapshot counts the adoption and its span
+       trace carries the ``job_adopted`` point event.
+    """
+    import json
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    from splatt_tpu import resilience, serve, trace
+
+    nrep = int(replicas) if replicas else (2 if smoke else 3)
+    dims, nnz, rank, iters = (20, 16, 12), 1200, 3, 6
+    if not smoke:
+        dims, nnz, rank, iters = (40, 32, 24), 3000, 4, 10
+    syn = {"dims": list(dims), "nnz": nnz, "seed": seed}
+    violations: List[str] = []
+    jobs: Dict[str, str] = {}
+    adopted: List[str] = []
+    affinity: Dict[str, dict] = {}
+    rids = [f"r{i}" for i in range(nrep)]
+    victim = None
+    error = None
+    procs: Dict[str, object] = {}
+    logs = []
+    tmp = tempfile.mkdtemp(prefix="splatt-fleet-chaos-")
+    jpath = os.path.join(tmp, "journal.jsonl")
+    # splint: ignore[SPL001] forwarding the whole environment to the
+    # daemon subprocesses, not reading config — no single ENV_VARS name
+    base_env = dict(os.environ)
+    # shared WARM caches (the point of the fleet) but throwaway ones
+    # (soak plans must not leak into the real caches); short leases so
+    # failover fits a smoke budget
+    base_env.update(
+        SPLATT_TUNE_CACHE=os.path.join(tmp, "tune_cache.json"),
+        SPLATT_PROBE_CACHE=os.path.join(tmp, "probe_cache.json"),
+        SPLATT_FLEET_LEASE_S="2.0", SPLATT_FLEET_HEARTBEAT_S="0.5",
+        SPLATT_SERVE_POLL_S="0.25")
+
+    def spawn(rid: str):
+        env = dict(base_env,
+                   SPLATT_METRICS_PATH=os.path.join(
+                       tmp, f"metrics-{rid}.prom"))
+        log = open(os.path.join(tmp, f"{rid}.log"), "w")
+        logs.append(log)
+        cmd = [sys.executable, "-m", "splatt_tpu.cli", "serve", tmp,
+               "--fleet", "--replica", rid, "--workers", "1",
+               "--trace", os.path.join(tmp, f"trace-{rid}.json")]
+        if verbose:
+            cmd.append("-v")
+        procs[rid] = subprocess.Popen(cmd, env=env, stdout=log,
+                                      stderr=subprocess.STDOUT)
+
+    def states() -> Dict[str, tuple]:
+        recs, _ = serve.Journal(jpath).replay()
+        out: Dict[str, tuple] = {}
+        for r in recs:
+            if r.get("job") and r.get("rec"):
+                out[r["job"]] = (r["rec"], r.get("replica"))
+        return out
+
+    def wait_for(pred, deadline_s: float, what: str) -> bool:
+        end = time.time() + deadline_s
+        while time.time() < end:
+            if pred():
+                return True
+            if all(p.poll() is not None for p in procs.values()):
+                violations.append(
+                    f"every replica exited while waiting for {what}")
+                return False
+            time.sleep(0.15)
+        violations.append(f"timed out waiting for {what}")
+        return False
+
+    try:
+        for rid in rids:
+            spawn(rid)
+        # phase 1 — warm one shape regime fleet-wide: the shared plan
+        # cache is what makes the later adoption's Nth request free
+        warm = {"id": "fleet-0-warm", "tenant": "acme", "rank": rank,
+                "iters": iters, "tune": True, "synthetic": syn}
+        serve.file_request(tmp, warm)
+        if not wait_for(lambda: states().get("fleet-0-warm",
+                                             (None,))[0]
+                        in serve.TERMINAL, 300, "the warm job"):
+            raise RuntimeError("fleet soak setup failed")
+        # phase 2 — multi-tenant load, including the pinned
+        # same-regime job the kill will orphan mid-run
+        pin = {"id": "fleet-1-pin", "tenant": "acme", "rank": rank,
+               "iters": iters, "tune": True,
+               "synthetic": dict(syn, seed=seed + 1),
+               "faults": "serve.job_run:slow:delay=5"}
+        nan = {"id": "fleet-2-nan", "tenant": "beta", "rank": rank,
+               "iters": iters, "health_retries": 2,
+               "synthetic": dict(syn, seed=seed + 2),
+               "faults": "cpd.sweep:nan:iter=2"}
+        clean = {"id": "fleet-3-clean", "tenant": "coyote",
+                 "rank": rank, "iters": iters,
+                 "synthetic": dict(syn, seed=seed + 3)}
+        for spec in (pin, nan, clean):
+            serve.file_request(tmp, spec)
+        if not wait_for(
+                lambda: states().get("fleet-1-pin",
+                                     (None,))[0] == serve.STARTED,
+                120, "the pinned job to start"):
+            raise RuntimeError("fleet soak setup failed")
+        victim = states()["fleet-1-pin"][1]
+        if victim not in procs:
+            raise RuntimeError(f"journal names unknown replica "
+                               f"{victim!r} for the pinned job")
+        time.sleep(0.5)  # well inside the 5 s slow-fault window
+        procs[victim].kill()  # SIGKILL: no drain, no lease release
+        procs[victim].wait(timeout=60)
+        # kill-and-RESTART: a replacement joins under a fresh id (a
+        # new incarnation — the dead id's leases must EXPIRE, not be
+        # silently re-owned)
+        restart = f"{victim}b"
+        rids.append(restart)
+        spawn(restart)
+        all_jobs = ["fleet-0-warm", "fleet-1-pin", "fleet-2-nan",
+                    "fleet-3-clean"]
+        wait_for(lambda: all(states().get(j, (None,))[0]
+                             in serve.TERMINAL for j in all_jobs),
+                 300 if smoke else 900, "all jobs to finish")
+    except Exception as e:  # the harness itself must not crash the CLI
+        error = (f"{resilience.classify_failure(e).value}: "
+                 f"{resilience.failure_message(e)[:300]}")
+        violations.append(f"fleet-chaos harness error: {error}")
+    finally:
+        for rid, p in procs.items():
+            if p.poll() is None:
+                p.send_signal(_signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
+
+    recs, _torn = serve.Journal(jpath).replay()
+    accepted = sorted({r["job"] for r in recs
+                       if r.get("rec") == serve.ACCEPTED})
+    adopted = sorted({r["job"] for r in recs
+                      if r.get("rec") == serve.ADOPTED})
+    # 1. zero accepted jobs lost
+    for jid in accepted:
+        last = states().get(jid, (None, None))
+        res = serve.read_result(tmp, jid)
+        if last[0] not in serve.TERMINAL:
+            violations.append(f"accepted job {jid} never reached a "
+                              f"terminal state — a job was LOST")
+            jobs[jid] = "lost"
+            continue
+        if res is None:
+            violations.append(f"job {jid} is terminal but published "
+                              f"no result record")
+            jobs[jid] = "no-result"
+            continue
+        jobs[jid] = res["status"]
+    # 2. the failover actually happened, and lineage is single-owner
+    if victim is not None:
+        pin_last = states().get("fleet-1-pin", (None, None))
+        if pin_last[1] == victim:
+            violations.append(
+                f"the pinned job's terminal record is on the killed "
+                f"replica {victim} — the kill exercised no failover")
+        if not any(r.get("rec") == serve.ADOPTED
+                   and r.get("job") == "fleet-1-pin"
+                   and r.get("from_replica") == victim for r in recs):
+            violations.append(
+                "no adopted record shows the pinned job taken over "
+                "from the killed replica — adoption lineage missing")
+    violations.extend(_fleet_lineage_violations(recs))
+    # 3./4. per-job evidence: warm-cache affinity + tenant isolation
+    for jid, status in sorted(jobs.items()):
+        res = serve.read_result(tmp, jid)
+        if res is None:
+            continue
+        kinds = {e["kind"] for e in res.get("events", [])}
+        if jid == "fleet-2-nan":
+            if status == "converged" \
+                    and not kinds & {"health_rollback",
+                                     "health_degraded"}:
+                violations.append(
+                    "the NaN job converged with no health evidence — "
+                    "the injected fault was silently lost")
+            if res.get("demotions"):
+                violations.append(
+                    "the NaN job demoted engines — NUMERICAL failures "
+                    "must roll back, never demote")
+        else:
+            if kinds & {"health_nonfinite", "health_rollback",
+                        "health_degraded"}:
+                violations.append(
+                    f"clean job {jid} carries health events — the NaN "
+                    f"tenant leaked into a neighbor")
+            if res.get("demotions"):
+                violations.append(
+                    f"clean job {jid} carries engine demotions — "
+                    f"cross-tenant poisoning")
+            if status != "converged":
+                violations.append(
+                    f"clean job {jid} finished {status!r} instead of "
+                    f"converging")
+        if jid == "fleet-1-pin":
+            tune_info = res.get("tune") or {}
+            affinity[jid] = dict(
+                cache_hits=tune_info.get("cache_hits"),
+                measured=tune_info.get("measured"),
+                adopted_from=res.get("adopted_from"),
+                replica=res.get("replica"))
+            if not tune_info or not tune_info.get("cache_hits"):
+                violations.append(
+                    "the adopted same-regime job reports no warm "
+                    "plan-cache hits — the Nth-request-is-free "
+                    "property did not survive the failover")
+            elif tune_info.get("measured"):
+                violations.append(
+                    f"the adopted job re-measured "
+                    f"{tune_info['measured']} plans despite the warm "
+                    f"shared cache")
+    # 5. the adopter's metrics + trace account for the failover
+    pin_replica = states().get("fleet-1-pin", (None, None))[1]
+    if pin_replica and pin_replica != victim:
+        mpath = os.path.join(tmp, f"metrics-{pin_replica}.prom")
+        try:
+            with open(mpath) as f:
+                mtext = f.read()
+            if "splatt_fleet_adoptions_total" not in mtext:
+                violations.append(
+                    f"the adopter {pin_replica}'s Prometheus snapshot "
+                    f"carries no splatt_fleet_adoptions_total sample "
+                    f"— the failover is unaccounted")
+        except OSError as e:
+            violations.append(f"no metrics snapshot from the adopter "
+                              f"{pin_replica}: {e}")
+        tpath = os.path.join(tmp, f"trace-{pin_replica}.json")
+        try:
+            summ = trace.summarize(trace.load_trace(tpath))
+            fl = summ.get("fleet") or {}
+            if not fl.get("adoptions"):
+                violations.append(
+                    f"the adopter {pin_replica}'s span trace carries "
+                    f"no job_adopted point event — the failover left "
+                    f"no trace evidence")
+        except (OSError, ValueError) as e:
+            violations.append(f"no loadable span trace from the "
+                              f"adopter {pin_replica}: {e}")
+    verdict = "violated" if violations else "survived"
+    return FleetChaosResult(verdict=verdict, jobs=jobs, replicas=rids,
+                            victim=victim, adopted=adopted,
+                            affinity=affinity, violations=violations,
+                            error=error)
+
+
+def format_fleet_report(res: FleetChaosResult) -> List[str]:
+    """Human-readable fleet-soak verdict lines for the CLI."""
+    lines = [f"fleet chaos: replicas {', '.join(res.replicas)}; "
+             f"SIGKILLed {res.victim or '(nobody)'}; adopted: "
+             f"{', '.join(res.adopted) or '(none)'}"]
+    for jid, status in sorted(res.jobs.items()):
+        lines.append(f"  job {jid}: {status}")
+    for jid, ev in sorted(res.affinity.items()):
+        lines.append(f"  affinity {jid}: cache_hits={ev['cache_hits']} "
+                     f"measured={ev['measured']} "
+                     f"adopted_from={ev['adopted_from']} "
+                     f"ran_on={ev['replica']}")
+    for v in res.violations:
+        lines.append(f"INVARIANT VIOLATED: {v}")
+    lines.append(f"fleet chaos verdict: {res.verdict.upper()}")
+    return lines
+
+
 def format_serve_report(res: ServeChaosResult) -> List[str]:
     """Human-readable serve-soak verdict lines for the CLI."""
     lines = [f"serve chaos: SIGKILL mid-queue "

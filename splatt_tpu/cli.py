@@ -268,6 +268,19 @@ def cmd_chaos(args) -> int:
     iff the invariant held."""
     from splatt_tpu import chaos
 
+    if args.fleet:
+        # fleet soak: SIGKILL-and-restart across N replica daemons
+        # over one spool under multi-tenant load (docs/fleet.md)
+        res = chaos.run_fleet_chaos(seed=args.seed, smoke=args.smoke,
+                                    replicas=args.replicas,
+                                    verbose=args.verbose > 0)
+        for line in chaos.format_fleet_report(res):
+            print(line)
+        if args.json:
+            import json as _json
+
+            print(_json.dumps(res.to_json()))
+        return 0 if res.ok else 1
     if args.serve:
         # serve-daemon soak: SIGKILL a real daemon mid-queue, restart,
         # assert no accepted job is lost and one tenant's NaN never
@@ -337,9 +350,18 @@ def cmd_serve(args) -> int:
     srv = serve.Server(args.dir, workers=args.workers,
                        queue_max=args.queue_max, poll_s=args.poll,
                        job_deadline_s=args.job_deadline,
-                       verbose=args.verbose > 0)
+                       verbose=args.verbose > 0,
+                       fleet=args.fleet, replica=args.replica,
+                       lease_s=args.lease, heartbeat_s=args.heartbeat,
+                       tenant_quota=args.tenant_quota)
     srv.install_signal_handlers()
-    summary = srv.run_once() if args.once else srv.serve_forever()
+    try:
+        summary = srv.run_once() if args.once else srv.serve_forever()
+    finally:
+        if args.fleet:
+            # retire the membership lease on the way out: peers route
+            # around this replica immediately (docs/fleet.md)
+            srv.shutdown()
     if args.once:
         # batch mode exits without the daemon loop's exit snapshot:
         # force one here so SPLATT_METRICS_PATH always holds the final
@@ -650,6 +672,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "assert no accepted job is lost and one "
                         "tenant's injected NaN never demotes a "
                         "neighbor's engines (docs/serve.md)")
+    p.add_argument("--fleet", action="store_true",
+                   help="soak a serve FLEET instead: N replica "
+                        "daemons over one spool under multi-tenant "
+                        "load, SIGKILL-and-restart a replica mid-job, "
+                        "and assert no accepted job is lost, the "
+                        "single-owner lineage holds, adoptions are "
+                        "accounted in metrics, and adopted same-"
+                        "regime jobs hit warm caches (docs/fleet.md)")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="fleet soak: replica count (default 2 under "
+                        "--smoke, else 3)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-r", "--rank", type=int, default=4)
     p.add_argument("-i", "--iters", type=int, default=8)
@@ -700,6 +733,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="process the spool and queue to completion, "
                         "then exit (batch/CI mode; nonzero exit iff "
                         "a job failed outright)")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet mode (docs/fleet.md): run as one of N "
+                        "replicas over this shared DIR — job "
+                        "ownership via leases, heartbeat membership, "
+                        "dead-peer adoption, cache-affinity routing")
+    p.add_argument("--replica", metavar="ID",
+                   help="fleet: this replica's stable id (default: "
+                        "$SPLATT_FLEET_REPLICA, else a fresh "
+                        "pid+random id)")
+    p.add_argument("--lease", type=float, metavar="S",
+                   help="fleet: lease duration in seconds — the "
+                        "failure-detection horizon (default: "
+                        "$SPLATT_FLEET_LEASE_S)")
+    p.add_argument("--heartbeat", type=float, metavar="S",
+                   help="fleet: heartbeat/renewal cadence (default: "
+                        "$SPLATT_FLEET_HEARTBEAT_S, else lease/3)")
+    p.add_argument("--tenant-quota", type=int, dest="tenant_quota",
+                   help="admission control: max non-terminal jobs per "
+                        "tenant, shed past it with a quota_rejected "
+                        "event (default: $SPLATT_FLEET_TENANT_QUOTA; "
+                        "<= 0 off)")
     p.add_argument("--submit", metavar="SPEC_JSON",
                    help="client mode: file this job-spec JSON into "
                         "DIR/requests/ and exit")

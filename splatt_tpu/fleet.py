@@ -1,0 +1,439 @@
+"""Fleet membership for `splatt serve` — leases, heartbeats, adoption
+(docs/fleet.md; ROADMAP open item 5).
+
+One daemon process cannot serve the million-user workload, and N
+*independent* daemons would each cold-start their own probe/tune/
+compile caches and strand their jobs when they die.  This module is
+the membership layer that turns N `splatt serve` replicas over one
+shared spool into a FLEET:
+
+Replica heartbeats
+    Every replica maintains a lease file
+    ``<root>/fleet/replicas/<replica>.json`` carrying ``{replica, pid,
+    ts, expires, regimes, active}`` and renews it every
+    ``heartbeat_s`` seconds.  A replica whose heartbeat expiry is in
+    the past is DEAD as far as the fleet is concerned — there is no
+    other failure detector.  The ``regimes`` list advertises the shape
+    regimes whose probe/tune/compile caches this replica has already
+    warmed (the affinity-routing signal, serve.py), ``active`` its
+    current job-lease count (the load tiebreaker).
+
+Job leases — ownership is a lease, not an assumption
+    A replica may only RUN a job while it holds the job's lease file
+    ``<root>/fleet/leases/<job>.json``.  The protocol is flock +
+    atomic rename: every lease mutation happens under an exclusive
+    ``flock`` on the job's ``.lock`` sidecar (two racing replicas
+    serialize), reads the current lease inside the lock, decides, and
+    publishes the new lease by tmp-write + ``os.replace`` (a reader
+    outside the lock never sees a torn lease).  The rules:
+
+    - :meth:`acquire` claims an absent lease, or renews one this
+      replica already holds.  A lease validly held by a peer — and an
+      EXPIRED lease, which only :meth:`adopt` may take — refuses.
+    - :meth:`renew` extends a held lease, and REFUSES once the lease
+      expired or changed hands (even if nobody re-took it yet:
+      ownership must be continuous, a gap means a peer may have run
+      the job meanwhile).  The owner learns it lost the job and stops
+      at its next cooperative poll.
+    - :meth:`adopt` takes over an expired lease (bumping the ``gen``
+      counter so the previous owner's stale renew can never match) —
+      the crash-failover path: a dead replica's non-terminal jobs are
+      adopted by a live peer and resume from their hardened
+      checkpoints.
+
+    Expiry is the fence: a replica whose lease expired gets its renew
+    refused at the next poll and abandons the job without committing
+    anything further, while the adopter resumes from the last
+    checkpoint.  (Between expiry and that poll the old owner may still
+    be *computing* — but it can no longer journal a terminal record or
+    keep the result, so the job's durable lineage stays single-owner.)
+
+Fault sites (docs/resilience.md): ``fleet.lease_acquire`` (one atomic
+lease acquisition), ``fleet.heartbeat`` (one membership heartbeat +
+held-lease renewal sweep), ``fleet.adopt`` (one dead-peer takeover) —
+each degrades classified, never killing the replica.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+try:
+    import fcntl
+except ImportError:  # non-POSIX: leases degrade to rename-only
+    fcntl = None
+
+#: heartbeat cadence when SPLATT_FLEET_HEARTBEAT_S is unset/<=0:
+#: renew this many times per lease window
+_BEATS_PER_LEASE = 3.0
+
+
+@dataclasses.dataclass
+class Lease:
+    """One published job lease: who owns the job until when.  ``gen``
+    increments at every takeover, so a stale owner's renew (matching
+    on replica AND gen) can never revive a lease that changed hands
+    and came back."""
+
+    job: str
+    replica: str
+    ts: float
+    expires: float
+    gen: int = 1
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (now if now is not None else time.time()) >= self.expires
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FleetMember:
+    """This replica's view of the fleet: its own heartbeat, the leases
+    it holds, and the liveness/warmth of its peers (serve.py drives
+    one of these per fleet-mode daemon)."""
+
+    def __init__(self, root: str, replica: Optional[str] = None,
+                 lease_s: Optional[float] = None,
+                 heartbeat_s: Optional[float] = None):
+        from splatt_tpu.utils.env import read_env, read_env_float
+
+        self.root = os.path.abspath(root)
+        self.dir = os.path.join(self.root, "fleet")
+        self.replicas_dir = os.path.join(self.dir, "replicas")
+        self.leases_dir = os.path.join(self.dir, "leases")
+        for d in (self.dir, self.replicas_dir, self.leases_dir):
+            os.makedirs(d, exist_ok=True)
+        rid = replica or read_env("SPLATT_FLEET_REPLICA") \
+            or f"r-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self.replica = _safe_name(str(rid))
+        self.lease_s = float(lease_s if lease_s is not None
+                             else read_env_float("SPLATT_FLEET_LEASE_S"))
+        hb = float(heartbeat_s if heartbeat_s is not None
+                   else read_env_float("SPLATT_FLEET_HEARTBEAT_S"))
+        self.heartbeat_s = hb if hb > 0 \
+            else max(self.lease_s / _BEATS_PER_LEASE, 0.05)
+        self._lock = threading.Lock()
+        self._held: Dict[str, Lease] = {}
+        self._lost: set = set()
+        self._regimes: set = set()
+
+    # -- flock + atomic-rename primitives ------------------------------------
+
+    @contextlib.contextmanager
+    def _locked(self, jid: str):
+        """Exclusive advisory lock on the job's ``.lock`` sidecar —
+        the mutual-exclusion half of the lease protocol (two replicas
+        racing an acquire/renew/adopt serialize here; the atomic
+        rename below makes the published lease torn-proof for
+        lock-free readers)."""
+        path = os.path.join(self.leases_dir, f"{_safe_name(jid)}.lock")
+        f = open(path, "a")
+        try:
+            if fcntl is not None:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            yield
+        finally:
+            if fcntl is not None:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            f.close()
+
+    def _lease_path(self, jid: str) -> str:
+        return os.path.join(self.leases_dir, f"{_safe_name(jid)}.json")
+
+    def _write_lease(self, lease: Lease) -> None:
+        path = self._lease_path(lease.job)
+        tmp = f"{path}.{self.replica}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(lease.to_json(), f)
+        os.replace(tmp, path)
+
+    def lease_of(self, jid: str) -> Optional[Lease]:
+        """The published lease for `jid`, or None (lock-free read —
+        the atomic rename guarantees an untorn file; a malformed one
+        reads as absent, i.e. claimable)."""
+        try:
+            with open(self._lease_path(jid)) as f:
+                rec = json.load(f)
+            return Lease(job=str(rec["job"]), replica=str(rec["replica"]),
+                         ts=float(rec["ts"]), expires=float(rec["expires"]),
+                         gen=int(rec.get("gen", 1)))
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    # -- the lease state machine ---------------------------------------------
+
+    def acquire(self, jid: str) -> bool:
+        """Claim the job's lease: absent → this replica's; already
+        ours and unexpired → renewed; held by a peer, or EXPIRED
+        (stale leases are :meth:`adopt`'s, which audits the takeover)
+        → refused.  Exactly one of N racing replicas wins."""
+        from splatt_tpu.utils import faults
+
+        faults.maybe_fail("fleet.lease_acquire")
+        now = time.time()
+        with self._locked(jid):
+            cur = self.lease_of(jid)
+            if cur is not None:
+                if cur.replica != self.replica or cur.expired(now):
+                    return False
+                lease = Lease(job=jid, replica=self.replica, ts=now,
+                              expires=now + self.lease_s, gen=cur.gen)
+            else:
+                lease = Lease(job=jid, replica=self.replica, ts=now,
+                              expires=now + self.lease_s, gen=1)
+            self._write_lease(lease)
+        with self._lock:
+            self._held[jid] = lease
+            self._lost.discard(jid)
+        return True
+
+    def renew(self, jid: str) -> bool:
+        """Extend a held lease.  Refuses — and drops the job into the
+        :meth:`lost` set — when the published lease is gone, expired,
+        or no longer this replica's generation: ownership must be
+        continuous, so an expired lease is never revived even if no
+        peer re-took it yet."""
+        with self._lock:
+            held = self._held.get(jid)
+        if held is None:
+            return False
+        now = time.time()
+        with self._locked(jid):
+            cur = self.lease_of(jid)
+            if (cur is None or cur.replica != self.replica
+                    or cur.gen != held.gen or cur.expired(now)):
+                self._mark_lost(jid)
+                return False
+            lease = Lease(job=jid, replica=self.replica, ts=now,
+                          expires=now + self.lease_s, gen=cur.gen)
+            self._write_lease(lease)
+        with self._lock:
+            self._held[jid] = lease
+        return True
+
+    def adopt(self, jid: str) -> bool:
+        """Take over an EXPIRED lease (or claim an absent one) — the
+        failover path for a dead peer's jobs.  Bumps ``gen`` so the
+        previous owner's stale renew can never match.  Refuses while
+        the lease is validly held."""
+        from splatt_tpu.utils import faults
+
+        faults.maybe_fail("fleet.adopt")
+        now = time.time()
+        with self._locked(jid):
+            cur = self.lease_of(jid)
+            if cur is not None and not cur.expired(now) \
+                    and cur.replica != self.replica:
+                return False
+            gen = (cur.gen + 1) if cur is not None else 1
+            lease = Lease(job=jid, replica=self.replica, ts=now,
+                          expires=now + self.lease_s, gen=gen)
+            self._write_lease(lease)
+        with self._lock:
+            self._held[jid] = lease
+            self._lost.discard(jid)
+        return True
+
+    def release(self, jid: str) -> None:
+        """Drop a held lease (the job reached a terminal state).  A
+        lease we no longer own is left alone — the current owner's."""
+        with self._lock:
+            held = self._held.pop(jid, None)
+            self._lost.discard(jid)
+        if held is None:
+            return
+        with self._locked(jid):
+            cur = self.lease_of(jid)
+            if cur is not None and cur.replica == self.replica \
+                    and cur.gen == held.gen:
+                try:
+                    os.unlink(self._lease_path(jid))
+                    # the .lock sidecar too, or leases/ grows one
+                    # file per job forever.  Job ids are never reused
+                    # after a terminal release, so a racer blocked on
+                    # the old inode just re-reads an absent lease.
+                    os.unlink(os.path.join(
+                        self.leases_dir, f"{_safe_name(jid)}.lock"))
+                except OSError:
+                    pass
+
+    def lost(self, jid: str) -> bool:
+        """Whether this replica's lease on `jid` was lost (renew
+        refused) — the running job's cooperative stop-poll checks this
+        and abandons without committing anything further."""
+        with self._lock:
+            return jid in self._lost
+
+    def held(self) -> List[str]:
+        with self._lock:
+            return sorted(self._held)
+
+    def _mark_lost(self, jid: str) -> None:
+        with self._lock:
+            if jid in self._held:
+                del self._held[jid]
+                self._lost.add(jid)
+        from splatt_tpu import resilience, trace
+
+        resilience.run_report().add(
+            "lease_expired", job=jid, replica=self.replica, role="owner")
+        trace.metric_inc("splatt_fleet_lease_expired_total", role="owner")
+
+    # -- membership heartbeat ------------------------------------------------
+
+    def beat(self) -> List[str]:
+        """One heartbeat tick: publish this replica's membership lease
+        (liveness + warm regimes + load) and renew every held job
+        lease.  Returns the jobs whose renewal was refused this tick.
+        Any failure degrades classified — a missed beat makes this
+        replica look dead sooner (peers adopt after ``lease_s``),
+        which is the documented failure mode, not a crash."""
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        lost: List[str] = []
+        try:
+            faults.maybe_fail("fleet.heartbeat")
+            now = time.time()
+            with self._lock:
+                regimes = sorted(self._regimes)
+                active = len(self._held)
+                held = list(self._held)
+            rec = {"replica": self.replica, "pid": os.getpid(),
+                   "ts": now, "expires": now + self.lease_s,
+                   "regimes": regimes, "active": active}
+            path = os.path.join(self.replicas_dir,
+                                f"{self.replica}.json")
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+            os.replace(tmp, path)
+            for jid in held:
+                if not self.renew(jid):
+                    lost.append(jid)
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            import sys
+
+            print(f"splatt-fleet[{self.replica}]: heartbeat degraded "
+                  f"({cls.value}: "
+                  f"{resilience.failure_message(e)[:120]}); peers may "
+                  f"adopt after {self.lease_s:g}s", file=sys.stderr)
+        return lost
+
+    def peers(self) -> Dict[str, dict]:
+        """Live peers (unexpired heartbeats, this replica excluded):
+        replica -> its heartbeat record.  Dead/malformed heartbeat
+        files read as absent."""
+        out: Dict[str, dict] = {}
+        now = time.time()
+        try:
+            names = os.listdir(self.replicas_dir)
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.replicas_dir, name)) as f:
+                    rec = json.load(f)
+                rid = str(rec["replica"])
+                if rid == self.replica:
+                    continue
+                if float(rec.get("expires", 0)) > now:
+                    out[rid] = rec
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        return out
+
+    def replica_alive(self, rid: str) -> bool:
+        """Whether `rid`'s membership lease is current (itself = yes)."""
+        if rid == self.replica:
+            return True
+        return rid in self.peers()
+
+    def retire(self) -> None:
+        """Remove this replica's heartbeat (graceful exit): peers stop
+        routing around it immediately instead of waiting out the lease."""
+        try:
+            os.unlink(os.path.join(self.replicas_dir,
+                                   f"{self.replica}.json"))
+        except OSError:
+            pass
+
+    # -- warm-regime advertisement (affinity routing, serve.py) --------------
+
+    def add_regime(self, key: Optional[str]) -> None:
+        """Advertise a shape regime as warm on this replica (published
+        at the next beat)."""
+        if key:
+            with self._lock:
+                self._regimes.add(str(key))
+
+    def warm(self, key: Optional[str]) -> bool:
+        """Whether this replica's caches are warm for `key`."""
+        if not key:
+            return False
+        with self._lock:
+            return key in self._regimes
+
+    def peer_warm(self, key: Optional[str],
+                  peers: Optional[Dict[str, dict]] = None
+                  ) -> Optional[str]:
+        """The least-loaded live peer advertising `key` warm, or None
+        (`peers` reuses a snapshot from :meth:`peers`)."""
+        if not key:
+            return None
+        if peers is None:
+            peers = self.peers()
+        best = None
+        for rid, rec in sorted(peers.items()):
+            if key in (rec.get("regimes") or []):
+                load = int(rec.get("active", 0))
+                if best is None or load < best[0]:
+                    best = (load, rid)
+        return best[1] if best else None
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._held)
+
+
+def _safe_name(name: str) -> str:
+    """Replica/job ids become file names; serve._job_id already
+    restricts job ids, this guards replica ids from the same escapes."""
+    import re
+
+    if not re.match(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$", name):
+        raise ValueError(
+            f"fleet name {name!r} is not filesystem-safe (want "
+            f"[A-Za-z0-9][A-Za-z0-9._-]*, max 64 chars)")
+    return name
+
+
+def job_regime(spec: dict) -> Optional[str]:
+    """The shape-regime key of a job spec — the affinity-routing
+    signal (docs/fleet.md).  Matches the tune/probe cache granularity
+    (power-of-two dim/nnz buckets + rank), so 'same regime' means
+    'hits the same warm plans'.  File-tensor jobs return None (the
+    shape is unknown without loading; they route by load only)."""
+    syn = spec.get("synthetic")
+    if not isinstance(syn, dict) or not syn.get("dims"):
+        return None
+    from splatt_tpu.tune import shape_regime
+
+    try:
+        dims = [int(d) for d in syn["dims"]]
+        nnz = int(syn.get("nnz", 1000))
+        rank = int(spec.get("rank", 8))
+    except (TypeError, ValueError):
+        return None
+    return f"{shape_regime(dims, nnz)}:r{rank}"

@@ -590,6 +590,33 @@ RUN_REPORT_EVENTS = {
                     "classified error) instead of converging; the "
                     "job's own run report carries the evidence "
                     "(docs/serve.md)",
+    "journal_torn": "journal replay skipped one unparseable record — "
+                    "final OR mid-file, the debris a writer dying "
+                    "mid-append (or a SIGKILLed fleet replica) can "
+                    "leave; classified and skipped, never fatal, and "
+                    "the next append heals a torn tail before writing "
+                    "(serve.py Journal, docs/fleet.md)",
+    "job_adopted": "a fleet replica took over a dead peer's "
+                   "non-terminal job after its lease expired (the "
+                   "fleet.adopt takeover path); the job resumes from "
+                   "its hardened checkpoint on the adopter "
+                   "(docs/fleet.md)",
+    "lease_expired": "a job lease expired: role=owner — this "
+                     "replica's renew was refused and the running job "
+                     "was abandoned uncommitted; role=adopter — an "
+                     "expired lease was observed and taken over "
+                     "(fleet.py/serve.py, docs/fleet.md)",
+    "quota_rejected": "admission control shed a submission because "
+                      "its tenant is at the per-tenant non-terminal-"
+                      "job quota (SPLATT_FLEET_TENANT_QUOTA) — one "
+                      "tenant flooding the spool cannot crowd out "
+                      "the rest (serve.py, docs/fleet.md)",
+    "affinity_routed": "the fleet scheduler made a cache-affinity "
+                       "decision: a job dispatched to this replica's "
+                       "warm caches (warm_local), deferred to a warm "
+                       "peer (deferred), or taken anyway on the load "
+                       "tiebreaker / deferral cap (load_tiebreak) "
+                       "(serve.py, docs/fleet.md)",
     "comm_fallback": "a distributed comm engine failed its probe and "
                      "the sweep degraded down the comm chain — "
                      "async_ring -> ring -> all2all — with the failed "
@@ -799,6 +826,33 @@ class RunReport:
         for e in self.events("job_resumed"):
             lines.append(f"  job {e.get('job')} resumed from the "
                          f"journal after a daemon restart")
+        torn = self.events("journal_torn")
+        if torn:
+            lines.append(f"  journal replay skipped {len(torn)} torn "
+                         f"record(s) (crash debris; healed on the "
+                         f"next append)")
+        for e in self.events("job_adopted"):
+            lines.append(f"  job {e.get('job')} ADOPTED by "
+                         f"{e.get('replica')} from dead peer "
+                         f"{e.get('from_replica')}")
+        for e in self.events("lease_expired"):
+            if e.get("role") == "owner":
+                lines.append(f"  job {e.get('job')}: lease expired "
+                             f"under {e.get('replica')} — abandoned "
+                             f"uncommitted (a peer may adopt)")
+        for e in self.events("quota_rejected"):
+            lines.append(f"  job {e.get('job')} shed: tenant "
+                         f"{e.get('tenant')} at quota "
+                         f"({e.get('live')}/{e.get('quota')} "
+                         f"non-terminal)")
+        routed = self.events("affinity_routed")
+        if routed:
+            by_reason: Dict[str, int] = {}
+            for e in routed:
+                by_reason[e.get("reason", "?")] = \
+                    by_reason.get(e.get("reason", "?"), 0) + 1
+            lines.append("  affinity routing: " + ", ".join(
+                f"{k}x{v}" for k, v in sorted(by_reason.items())))
         for e in self.events("job_degraded"):
             lines.append(f"  job {e.get('job')} finished degraded "
                          f"({e.get('failure_class')}: "

@@ -51,6 +51,24 @@ Job API (machine-readable)
     client-side readers.  The :class:`Server` methods are the same API
     in-process.
 
+Fleet mode (docs/fleet.md)
+    ``Server(root, fleet=True)`` / ``splatt serve --fleet`` runs this
+    daemon as one of N replicas over the SAME root: the journal
+    becomes a flock-serialized shared log every replica tails, job
+    ownership becomes a lease (``splatt_tpu/fleet.py`` — claimed at
+    dispatch, renewed by a heartbeat thread, adopted by a live peer
+    once a dead replica's lease expires), scheduling becomes
+    cache-affinity routing (jobs prefer the replica whose warm
+    probe/tune/compile caches match their shape regime; load is the
+    tiebreaker, never the signal), and admission control grows
+    per-tenant quotas (``SPLATT_FLEET_TENANT_QUOTA``) and priority
+    classes (``priority: high|normal|low``) over the queue_full
+    shedding.  `splatt chaos --fleet` is the soak proving the fleet
+    invariant: SIGKILL-and-restart across replicas under multi-tenant
+    load loses no accepted job, never runs a job on two replicas at
+    once, and keeps the Nth-request-is-free property through
+    adoption.
+
 A job spec is a JSON object::
 
     {"id": "j1", "rank": 8, "iters": 25, "seed": 0,
@@ -58,7 +76,7 @@ A job spec is a JSON object::
      # or "tensor": "/path/to/tensor.tns",
      "tol": 1e-5, "checkpoint_every": 5, "tune": false,
      "autotune": null, "health_retries": null, "deadline_s": null,
-     "faults": ""}
+     "faults": "", "tenant": "default", "priority": "normal"}
 """
 
 from __future__ import annotations
@@ -70,8 +88,12 @@ import signal
 import threading
 import time
 import uuid
-from collections import deque
 from typing import Callable, Dict, List, Optional
+
+try:
+    import fcntl as _fcntl
+except ImportError:  # non-POSIX: in-process locking only
+    _fcntl = None
 
 # journal record kinds (the `rec` field of each JSONL line)
 #: in-memory-only reservation state while the accept append fsyncs
@@ -80,6 +102,7 @@ ACCEPTING = "accepting"
 ACCEPTED = "accepted"
 STARTED = "started"
 RESUMED = "resumed"
+ADOPTED = "adopted"    # fleet: a live replica took over a dead peer's job
 INTERRUPTED = "interrupted"
 DONE = "done"          # terminal: converged or degraded (see status)
 FAILED = "failed"      # terminal: a classified error
@@ -88,7 +111,17 @@ REJECTED = "rejected"  # terminal: load-shed or invalid
 #: records after which a job needs no further work
 TERMINAL = (DONE, FAILED, REJECTED)
 
+#: admission priority classes, class -> rank (lower runs first); the
+#: scheduler orders by (priority rank, arrival) so within a class the
+#: queue stays FIFO (docs/fleet.md)
+PRIORITIES = {"high": 0, "normal": 1, "low": 2}
+
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+#: how many scheduler passes a job warm on a PEER replica may be
+#: deferred to that peer before this replica takes it anyway —
+#: affinity is a routing preference, never a starvation mechanism
+AFFINITY_DEFER_MAX = 3
 
 
 def _job_id(spec: dict) -> str:
@@ -106,12 +139,16 @@ def _job_id(spec: dict) -> str:
 class Journal:
     """Append-only JSONL job journal with durable, atomic appends.
 
-    One `write()` of a full line + flush + fsync per record: a SIGKILL
-    can tear at most the final line, which :meth:`replay` skips (the
-    record it carried is re-derived — an un-journaled terminal record
-    just means the job re-runs, and resume makes that cheap).  Appends
-    are serialized across threads; the journal is single-writer by
-    design (one daemon per serve root)."""
+    One write of a full line + fsync per record, serialized across
+    threads AND processes (an advisory ``flock`` beside the in-process
+    lock — a fleet of replicas shares one journal, docs/fleet.md).  A
+    SIGKILL can tear a line anywhere a writer died: :meth:`replay`
+    skips every unparseable record with a classified ``journal_torn``
+    event (the record it carried is re-derived — an un-journaled
+    terminal record just means the job re-runs, and resume makes that
+    cheap), and :meth:`append` heals a torn TAIL (no trailing newline)
+    before writing, so crash debris can never merge into — and
+    swallow — the next record."""
 
     def __init__(self, path: str):
         self.path = str(path)
@@ -124,36 +161,90 @@ class Journal:
 
         faults.maybe_fail("serve.journal_write")
         line = json.dumps(dict(rec, ts=time.time()), sort_keys=True)
+        data = line.encode() + b"\n"
         with self._lock:
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+            with open(self.path, "ab") as f:
+                if _fcntl is not None:
+                    _fcntl.flock(f.fileno(), _fcntl.LOCK_EX)
+                try:
+                    # heal a torn tail: a dead writer's partial final
+                    # line must be newline-terminated before this
+                    # record lands, or the two would merge into one
+                    # garbage line and THIS record would be lost
+                    if f.tell() > 0:
+                        with open(self.path, "rb") as r:
+                            r.seek(-1, os.SEEK_END)
+                            if r.read(1) != b"\n":
+                                f.write(b"\n")
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                finally:
+                    if _fcntl is not None:
+                        _fcntl.flock(f.fileno(), _fcntl.LOCK_UN)
 
     def replay(self):
         """Parse every complete record → (records, torn_line_count).
-        A torn/garbled line (the one a SIGKILL can leave) is counted
-        and skipped — replay must never die on its own crash debris."""
+        A torn/garbled line — final OR mid-file, which concurrent
+        fleet appends can leave when a writer dies mid-write — is
+        skipped with a classified ``journal_torn`` event, never fatal:
+        replay must not die on its own crash debris, and one replica's
+        debris must never poison a peer's replay."""
+        recs, torn, _ = self._parse(self._read(0),
+                                    partial_tail_is_torn=True)
+        return recs, torn
+
+    def replay_new(self, offset: int):
+        """Incremental tail read for live fleet sync: parse complete
+        records from byte `offset` on → (records, torn, new_offset).
+        A final line with no newline yet is a peer's IN-PROGRESS
+        append, not debris: it is left unconsumed (the returned offset
+        stays before it) and re-read complete on the next call."""
+        recs, torn, consumed = self._parse(self._read(offset),
+                                           partial_tail_is_torn=False)
+        return recs, torn, offset + consumed
+
+    def _read(self, offset: int) -> bytes:
+        try:
+            with open(self.path, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read()
+        except FileNotFoundError:
+            return b""  # fresh serve root: nothing journaled yet
+
+    def _parse(self, data: bytes, partial_tail_is_torn: bool):
         recs: List[dict] = []
         torn = 0
-        try:
-            with open(self.path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        torn += 1
-                        continue
-                    if isinstance(rec, dict):
-                        recs.append(rec)
-                    else:
-                        torn += 1
-        except FileNotFoundError:
-            pass  # fresh serve root: nothing journaled yet
-        return recs, torn
+        consumed = 0
+        for raw in data.split(b"\n"):
+            complete = consumed + len(raw) < len(data)  # has its \n
+            if not complete and not partial_tail_is_torn:
+                break  # in-progress append: not ours to judge yet
+            consumed += len(raw) + (1 if complete else 0)
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw.decode(errors="replace"))
+                if not isinstance(rec, dict):
+                    raise ValueError("journal record is not an object")
+            except ValueError as e:
+                torn += 1
+                self._report_torn(raw, e)
+                continue
+            recs.append(rec)
+        return recs, torn, consumed
+
+    def _report_torn(self, raw: bytes, exc: Exception) -> None:
+        """One skipped record → a classified ``journal_torn`` event:
+        tolerated crash debris is still OBSERVABLE crash debris."""
+        from splatt_tpu import resilience
+
+        resilience.run_report().add(
+            "journal_torn", path=self.path,
+            failure_class=resilience.classify_failure(exc).value,
+            error=resilience.failure_message(exc)[:120],
+            preview=raw[:60].decode(errors="replace"))
 
 
 class Server:
@@ -166,7 +257,12 @@ class Server:
                  queue_max: Optional[int] = None,
                  poll_s: Optional[float] = None,
                  job_deadline_s: Optional[float] = None,
-                 verbose: bool = False):
+                 verbose: bool = False,
+                 fleet: bool = False, replica: Optional[str] = None,
+                 lease_s: Optional[float] = None,
+                 heartbeat_s: Optional[float] = None,
+                 tenant_quota: Optional[int] = None,
+                 affinity: Optional[bool] = None):
         from splatt_tpu.utils.env import read_env_float, read_env_int
 
         self.root = os.path.abspath(root)
@@ -197,44 +293,141 @@ class Server:
             read_env_float("SPLATT_METRICS_INTERVAL_S"))
         self._metrics_last = 0.0
         self.verbose = verbose
+        # admission control (docs/fleet.md): per-tenant cap on
+        # non-terminal jobs (0 = unlimited), layered over queue_full
+        self.tenant_quota = int(
+            tenant_quota if tenant_quota is not None
+            else read_env_int("SPLATT_FLEET_TENANT_QUOTA"))
         self._lock = threading.Lock()
         #: id -> {"spec": dict|None, "state": str, "status": str|None,
-        #:        "resumed": bool}
+        #:        "resumed": bool, "tenant": str, "priority": str,
+        #:        "seq": int, "owner": str|None (fleet: last journaled
+        #:        replica), "adopt_from": str|None, "deferred": int}
         self._jobs: Dict[str, dict] = {}
-        self._queue: deque = deque()
+        #: pending job ids; _next() picks by (priority, arrival seq)
+        self._queue: List[str] = []
+        self._seq = 0
+        #: job ids currently claimed/running on THIS replica's workers
+        self._running: set = set()
         self._draining = threading.Event()
+        # fleet membership (docs/fleet.md): job ownership is a lease,
+        # routing prefers warm caches, dead peers' jobs are adopted
+        self.fleet = None
+        self._journal_offset = 0
+        self._hb_thread: Optional[threading.Thread] = None
+        if fleet:
+            from splatt_tpu.fleet import FleetMember
+
+            self.fleet = FleetMember(self.root, replica=replica,
+                                     lease_s=lease_s,
+                                     heartbeat_s=heartbeat_s)
+            from splatt_tpu.utils.env import read_env
+
+            self.affinity = bool(affinity if affinity is not None
+                                 else str(read_env(
+                                     "SPLATT_FLEET_AFFINITY")).lower()
+                                 not in ("0", "off", "false", "no"))
+        else:
+            self.affinity = False
         self._replay()
+        if self.fleet is not None:
+            self.fleet.beat()
+            self._start_heartbeat()
 
     # -- crash recovery -----------------------------------------------------
+
+    def _new_job(self, spec: Optional[dict] = None,
+                 state: Optional[str] = None) -> dict:
+        """One fresh job-table entry (callers hold the server lock, or
+        are still single-threaded in __init__)."""
+        j = {"spec": spec, "state": state, "status": None,
+             "resumed": False, "tenant": "default", "priority": "normal",
+             "seq": self._seq, "owner": None, "adopt_from": None,
+             "adopted_from": None, "deferred": 0, "regime": None}
+        self._seq += 1
+        if spec is not None:
+            self._fill_admission(j, spec)
+        return j
+
+    @staticmethod
+    def _fill_admission(j: dict, spec: dict) -> None:
+        """Derive the admission/routing fields from a job spec: the
+        tenant (quota unit), priority class, and shape-regime key (the
+        cache-affinity signal, docs/fleet.md)."""
+        from splatt_tpu.fleet import job_regime
+
+        j["tenant"] = str(spec.get("tenant") or "default")
+        p = str(spec.get("priority") or "normal")
+        j["priority"] = p if p in PRIORITIES else "normal"
+        j["regime"] = job_regime(spec)
+
+    def _apply_rec(self, rec: dict) -> Optional[str]:
+        """Fold one journal record into the job table (last record per
+        job wins — the flock-serialized journal is totally ordered
+        even across fleet replicas).  Callers hold the server lock (or
+        are single-threaded in __init__).  Returns the job id."""
+        jid = rec.get("job")
+        kind = rec.get("rec")
+        if not jid or not kind:
+            return None
+        j = self._jobs.setdefault(jid, self._new_job())
+        if kind == ACCEPTED:
+            if rec.get("spec") is not None:
+                j["spec"] = rec.get("spec")
+                self._fill_admission(j, j["spec"])
+            j["state"] = ACCEPTED
+        else:
+            j["state"] = kind
+            if kind in (DONE, FAILED):
+                j["status"] = rec.get("status")
+        if rec.get("replica"):
+            j["owner"] = rec["replica"]
+        return jid
+
+    def _rec(self, kind: str, jid: str, **kw) -> dict:
+        """One journal record, stamped with this replica's id in
+        fleet mode (the soak's single-owner lineage audit and the
+        adoption scan both key on it)."""
+        rec = {"rec": kind, "job": jid, **kw}
+        if self.fleet is not None:
+            rec["replica"] = self.fleet.replica
+        return rec
 
     def _replay(self) -> None:
         """Rebuild queue state from the journal: the last record per
         job wins; every accepted-but-non-terminal job is re-enqueued
-        (``job_resumed``) and will resume from its checkpoint."""
+        (``job_resumed``) and will resume from its checkpoint.  In
+        fleet mode a job whose lease is validly held by a live peer is
+        only TRACKED — the peer owns it; the adoption scan takes over
+        if that peer dies (docs/fleet.md)."""
         from splatt_tpu import resilience
 
-        recs, torn = self.journal.replay()
+        if self.fleet is not None:
+            recs, torn, self._journal_offset = self.journal.replay_new(0)
+        else:
+            recs, torn = self.journal.replay()
         if torn:
             self._log(f"journal: skipped {torn} torn line(s) "
                       f"(crash debris)")
         for rec in recs:
-            jid = rec.get("job")
-            kind = rec.get("rec")
-            if not jid or not kind:
-                continue
-            j = self._jobs.setdefault(
-                jid, {"spec": None, "state": None, "status": None,
-                      "resumed": False})
-            if kind == ACCEPTED:
-                j["spec"] = rec.get("spec")
-                j["state"] = ACCEPTED
-            else:
-                j["state"] = kind
-                if kind == DONE:
-                    j["status"] = rec.get("status")
+            self._apply_rec(rec)
         for jid, j in self._jobs.items():
             if j["state"] in TERMINAL or j["spec"] is None:
                 continue
+            if self.fleet is not None:
+                me = self.fleet.replica
+                lease = self.fleet.lease_of(jid)
+                if lease is not None and not lease.expired() \
+                        and lease.replica != me:
+                    continue  # a live peer's; watched by _fleet_scan
+                if lease is not None and lease.expired() \
+                        and lease.replica != me:
+                    j["adopt_from"] = lease.replica
+                elif lease is None and j.get("owner") not in (None, me) \
+                        and not self.fleet.replica_alive(j["owner"]):
+                    # accepted by a dead peer, never claimed: taking
+                    # it over is an adoption, audited as one
+                    j["adopt_from"] = j["owner"]
             j["resumed"] = True
             self._queue.append(jid)
             resilience.run_report().add("job_resumed", job=jid,
@@ -242,7 +435,7 @@ class Server:
             self._log(f"job {jid}: resumed from journal "
                       f"(was {j['state']})")
             try:
-                self.journal.append({"rec": RESUMED, "job": jid})
+                self.journal.append(self._rec(RESUMED, jid))
             except Exception as e:
                 # lineage entry only — the ACCEPTED record already
                 # guarantees a later replay re-finds this job
@@ -258,9 +451,13 @@ class Server:
         Durability-first: the submitter hears "accepted" only after the
         journal append succeeded — a submission the journal cannot
         record is REJECTED, because a crash would silently forget it.
-        A full pending queue load-sheds with an explicit ``queue_full``
-        rejection.  Re-submitting a known id is idempotent (a crashed
-        client retrying, or a spool file re-ingested after a crash)."""
+        Admission control layers on top: an unknown ``priority`` class
+        is invalid, a tenant at its non-terminal-job quota
+        (``SPLATT_FLEET_TENANT_QUOTA``) is shed with a
+        ``quota_rejected`` event, and a full pending queue load-sheds
+        with an explicit ``queue_full`` rejection.  Re-submitting a
+        known id is idempotent (a crashed client retrying, or a spool
+        file re-ingested after a crash)."""
         from splatt_tpu import resilience
         from splatt_tpu.utils import faults
 
@@ -279,9 +476,14 @@ class Server:
                 # invitation to retry, not a permanent verdict
                 return {"job": jid, "state": known["state"],
                         "duplicate": True}
+            tenant = str(spec.get("tenant") or "default")
+            prio = spec.get("priority")
             if not (spec.get("synthetic") or spec.get("tensor")):
                 reason = ("invalid: no workload (give 'synthetic' or "
                           "'tensor')")
+            elif prio is not None and str(prio) not in PRIORITIES:
+                reason = (f"invalid: unknown priority {prio!r} (want "
+                          f"one of {sorted(PRIORITIES)})")
             elif spec.get("faults"):
                 # validate the declared chaos schedule at the door: a
                 # typo rejects THIS submission with the parse error
@@ -290,6 +492,17 @@ class Server:
                     faults.parse_schedule(str(spec["faults"]))
                 except (ValueError, TypeError) as e:
                     reason = f"invalid: bad faults schedule ({e})"
+            if reason is None and self.tenant_quota > 0:
+                live = sum(1 for j in self._jobs.values()
+                           if j.get("tenant") == tenant
+                           and j["state"] not in TERMINAL)
+                if live >= self.tenant_quota:
+                    # per-tenant isolation at the door: one tenant
+                    # flooding the spool cannot crowd out the rest
+                    resilience.run_report().add(
+                        "quota_rejected", job=jid, tenant=tenant,
+                        quota=self.tenant_quota, live=live)
+                    reason = f"quota:{tenant}"
             if reason is None and self.queue_max > 0 \
                     and len(self._queue) >= self.queue_max:
                 resilience.run_report().add("queue_full", job=jid,
@@ -298,15 +511,13 @@ class Server:
             if reason is None:
                 # reserve the id so a concurrent same-id submission
                 # dedups while we journal lock-free below
-                self._jobs[jid] = {"spec": spec, "state": ACCEPTING,
-                                   "status": None, "resumed": False}
+                self._jobs[jid] = self._new_job(spec, ACCEPTING)
         if reason is not None:
             return self._reject(jid, spec, reason)
         # durability-first: the submitter hears "accepted" only once
         # this append has fsynced
         try:
-            self.journal.append({"rec": ACCEPTED, "job": jid,
-                                 "spec": spec})
+            self.journal.append(self._rec(ACCEPTED, jid, spec=spec))
         except Exception as e:
             cls = resilience.classify_failure(e)
             return self._reject(
@@ -315,7 +526,10 @@ class Server:
         resilience.run_report().add("job_accepted", job=jid)
         with self._lock:
             self._jobs[jid]["state"] = ACCEPTED
-            self._queue.append(jid)
+            # a fleet peer's journal sync may have surfaced the id
+            # while our accept append fsynced — never queue it twice
+            if jid not in self._queue and jid not in self._running:
+                self._queue.append(jid)
             # gauge published under the lock: concurrent workers'
             # pop/publish pairs stay ordered, so the depth is
             # monotone-consistent with the queue
@@ -331,11 +545,11 @@ class Server:
         from splatt_tpu import resilience
 
         with self._lock:
-            self._jobs[jid] = {"spec": spec, "state": REJECTED,
-                               "status": "rejected", "resumed": False}
+            j = self._new_job(spec, REJECTED)
+            j["status"] = "rejected"
+            self._jobs[jid] = j
         try:
-            self.journal.append(
-                {"rec": REJECTED, "job": jid, "reason": reason})
+            self.journal.append(self._rec(REJECTED, jid, reason=reason))
         except Exception as e:
             # the rejection itself needs no durability: an un-journaled
             # rejected job simply never existed after a restart
@@ -371,8 +585,12 @@ class Server:
         counts: Dict[str, int] = {}
         for s in jobs.values():
             counts[s] = counts.get(s, 0) + 1
-        return {"jobs": jobs, "counts": counts, "pending": pending,
-                "draining": self._draining.is_set()}
+        out = {"jobs": jobs, "counts": counts, "pending": pending,
+               "draining": self._draining.is_set()}
+        if self.fleet is not None:
+            out["replica"] = self.fleet.replica
+            out["held_leases"] = self.fleet.held()
+        return out
 
     # -- filed-request spool -------------------------------------------------
 
@@ -382,16 +600,35 @@ class Server:
         between journaling and unlink re-ingests a known id, which the
         idempotent :meth:`submit` dedups.  A malformed or failing
         request is quarantined as ``<name>.bad`` (classified, logged)
-        so the scanner cannot spin on it."""
+        so the scanner cannot spin on it.
+
+        Fleet mode makes the spool multi-consumer: a replica CLAIMS a
+        request first (atomic rename to ``<name>.<replica>.claim`` —
+        exactly one of N racing replicas wins; the losers skip
+        silently), then parses and submits from the claimed file.  A
+        replica that dies between claim and journal leaves the
+        ``.claim`` file behind; :meth:`_reclaim_requests` renames a
+        dead claimant's files back into the spool, so a claimed-but-
+        never-journaled request is delayed, never lost."""
         from splatt_tpu import resilience
 
         n = 0
+        if self.fleet is not None:
+            self._reclaim_requests()
         for name in sorted(os.listdir(self.requests_dir)):
             if not name.endswith(".json"):
                 continue
             path = os.path.join(self.requests_dir, name)
+            read_path = path
+            if self.fleet is not None:
+                claim = f"{path}.{self.fleet.replica}.claim"
+                try:
+                    os.replace(path, claim)
+                except OSError:
+                    continue  # a peer claimed this request first
+                read_path = claim
             try:
-                with open(path) as f:
+                with open(read_path) as f:
                     spec = json.load(f)
                 if not isinstance(spec, dict):
                     raise ValueError("job spec must be a JSON object")
@@ -405,24 +642,179 @@ class Server:
                           f"{resilience.failure_message(e)[:120]}); "
                           f"quarantined as {name}.bad", error=True)
                 try:
-                    os.replace(path, path + ".bad")
+                    os.replace(read_path, path + ".bad")
                 except OSError:
                     pass
                 continue
             try:
-                os.unlink(path)
+                os.unlink(read_path)
             except OSError:
                 pass  # re-ingested next scan; submit dedups
         return n
 
+    def _reclaim_requests(self) -> None:
+        """Return a dead (or our own restarted) claimant's
+        ``<name>.json.<replica>.claim`` spool files to the spool: the
+        claim protects against double-ingest, never against ingest."""
+        try:
+            names = os.listdir(self.requests_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".claim"):
+                continue
+            parts = name[:-len(".claim")].rsplit(".", 1)
+            if len(parts) != 2 or not parts[0].endswith(".json"):
+                continue
+            base, rid = parts
+            if rid != self.fleet.replica \
+                    and self.fleet.replica_alive(rid):
+                continue  # claimant lives; it is mid-ingest
+            try:
+                os.replace(os.path.join(self.requests_dir, name),
+                           os.path.join(self.requests_dir, base))
+            except OSError:
+                pass
+
     # -- supervisor ----------------------------------------------------------
 
+    def _order_locked(self) -> List[str]:
+        """Queue in dispatch order: priority class first, arrival
+        order within a class (callers hold the server lock)."""
+        return sorted(
+            self._queue,
+            key=lambda jid: (PRIORITIES.get(
+                self._jobs[jid].get("priority") or "normal", 1),
+                self._jobs[jid].get("seq", 0)))
+
     def _next(self) -> Optional[str]:
+        """Pick (and in fleet mode, lease-claim) the next job.
+
+        Single replica: highest-priority, oldest job — done.  Fleet
+        (docs/fleet.md): cache affinity is the scheduling signal, load
+        the tiebreaker — a job whose shape regime is warm HERE is
+        taken first (the Nth-request-is-free property survives
+        scale-out); a job warm only on a live, not-busier PEER is
+        deferred to that peer for up to AFFINITY_DEFER_MAX passes;
+        everything else dispatches by priority/arrival.  The pick only
+        becomes ours once the job's lease is acquired — a claim a peer
+        won (or a claim fault) drops the job here and the fleet scan
+        re-surfaces it."""
+        # peer snapshot before taking the lock: heartbeat reads are
+        # file IO and must not stall the control plane
+        peers = (self.fleet.peers()
+                 if self.fleet is not None and self.affinity else {})
+        while True:
+            routed = None  # (reason, jid, regime, peer) emitted below
+            with self._lock:
+                pick = None
+                order = self._order_locked()
+                if self.affinity and self.fleet is not None:
+                    # affinity pass: ANY job warm on this replica
+                    # beats queue position (within a scan the
+                    # priority/arrival order still breaks warm ties)
+                    for jid in order:
+                        reg = self._jobs[jid].get("regime")
+                        if reg and self.fleet.warm(reg):
+                            pick = jid
+                            routed = ("warm_local", jid, reg, None)
+                            break
+                for jid in order if pick is None else ():
+                    j = self._jobs[jid]
+                    reg = j.get("regime")
+                    if not self.affinity or self.fleet is None:
+                        pick = jid
+                        break
+                    peer = self.fleet.peer_warm(reg, peers)
+                    if peer is not None:
+                        if j["deferred"] < AFFINITY_DEFER_MAX \
+                                and int(peers[peer].get("active", 0)) \
+                                <= self.fleet.active_count() + 1:
+                            j["deferred"] += 1
+                            if j["deferred"] == 1:
+                                routed = ("deferred", jid, reg, peer)
+                            continue  # leave it for the warm peer
+                        routed = ("load_tiebreak", jid, reg, peer)
+                    pick = jid
+                    break
+                if pick is not None:
+                    self._queue.remove(pick)
+                    self._running.add(pick)
+                    self._queue_metric(len(self._queue))
+            if routed is not None:
+                self._route_event(*routed)
+            if pick is None or self.fleet is None:
+                return pick
+            if self._claim(pick):
+                return pick
+            # a peer won the lease (or the claim faulted): not ours —
+            # the fleet scan re-surfaces it if it goes unowned
+            with self._lock:
+                self._running.discard(pick)
+
+    def _route_event(self, reason: str, jid: str, regime: str,
+                     peer: Optional[str]) -> None:
+        """One ``affinity_routed`` audit event (docs/fleet.md)."""
+        from splatt_tpu import resilience
+
+        info = dict(job=jid, regime=regime, reason=reason,
+                    replica=self.fleet.replica)
+        if peer is not None:
+            info["to_replica"] = peer
+        resilience.run_report().add("affinity_routed", **info)
+
+    def _claim(self, jid: str) -> bool:
+        """Acquire the job's lease (fleet mode): the normal path for
+        an unclaimed job, the audited ``fleet.adopt`` takeover for an
+        expired one.  A successful takeover journals an ``adopted``
+        record and leaves ``job_adopted``/``lease_expired`` evidence;
+        any failure degrades classified — never a dead worker."""
+        from splatt_tpu import resilience, trace
+
+        me = self.fleet.replica
         with self._lock:
-            jid = self._queue.popleft() if self._queue else None
-            if jid is not None:
-                self._queue_metric(len(self._queue))
-        return jid
+            adopt_from = self._jobs[jid].get("adopt_from")
+        try:
+            lease = self.fleet.lease_of(jid)
+            stale = (lease.replica if lease is not None
+                     and lease.expired() else None)
+            if stale is not None:
+                ok = self.fleet.adopt(jid)
+            else:
+                ok = self.fleet.acquire(jid)
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            self._log(f"job {jid}: lease claim degraded ({cls.value}: "
+                      f"{resilience.failure_message(e)[:120]}); "
+                      f"re-surfaced by the fleet scan", error=True)
+            return False
+        if not ok:
+            return False
+        victim = adopt_from or (stale if stale != me else None)
+        if victim:
+            # a dead peer's job changed hands: audit the takeover
+            resilience.run_report().add(
+                "job_adopted", job=jid, replica=me, from_replica=victim)
+            trace.metric_inc("splatt_fleet_adoptions_total")
+            if stale is not None and stale != me:
+                resilience.run_report().add(
+                    "lease_expired", job=jid, replica=stale,
+                    role="adopter")
+                trace.metric_inc("splatt_fleet_lease_expired_total",
+                                 role="adopter")
+            try:
+                self.journal.append(self._rec(ADOPTED, jid,
+                                              from_replica=victim))
+            except Exception as e:
+                # lineage entry only — the lease itself is the
+                # ownership record
+                self._warn_journal("adopt", jid, e)
+            self._log(f"job {jid}: adopted from {victim}")
+            with self._lock:
+                self._jobs[jid]["adopt_from"] = None
+                self._jobs[jid]["adopted_from"] = victim
+                self._jobs[jid]["resumed"] = True
+        return True
 
     @staticmethod
     def _queue_metric(depth: int) -> None:
@@ -431,58 +823,196 @@ class Server:
         trace.metric_set("splatt_serve_queue_depth", float(depth))
 
     def run_once(self) -> dict:
-        """Ingest the spool, then run every queued job to a terminal
-        state (or until a drain interrupts) on `workers` supervisor
-        threads.  Returns :meth:`summary`."""
+        """Ingest the spool (and in fleet mode, sync the shared
+        journal + adopt dead peers' jobs), then run every queued job
+        to a terminal state (or until a drain interrupts) on `workers`
+        supervisor threads.  Returns :meth:`summary`.
+
+        The outer pass loop exists for fleet affinity: a pass may end
+        with only PEER-WARM jobs left deferred in the queue; each
+        further pass bumps their deferral counters toward the
+        AFFINITY_DEFER_MAX steal, so a batch (``--once``) run still
+        terminates with every job dispatched somewhere."""
         from splatt_tpu import resilience
 
         self.scan_requests()
-        with self._lock:
-            idle = not self._queue
-        if idle:
-            # nothing queued (the serve_forever steady state): skip
-            # worker-thread construction entirely — an idle daemon
-            # must not churn threads twice a second
-            return self.summary()
+        if self.fleet is not None:
+            self._fleet_scan()
+        while not self._draining.is_set():
+            with self._lock:
+                idle = not self._queue
+            if idle:
+                # nothing queued (the serve_forever steady state): skip
+                # worker-thread construction entirely — an idle daemon
+                # must not churn threads twice a second
+                break
 
-        def loop():
-            while not self._draining.is_set():
-                jid = self._next()
-                if jid is None:
-                    return
-                try:
-                    self._run_job(jid)
-                except Exception as e:
-                    # backstop: _run_job handles job failures itself,
-                    # so anything landing here is a supervisor bug —
-                    # mark the job failed (classified) rather than
-                    # dying silently and stranding the rest of the
-                    # queue behind a dead worker
-                    cls = resilience.classify_failure(e)
-                    msg = resilience.failure_message(e)[:200]
-                    self._log(f"job {jid}: supervisor error "
-                              f"({cls.value}: {msg})", error=True)
-                    self._write_result(jid, {"job": jid,
-                                             "status": "failed",
-                                             "failure_class": cls.value,
-                                             "error": msg})
+            def loop():
+                while not self._draining.is_set():
+                    jid = self._next()
+                    if jid is None:
+                        return
                     try:
-                        self.journal.append({"rec": FAILED, "job": jid,
-                                             "status": "failed"})
-                    except Exception as e2:
-                        self._warn_journal("finish", jid, e2)
-                    with self._lock:
-                        self._jobs[jid]["state"] = FAILED
-                        self._jobs[jid]["status"] = "failed"
+                        self._run_job(jid)
+                    except Exception as e:
+                        # backstop: _run_job handles job failures
+                        # itself, so anything landing here is a
+                        # supervisor bug — mark the job failed
+                        # (classified) rather than dying silently and
+                        # stranding the rest of the queue behind a
+                        # dead worker
+                        cls = resilience.classify_failure(e)
+                        msg = resilience.failure_message(e)[:200]
+                        self._log(f"job {jid}: supervisor error "
+                                  f"({cls.value}: {msg})", error=True)
+                        self._backstop_fail(jid, cls, msg)
+                    finally:
+                        with self._lock:
+                            self._running.discard(jid)
+                        if self.fleet is not None:
+                            try:
+                                # never leak a held lease past the
+                                # job (a heartbeat renewing a
+                                # finished job forever); a failing
+                                # release must not kill the worker
+                                self.fleet.release(jid)
+                            except Exception as e:
+                                from splatt_tpu import resilience \
+                                    as _res
 
-        threads = [threading.Thread(target=loop, daemon=True,
-                                    name=f"splatt-serve-w{i}")
-                   for i in range(max(self.workers, 1))]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+                                self._log(
+                                    f"job {jid}: lease release "
+                                    f"degraded "
+                                    f"({_res.classify_failure(e).value}"
+                                    f": {e})", error=True)
+
+            threads = [threading.Thread(target=loop, daemon=True,
+                                        name=f"splatt-serve-w{i}")
+                       for i in range(max(self.workers, 1))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            with self._lock:
+                again = bool(self._queue)
+            if not again or self.fleet is None:
+                break
         return self.summary()
+
+    def _backstop_fail(self, jid: str, cls, msg: str) -> None:
+        """Commit a supervisor-error FAILED verdict — with the same
+        fences the normal commit path has.  A job already terminal
+        (the escape was post-commit cleanup) must NOT gain a second
+        terminal record, and in fleet mode a terminal record may only
+        be journaled under a live lease (a renew refusal means a peer
+        owns the job now — abandon uncommitted, exactly like the
+        zombie path in _run_job)."""
+        with self._lock:
+            already = self._jobs[jid]["state"] in TERMINAL
+        if already:
+            self._log(f"job {jid}: already terminal; the supervisor "
+                      f"error was post-commit cleanup", error=True)
+            return
+        if self.fleet is not None:
+            try:
+                owned = self.fleet.renew(jid)
+            # splint: ignore[SPL002] an unverifiable lease is an
+            # unowned lease: the conservative answer is abandon
+            except Exception:
+                owned = False
+            if not owned:
+                with self._lock:
+                    self._jobs[jid]["state"] = ACCEPTED
+                self._log(f"job {jid}: supervisor error without a "
+                          f"live lease; abandoned uncommitted",
+                          error=True)
+                return
+        self._write_result(jid, {"job": jid, "status": "failed",
+                                 "failure_class": cls.value,
+                                 "error": msg})
+        try:
+            self.journal.append(self._rec(FAILED, jid,
+                                          status="failed"))
+        except Exception as e2:
+            self._warn_journal("finish", jid, e2)
+        with self._lock:
+            self._jobs[jid]["state"] = FAILED
+            self._jobs[jid]["status"] = "failed"
+
+    # -- fleet membership (docs/fleet.md) ------------------------------------
+
+    def _start_heartbeat(self) -> None:
+        """The replica's liveness thread: publish the membership lease
+        and renew every held job lease each ``heartbeat_s`` — running
+        jobs must stay owned through arbitrarily long sweeps, so the
+        renewal cannot ride the workers' cooperative polls alone."""
+        def beat_loop():
+            while not self._draining.wait(self.fleet.heartbeat_s):
+                self.fleet.beat()
+
+        self._hb_thread = threading.Thread(
+            target=beat_loop, daemon=True, name="splatt-fleet-hb")
+        self._hb_thread.start()
+
+    def _fleet_scan(self) -> None:
+        """One fleet sync + adoption pass: fold the shared journal's
+        new records into the job table, then (re-)surface every
+        non-terminal job that is neither queued/running here nor
+        validly leased elsewhere — a dead peer's jobs become local
+        queue entries marked ``adopt_from`` (claimed, audited, via
+        :meth:`_claim`).  Exactly one of N scanning replicas wins the
+        subsequent lease claim."""
+        me = self.fleet.replica
+        with self._lock:
+            recs, torn, self._journal_offset = \
+                self.journal.replay_new(self._journal_offset)
+            for rec in recs:
+                jid = self._apply_rec(rec)
+                if jid and self._jobs[jid]["state"] in TERMINAL \
+                        and jid in self._queue:
+                    # a peer finished a job we still had queued
+                    self._queue.remove(jid)
+            candidates = [
+                jid for jid, j in self._jobs.items()
+                if j["state"] not in (*TERMINAL, ACCEPTING)
+                and j["spec"] is not None
+                and jid not in self._queue and jid not in self._running]
+        for jid in candidates:
+            lease = self.fleet.lease_of(jid)
+            if lease is not None and not lease.expired():
+                continue  # validly owned (a live peer's, or mid-claim)
+            with self._lock:
+                j = self._jobs.get(jid)
+                if (j is None or j["state"] in (*TERMINAL, ACCEPTING)
+                        or jid in self._queue or jid in self._running):
+                    continue
+                owner = (lease.replica if lease is not None
+                         else j.get("owner"))
+                steal = False
+                if lease is None and owner not in (None, me) \
+                        and self.fleet.replica_alive(owner):
+                    # its accepting replica lives and will claim it —
+                    # EXCEPT when our caches are warm for the job's
+                    # regime: then this is the receiving half of the
+                    # peer's affinity deferral (docs/fleet.md), and we
+                    # surface the job here.  The flock'd lease claim
+                    # resolves the resulting race to one owner.
+                    if not (self.affinity and j.get("regime")
+                            and self.fleet.warm(j["regime"])):
+                        continue
+                    steal = True
+                j["adopt_from"] = owner if not steal \
+                    and owner not in (None, me) else None
+                # resumed=True is safe even for a never-started job:
+                # _execute just finds no checkpoint and starts fresh
+                j["resumed"] = not steal or j["state"] != ACCEPTED
+                j["deferred"] = 0
+                self._queue.append(jid)
+                self._queue_metric(len(self._queue))
+            if j["adopt_from"]:
+                self._log(f"job {jid}: dead-peer candidate "
+                          f"(owner {j['adopt_from']}); queued for "
+                          f"adoption")
 
     def serve_forever(self) -> dict:
         """The daemon loop: process the queue, poll the spool, repeat —
@@ -526,6 +1056,18 @@ class Server:
         everything else journaled for the next start."""
         self._draining.set()
 
+    def shutdown(self) -> None:
+        """Graceful-exit bookkeeping on top of :meth:`drain`: stop the
+        fleet heartbeat thread and retire the membership lease, so
+        peers route around this replica immediately instead of
+        waiting out the lease window."""
+        self.drain()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=max(self.fleet.heartbeat_s * 4,
+                                             1.0))
+        if self.fleet is not None:
+            self.fleet.retire()
+
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT → graceful drain (main thread only)."""
         signal.signal(signal.SIGTERM, lambda s, f: self.drain())
@@ -539,9 +1081,10 @@ class Server:
         with self._lock:
             j = self._jobs[jid]
             spec, resumed = j["spec"], j["resumed"]
+            regime = j.get("regime")
             j["state"] = STARTED
         try:
-            self.journal.append({"rec": STARTED, "job": jid})
+            self.journal.append(self._rec(STARTED, jid))
         except Exception as e:
             # non-fatal: without this line a crash replays the job from
             # ACCEPTED — it re-runs, and checkpoint resume makes the
@@ -552,48 +1095,93 @@ class Server:
 
         # one span per supervised job (docs/observability.md): with
         # tracing on, a tenant's whole run — cpd.als and its guard
-        # spans nested under it — carries the job id
-        with trace.span("serve.job", job=jid, resumed=resumed):
-            record = self._execute(jid, spec, resumed)
+        # spans nested under it — carries the job id (and, in fleet
+        # mode, the replica that ran it — the `splatt trace` fleet
+        # summary groups on it)
+        attrs = dict(job=jid, resumed=resumed)
+        if self.fleet is not None:
+            attrs["replica"] = self.fleet.replica
+        with trace.span("serve.job", **attrs):
+            record, stopped = self._execute(jid, spec, resumed)
+        if self.fleet is not None and record is not None \
+                and not self.fleet.renew(jid):
+            # commit fence: a terminal record may only be journaled
+            # under a live lease.  A stalled heartbeat (paused
+            # process, busy host) can let the lease expire mid-run
+            # unnoticed by the cooperative poll — the renew refusal
+            # here catches it at the last gate, so a zombie owner can
+            # never double-commit a job a peer already adopted
+            stopped["lease"] = True
+            record = None
+        if record is None and stopped.get("lease"):
+            # ownership moved on (lease expired; possibly adopted):
+            # abandon WITHOUT committing anything — no terminal
+            # record, no result — the current owner carries the
+            # job's lineage from here (docs/fleet.md)
+            with self._lock:
+                self._jobs[jid]["state"] = ACCEPTED
+            self._log(f"job {jid}: lease lost mid-run; abandoned "
+                      f"uncommitted (the adopter owns it now)",
+                      error=True)
+            return
         if record is None:
             # drain interrupt: NOT terminal — the job already
             # checkpointed via the stop hook; journal the interruption
             # so the restart lineage is explicit
             try:
-                self.journal.append({"rec": INTERRUPTED, "job": jid})
+                self.journal.append(self._rec(INTERRUPTED, jid))
             except Exception as e:
                 self._warn_journal("interrupt", jid, e)
             with self._lock:
                 self._jobs[jid]["state"] = INTERRUPTED
+            if self.fleet is not None:
+                # release immediately: any live replica may resume it
+                self.fleet.release(jid)
             self._log(f"job {jid}: interrupted by drain (checkpointed; "
                       f"resumes next start)")
             return
         self._write_result(jid, record)
         kind = FAILED if record["status"] == "failed" else DONE
         try:
-            self.journal.append({"rec": kind, "job": jid,
-                                 "status": record["status"]})
+            self.journal.append(self._rec(kind, jid,
+                                          status=record["status"]))
         except Exception as e:
             self._warn_journal("finish", jid, e)
         with self._lock:
             self._jobs[jid]["state"] = kind
             self._jobs[jid]["status"] = record["status"]
+        if self.fleet is not None:
+            self.fleet.release(jid)
+            if kind == DONE:
+                # advertise the now-warm regime: same-regime jobs
+                # route here and hit the probe/tune/compile caches
+                # warm.  A FAILED job proved nothing about the caches
+                # — advertising it would concentrate same-regime work
+                # on a replica that never warmed them.
+                self.fleet.add_regime(regime)
         self._log(f"job {jid}: {record['status']}"
                   + (f" fit={record['fit']:.5f}"
                      if record.get("fit") is not None else ""))
 
-    def _execute(self, jid: str, spec: dict, resumed: bool
-                 ) -> Optional[dict]:
+    def _execute(self, jid: str, spec: dict, resumed: bool):
         """Run one job under its own resilience scope and fault
-        schedule; returns the result record, or None when a drain
-        interrupted the run (already checkpointed, not terminal)."""
+        schedule; returns ``(record, stopped)`` — the result record,
+        or None when a drain interrupted the run (already
+        checkpointed, not terminal) or the job's lease was lost
+        (``stopped["lease"]``: abandon, committing nothing — the
+        adopter owns the job now)."""
         from splatt_tpu import resilience
         from splatt_tpu.utils import faults
 
         t0 = time.time()
-        stopped = {"drain": False, "deadline": False}
+        stopped = {"drain": False, "deadline": False, "lease": False}
 
         def _stop() -> bool:
+            if self.fleet is not None and self.fleet.lost(jid):
+                # the heartbeat thread's renew was refused: ownership
+                # is gone, stop before committing anything further
+                stopped["lease"] = True
+                return True
             if self._draining.is_set():
                 stopped["drain"] = True
                 return True
@@ -648,8 +1236,8 @@ class Server:
                                 f"splatt deadline blown at "
                                 f"serve.job_run after {deadline_s:g}s "
                                 f"(cooperative job-deadline stop)")
-                if stopped["drain"]:
-                    return None
+                if stopped["lease"] or stopped["drain"]:
+                    return None, stopped
                 degraded = bool(sc.report.events("health_degraded"))
                 if degraded:
                     # run_report() here IS the job scope's report
@@ -697,7 +1285,13 @@ class Server:
             trace.metric_observe("splatt_job_seconds",
                                  float(record["seconds"]))
             record["metrics"] = trace.metrics_snapshot(job=jid)
-        return record
+            if self.fleet is not None:
+                record["replica"] = self.fleet.replica
+                with self._lock:
+                    adopted_from = self._jobs[jid].get("adopted_from")
+                if adopted_from:
+                    record["adopted_from"] = adopted_from
+        return record, stopped
 
     def _run_cpd(self, jid: str, spec: dict, stop: Callable[[], bool]):
         """The job body: workload → (optional pre-tune) → blocked

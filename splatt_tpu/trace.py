@@ -154,6 +154,14 @@ METRICS = {
                    "(converged/degraded/failed/rejected)"),
     "splatt_job_seconds": (
         "histogram", "serve: per-job wall seconds accepted-to-terminal"),
+    "splatt_fleet_adoptions_total": (
+        "counter", "fleet: dead peers' jobs adopted by this replica "
+                   "(expired-lease takeovers; docs/fleet.md)"),
+    "splatt_fleet_lease_expired_total": (
+        "counter", "fleet: job-lease expiries by role (owner: this "
+                   "replica's renew refused, job abandoned "
+                   "uncommitted; adopter: an expired lease was taken "
+                   "over)"),
 }
 
 #: histogram bucket upper bounds (seconds); +Inf is implicit
@@ -747,7 +755,23 @@ def summarize(events: List[dict]) -> dict:
     kinds: Dict[str, int] = {}
     for p in pts:
         kinds[p["name"]] = kinds.get(p["name"], 0) + 1
+    # fleet accounting (docs/fleet.md): serve.job spans carry the
+    # replica that ran them; adoption/lease-expiry point events carry
+    # the failover story — `splatt trace` must account for every
+    # adoption next to the per-replica job counts
+    replicas: Dict[str, int] = {}
+    for e in sp:
+        if e["name"] == "serve.job":
+            rid = (e.get("args") or {}).get("replica")
+            if rid:
+                replicas[str(rid)] = replicas.get(str(rid), 0) + 1
+    fleet = None
+    if replicas or kinds.get("job_adopted") or kinds.get("lease_expired"):
+        fleet = {"replicas": replicas,
+                 "adoptions": kinds.get("job_adopted", 0),
+                 "lease_expired": kinds.get("lease_expired", 0)}
     return {"spans": sum(a["count"] for a in names.values()),
+            "fleet": fleet,
             "names": names,
             "top": sorted(names.items(), key=lambda kv: -kv[1]["self_us"]),
             "iters": iters,
@@ -789,6 +813,15 @@ def format_summary(s: dict, top_n: int = 12) -> List[str]:
     lines.append(f"guard overhead: {s['guard_self_us'] / 1e6:.4f}s "
                  f"self-time = {s['guard_pct']}% of the run "
                  f"(cpd.guard.* + guard.* spans)")
+    if s.get("fleet"):
+        fl = s["fleet"]
+        per = ", ".join(f"{rid}={n}"
+                        for rid, n in sorted(fl["replicas"].items())) \
+            or "(no serve.job spans)"
+        lines.append(f"fleet: {fl['adoptions']} adoption(s), "
+                     f"{fl['lease_expired']} lease expir"
+                     f"{'y' if fl['lease_expired'] == 1 else 'ies'}; "
+                     f"jobs per replica: {per}")
     if s["points"]:
         evs = ", ".join(f"{k}x{v}"
                         for k, v in sorted(s["points"].items()))

@@ -203,6 +203,36 @@ ENV_VARS = {
                                           "TIMEOUT and the job is "
                                           "marked failed, releasing "
                                           "its worker; <= 0 disables"),
+    # fleet-mode serve knobs (splatt_tpu/fleet.py, docs/fleet.md)
+    "SPLATT_FLEET_REPLICA": EnvVar(None, "fleet: this replica's "
+                                   "stable id (file-name-safe); "
+                                   "default is a fresh pid+random id "
+                                   "per process — set it explicitly "
+                                   "when a restarted replica should "
+                                   "keep its identity"),
+    "SPLATT_FLEET_LEASE_S": EnvVar(10.0, "fleet: job/membership lease "
+                                   "duration in seconds — the "
+                                   "failure-detection horizon: a "
+                                   "replica silent this long is dead "
+                                   "and its non-terminal jobs are "
+                                   "adopted by live peers"),
+    "SPLATT_FLEET_HEARTBEAT_S": EnvVar(0.0, "fleet: seconds between "
+                                       "heartbeat/lease-renewal "
+                                       "sweeps; <= 0 derives "
+                                       "lease_s / 3"),
+    "SPLATT_FLEET_TENANT_QUOTA": EnvVar(0, "serve admission control: "
+                                        "max non-terminal jobs per "
+                                        "tenant; past it submissions "
+                                        "are shed with a "
+                                        "quota_rejected event; <= 0 "
+                                        "disables (docs/fleet.md)"),
+    "SPLATT_FLEET_AFFINITY": EnvVar("1", "fleet: cache-affinity "
+                                    "routing — jobs prefer the "
+                                    "replica whose probe/tune/compile "
+                                    "caches are warm for their shape "
+                                    "regime, load as the tiebreaker; "
+                                    "0/off/false/no = pure "
+                                    "priority/FIFO dispatch"),
     # repo-root bench.py driver knobs (documented here; bench.py is a
     # standalone script outside the package's SPL001 scope)
     "SPLATT_BENCH_PRIOR_DIR": EnvVar(None, "bench.py: directory "

@@ -187,6 +187,23 @@ SITES = {
                      "'slow' holds the job open (blowing a per-job "
                      "deadline, or pinning it for kill-and-restart "
                      "soaks)",
+    "fleet.lease_acquire": "one atomic job-lease acquisition (fleet.py "
+                           "FleetMember.acquire — the flock + atomic-"
+                           "rename claim of docs/fleet.md); a raised "
+                           "fault drops the claim classified (the job "
+                           "stays claimable and the fleet scan "
+                           "re-surfaces it), never kills the worker",
+    "fleet.heartbeat": "one membership heartbeat + held-lease renewal "
+                       "sweep (fleet.py FleetMember.beat); a raised "
+                       "fault degrades classified — a missed beat "
+                       "makes the replica look dead sooner, so peers "
+                       "adopt its jobs after the lease window, which "
+                       "is the documented failure mode",
+    "fleet.adopt": "one dead-peer job takeover (fleet.py "
+                   "FleetMember.adopt: expired-lease steal with a gen "
+                   "bump); a raised fault leaves the job for the next "
+                   "scan pass, classified — adoption is retried, "
+                   "never lost",
     "trace.export": "the Chrome trace-event JSON export "
                     "(trace.write_chrome_trace); a raised fault must "
                     "degrade classified to a trace_written ok=False "

@@ -234,6 +234,41 @@ def test_serve_chaos_cli_flag_parses():
     assert args.serve and args.smoke
 
 
+# -- fleet kill-and-FAILOVER soak (docs/fleet.md) -----------------------------
+
+def test_fleet_chaos_smoke_kill_and_failover():
+    """Acceptance (tier-1): SIGKILL one of 2 fleet replicas mid-job
+    under seeded multi-tenant load, restart a replacement, and the
+    fleet invariant holds — zero accepted jobs lost (the pinned job is
+    ADOPTED by the survivor and finishes from its checkpoint), the
+    journal's single-owner lineage is clean for every job, the adopted
+    same-regime job hits the warm shared caches (cache_hits > 0, zero
+    re-measurements), tenant isolation holds, and the adopter's
+    metrics snapshot + span trace account for the takeover."""
+    res = chaos.run_fleet_chaos(smoke=True)
+    assert res.ok, res.violations
+    assert res.verdict == "survived"
+    assert res.victim is not None        # the kill genuinely landed
+    assert "fleet-1-pin" in res.adopted  # and forced an adoption
+    assert set(res.jobs) == {"fleet-0-warm", "fleet-1-pin",
+                             "fleet-2-nan", "fleet-3-clean"}
+    assert all(s in ("converged", "degraded")
+               for s in res.jobs.values())
+    aff = res.affinity["fleet-1-pin"]
+    assert aff["cache_hits"] and not aff["measured"]
+    assert aff["adopted_from"] == res.victim
+    rec = res.to_json()
+    assert rec["verdict"] == "survived" and not rec["violations"]
+
+
+def test_fleet_chaos_cli_flag_parses():
+    from splatt_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["chaos", "--fleet", "--smoke", "--replicas", "3"])
+    assert args.fleet and args.smoke and args.replicas == 3
+
+
 def test_bench_gate_cli_flag_parses():
     from splatt_tpu.cli import build_parser
 

@@ -1,0 +1,539 @@
+"""Fleet membership for `splatt serve` — leases, heartbeats, adoption
+(docs/fleet.md).
+
+The contracts under test:
+
+- journal robustness: a torn line ANYWHERE in the file (not just the
+  final one) is skipped with a classified `journal_torn` event, a torn
+  tail is healed before the next append, and the incremental tail read
+  withholds an in-progress final line instead of mis-judging it;
+- THE LEASE INVARIANT: two replicas racing to claim one job resolve to
+  exactly one owner (flock + atomic-rename protocol), renewal after
+  expiry is refused even when nobody re-took the lease, and stale
+  leases are only taken through the audited adopt path (gen fence);
+- fleet serving: a dead replica's accepted jobs are adopted by a live
+  peer (journal `adopted` lineage + `job_adopted` event + the result's
+  `adopted_from`), a zombie owner can never commit without a live
+  lease, and the fault sites (fleet.lease_acquire / fleet.heartbeat /
+  fleet.adopt) degrade classified without killing the worker;
+- admission control: per-tenant quotas shed with `quota_rejected`,
+  priority classes order dispatch high > normal > low;
+- affinity routing: warm-local jobs dispatch first (`affinity_routed`
+  warm_local), peer-warm jobs are deferred to the warm peer and stolen
+  at the deferral cap (load_tiebreak) — routing, never starvation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from splatt_tpu import fleet, resilience, serve, trace
+from splatt_tpu.utils import faults
+
+SYN = {"dims": [20, 16, 12], "nnz": 1200, "seed": 0}
+#: a second shape regime (different power-of-two buckets than SYN)
+SYN_BIG = {"dims": [64, 48, 40], "nnz": 5000, "seed": 0}
+
+
+def _spec(jid, **kw):
+    spec = {"id": jid, "rank": 3, "iters": 6, "seed": 0,
+            "synthetic": dict(SYN)}
+    spec.update(kw)
+    return spec
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    def clean():
+        faults.reset()
+        resilience.reset_demotions()
+        resilience.run_report().clear()
+        resilience._state().last_attempt = None
+
+    clean()
+    yield
+    clean()
+
+
+def _journal_kinds(root, jid):
+    recs, _ = serve.Journal(os.path.join(root, "journal.jsonl")).replay()
+    return [r["rec"] for r in recs if r.get("job") == jid]
+
+
+# -- journal robustness (satellite: mid-file torn lines) ---------------------
+
+def test_journal_mid_file_torn_line_skipped_classified(tmp_path):
+    """A torn line in the MIDDLE of the journal (a fleet writer dying
+    mid-append before peers continued) is skipped with a classified
+    journal_torn event; every record after it survives."""
+    path = str(tmp_path / "journal.jsonl")
+    j = serve.Journal(path)
+    j.append({"rec": "accepted", "job": "a"})
+    with open(path, "ab") as f:
+        f.write(b'{"rec": "started", "jo\x00\xff\n')  # mid-file debris
+    j.append({"rec": "done", "job": "a"})
+    recs, torn = j.replay()
+    assert torn == 1
+    assert [r["rec"] for r in recs] == ["accepted", "done"]
+    evs = resilience.run_report().events("journal_torn")
+    assert len(evs) == 1
+    assert evs[0]["failure_class"]  # classified
+    assert evs[0]["path"] == path
+
+
+def test_journal_append_heals_torn_tail(tmp_path):
+    """A partial final line (no newline — SIGKILL mid-write) is
+    newline-healed by the next append, so the next record can never be
+    swallowed into the debris."""
+    path = str(tmp_path / "journal.jsonl")
+    j = serve.Journal(path)
+    j.append({"rec": "accepted", "job": "a"})
+    with open(path, "ab") as f:
+        f.write(b'{"rec": "sta')  # torn tail, no newline
+    j.append({"rec": "done", "job": "a"})
+    recs, torn = j.replay()
+    assert torn == 1
+    assert [r["rec"] for r in recs] == ["accepted", "done"]
+
+
+def test_journal_replay_new_withholds_in_progress_tail(tmp_path):
+    """The incremental tail read must not judge an unterminated final
+    line: a peer may still be mid-append.  It stays unconsumed and is
+    returned complete on the next call."""
+    path = str(tmp_path / "journal.jsonl")
+    j = serve.Journal(path)
+    j.append({"rec": "accepted", "job": "a"})
+    recs, torn, off = j.replay_new(0)
+    assert len(recs) == 1 and torn == 0
+    with open(path, "ab") as f:
+        f.write(b'{"rec": "done", "job": "a"')  # mid-append
+    recs2, torn2, off2 = j.replay_new(off)
+    assert recs2 == [] and torn2 == 0 and off2 == off
+    with open(path, "ab") as f:
+        f.write(b'}\n')  # the append completes
+    recs3, _, off3 = j.replay_new(off2)
+    assert [r["rec"] for r in recs3] == ["done"] and off3 > off2
+    assert not resilience.run_report().events("journal_torn")
+
+
+# -- the lease protocol ------------------------------------------------------
+
+def test_lease_acquire_exclusive_and_release(tmp_path):
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=5.0)
+    b = fleet.FleetMember(str(tmp_path), replica="rb", lease_s=5.0)
+    assert a.acquire("j1")
+    assert not b.acquire("j1")       # validly held elsewhere
+    assert not b.adopt("j1")         # adopt refuses unexpired leases
+    assert a.renew("j1")
+    assert a.held() == ["j1"]
+    a.release("j1")
+    assert b.acquire("j1")           # free again
+
+
+def test_lease_contention_exactly_one_owner(tmp_path):
+    """THE CONTENTION INVARIANT: two replicas racing the same claims
+    resolve to exactly one owner per job, every time."""
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=5.0)
+    b = fleet.FleetMember(str(tmp_path), replica="rb", lease_s=5.0)
+    jobs = [f"j{i}" for i in range(16)]
+    wins = {"ra": set(), "rb": set()}
+
+    def claim(m, key):
+        for jid in jobs:
+            if m.acquire(jid):
+                wins[key].add(jid)
+
+    ts = [threading.Thread(target=claim, args=(a, "ra")),
+          threading.Thread(target=claim, args=(b, "rb"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not (wins["ra"] & wins["rb"]), "a job got two owners"
+    assert wins["ra"] | wins["rb"] == set(jobs)
+
+
+def test_renew_after_expiry_refused_even_unclaimed(tmp_path):
+    """Ownership must be continuous: once the lease expired, renew is
+    refused even when no peer re-took it — a gap means a peer MAY have
+    run the job meanwhile."""
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=0.15)
+    assert a.acquire("j1")
+    time.sleep(0.25)
+    assert not a.renew("j1")
+    assert a.lost("j1")
+    assert a.held() == []
+    evs = resilience.run_report().events("lease_expired")
+    assert evs and evs[-1]["role"] == "owner" and evs[-1]["job"] == "j1"
+
+
+def test_stale_lease_adoption_and_gen_fence(tmp_path):
+    """adopt() takes an expired lease with a gen bump, so the old
+    owner can neither renew nor plainly re-acquire."""
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=0.15)
+    b = fleet.FleetMember(str(tmp_path), replica="rb", lease_s=5.0)
+    assert a.acquire("j1")
+    gen1 = a.lease_of("j1").gen
+    time.sleep(0.25)
+    assert not b.acquire("j1")   # stale leases are adopt()'s only
+    assert b.adopt("j1")
+    assert b.lease_of("j1").gen == gen1 + 1
+    assert not a.renew("j1")     # gen fence: the old owner is out
+    assert not a.acquire("j1")   # and rb's lease is valid
+
+
+def test_heartbeat_membership_and_retire(tmp_path):
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=0.2)
+    b = fleet.FleetMember(str(tmp_path), replica="rb", lease_s=5.0)
+    a.add_regime("regimeX")
+    a.beat()
+    peers = b.peers()
+    assert "ra" in peers and peers["ra"]["regimes"] == ["regimeX"]
+    assert b.replica_alive("ra") and b.replica_alive("rb")
+    assert b.peer_warm("regimeX") == "ra"
+    time.sleep(0.3)  # ra's heartbeat lease expires
+    assert not b.replica_alive("ra")
+    assert b.peer_warm("regimeX") is None
+    b.beat()
+    b.retire()
+    assert "rb" not in a.peers()
+
+
+def test_job_regime_matches_tune_granularity():
+    from splatt_tpu.tune import shape_regime
+
+    key = fleet.job_regime(_spec("x"))
+    assert key == f"{shape_regime(SYN['dims'], SYN['nnz'])}:r3"
+    # same dims/nnz bucket + rank -> same regime; different rank -> not
+    assert fleet.job_regime(_spec("y", synthetic=dict(SYN, seed=9))) \
+        == key
+    assert fleet.job_regime(_spec("z", rank=8)) != key
+    assert fleet.job_regime({"tensor": "/some/file.tns"}) is None
+
+
+# -- fleet fault sites (SPL006) ----------------------------------------------
+
+def test_lease_acquire_fault_degrades_and_job_survives(tmp_path):
+    """fleet.lease_acquire: a raised fault drops the claim classified;
+    the job is re-surfaced and completes on a later pass — never a
+    dead worker, never a lost job."""
+    srv = serve.Server(str(tmp_path), workers=1, fleet=True,
+                       replica="ra", lease_s=5.0)
+    srv.submit(_spec("f1"))
+    with faults.inject("fleet.lease_acquire", "runtime", times=1):
+        srv.run_once()
+    # the claim faulted; the job is still accepted, not lost
+    assert srv.status("f1")["state"] in (serve.ACCEPTED, serve.DONE)
+    summary = srv.run_once()
+    assert summary["counts"][serve.DONE] == 1
+    assert serve.read_result(str(tmp_path), "f1")["status"] == "converged"
+    srv.shutdown()
+
+
+def test_heartbeat_fault_degrades_classified(tmp_path, capsys):
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=5.0)
+    assert a.acquire("j1")
+    with faults.inject("fleet.heartbeat", "runtime", times=1):
+        lost = a.beat()
+    assert lost == []            # degraded, not a crash
+    assert "heartbeat degraded" in capsys.readouterr().err
+    assert a.beat() == []        # healthy again; lease still ours
+    assert a.renew("j1")
+
+
+def test_adopt_fault_leaves_job_for_next_scan(tmp_path):
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=0.15)
+    b = fleet.FleetMember(str(tmp_path), replica="rb", lease_s=5.0)
+    assert a.acquire("j1")
+    time.sleep(0.25)
+    with faults.inject("fleet.adopt", "runtime", times=1):
+        with pytest.raises(RuntimeError):
+            b.adopt("j1")
+    assert b.lease_of("j1").replica == "ra"  # takeover did not happen
+    assert b.adopt("j1")                     # retried fine
+
+
+# -- fleet serving: adoption, zombie fencing ---------------------------------
+
+def test_dead_peer_job_adopted_with_lineage(tmp_path, monkeypatch):
+    """FAILOVER INVARIANT (in-process half; the SIGKILL half lives in
+    test_chaos.py's fleet soak): a dead replica's accepted job is
+    adopted — journal `adopted` record, job_adopted event, result
+    stamped with the adopter and `adopted_from` — and converges."""
+    monkeypatch.setenv("SPLATT_TUNE_CACHE", str(tmp_path / "tc.json"))
+    root = str(tmp_path / "root")
+    a = serve.Server(root, workers=1, fleet=True, replica="ra",
+                     lease_s=0.3)
+    a.submit(_spec("adoptme"))
+    a.shutdown()  # accepted, never run; heartbeat retires...
+    # ...but simulate a CRASH, not a clean exit: restore an already-
+    # expired heartbeat so rb sees a dead peer, not a retired one
+    time.sleep(0.4)
+    b = serve.Server(root, workers=1, fleet=True, replica="rb",
+                     lease_s=5.0)
+    summary = b.run_once()
+    assert summary["counts"] == {serve.DONE: 1}
+    res = serve.read_result(root, "adoptme")
+    assert res["status"] == "converged"
+    assert res["replica"] == "rb" and res["adopted_from"] == "ra"
+    kinds = _journal_kinds(root, "adoptme")
+    assert serve.ADOPTED in kinds and kinds[-1] == serve.DONE
+    evs = resilience.run_report().events("job_adopted")
+    assert [(e["job"], e["from_replica"]) for e in evs] == \
+        [("adoptme", "ra")]
+    # the failover is accounted in the metrics registry
+    snap = trace.metrics_snapshot()
+    assert any(k.startswith("splatt_fleet_adoptions_total")
+               for k in snap)
+    b.shutdown()
+
+
+def test_zombie_owner_cannot_commit_without_lease(tmp_path):
+    """COMMIT FENCE: a replica whose lease expired mid-run (stalled
+    heartbeat) must abandon uncommitted — no terminal record, no
+    result — and the job is adoptable afterwards."""
+    root = str(tmp_path / "root")
+    # heartbeat_s >> job duration: renewals never happen, so the
+    # 0.3 s lease expires while the slow-pinned job runs
+    a = serve.Server(root, workers=1, fleet=True, replica="ra",
+                     lease_s=0.3, heartbeat_s=30.0)
+    a.submit(_spec("z1", faults="serve.job_run:slow:delay=0.6"))
+    a.run_once()
+    # abandoned: non-terminal, no result, no terminal journal record
+    assert serve.read_result(root, "z1") is None
+    kinds = _journal_kinds(root, "z1")
+    assert serve.DONE not in kinds and serve.FAILED not in kinds
+    evs = resilience.run_report().events("lease_expired")
+    assert any(e.get("role") == "owner" and e.get("job") == "z1"
+               for e in evs)
+    a.drain()
+    # a live peer adopts and finishes it
+    b = serve.Server(root, workers=1, fleet=True, replica="rb",
+                     lease_s=5.0)
+    assert b.run_once()["counts"][serve.DONE] == 1
+    assert serve.read_result(root, "z1")["status"] == "converged"
+    b.shutdown()
+
+
+# -- admission control: quotas + priorities ----------------------------------
+
+def test_tenant_quota_sheds_with_event_and_frees_up(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1, tenant_quota=1)
+    assert srv.submit(_spec("q1", tenant="acme"))["state"] == \
+        serve.ACCEPTED
+    shed = srv.submit(_spec("q2", tenant="acme"))
+    assert shed["state"] == serve.REJECTED
+    assert shed["reason"] == "quota:acme"
+    evs = resilience.run_report().events("quota_rejected")
+    assert len(evs) == 1 and evs[0]["tenant"] == "acme" \
+        and evs[0]["quota"] == 1
+    # isolation: ANOTHER tenant is not crowded out
+    assert srv.submit(_spec("q3", tenant="zeta"))["state"] == \
+        serve.ACCEPTED
+    srv.run_once()
+    # quota counts NON-TERMINAL jobs: once q1 finished, acme may retry
+    retry = srv.submit(_spec("q2", tenant="acme"))
+    assert retry["state"] == serve.ACCEPTED
+    srv.run_once()
+    assert serve.read_result(str(tmp_path), "q2")["status"] == \
+        "converged"
+
+
+def test_priority_classes_order_dispatch(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    srv.submit(_spec("p-low", priority="low"))
+    srv.submit(_spec("p-norm"))
+    srv.submit(_spec("p-high", priority="high"))
+    srv.run_once()
+    recs, _ = serve.Journal(
+        os.path.join(str(tmp_path), "journal.jsonl")).replay()
+    started = [r["job"] for r in recs if r["rec"] == serve.STARTED]
+    assert started == ["p-high", "p-norm", "p-low"]
+
+
+def test_unknown_priority_rejected(tmp_path):
+    srv = serve.Server(str(tmp_path))
+    r = srv.submit(_spec("p-bad", priority="urgent"))
+    assert r["state"] == serve.REJECTED and "priority" in r["reason"]
+
+
+# -- cache-affinity routing --------------------------------------------------
+
+def test_affinity_prefers_warm_local_regime(tmp_path, monkeypatch):
+    """Jobs whose shape regime is warm on this replica dispatch first
+    (affinity beats FIFO), with an affinity_routed warm_local audit."""
+    monkeypatch.setenv("SPLATT_TUNE_CACHE", str(tmp_path / "tc.json"))
+    srv = serve.Server(str(tmp_path / "root"), workers=1, fleet=True,
+                       replica="ra", lease_s=5.0)
+    srv.fleet.add_regime(fleet.job_regime(_spec("warm")))
+    srv.submit(_spec("cold", synthetic=dict(SYN_BIG)))
+    srv.submit(_spec("warm"))  # filed second, dispatched first
+    srv.run_once()
+    recs, _ = serve.Journal(
+        os.path.join(str(tmp_path / "root"), "journal.jsonl")).replay()
+    started = [r["job"] for r in recs if r["rec"] == serve.STARTED]
+    assert started == ["warm", "cold"]
+    evs = resilience.run_report().events("affinity_routed")
+    assert any(e["job"] == "warm" and e["reason"] == "warm_local"
+               for e in evs)
+    srv.shutdown()
+
+
+def test_affinity_defers_to_warm_peer_then_steals(tmp_path):
+    """A job warm only on a live PEER is deferred to that peer — but
+    only up to the cap: affinity routes work, it never starves it."""
+    root = str(tmp_path / "root")
+    peer = fleet.FleetMember(root, replica="rb", lease_s=30.0)
+    peer.add_regime(fleet.job_regime(_spec("x")))
+    peer.beat()  # rb is alive and warm for SYN's regime, load 0
+    srv = serve.Server(root, workers=1, fleet=True, replica="ra",
+                       lease_s=5.0)
+    srv.submit(_spec("x"))
+    summary = srv.run_once()  # rb never claims; ra must steal
+    assert summary["counts"] == {serve.DONE: 1}
+    evs = resilience.run_report().events("affinity_routed")
+    reasons = {e["reason"] for e in evs if e["job"] == "x"}
+    assert "deferred" in reasons       # the courtesy happened
+    assert "load_tiebreak" in reasons  # and the cap ended it
+    assert any(e.get("to_replica") == "rb" for e in evs)
+    srv.shutdown()
+
+
+def test_release_cleans_lock_sidecar(tmp_path):
+    """A terminal release removes BOTH lease files — leases/ must not
+    grow one .lock per job forever on a long-lived root."""
+    a = fleet.FleetMember(str(tmp_path), replica="ra", lease_s=5.0)
+    assert a.acquire("j1")
+    a.release("j1")
+    assert os.listdir(a.leases_dir) == []
+
+
+def test_failed_job_does_not_advertise_regime(tmp_path):
+    """A FAILED job proved nothing about the caches: its regime must
+    not become a warm_local/peer_warm routing signal."""
+    srv = serve.Server(str(tmp_path), workers=1, fleet=True,
+                       replica="ra", lease_s=5.0)
+    spec = _spec("bad", tensor="/nonexistent/t.tns")
+    del spec["synthetic"]
+    srv.submit(spec)
+    srv.run_once()
+    assert serve.read_result(str(tmp_path), "bad")["status"] == "failed"
+    assert not srv.fleet.warm(fleet.job_regime(_spec("probe")))
+    assert srv.fleet._regimes == set()
+    srv.shutdown()
+
+
+def test_fleet_spool_claim_single_ingest(tmp_path):
+    """Two replicas scanning one spool ingest each request exactly
+    once (atomic rename claim) — no duplicate accepted records, no
+    spurious quarantine."""
+    root = str(tmp_path / "root")
+    a = serve.Server(root, workers=1, fleet=True, replica="ra",
+                     lease_s=5.0)
+    b = serve.Server(root, workers=1, fleet=True, replica="rb",
+                     lease_s=5.0)
+    for i in range(4):
+        serve.file_request(root, _spec(f"s{i}"))
+    na = a.scan_requests()
+    nb = b.scan_requests()
+    assert na + nb == 4
+    recs, _ = serve.Journal(os.path.join(root, "journal.jsonl")).replay()
+    accepted = [r["job"] for r in recs if r["rec"] == serve.ACCEPTED]
+    assert sorted(accepted) == [f"s{i}" for i in range(4)]
+    assert not [n for n in os.listdir(os.path.join(root, "requests"))
+                if n.endswith(".bad")]
+    a.shutdown()
+    b.shutdown()
+
+
+def test_dead_claimant_request_reclaimed(tmp_path):
+    """A replica dying between spool claim and journal delays the
+    request, never loses it: a peer renames the orphaned .claim back
+    once the claimant's heartbeat expires."""
+    root = str(tmp_path / "root")
+    os.makedirs(os.path.join(root, "requests"), exist_ok=True)
+    orphan = os.path.join(root, "requests", "lost1.json.rz.claim")
+    with open(orphan, "w") as f:
+        json.dump(_spec("lost1"), f)
+    b = serve.Server(root, workers=1, fleet=True, replica="rb",
+                     lease_s=5.0)
+    assert b.scan_requests() == 1  # rz has no heartbeat: dead
+    assert b.status("lost1")["state"] == serve.ACCEPTED
+    b.shutdown()
+
+
+def test_warm_peer_steals_live_peers_unleased_job(tmp_path, monkeypatch):
+    """The deferral's receiving half: a job accepted (but not yet
+    leased) by a LIVE cold peer is surfaced and run by the replica
+    whose caches are warm for its regime — not audited as an
+    adoption, since nobody died."""
+    monkeypatch.setenv("SPLATT_TUNE_CACHE", str(tmp_path / "tc.json"))
+    root = str(tmp_path / "root")
+    a = serve.Server(root, workers=1, fleet=True, replica="ra",
+                     lease_s=5.0)
+    a.submit(_spec("hot"))  # accepted on ra; ra never dispatches
+    b = serve.Server(root, workers=1, fleet=True, replica="rb",
+                     lease_s=5.0)
+    b.fleet.add_regime(fleet.job_regime(_spec("hot")))
+    assert b.run_once()["counts"][serve.DONE] == 1
+    res = serve.read_result(root, "hot")
+    assert res["status"] == "converged" and res["replica"] == "rb"
+    assert res.get("adopted_from") is None
+    assert not resilience.run_report().events("job_adopted")
+    a.shutdown()
+    b.shutdown()
+
+
+# -- `splatt trace` fleet summary (satellite) --------------------------------
+
+def test_trace_summary_fleet_block(tmp_path):
+    events = [
+        {"name": "serve.job", "cat": "span", "ph": "X", "ts": 0,
+         "dur": 1000, "pid": 1, "tid": 1,
+         "args": {"sid": 1, "job": "a", "replica": "r0"}},
+        {"name": "serve.job", "cat": "span", "ph": "X", "ts": 2000,
+         "dur": 1000, "pid": 1, "tid": 1,
+         "args": {"sid": 2, "job": "b", "replica": "r1"}},
+        {"name": "serve.job", "cat": "span", "ph": "X", "ts": 4000,
+         "dur": 1000, "pid": 1, "tid": 1,
+         "args": {"sid": 3, "job": "c", "replica": "r1"}},
+        {"name": "job_adopted", "cat": "event", "ph": "i", "s": "t",
+         "ts": 1500, "pid": 1, "tid": 1, "args": {"job": "b"}},
+        {"name": "lease_expired", "cat": "event", "ph": "i", "s": "t",
+         "ts": 1400, "pid": 1, "tid": 1, "args": {"job": "b"}},
+    ]
+    s = trace.summarize(events)
+    assert s["fleet"] == {"replicas": {"r0": 1, "r1": 2},
+                          "adoptions": 1, "lease_expired": 1}
+    text = "\n".join(trace.format_summary(s))
+    assert "fleet: 1 adoption(s), 1 lease expiry" in text
+    assert "r1=2" in text
+    # a fleet-free trace has no fleet block and prints no fleet line
+    s2 = trace.summarize([e for e in events
+                          if e["name"] not in ("serve.job", "job_adopted",
+                                               "lease_expired")])
+    assert s2["fleet"] is None
+    assert "fleet:" not in "\n".join(trace.format_summary(s2))
+
+
+def test_registries_cover_fleet_surface():
+    """The new events/sites/env vars/metrics are declared (SPL006/
+    SPL007/SPL012 stay at zero by construction)."""
+    from splatt_tpu.utils.env import ENV_VARS
+
+    for ev in ("journal_torn", "job_adopted", "lease_expired",
+               "quota_rejected", "affinity_routed"):
+        assert ev in resilience.RUN_REPORT_EVENTS
+    for site in ("fleet.lease_acquire", "fleet.heartbeat",
+                 "fleet.adopt"):
+        assert site in faults.SITES
+    for var in ("SPLATT_FLEET_REPLICA", "SPLATT_FLEET_LEASE_S",
+                "SPLATT_FLEET_HEARTBEAT_S", "SPLATT_FLEET_TENANT_QUOTA",
+                "SPLATT_FLEET_AFFINITY"):
+        assert var in ENV_VARS
+    for metric in ("splatt_fleet_adoptions_total",
+                   "splatt_fleet_lease_expired_total"):
+        assert metric in trace.METRICS
