@@ -377,9 +377,14 @@ def _save_checkpoint(path: str, factors, lam, it: int, fit: float,
             size = os.path.getsize(tmp)
             with open(tmp, "r+b") as f:
                 f.truncate(max(size // 2, 1))
+        from splatt_tpu.utils.durable import publish_file
+
         if os.path.exists(path):
             os.replace(path, path + ".bak")
-        os.replace(tmp, path)
+        # fsync + atomic rename through the sanctioned durable-write
+        # helper (SPL016) — the .bak shuffle above moves an EXISTING
+        # file and needs no durability protocol of its own
+        publish_file(tmp, path)
 
 
 def load_checkpoint(path: str, verify: bool = True,

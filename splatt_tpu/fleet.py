@@ -63,7 +63,7 @@ import os
 import threading
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 try:
     import fcntl
@@ -102,7 +102,8 @@ class FleetMember:
 
     def __init__(self, root: str, replica: Optional[str] = None,
                  lease_s: Optional[float] = None,
-                 heartbeat_s: Optional[float] = None):
+                 heartbeat_s: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None):
         from splatt_tpu.utils.env import read_env, read_env_float
 
         self.root = os.path.abspath(root)
@@ -120,10 +121,24 @@ class FleetMember:
                    else read_env_float("SPLATT_FLEET_HEARTBEAT_S"))
         self.heartbeat_s = hb if hb > 0 \
             else max(self.lease_s / _BEATS_PER_LEASE, 0.05)
-        self._lock = threading.Lock()
-        self._held: Dict[str, Lease] = {}
-        self._lost: set = set()
-        self._regimes: set = set()
+        #: the protocol's time source.  Production uses the wall clock;
+        #: the bounded-exhaustive interleaving checker
+        #: (tools/splint/interleave.py, docs/fleet.md) injects a
+        #: virtual clock so lease expiry becomes a schedule step it can
+        #: enumerate instead of a race it must win.
+        self._clock = clock if clock is not None else time.time
+        # declared shared structures ([tool.splint] shared-state);
+        # owner-assertion proxies under SPLATT_LOCKCHECK, pass-through
+        # otherwise (utils/lockcheck.py, the SPL014 dynamic cross-check)
+        from splatt_tpu.utils import lockcheck
+
+        self._lock = lockcheck.guard_lock(threading.Lock())
+        self._held: Dict[str, Lease] = lockcheck.guard(
+            {}, self._lock, "fleet.FleetMember._held")
+        self._lost: set = lockcheck.guard(
+            set(), self._lock, "fleet.FleetMember._lost")
+        self._regimes: set = lockcheck.guard(
+            set(), self._lock, "fleet.FleetMember._regimes")
 
     # -- flock + atomic-rename primitives ------------------------------------
 
@@ -149,11 +164,9 @@ class FleetMember:
         return os.path.join(self.leases_dir, f"{_safe_name(jid)}.json")
 
     def _write_lease(self, lease: Lease) -> None:
-        path = self._lease_path(lease.job)
-        tmp = f"{path}.{self.replica}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(lease.to_json(), f)
-        os.replace(tmp, path)
+        from splatt_tpu.utils.durable import publish_json
+
+        publish_json(self._lease_path(lease.job), lease.to_json())
 
     def lease_of(self, jid: str) -> Optional[Lease]:
         """The published lease for `jid`, or None (lock-free read —
@@ -178,7 +191,7 @@ class FleetMember:
         from splatt_tpu.utils import faults
 
         faults.maybe_fail("fleet.lease_acquire")
-        now = time.time()
+        now = self._clock()
         with self._locked(jid):
             cur = self.lease_of(jid)
             if cur is not None:
@@ -205,7 +218,7 @@ class FleetMember:
             held = self._held.get(jid)
         if held is None:
             return False
-        now = time.time()
+        now = self._clock()
         with self._locked(jid):
             cur = self.lease_of(jid)
             if (cur is None or cur.replica != self.replica
@@ -227,7 +240,7 @@ class FleetMember:
         from splatt_tpu.utils import faults
 
         faults.maybe_fail("fleet.adopt")
-        now = time.time()
+        now = self._clock()
         with self._locked(jid):
             cur = self.lease_of(jid)
             if cur is not None and not cur.expired(now) \
@@ -302,7 +315,7 @@ class FleetMember:
         lost: List[str] = []
         try:
             faults.maybe_fail("fleet.heartbeat")
-            now = time.time()
+            now = self._clock()
             with self._lock:
                 regimes = sorted(self._regimes)
                 active = len(self._held)
@@ -310,12 +323,10 @@ class FleetMember:
             rec = {"replica": self.replica, "pid": os.getpid(),
                    "ts": now, "expires": now + self.lease_s,
                    "regimes": regimes, "active": active}
-            path = os.path.join(self.replicas_dir,
-                                f"{self.replica}.json")
-            tmp = f"{path}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(rec, f)
-            os.replace(tmp, path)
+            from splatt_tpu.utils.durable import publish_json
+
+            publish_json(os.path.join(self.replicas_dir,
+                                      f"{self.replica}.json"), rec)
             for jid in held:
                 if not self.renew(jid):
                     lost.append(jid)
@@ -334,7 +345,7 @@ class FleetMember:
         replica -> its heartbeat record.  Dead/malformed heartbeat
         files read as absent."""
         out: Dict[str, dict] = {}
-        now = time.time()
+        now = self._clock()
         try:
             names = os.listdir(self.replicas_dir)
         except OSError:

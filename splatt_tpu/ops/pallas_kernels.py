@@ -738,8 +738,6 @@ def _json_cache_update(path, mutate, on_error=None) -> None:
     `on_error(op, exc)` (classified into the run report) and swallowed.
     """
     import json
-    import os
-    import tempfile
 
     if on_error is None:
         on_error = _cache_io_error
@@ -763,17 +761,9 @@ def _json_cache_update(path, mutate, on_error=None) -> None:
                 on_error("store", e)
                 data = {}
             data = mutate(data)
-            fd, tmp = tempfile.mkstemp(dir=str(path.parent), suffix=".tmp")
-            try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(data, f, indent=1, sort_keys=True)
-                os.replace(tmp, path)
-            except Exception:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise
+            from splatt_tpu.utils.durable import publish_json
+
+            publish_json(path, data, indent=1, sort_keys=True)
     except Exception as e:
         # best-effort by contract (cache IO must never break dispatch):
         # degrade to an uncached probe/plan, but say so in the run report

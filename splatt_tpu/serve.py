@@ -90,11 +90,6 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional
 
-try:
-    import fcntl as _fcntl
-except ImportError:  # non-POSIX: in-process locking only
-    _fcntl = None
-
 # journal record kinds (the `rec` field of each JSONL line)
 #: in-memory-only reservation state while the accept append fsyncs
 #: (never journaled; a concurrent same-id submission dedups on it)
@@ -148,7 +143,10 @@ class Journal:
     terminal record just means the job re-runs, and resume makes that
     cheap), and :meth:`append` heals a torn TAIL (no trailing newline)
     before writing, so crash debris can never merge into — and
-    swallow — the next record."""
+    swallow — the next record.  The flock + heal + fsync discipline
+    itself lives in :func:`splatt_tpu.utils.durable.append_line`, the
+    sanctioned durable-append helper (splint rule SPL016) shared with
+    every other durable writer in the tree."""
 
     def __init__(self, path: str):
         self.path = str(path)
@@ -158,30 +156,12 @@ class Journal:
         """Durably append one record (raises on IO failure — callers
         decide whether durability is load-bearing for this record)."""
         from splatt_tpu.utils import faults
+        from splatt_tpu.utils.durable import append_line
 
         faults.maybe_fail("serve.journal_write")
         line = json.dumps(dict(rec, ts=time.time()), sort_keys=True)
-        data = line.encode() + b"\n"
         with self._lock:
-            with open(self.path, "ab") as f:
-                if _fcntl is not None:
-                    _fcntl.flock(f.fileno(), _fcntl.LOCK_EX)
-                try:
-                    # heal a torn tail: a dead writer's partial final
-                    # line must be newline-terminated before this
-                    # record lands, or the two would merge into one
-                    # garbage line and THIS record would be lost
-                    if f.tell() > 0:
-                        with open(self.path, "rb") as r:
-                            r.seek(-1, os.SEEK_END)
-                            if r.read(1) != b"\n":
-                                f.write(b"\n")
-                    f.write(data)
-                    f.flush()
-                    os.fsync(f.fileno())
-                finally:
-                    if _fcntl is not None:
-                        _fcntl.flock(f.fileno(), _fcntl.LOCK_UN)
+            append_line(self.path, line.encode())
 
     def replay(self):
         """Parse every complete record → (records, torn_line_count).
@@ -298,17 +278,27 @@ class Server:
         self.tenant_quota = int(
             tenant_quota if tenant_quota is not None
             else read_env_int("SPLATT_FLEET_TENANT_QUOTA"))
-        self._lock = threading.Lock()
+        # the declared shared structures below mirror [tool.splint]
+        # shared-state; under SPLATT_LOCKCHECK they become
+        # owner-assertion proxies (utils/lockcheck.py — the dynamic
+        # cross-check of splint rule SPL014), otherwise they pass
+        # through untouched
+        from splatt_tpu.utils import lockcheck
+
+        self._lock = lockcheck.guard_lock(threading.Lock())
         #: id -> {"spec": dict|None, "state": str, "status": str|None,
         #:        "resumed": bool, "tenant": str, "priority": str,
         #:        "seq": int, "owner": str|None (fleet: last journaled
         #:        replica), "adopt_from": str|None, "deferred": int}
-        self._jobs: Dict[str, dict] = {}
+        self._jobs: Dict[str, dict] = lockcheck.guard(
+            {}, self._lock, "serve.Server._jobs")
         #: pending job ids; _next() picks by (priority, arrival seq)
-        self._queue: List[str] = []
+        self._queue: List[str] = lockcheck.guard(
+            [], self._lock, "serve.Server._queue")
         self._seq = 0
         #: job ids currently claimed/running on THIS replica's workers
-        self._running: set = set()
+        self._running: set = lockcheck.guard(
+            set(), self._lock, "serve.Server._running")
         self._draining = threading.Event()
         # fleet membership (docs/fleet.md): job ownership is a lease,
         # routing prefers warm caches, dead peers' jobs are adopted
@@ -336,10 +326,11 @@ class Server:
 
     # -- crash recovery -----------------------------------------------------
 
-    def _new_job(self, spec: Optional[dict] = None,
-                 state: Optional[str] = None) -> dict:
-        """One fresh job-table entry (callers hold the server lock, or
-        are still single-threaded in __init__)."""
+    def _new_job_locked(self, spec: Optional[dict] = None,
+                        state: Optional[str] = None) -> dict:
+        """One fresh job-table entry.  The ``_locked`` suffix is the
+        caller-owns-the-lock convention (docs/static-analysis.md,
+        SPL014): every caller holds the server lock."""
         j = {"spec": spec, "state": state, "status": None,
              "resumed": False, "tenant": "default", "priority": "normal",
              "seq": self._seq, "owner": None, "adopt_from": None,
@@ -361,16 +352,16 @@ class Server:
         j["priority"] = p if p in PRIORITIES else "normal"
         j["regime"] = job_regime(spec)
 
-    def _apply_rec(self, rec: dict) -> Optional[str]:
+    def _apply_rec_locked(self, rec: dict) -> Optional[str]:
         """Fold one journal record into the job table (last record per
         job wins — the flock-serialized journal is totally ordered
-        even across fleet replicas).  Callers hold the server lock (or
-        are single-threaded in __init__).  Returns the job id."""
+        even across fleet replicas).  Callers hold the server lock
+        (the ``_locked`` convention, SPL014).  Returns the job id."""
         jid = rec.get("job")
         kind = rec.get("rec")
         if not jid or not kind:
             return None
-        j = self._jobs.setdefault(jid, self._new_job())
+        j = self._jobs.setdefault(jid, self._new_job_locked())
         if kind == ACCEPTED:
             if rec.get("spec") is not None:
                 j["spec"] = rec.get("spec")
@@ -409,39 +400,49 @@ class Server:
         if torn:
             self._log(f"journal: skipped {torn} torn line(s) "
                       f"(crash debris)")
-        for rec in recs:
-            self._apply_rec(rec)
-        for jid, j in self._jobs.items():
-            if j["state"] in TERMINAL or j["spec"] is None:
-                continue
-            if self.fleet is not None:
-                me = self.fleet.replica
-                lease = self.fleet.lease_of(jid)
-                if lease is not None and not lease.expired() \
-                        and lease.replica != me:
-                    continue  # a live peer's; watched by _fleet_scan
-                if lease is not None and lease.expired() \
-                        and lease.replica != me:
-                    j["adopt_from"] = lease.replica
-                elif lease is None and j.get("owner") not in (None, me) \
-                        and not self.fleet.replica_alive(j["owner"]):
-                    # accepted by a dead peer, never claimed: taking
-                    # it over is an adoption, audited as one
-                    j["adopt_from"] = j["owner"]
-            j["resumed"] = True
-            self._queue.append(jid)
+        # the job-table/queue mutations run under the server lock even
+        # though __init__ is still single-threaded (SPL014: the
+        # shared-state invariant is uniform, with no "but this call
+        # path is special" carve-outs); the journal appends — fsyncs —
+        # run after the lock is released, like every other append site
+        resumed: List[tuple] = []
+        with self._lock:
+            for rec in recs:
+                self._apply_rec_locked(rec)
+            for jid, j in self._jobs.items():
+                if j["state"] in TERMINAL or j["spec"] is None:
+                    continue
+                if self.fleet is not None:
+                    me = self.fleet.replica
+                    lease = self.fleet.lease_of(jid)
+                    if lease is not None and not lease.expired() \
+                            and lease.replica != me:
+                        continue  # a live peer's; watched by _fleet_scan
+                    if lease is not None and lease.expired() \
+                            and lease.replica != me:
+                        j["adopt_from"] = lease.replica
+                    elif lease is None \
+                            and j.get("owner") not in (None, me) \
+                            and not self.fleet.replica_alive(j["owner"]):
+                        # accepted by a dead peer, never claimed: taking
+                        # it over is an adoption, audited as one
+                        j["adopt_from"] = j["owner"]
+                j["resumed"] = True
+                self._queue.append(jid)
+                resumed.append((jid, j["state"]))
+            depth = len(self._queue)
+        for jid, was in resumed:
             resilience.run_report().add("job_resumed", job=jid,
-                                        from_state=j["state"])
-            self._log(f"job {jid}: resumed from journal "
-                      f"(was {j['state']})")
+                                        from_state=was)
+            self._log(f"job {jid}: resumed from journal (was {was})")
             try:
                 self.journal.append(self._rec(RESUMED, jid))
             except Exception as e:
                 # lineage entry only — the ACCEPTED record already
                 # guarantees a later replay re-finds this job
                 self._warn_journal("resume", jid, e)
-        if self._queue:
-            self._queue_metric(len(self._queue))
+        if depth:
+            self._queue_metric(depth)
 
     # -- submission / job API ----------------------------------------------
 
@@ -511,7 +512,7 @@ class Server:
             if reason is None:
                 # reserve the id so a concurrent same-id submission
                 # dedups while we journal lock-free below
-                self._jobs[jid] = self._new_job(spec, ACCEPTING)
+                self._jobs[jid] = self._new_job_locked(spec, ACCEPTING)
         if reason is not None:
             return self._reject(jid, spec, reason)
         # durability-first: the submitter hears "accepted" only once
@@ -545,7 +546,7 @@ class Server:
         from splatt_tpu import resilience
 
         with self._lock:
-            j = self._new_job(spec, REJECTED)
+            j = self._new_job_locked(spec, REJECTED)
             j["status"] = "rejected"
             self._jobs[jid] = j
         try:
@@ -746,11 +747,39 @@ class Server:
             if pick is None or self.fleet is None:
                 return pick
             if self._claim(pick):
-                return pick
+                if not self._terminal_after_claim(pick):
+                    return pick
+                # a peer finished this job between our queue scan and
+                # our claim: the terminal append always happens UNDER
+                # the lease, before release, so a journal read made
+                # while WE hold the lease is authoritative — drop the
+                # pick instead of re-running a finished job (found by
+                # the interleaving checker, tools/splint/interleave.py)
+                self.fleet.release(pick)
+                self._log(f"job {pick}: finished by a peer before our "
+                          f"claim; dropped")
             # a peer won the lease (or the claim faulted): not ours —
             # the fleet scan re-surfaces it if it goes unowned
             with self._lock:
                 self._running.discard(pick)
+
+    def _terminal_after_claim(self, jid: str) -> bool:
+        """Post-claim journal re-check (fleet mode): tail the shared
+        journal and report whether `jid` is now terminal.  Called
+        while HOLDING the job's lease, which makes the read
+        authoritative: a peer's terminal append happens under the
+        lease before release, so if one exists it is visible here —
+        and if none is visible, no zombie can add one later (its
+        last-gate renew fails against our generation)."""
+        with self._lock:
+            recs, _torn, self._journal_offset = \
+                self.journal.replay_new(self._journal_offset)
+            for rec in recs:
+                done = self._apply_rec_locked(rec)
+                if done and self._jobs[done]["state"] in TERMINAL \
+                        and done in self._queue:
+                    self._queue.remove(done)
+            return self._jobs[jid]["state"] in TERMINAL
 
     def _route_event(self, reason: str, jid: str, regime: str,
                      peer: Optional[str]) -> None:
@@ -967,7 +996,7 @@ class Server:
             recs, torn, self._journal_offset = \
                 self.journal.replay_new(self._journal_offset)
             for rec in recs:
-                jid = self._apply_rec(rec)
+                jid = self._apply_rec_locked(rec)
                 if jid and self._jobs[jid]["state"] in TERMINAL \
                         and jid in self._queue:
                     # a peer finished a job we still had queued
@@ -1337,13 +1366,11 @@ class Server:
         """Atomic result publish (tmp + rename): a reader never sees a
         torn result file."""
         from splatt_tpu import resilience
+        from splatt_tpu.utils.durable import publish_json
 
         path = os.path.join(self.results_dir, f"{jid}.json")
-        tmp = path + ".tmp"
         try:
-            with open(tmp, "w") as f:
-                json.dump(record, f, sort_keys=True)
-            os.replace(tmp, path)
+            publish_json(path, record, sort_keys=True)
         except Exception as e:
             cls = resilience.classify_failure(e)
             self._log(f"job {jid}: result write failed ({cls.value}: "
@@ -1396,14 +1423,13 @@ def file_request(root: str, spec: dict) -> str:
     """Client side of the filed-request API: atomically drop a job
     spec into ``<root>/requests/`` for a (possibly not-yet-running)
     daemon to ingest.  Returns the job id."""
+    from splatt_tpu.utils.durable import publish_json
+
     jid = _job_id(spec)
     spec = dict(spec, id=jid)
     reqs = os.path.join(os.path.abspath(root), "requests")
     os.makedirs(reqs, exist_ok=True)
-    tmp = os.path.join(reqs, f".{jid}.tmp")
-    with open(tmp, "w") as f:
-        json.dump(spec, f)
-    os.replace(tmp, os.path.join(reqs, f"{jid}.json"))
+    publish_json(os.path.join(reqs, f"{jid}.json"), spec)
     return jid
 
 

@@ -233,11 +233,16 @@ def enabling(value: Optional[bool]):
 
 # -- span recorder -----------------------------------------------------------
 
-_LOCK = threading.Lock()
+# the recorder's shared registries ([tool.splint] shared-state):
+# owner-assertion proxies under SPLATT_LOCKCHECK (utils/lockcheck.py,
+# the SPL014 dynamic cross-check), plain containers otherwise
+from splatt_tpu.utils import lockcheck as _lockcheck
+
+_LOCK = _lockcheck.guard_lock(threading.Lock())
 _SIDS = itertools.count(1)
-_DONE: List[dict] = []
-_OPEN: Dict[int, dict] = {}
-_POINTS: List[dict] = []
+_DONE: List[dict] = _lockcheck.guard([], _LOCK, "trace._DONE")
+_OPEN: Dict[int, dict] = _lockcheck.guard({}, _LOCK, "trace._OPEN")
+_POINTS: List[dict] = _lockcheck.guard([], _LOCK, "trace._POINTS")
 #: (wall-clock, perf_counter) anchor pair: spans time with the
 #: monotonic perf_counter and the exporter maps onto the epoch once
 _ANCHOR: Tuple[float, float] = (time.time(), time.perf_counter())
@@ -429,9 +434,11 @@ def reset() -> None:
 
 # -- metrics registry --------------------------------------------------------
 
-_MET_LOCK = threading.Lock()
+_MET_LOCK = _lockcheck.guard_lock(threading.Lock())
 #: (name, ((label, value), ...)) -> float | histogram-state dict
-_SAMPLES: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+#: (owner-assertion proxy under SPLATT_LOCKCHECK, like the recorder)
+_SAMPLES: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = \
+    _lockcheck.guard({}, _MET_LOCK, "trace._SAMPLES")
 
 
 def _declared(name: str, want: str) -> None:
@@ -593,16 +600,12 @@ def write_metrics(path: str, job: Optional[str] = None) -> dict:
     ``metrics_snapshot`` run-report event.  A write failure degrades
     classified (the event carries the error) — metrics must never kill
     the daemon they observe."""
-    import os
-
     from splatt_tpu import resilience
+    from splatt_tpu.utils.durable import publish_text
 
     text = metrics_text(job=job)
     try:
-        tmp = str(path) + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(text)
-        os.replace(tmp, str(path))
+        publish_text(str(path), text)
     except Exception as e:
         cls = resilience.classify_failure(e)
         return resilience.run_report().add(
@@ -667,20 +670,16 @@ def write_chrome_trace(path: str) -> dict:
     run-report event.  A write failure degrades classified — losing
     the trace must never lose the run (the ``trace.export`` fault site
     drills exactly that)."""
-    import os
-
     from splatt_tpu import resilience
     from splatt_tpu.utils import faults
+    from splatt_tpu.utils.durable import publish_json
 
     evs = chrome_events()
     with span("trace.export", path=str(path)):
         try:
             faults.maybe_fail("trace.export")
-            tmp = str(path) + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"traceEvents": evs, "displayTimeUnit": "ms"},
-                          f)
-            os.replace(tmp, str(path))
+            publish_json(str(path), {"traceEvents": evs,
+                                     "displayTimeUnit": "ms"})
         except Exception as e:
             cls = resilience.classify_failure(e)
             return resilience.run_report().add(

@@ -261,8 +261,13 @@ def _cache_io_error(op: str, exc) -> None:
 #: point of multi-tenancy — docs/serve.md), and a reset racing a
 #: write-through must not resurrect an entry from a replaced cache
 #: file.
-_MEM: dict = {}
-_MEM_LOCK = threading.Lock()
+#: under SPLATT_LOCKCHECK the memo is an owner-assertion proxy
+#: (utils/lockcheck.py — the SPL014 dynamic cross-check); otherwise
+#: both pass through as a plain dict and Lock
+from splatt_tpu.utils import lockcheck as _lockcheck
+
+_MEM_LOCK = _lockcheck.guard_lock(threading.Lock())
+_MEM: dict = _lockcheck.guard({}, _MEM_LOCK, "tune._MEM")
 
 #: lookup-miss sentinel (None is a legitimate memoized value)
 _MISS = object()

@@ -1,0 +1,122 @@
+"""The sanctioned durable-write helpers (splint rule SPL016).
+
+Every durable artifact this project publishes — journal lines, fleet
+leases and heartbeats, checkpoints, probe/tune cache files, metrics
+snapshots, result files — follows one of exactly two disk protocols:
+
+Atomic publish (:func:`publish_file` / :func:`publish_bytes` /
+:func:`publish_text` / :func:`publish_json`)
+    Write the full content to a same-directory temp file, ``fsync`` it,
+    then ``os.replace`` onto the destination.  A reader never observes
+    a torn file (rename is atomic on POSIX), and a crash between write
+    and rename leaves only debris — never a half-written destination.
+
+Durable append (:func:`append_line`)
+    One full line + ``fsync`` per record under an exclusive ``flock``,
+    healing a dead writer's torn tail (a partial final line with no
+    newline) before appending so crash debris can never merge into —
+    and swallow — the next record.  This is the journal protocol of
+    ``splatt serve`` (docs/serve.md), shared here so every appender
+    uses the same heal + fsync discipline.
+
+Before this module the pattern was hand-rolled in serve.py, fleet.py,
+trace.py, cpd.py and ops/pallas_kernels.py — five slightly different
+spellings of the same contract, which is how protocol drift starts
+(one of them skipped the fsync).  splint rule SPL016 now flags any
+``os.fsync``, tmp-write→``os.replace`` publish, or durable append
+outside these helpers, which is only enforceable because this
+chokepoint exists.
+
+The helpers RAISE on failure: durability call sites decide whether a
+failed write is load-bearing (serve's accept append rejects the job)
+or best-effort (a metrics snapshot degrades classified).  Nothing here
+classifies, logs or swallows — policy stays with the caller.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+try:
+    import fcntl as _fcntl
+except ImportError:  # non-POSIX: appends degrade to in-process safety
+    _fcntl = None
+
+
+def publish_file(tmp: str, path: str, fsync: bool = True) -> None:
+    """Atomically publish an already-written temp file onto `path`:
+    fsync the temp's content, then ``os.replace``.  For callers whose
+    content is produced by a writer that needs the filename itself
+    (``np.savez`` in cpd.py's checkpoint path)."""
+    if fsync:
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    os.replace(tmp, path)
+
+
+def publish_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Atomically publish `data` as the full new content of `path`
+    (same-directory temp write + fsync + ``os.replace``).  The temp
+    name carries the pid so concurrent publishers in different
+    processes never collide on debris."""
+    path = str(path)
+    tmp = f"{path}.~{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def publish_text(path: str, text: str, fsync: bool = True) -> None:
+    publish_bytes(path, text.encode(), fsync=fsync)
+
+
+def publish_json(path: str, obj, fsync: bool = True,
+                 indent: Optional[int] = None,
+                 sort_keys: bool = False) -> None:
+    publish_bytes(path, json.dumps(obj, indent=indent,
+                                   sort_keys=sort_keys).encode(),
+                  fsync=fsync)
+
+
+def append_line(path: str, data: bytes, heal_tail: bool = True,
+                fsync: bool = True, use_flock: bool = True) -> None:
+    """Durably append one newline-terminated record to `path`,
+    serialized across processes by an exclusive ``flock`` on the file
+    itself.  With `heal_tail`, a dead writer's partial final line is
+    newline-terminated first — otherwise the two lines would merge
+    into one garbage line and THIS record would be lost.  In-process
+    serialization (threads sharing one appender) stays with the
+    caller: the journal holds its own lock around this call."""
+    if not data.endswith(b"\n"):
+        data = data + b"\n"
+    with open(path, "ab") as f:
+        if _fcntl is not None and use_flock:
+            _fcntl.flock(f.fileno(), _fcntl.LOCK_EX)
+        try:
+            if heal_tail and f.tell() > 0:
+                with open(path, "rb") as r:
+                    r.seek(-1, os.SEEK_END)
+                    if r.read(1) != b"\n":
+                        f.write(b"\n")
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        finally:
+            if _fcntl is not None and use_flock:
+                _fcntl.flock(f.fileno(), _fcntl.LOCK_UN)

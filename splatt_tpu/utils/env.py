@@ -233,6 +233,14 @@ ENV_VARS = {
                                     "regime, load as the tiebreaker; "
                                     "0/off/false/no = pure "
                                     "priority/FIFO dispatch"),
+    "SPLATT_LOCKCHECK": EnvVar("0", "runtime lock-ownership sanitizer "
+                               "(utils/lockcheck.py): the structures "
+                               "declared in [tool.splint] "
+                               "shared-state are wrapped in proxies "
+                               "asserting their owning lock is held "
+                               "by the mutating thread — the dynamic "
+                               "cross-check of splint rule SPL014; "
+                               "off by default (zero wrappers)"),
     # repo-root bench.py driver knobs (documented here; bench.py is a
     # standalone script outside the package's SPL001 scope)
     "SPLATT_BENCH_PRIOR_DIR": EnvVar(None, "bench.py: directory "

@@ -1,0 +1,152 @@
+"""SPLATT_LOCKCHECK — runtime lock-ownership sanitizer.
+
+splint rule SPL014 statically proves that every write to a declared
+shared structure happens under its owning lock — modulo the documented
+imprecision (aliases, container elements, the ``_locked``-suffix
+caller-owns-lock convention).  This module is the DYNAMIC half of that
+check: with ``SPLATT_LOCKCHECK=1``, the declared structures are
+wrapped in owner-assertion proxies whose every mutating method asserts
+that the owning lock is held *by the current thread*.  Where the
+static map lies (a structure guarded on paper by a lock nobody takes)
+the proxy raises at the first unguarded mutation — in the test suite,
+with a stack trace pointing at the exact call site the AST analysis
+could not see.
+
+Disabled (the default), :func:`guard_lock` and :func:`guard` return
+their arguments untouched — zero wrappers, zero overhead, nothing to
+reason about in production.
+
+The wrapped structures mirror the ``[tool.splint] shared-state`` map
+(pyproject.toml): the Server job table/queue/running set, the fleet
+held/lost/regime maps, tune's plan memo, trace's span and metric
+registries.  tests/test_lockcheck.py cross-checks the two lists so
+the static map and the dynamic sanitizer cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+#: names registered by :func:`guard` this process (the cross-check
+#: surface for tests): name -> the guarding OwnedLock
+WRAPPED: Dict[str, "OwnedLock"] = {}
+
+
+def enabled() -> bool:
+    """Whether the sanitizer is armed (``SPLATT_LOCKCHECK`` truthy).
+    Read per call — tests flip it with monkeypatch.setenv before
+    constructing the object under test."""
+    from splatt_tpu.utils.env import read_env
+
+    return str(read_env("SPLATT_LOCKCHECK") or "").lower() in (
+        "1", "on", "true", "yes")
+
+
+class LockOwnershipError(AssertionError):
+    """A declared shared structure was mutated without its owning lock
+    held by the current thread — the SPL014 hazard, caught live."""
+
+
+class OwnedLock:
+    """A ``threading.Lock`` wrapper that records the owning thread —
+    what a non-reentrant Lock cannot report by itself.  Supports the
+    same ``with``/acquire/release surface the wrapped lock has."""
+
+    def __init__(self, lock=None):
+        self._lock = lock if lock is not None else threading.Lock()
+        self._owner: Optional[int] = None
+
+    def acquire(self, *a, **kw) -> bool:
+        ok = self._lock.acquire(*a, **kw)
+        if ok:
+            self._owner = threading.get_ident()
+        return ok
+
+    def release(self) -> None:
+        self._owner = None
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def held_by_me(self) -> bool:
+        return self._owner == threading.get_ident()
+
+
+def guard_lock(lock=None):
+    """Wrap `lock` for ownership tracking when the sanitizer is armed;
+    return it untouched otherwise."""
+    if not enabled():
+        return lock if lock is not None else threading.Lock()
+    return OwnedLock(lock)
+
+
+def _assert_owned(lock: OwnedLock, name: str) -> None:
+    if not lock.held_by_me():
+        raise LockOwnershipError(
+            f"SPLATT_LOCKCHECK: shared structure {name!r} mutated "
+            f"without its owning lock held by this thread (the "
+            f"[tool.splint] shared-state contract, SPL014)")
+
+
+def _make_guarded(base, mutators):
+    """A subclass of `base` whose listed mutators assert ownership."""
+    ns = {"__slots__": ("_lc_lock", "_lc_name")}
+
+    def mk(meth):
+        orig = getattr(base, meth)
+
+        def guarded(self, *a, **kw):
+            _assert_owned(self._lc_lock, self._lc_name)
+            return orig(self, *a, **kw)
+
+        guarded.__name__ = meth
+        return guarded
+
+    for meth in mutators:
+        if hasattr(base, meth):
+            ns[meth] = mk(meth)
+    return type(f"Guarded{base.__name__.capitalize()}", (base,), ns)
+
+
+_DICT_MUTATORS = ("__setitem__", "__delitem__", "pop", "popitem",
+                  "clear", "update", "setdefault")
+_LIST_MUTATORS = ("__setitem__", "__delitem__", "append", "extend",
+                  "insert", "remove", "pop", "clear", "sort", "reverse")
+_SET_MUTATORS = ("add", "discard", "remove", "pop", "clear", "update",
+                 "difference_update", "intersection_update",
+                 "symmetric_difference_update")
+
+_GuardedDict = _make_guarded(dict, _DICT_MUTATORS)
+_GuardedList = _make_guarded(list, _LIST_MUTATORS)
+_GuardedSet = _make_guarded(set, _SET_MUTATORS)
+
+
+def guard(struct, lock, name: str):
+    """Wrap a dict/list/set in an owner-assertion proxy bound to
+    `lock` (an :class:`OwnedLock`).  Returns `struct` untouched when
+    the sanitizer is disarmed or the lock is unwrapped (a plain Lock
+    cannot report ownership)."""
+    if not enabled() or not isinstance(lock, OwnedLock):
+        return struct
+    if isinstance(struct, dict):
+        out = _GuardedDict(struct)
+    elif isinstance(struct, list):
+        out = _GuardedList(struct)
+    elif isinstance(struct, set):
+        out = _GuardedSet(struct)
+    else:
+        return struct
+    out._lc_lock = lock
+    out._lc_name = name
+    WRAPPED[name] = lock
+    return out

@@ -31,6 +31,14 @@ import pytest
 from tests import gen
 
 
+def pytest_configure(config):
+    # the tier-1 gate runs `-m 'not slow'` (ROADMAP.md); register the
+    # marker so slow-tier tests don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` "
+                   "gate (full sweeps, 3-replica interleavings)")
+
+
 @pytest.fixture(scope="session")
 def tensors_dir(tmp_path_factory):
     """Generate the fixture tensor files once per session."""

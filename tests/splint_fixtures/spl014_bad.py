@@ -1,0 +1,29 @@
+"""SPL014 bad: writes to declared shared structures without their
+owning lock (the [tool.splint] shared-state map names the owners)."""
+
+import threading
+
+_TABLE = {}
+_TABLE_LOCK = threading.Lock()
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+
+    def accept(self, jid, spec):
+        # decided nothing, locked nothing: a worker thread iterating
+        # _jobs concurrently sees a dict resized under its feet
+        self._jobs[jid] = {"spec": spec, "state": "accepted"}
+
+    def forget(self, jid):
+        del self._jobs[jid]
+
+
+def remember(key, value):
+    _TABLE[key] = value  # module-global shared map, same hazard
+
+
+def forget_all():
+    _TABLE.clear()

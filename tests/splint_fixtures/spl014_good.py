@@ -1,0 +1,33 @@
+"""SPL014 good: every shared-structure write holds the owning lock —
+directly, or inside a ``_locked``-suffix helper whose callers hold it
+(the caller-owns-the-lock convention, docs/static-analysis.md)."""
+
+import threading
+
+_TABLE = {}
+_TABLE_LOCK = threading.Lock()
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}  # construction: the object is not yet shared
+
+    def accept(self, jid, spec):
+        with self._lock:
+            self._jobs[jid] = {"spec": spec, "state": "accepted"}
+
+    def accept_many(self, specs):
+        with self._lock:
+            for jid, spec in specs.items():
+                self._apply_locked(jid, spec)
+
+    def _apply_locked(self, jid, spec):
+        # the _locked suffix documents (and SPL014 trusts) that every
+        # caller already holds self._lock
+        self._jobs[jid] = {"spec": spec, "state": "accepted"}
+
+
+def remember(key, value):
+    with _TABLE_LOCK:
+        _TABLE[key] = value

@@ -1,0 +1,22 @@
+"""SPL015 bad: two sites nest the same locks in opposite orders — two
+threads walking the two paths deadlock (A waits for B's lock, B for
+A's).  The acquisition-graph cycle is the static witness."""
+
+import threading
+
+_QUEUE_LOCK = threading.Lock()
+_CACHE_LOCK = threading.Lock()
+
+
+def drain_into_cache(queue, cache):
+    with _QUEUE_LOCK:
+        with _CACHE_LOCK:  # queue-lock -> cache-lock
+            while queue:
+                cache[queue.pop()] = True
+
+
+def evict_into_queue(queue, cache):
+    with _CACHE_LOCK:
+        with _QUEUE_LOCK:  # cache-lock -> queue-lock: the cycle
+            for key in list(cache):
+                queue.append(cache.pop(key))

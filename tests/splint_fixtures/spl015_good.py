@@ -1,0 +1,24 @@
+"""SPL015 good: one global lock order, everywhere — queue-lock before
+cache-lock at every nesting site, so the acquisition graph is acyclic
+and no interleaving can deadlock."""
+
+import threading
+
+_QUEUE_LOCK = threading.Lock()
+_CACHE_LOCK = threading.Lock()
+
+
+def drain_into_cache(queue, cache):
+    with _QUEUE_LOCK:
+        with _CACHE_LOCK:
+            while queue:
+                cache[queue.pop()] = True
+
+
+def evict_into_queue(queue, cache):
+    # same order as drain_into_cache; the eviction set is decided
+    # under both locks, exactly like the drain
+    with _QUEUE_LOCK:
+        with _CACHE_LOCK:
+            for key in list(cache):
+                queue.append(cache.pop(key))

@@ -1,0 +1,22 @@
+"""SPL016 bad: hand-rolled durable-write protocol — an inline fsync,
+a tmp-write -> os.replace publish, and an append-mode open that
+writes — all outside the sanctioned helpers.  Three call sites, three
+chances for the protocol to drift (this one forgot to fsync before
+the rename)."""
+
+import json
+import os
+
+
+def publish_record(path, record):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, path)  # no fsync: a crash can publish empty bytes
+
+
+def append_record(path, record):
+    with open(path, "ab") as f:
+        f.write(json.dumps(record).encode() + b"\n")
+        f.flush()
+        os.fsync(f.fileno())  # no torn-tail heal, no flock

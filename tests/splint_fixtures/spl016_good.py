@@ -1,0 +1,28 @@
+"""SPL016 good: durable writes routed through the sanctioned helper
+(here defined locally under the configured helper name — production
+code imports splatt_tpu.utils.durable).  The helper body is the ONE
+place the fsync/atomic-rename discipline lives."""
+
+import json
+import os
+
+
+def publish_bytes(path, data):
+    # the sanctioned chokepoint ([tool.splint] durable-write-helpers):
+    # tmp write + fsync + atomic rename, exempted by name
+    tmp = f"{path}.~{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def publish_record(path, record):
+    publish_bytes(path, json.dumps(record).encode())
+
+
+def claim_request(path, replica):
+    # renaming an EXISTING file (the spool-claim verb) is not a
+    # durable publish — no locally-written tmp is involved
+    os.replace(path, f"{path}.{replica}.claim")

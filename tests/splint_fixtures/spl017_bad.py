@@ -1,0 +1,25 @@
+"""SPL017 bad: blocking IO inside the control-plane lock's critical
+section on a configured hot path — every concurrent submitter and
+status poller stalls behind this thread's fsync and sleep (the PR 11
+submit bug shape)."""
+
+import os
+import threading
+import time
+
+
+class Server:
+    def __init__(self, journal_path):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._journal_path = journal_path
+
+    def submit_hot(self, jid, spec):
+        with self._lock:
+            self._jobs[jid] = spec
+            with open(self._journal_path, "ab") as f:
+                f.write(b"accepted\n")
+                f.flush()
+                os.fsync(f.fileno())  # the whole daemon waits on disk
+            time.sleep(0.01)          # and then some more
+        return jid

@@ -1,0 +1,19 @@
+"""SPL018 bad: ContextVar.set without a crash-safe reset — a thrown
+exception strands one job's scoped state on the worker thread, and the
+next tenant on that thread inherits it."""
+
+import contextvars
+
+_SCOPE = contextvars.ContextVar("scope", default=None)
+
+
+def run_job_leaky(job_id, body):
+    _SCOPE.set(job_id)  # token discarded: unrestorable
+    return body()
+
+
+def run_job_unguarded(job_id, body):
+    token = _SCOPE.set(job_id)
+    out = body()            # a raise here skips the reset entirely
+    _SCOPE.reset(token)     # reset exists, but not in a finally
+    return out

@@ -1,0 +1,25 @@
+"""SPL018 good: the sanctioned token + try/finally reset idiom
+(resilience.scope / faults.scoped / trace.enabling all have this
+shape) — the scoped state is restored on every exit path."""
+
+import contextlib
+import contextvars
+
+_SCOPE = contextvars.ContextVar("scope", default=None)
+
+
+def run_job(job_id, body):
+    token = _SCOPE.set(job_id)
+    try:
+        return body()
+    finally:
+        _SCOPE.reset(token)
+
+
+@contextlib.contextmanager
+def scope(job_id):
+    token = _SCOPE.set(job_id)
+    try:
+        yield job_id
+    finally:
+        _SCOPE.reset(token)

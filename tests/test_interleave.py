@@ -1,0 +1,98 @@
+"""The lease-protocol interleaving checker (tools/splint/interleave.py).
+
+The fleet chaos soak samples one kill-and-restart schedule per run;
+this checker enumerates every interleaving of fixed per-replica
+programs against the REAL FleetMember code under a virtual clock.
+Tier-1 pins three things: the protocol passes bounded-exhaustive
+schedules (2 replicas; the 3-replica sweep is the slow tier), the
+PR 11 zombie-commit mutant FAILS it (the checker is load-bearing, not
+decorative), and the gen-fence mutant fails it through the
+restarted-replica twin scenario.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.splint.interleave import (check, interleavings,  # noqa: E402
+                                     scenarios)
+
+
+def test_interleavings_enumerate_exhaustively():
+    """All order-preserving merges, no duplicates: programs of sizes
+    (2, 2) make 4!/(2!2!) = 6 schedules; (2, 2, 1) make 30."""
+    two = list(interleavings({"A": ("a1", "a2"), "B": ("b1", "b2")}))
+    assert len(two) == 6
+    assert len(set(two)) == 6
+    assert ("A:a1", "A:a2", "B:b1", "B:b2") in two
+    for sched in two:
+        assert sched.index("A:a1") < sched.index("A:a2")
+        assert sched.index("B:b1") < sched.index("B:b2")
+    three = list(interleavings({"A": ("a1", "a2"),
+                                "B": ("b1", "b2"),
+                                "clock": ("t",)}))
+    assert len(three) == 30
+
+
+def test_protocol_passes_two_replicas(tmp_path):
+    """The acceptance invariant: every interleaving of every scenario
+    upholds exactly-one-owner, gen monotonicity, the gen fence, and
+    at-most-one terminal append — with the real acquire/renew/adopt/
+    release code doing the work."""
+    res = check(replicas=2, root=str(tmp_path))
+    assert res.schedules > 400  # bounded-exhaustive, not a sample
+    assert res.ok, "\n".join(str(v) for v in res.violations[:5])
+    # the twin-revival scenario (restarted replica id) is in the set
+    assert "twin-revival" in scenarios(2)
+
+
+@pytest.mark.slow
+def test_protocol_passes_three_replicas(tmp_path):
+    res = check(replicas=3, root=str(tmp_path))
+    assert res.schedules > 2500
+    assert res.ok, "\n".join(str(v) for v in res.violations[:5])
+
+
+def test_zombie_commit_mutant_fails(tmp_path):
+    """Re-introducing the PR 11 zombie-commit bug (terminal append
+    without the last-gate renew) must produce violations — among them
+    the no-append-after-expiry breach in the failover scenario."""
+    res = check(replicas=2, mutant="no_fence", root=str(tmp_path))
+    assert not res.ok
+    kinds = {v.invariant for v in res.violations}
+    assert "no-append-after-expiry" in kinds
+    assert "single-terminal" in kinds
+    assert any(v.scenario == "failover" for v in res.violations)
+
+
+def test_gen_fence_mutant_fails(tmp_path):
+    """An adopt that forgets the generation bump lets the zombie twin
+    of a restarted replica revive its dead era — caught by the
+    gen-fence invariant in the twin-revival scenario."""
+    res = check(replicas=2, mutant="no_gen_bump", root=str(tmp_path))
+    assert not res.ok
+    assert any(v.invariant == "gen-fence"
+               and v.scenario == "twin-revival"
+               for v in res.violations)
+
+
+def test_cli_exit_codes(tmp_path):
+    """`python -m tools.splint.interleave` is the CI entry: 0 clean,
+    1 on a mutant."""
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.splint.interleave",
+         "--replicas", "2"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "0 violation(s)" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.splint.interleave",
+         "--replicas", "2", "--mutant", "no_fence"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert bad.returncode == 1
+    assert "zombie" in bad.stdout

@@ -1,0 +1,150 @@
+"""SPLATT_LOCKCHECK — the runtime lock-ownership sanitizer
+(splatt_tpu/utils/lockcheck.py), SPL014's dynamic cross-check.
+
+Pins: disabled means untouched pass-through objects; armed proxies
+raise on an unguarded mutation and stay silent on guarded ones (from
+any thread holding the lock); the production wiring (Server +
+FleetMember under SPLATT_LOCKCHECK=1) runs a real job end-to-end
+without tripping — live proof the [tool.splint] shared-state map
+matches how the code actually locks; and the static map and the
+dynamic wrapping cannot drift apart.
+"""
+
+import threading
+
+import pytest
+
+from splatt_tpu.utils import lockcheck
+
+
+def _armed(monkeypatch):
+    monkeypatch.setenv("SPLATT_LOCKCHECK", "1")
+
+
+def test_disabled_is_pass_through(monkeypatch):
+    monkeypatch.delenv("SPLATT_LOCKCHECK", raising=False)
+    lk = threading.Lock()
+    assert lockcheck.guard_lock(lk) is lk
+    d = {}
+    assert lockcheck.guard(d, lk, "t.d") is d
+    assert type(lockcheck.guard_lock(None)) is type(threading.Lock())
+
+
+def test_armed_proxies_assert_ownership(monkeypatch):
+    _armed(monkeypatch)
+    lk = lockcheck.guard_lock(threading.Lock())
+    d = lockcheck.guard({}, lk, "t.dict")
+    ls = lockcheck.guard([], lk, "t.list")
+    st = lockcheck.guard(set(), lk, "t.set")
+    with lk:
+        d["a"] = 1
+        d.setdefault("b", 2)
+        ls.append(3)
+        ls.remove(3)
+        st.add(4)
+        st.discard(4)
+        del d["b"]
+    assert dict(d) == {"a": 1}
+    for mutate in (lambda: d.__setitem__("x", 1),
+                   lambda: d.pop("a"),
+                   lambda: ls.append(1),
+                   lambda: st.add(1)):
+        with pytest.raises(lockcheck.LockOwnershipError):
+            mutate()
+    # reads never assert
+    assert d.get("a") == 1 and list(ls) == [] and len(st) == 0
+
+
+def test_armed_ownership_is_per_thread(monkeypatch):
+    """The lock being MERELY locked is not enough — the mutating
+    thread must be the one holding it (the hazard a plain
+    ``lock.locked()`` check would miss)."""
+    _armed(monkeypatch)
+    lk = lockcheck.guard_lock(threading.Lock())
+    d = lockcheck.guard({}, lk, "t.threads")
+    caught = []
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    try:
+        assert entered.wait(5)
+        try:
+            d["x"] = 1  # lk is locked — but by the OTHER thread
+        except lockcheck.LockOwnershipError as e:
+            caught.append(e)
+    finally:
+        release.set()
+        t.join(5)
+    assert caught
+    with lk:
+        d["x"] = 1  # same write, rightful owner: fine
+
+
+def test_server_and_fleet_run_clean_under_sanitizer(
+        monkeypatch, tmp_path):
+    """The wiring test: a fleet-mode Server doing real work (submit,
+    journal replay, lease claim, run to terminal) under the armed
+    sanitizer — zero ownership violations, and the wrapped-structure
+    registry covers the [tool.splint] shared-state map's serve/fleet
+    entries (static map ≡ dynamic wrapping)."""
+    _armed(monkeypatch)
+    lockcheck.WRAPPED.clear()
+    from splatt_tpu.serve import Server
+
+    srv = Server(str(tmp_path), workers=2, fleet=True, replica="lc",
+                 lease_s=30.0, heartbeat_s=10.0)
+    out = srv.submit({"id": "lk1", "rank": 2, "iters": 2,
+                      "synthetic": {"dims": [8, 6, 5], "nnz": 60,
+                                    "seed": 0}})
+    assert out["state"] == "accepted"
+    summary = srv.run_once()
+    assert summary["jobs"]["lk1"] in ("done", "failed")
+    srv.shutdown()
+    wrapped = set(lockcheck.WRAPPED)
+    assert {"serve.Server._jobs", "serve.Server._queue",
+            "serve.Server._running", "fleet.FleetMember._held",
+            "fleet.FleetMember._lost",
+            "fleet.FleetMember._regimes"} <= wrapped
+
+
+def test_static_map_matches_dynamic_wrapping(monkeypatch, tmp_path):
+    """Every serve.py/fleet.py [tool.splint] shared-state entry has a
+    lockcheck.guard call wiring it — parsed from pyproject so the two
+    lists cannot drift apart silently."""
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo))
+    from tools.splint import load_config
+    from tools.splint.rules import _parse_shared_state
+
+    _armed(monkeypatch)
+    lockcheck.WRAPPED.clear()
+    from splatt_tpu.serve import Server
+
+    Server(str(tmp_path), fleet=True, replica="xmap",
+           lease_s=30.0, heartbeat_s=10.0).shutdown()
+    by_file = _parse_shared_state(load_config(repo).shared_state)
+    for rel in ("splatt_tpu/serve.py", "splatt_tpu/fleet.py"):
+        for target, _lock in by_file[rel]:
+            attr = target.split(".", 1)[1]  # self.<attr>
+            assert any(name.endswith(f".{attr}")
+                       for name in lockcheck.WRAPPED), \
+                f"{rel} declares {target} but nothing wraps it"
+    # the module-global entries (tune._MEM, trace registries) name
+    # structures their modules guard at import time; assert the
+    # guard calls exist in source (import-time wrapping depends on
+    # the env at first import, which pytest fixed long ago)
+    for rel in ("splatt_tpu/tune.py", "splatt_tpu/trace.py"):
+        src = (repo / rel).read_text()
+        for target, _lock in by_file.get(rel, []):
+            assert f'"{rel.split("/")[-1][:-3]}.{target}"' in src, \
+                f"{rel} declares {target} but has no guard() call"

@@ -106,7 +106,8 @@ def test_baseline_has_no_stale_or_overcounted_entries():
 
 RULE_IDS = ["SPL000", "SPL001", "SPL002", "SPL003", "SPL004", "SPL005",
             "SPL006", "SPL007", "SPL008", "SPL009", "SPL010", "SPL011",
-            "SPL012", "SPL013"]
+            "SPL012", "SPL013", "SPL014", "SPL015", "SPL016", "SPL017",
+            "SPL018"]
 
 
 @pytest.mark.parametrize("rule", RULE_IDS)
@@ -561,6 +562,329 @@ def test_spl012_covers_aliased_report():
                for h in hits)
 
 
+# -- the concurrency family (SPL014-SPL018, tools/splint/locks.py) ----------
+
+from tools.splint.locks import (FileLocks,  # noqa: E402
+                                iter_scope_functions, lock_walk)
+from tools.splint.rules import (BlockingCallUnderLock,  # noqa: E402
+                                ContextvarLeak, LockOrderCycle,
+                                SharedStateWithoutLock)
+
+
+def _lock_walk_of(src: str):
+    ctx = _ctx_of(src)
+    fl = FileLocks(ctx)
+    fns = list(iter_scope_functions(ctx.tree))
+    fn, cls = fns[-1]
+    return ctx, lock_walk(ctx, fn, cls, fl)
+
+
+def test_lock_walk_with_nesting_and_restore():
+    src = """
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def f(x):
+            before = 1
+            with _A:
+                inside_a = 2
+                with _B:
+                    inside_ab = 3
+                after_b = 4
+            after_a = 5
+    """
+    ctx, walk = _lock_walk_of(src)
+    held_by_line = {}
+    fn = [s for s in ast.walk(ctx.tree)
+          if isinstance(s, ast.FunctionDef)][0]
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.stmt) and id(stmt) in walk.held_at:
+            held_by_line[stmt.lineno] = {
+                h.split("::")[-1] for h in walk.held_at[id(stmt)]}
+    assert held_by_line[7] == set()          # before
+    assert held_by_line[9] == {"_A"}         # inside_a
+    assert held_by_line[11] == {"_A", "_B"}  # inside_ab
+    assert held_by_line[12] == {"_A"}        # after_b: _B restored
+    assert held_by_line[13] == set()         # after_a: both restored
+    # acquisition sites record the held-before sets (SPL015's edges)
+    acq = {(lid.split("::")[-1], tuple(sorted(
+        h.split("::")[-1] for h in held)))
+        for lid, _line, held in walk.acquisitions}
+    assert acq == {("_A", ()), ("_B", ("_A",))}
+
+
+def test_lock_walk_acquire_release_pairs_and_closures():
+    src = """
+        import threading
+
+        _A = threading.Lock()
+
+        def f(xs):
+            _A.acquire()
+            xs.append(1)
+            _A.release()
+            xs.append(2)
+            def closure():
+                xs.append(3)  # runs later: NOT under _A
+    """
+    ctx, walk = _lock_walk_of(src)
+    fn = [s for s in ast.walk(ctx.tree)
+          if isinstance(s, ast.FunctionDef) and s.name == "f"][0]
+    held = {s.lineno: walk.held_at[id(s)] for s in fn.body
+            if id(s) in walk.held_at}
+    assert not held[6]                      # before acquire
+    assert any(held[7])                     # between the pair
+    assert not held[9]                      # after release
+
+
+def test_spl015_cross_function_cycle_and_self_loop():
+    hits = _rule_hits(LockOrderCycle(), """
+    import threading
+
+    _A = threading.Lock()
+    _B = threading.Lock()
+
+    def ab():
+        with _A:
+            with _B:
+                pass
+
+    def ba():
+        with _B:
+            with _A:
+                pass
+""")
+    assert any("cycle" in h.message and "_A" in h.message
+               and "_B" in h.message for h in hits)
+    # self-loop: re-acquiring a non-reentrant lock under itself
+    hits = _rule_hits(LockOrderCycle(), """
+    import threading
+
+    _A = threading.Lock()
+
+    def helper():
+        with _A:
+            pass
+
+    def outer():
+        with _A:
+            helper()
+""")
+    assert any("cycle" in h.message for h in hits)
+
+
+def test_spl015_interprocedural_edge_through_method_call():
+    """An edge discovered through a call under a held lock: outer holds
+    Server's lock while calling a helper that takes the metrics lock —
+    plus the reverse nesting elsewhere closes the cycle."""
+    hits = _rule_hits(LockOrderCycle(), """
+    import threading
+
+    _MET = threading.Lock()
+
+    def record():
+        with _MET:
+            pass
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def poll(self):
+            with self._lock:
+                record()
+
+        def backwards(self):
+            with _MET:
+                with self._lock:
+                    pass
+""")
+    assert any("cycle" in h.message for h in hits)
+
+
+def test_spl017_flags_transitive_blocking_and_exempts_str_join():
+    cfg = _cfg(hot_lock_paths=["mem.py::submit"])
+    src = """
+    import os
+    import threading
+
+    class Journal:
+        def append(self, rec):
+            with open("/tmp/j", "ab") as f:
+                f.write(rec)
+                os.fsync(f.fileno())
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.journal = Journal()
+
+        def submit(self, jid, parts):
+            with self._lock:
+                label = ", ".join(parts)   # str.join: NOT blocking
+                self.journal.append(label.encode())
+            return jid
+"""
+    ctx = _ctx_of(src)
+    project = Project(cfg)
+    project.files.append(ctx)
+    rule = BlockingCallUnderLock()
+    hits = rule.check(ctx, project) + rule.finalize(project)
+    assert len(hits) == 1, [h.message for h in hits]
+    assert "via Journal.append" in hits[0].message
+    assert "fsync" in hits[0].message or "flock" in hits[0].message
+
+
+def test_spl018_enter_exit_pairs_are_exempt():
+    hits = _rule_hits(ContextvarLeak(), """
+    import contextvars
+
+    _STACK = contextvars.ContextVar("stack", default=())
+
+    class Handle:
+        def __enter__(self):
+            _STACK.set(_STACK.get() + (self,))
+            return self
+
+        def __exit__(self, *exc):
+            _STACK.set(tuple(s for s in _STACK.get() if s is not self))
+            return False
+""")
+    assert not hits
+
+
+def test_spl014_flags_mutators_outside_bare_expressions():
+    """A mutator call is a write wherever it appears — assigned
+    (`jid = self._queue.pop(0)`), in a test position, in a return —
+    not only as a bare expression statement (review-found gap)."""
+    cfg = _cfg(shared_state=["mem.py::self._queue=self._lock"])
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._queue = []
+
+        def bad_pick(self):
+            jid = self._queue.pop(0)
+            return jid
+
+        def bad_test(self):
+            if self._queue.pop(0):
+                return True
+
+        def good_pick(self):
+            with self._lock:
+                return self._queue.pop(0)
+"""
+    ctx = _ctx_of(src)
+    project = Project(cfg)
+    project.files.append(ctx)
+    hits = SharedStateWithoutLock().check(ctx, project)
+    assert sorted(f.line for f in hits) == [9, 13]
+
+
+def test_spl014_alias_imprecision_is_documented_not_flagged():
+    """Mutation through an alias is the documented blind spot — the
+    SPLATT_LOCKCHECK runtime sanitizer covers it dynamically."""
+    cfg = _cfg(shared_state=["mem.py::self._jobs=self._lock"])
+    src = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = {}
+
+        def touch(self, jid):
+            j = self._jobs[jid]
+            j["state"] = "started"   # alias write: not seen
+"""
+    ctx = _ctx_of(src)
+    project = Project(cfg)
+    project.files.append(ctx)
+    rule = SharedStateWithoutLock()
+    assert not rule.check(ctx, project)
+
+
+def _copy_serve_tree(tmp_path, mutate):
+    """A tmp mini-tree holding the REAL serve.py (+ its durable-write
+    helper, preserving the package layout the call summaries resolve
+    against), with `mutate(src) -> src` applied to serve.py."""
+    pkg = tmp_path / "splatt_tpu"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "serve.py").write_text(
+        mutate((REPO / "splatt_tpu" / "serve.py").read_text()))
+    (pkg / "utils" / "durable.py").write_text(
+        (REPO / "splatt_tpu" / "utils" / "durable.py").read_text())
+    cfg = _cfg()
+    cfg.root = tmp_path
+    cfg.paths = ["splatt_tpu"]
+    return cfg
+
+
+def test_spl017_fires_when_submit_journals_under_the_lock(tmp_path):
+    """Re-introducing the PR 11 submit bug — the durable accept append
+    moved INSIDE the server lock — must trip SPL017 through the
+    interprocedural summary (the fsync is two calls down, in the
+    shared durable-write helper).  The unmutated file is clean (also
+    covered by the tree gate)."""
+    anchor = ("self._jobs[jid] = "
+              "self._new_job_locked(spec, ACCEPTING)")
+
+    def mutate(src):
+        assert anchor in src, "serve.py submit anchor drifted"
+        return src.replace(
+            anchor,
+            anchor + "\n                self.journal.append("
+                     "self._rec(ACCEPTED, jid, spec=spec))")
+
+    cfg = _copy_serve_tree(tmp_path, mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL017"]
+    assert hits and any("Journal.append" in f.message for f in hits)
+
+
+def test_spl014_fires_when_replay_drops_the_lock(tmp_path):
+    """Deleting _replay's server-lock region (the pre-PR-12 shape)
+    must trip SPL014 on the queue/job-table mutations — proof the
+    shared-state map guards the real file, not a fixture."""
+    def mutate(src):
+        anchor = ("        resumed: List[tuple] = []\n"
+                  "        with self._lock:")
+        assert anchor in src, "serve.py _replay anchor drifted"
+        return src.replace(
+            anchor, "        resumed: List[tuple] = []\n"
+                    "        if True:")
+
+    cfg = _copy_serve_tree(tmp_path, mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL014"]
+    assert hits and any("_queue" in f.message or "_jobs" in f.message
+                        for f in hits)
+
+
+def test_shared_state_config_is_well_formed():
+    """Every [tool.splint] shared-state / hot-lock-paths entry parses
+    and points at a real file (a typo'd map silently unguards)."""
+    from tools.splint.rules import _parse_shared_state
+
+    cfg = _cfg()
+    by_file = _parse_shared_state(cfg.shared_state)
+    assert "splatt_tpu/serve.py" in by_file
+    assert ("self._jobs", "self._lock") in by_file["splatt_tpu/serve.py"]
+    for rel in by_file:
+        assert (REPO / rel).is_file(), rel
+    for entry in cfg.hot_lock_paths:
+        rel, name = entry.split("::")
+        assert (REPO / rel).is_file(), rel
+    with pytest.raises(ValueError):
+        _parse_shared_state(["no-separator"])
+
+
 # -- the SPL008 guard: cpd.py's re-materialization is load-bearing ----------
 
 def test_spl008_fires_when_cpd_rematerialization_deleted(tmp_path):
@@ -644,12 +968,46 @@ def test_cli_json_lockstep_for_dataflow_rules():
     assert cli == mine
 
 
+def test_cli_json_lockstep_for_concurrency_rules(tmp_path):
+    """CLI --json ≡ in-process for the SPL014-SPL018 family, on a
+    mini-project holding the bad fixtures (the production tree is
+    clean for them by the zero-budget gate, so lockstep there would
+    compare empty sets).  Same pyproject, same analyzer, same
+    findings — the CI entry point cannot drift from the pytest gate."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    for n in ("014", "015", "016", "017", "018"):
+        name = f"spl{n}_bad.py"
+        (pkg / name).write_text((FIXTURES / name).read_text())
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.splint]\n'
+        'paths = ["pkg"]\n'
+        'shared-state = ["pkg/spl014_bad.py::self._jobs=self._lock",\n'
+        '               "pkg/spl014_bad.py::_TABLE=_TABLE_LOCK"]\n'
+        'durable-write-helpers = ["publish_bytes"]\n'
+        'hot-lock-paths = ["pkg/spl017_bad.py::submit_hot"]\n')
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.splint", "--root", str(tmp_path),
+         "--json", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    payload = json.loads(proc.stdout)
+    fam = {"SPL014", "SPL015", "SPL016", "SPL017", "SPL018"}
+    cli = sorted((f["rule"], f["path"], f["line"])
+                 for f in payload["findings"] if f["rule"] in fam)
+    report = run(load_config(tmp_path), baseline={})
+    mine = sorted((f.rule, f.path, f.line)
+                  for f in report.findings if f.rule in fam)
+    assert cli and cli == mine
+    assert {r for r, _, _ in cli} == fam  # every rule fires somewhere
+
+
 def test_cli_list_rules_covers_new_rules():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.splint", "--list-rules"],
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    for rid in ("SPL008", "SPL009", "SPL010", "SPL011", "SPL012"):
+    for rid in ("SPL008", "SPL009", "SPL010", "SPL011", "SPL012",
+                "SPL014", "SPL015", "SPL016", "SPL017", "SPL018"):
         line = next((ln for ln in proc.stdout.splitlines()
                      if ln.startswith(rid)), "")
         assert line and len(line.split(None, 1)[1]) > 10, \
@@ -711,6 +1069,17 @@ def test_config_matches_pyproject():
     assert set(cfg.cache_path_functions) == {"_cache_path", "cache_path"}
     assert "_json_cache_update" in cfg.cache_io_helpers
     assert "_json_cache_load" in cfg.cache_io_helpers
+    # the concurrency family (SPL014-SPL018) is zero-budget and its
+    # three config keys are populated
+    assert {"SPL014", "SPL015", "SPL016", "SPL017", "SPL018"} \
+        <= set(cfg.zero_rules)
+    assert any(e.startswith("splatt_tpu/serve.py::self._jobs=")
+               for e in cfg.shared_state)
+    assert any(e.startswith("splatt_tpu/tune.py::_MEM=")
+               for e in cfg.shared_state)
+    assert {"publish_bytes", "publish_json", "publish_file",
+            "append_line"} <= set(cfg.durable_write_helpers)
+    assert "splatt_tpu/serve.py::submit" in cfg.hot_lock_paths
 
 
 def test_run_report_registry_matches_runtime():

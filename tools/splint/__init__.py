@@ -18,6 +18,15 @@ Rules (see docs/static-analysis.md for the full catalog):
 - SPL005 — dtype literals outside ``config.py``
 - SPL006 — fault-site drift against ``utils/faults.py:SITES``
 - SPL007 — undocumented ``SPLATT_*`` environment variables
+- SPL008–SPL013 — the dataflow/registry family (use-after-donate,
+  tracer leaks, recompile triggers, cache-lock discipline, run-report
+  event and span-name drift)
+- SPL014–SPL018 — the concurrency family (tools/splint/locks.py):
+  shared-state writes without the owning lock, lock-order cycles,
+  durability-protocol drift, blocking calls under a control-plane
+  lock, contextvar set/reset leaks — paired with the dynamic side,
+  ``tools/splint/interleave.py``, a bounded-exhaustive interleaving
+  checker for the fleet lease protocol
 
 Escape hatch: ``# splint: ignore[SPL002] <reason>`` on the flagged
 line (inline) or as a full-line comment directly above it; the reason

@@ -55,6 +55,20 @@ class Config:
     #: the sanctioned cache-IO helper functions whose bodies SPL011
     #: exempts (they ARE the locked chokepoints)
     cache_io_helpers: List[str] = dataclasses.field(default_factory=list)
+    #: shared-structure → guarding-lock map for SPL014, entries of the
+    #: form "relpath::self.attr=self.lock" (instance state, the class
+    #: resolved at each mutation site) or "relpath::NAME=LOCK_NAME"
+    #: (module globals)
+    shared_state: List[str] = dataclasses.field(default_factory=list)
+    #: the sanctioned durable-write helper functions whose bodies
+    #: SPL016 exempts (they ARE the fsync/tmp-write→replace/append
+    #: chokepoints — splatt_tpu/utils/durable.py)
+    durable_write_helpers: List[str] = dataclasses.field(
+        default_factory=list)
+    #: control-plane functions ("relpath::name") where SPL017 flags a
+    #: blocking call (fsync/flock/sleep/join/wait/subprocess, directly
+    #: or transitively) made while an in-process lock is held
+    hot_lock_paths: List[str] = dataclasses.field(default_factory=list)
     #: rules whose finding budget is ZERO — never baselined, never
     #: grandfathered; the pytest gate enforces each at 0 findings
     zero_rules: List[str] = dataclasses.field(default_factory=list)

@@ -1,0 +1,436 @@
+"""Bounded-exhaustive interleaving checker for the fleet lease protocol.
+
+The fleet chaos soak (``splatt chaos --fleet``) SIGKILLs one replica in
+one schedule per run — a sampled point in the interleaving space.  This
+harness enumerates the space: it drives the REAL lease state machine
+(:class:`splatt_tpu.fleet.FleetMember` — actual lease files, actual
+flock sidecars, actual ``acquire``/``renew``/``adopt``/``release``
+code) across 2–3 virtual replicas under a **virtual clock**, running
+every interleaving of fixed per-replica programs and asserting the
+protocol invariants after every step of every schedule:
+
+exactly-one-owner
+    At every instant, at most one replica both believes it holds the
+    job AND matches the published lease (replica and generation, not
+    expired).
+
+generation-fence monotonicity
+    The published ``gen`` never decreases; a takeover always bumps it,
+    so a stale owner's state can never compare equal to the current
+    lease again.
+
+no terminal append after expiry (the zombie-commit fence)
+    A terminal journal record may only be appended under a live lease
+    whose generation matches — modeled exactly like serve.py's
+    ``_run_job``: a last-gate :meth:`renew` immediately before the
+    append, abandon on refusal.  At most one terminal append per job.
+
+The clock is a schedule step (``tick``), not a race: lease expiry
+happens exactly when a schedule says it does, so the
+expire-mid-run/adopt/zombie-commit orderings the soak can only
+occasionally hit are all visited, every run.
+
+**Mutants** re-introduce the bug classes PR 11's review caught, and
+the checker must fail on them (tests/test_interleave.py pins this):
+
+- ``no_fence`` — the zombie-commit bug: commit whenever the replica
+  still *believes* it owns the job, skipping the last-gate renew.
+- ``no_gen_bump`` — adoption without the generation fence: the old
+  owner's renew matches the adopter's lease and revives it.
+
+Run ``python -m tools.splint.interleave [--replicas N] [--mutant M]``
+for the CLI form; the module API is :func:`check`.
+
+Unlike the static side of splint, this module imports ``splatt_tpu``
+(it executes the protocol, it does not parse it) — keep it out of the
+analyzer's import path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+JOB = "j1"
+LEASE_S = 10.0
+
+
+class VirtualClock:
+    """The schedule-controlled time source injected into every
+    :class:`FleetMember` — expiry becomes a deterministic step."""
+
+    def __init__(self, t0: float = 1_000.0):
+        self.t = t0
+
+    def time(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@dataclasses.dataclass
+class Violation:
+    """One invariant breach: which schedule, after which step."""
+
+    scenario: str
+    schedule: Tuple[str, ...]
+    step: str
+    invariant: str
+    detail: str
+
+    def __str__(self):
+        return (f"[{self.scenario}] after {self.step} in "
+                f"{' '.join(self.schedule)}: {self.invariant} — "
+                f"{self.detail}")
+
+
+@dataclasses.dataclass
+class CheckResult:
+    replicas: int
+    mutant: Optional[str]
+    scenarios: int
+    schedules: int
+    steps: int
+    violations: List[Violation]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def interleavings(programs: Dict[str, Sequence[str]]):
+    """Every merge of the per-actor op sequences that preserves each
+    actor's internal order — the bounded-exhaustive schedule set.
+    Yields tuples of ``"actor:op"`` steps."""
+    actors = sorted(programs)
+    counts = [len(programs[a]) for a in actors]
+
+    def gen(idx):
+        if all(i == c for i, c in zip(idx, counts)):
+            yield ()
+            return
+        for k, a in enumerate(actors):
+            if idx[k] < counts[k]:
+                step = f"{a}:{programs[a][idx[k]]}"
+                nxt = list(idx)
+                nxt[k] += 1
+                for rest in gen(tuple(nxt)):
+                    yield (step,) + rest
+
+    yield from gen(tuple(0 for _ in actors))
+
+
+class _Run:
+    """One schedule execution over a fresh spool root.
+
+    The model mirrors serve.py's claim/commit coupling exactly:
+
+    - a claim (acquire or adopt) that succeeds immediately re-reads
+      the shared journal; a terminal record found there means a peer
+      already finished the job — release and never run it
+      (serve._next's post-claim re-check).  The lease's total order
+      makes this airtight: the terminal append happens UNDER the
+      lease, before release, so it is always visible to the next
+      holder.
+    - a commit renews at the last gate before its terminal append and
+      abandons on refusal (serve._run_job's zombie-commit fence).
+    """
+
+    def __init__(self, root: str, actors: Dict[str, str],
+                 mutant: Optional[str]):
+        from splatt_tpu.fleet import FleetMember
+
+        self.clock = VirtualClock()
+        self.mutant = mutant
+        #: actor name -> replica id.  Distinct actors may SHARE a
+        #: replica id — that is the restarted-replica-under-a-pinned-
+        #: SPLATT_FLEET_REPLICA scenario the generation fence exists
+        #: for (a zombie twin's stale renew must never match the
+        #: restarted instance's lease).
+        self.actors = dict(actors)
+        self.members: Dict[str, object] = {
+            actor: FleetMember(root, replica=rid, lease_s=LEASE_S,
+                               heartbeat_s=LEASE_S,
+                               clock=self.clock.time)
+            for actor, rid in actors.items()}
+        #: terminal journal: (replica, gen) per append, in order
+        self.journal: List[Tuple[str, int]] = []
+        #: replicas that have SEEN a terminal record (their job table
+        #: says terminal; they never claim or commit again)
+        self.done: set = set()
+        #: replicas whose lease was adopted away from them — the gen
+        #: fence's contract is that their renew can NEVER succeed
+        #: again for that era (cleared by a fresh successful claim)
+        self.adopted_away: set = set()
+        #: (invariant, detail) breaches raised by the ops themselves
+        #: (drained by the schedule loop alongside the polled checks)
+        self.step_violations: List[Tuple[str, str]] = []
+        self.max_gen = 0
+
+    # - ops -
+
+    def op(self, actor: str, name: str) -> None:
+        if actor == "clock":
+            self.clock.advance(LEASE_S + 1.0 if name == "tick"
+                               else LEASE_S / 2.0)
+            return
+        if name == "acquire":
+            self._claim(actor, adopt=False)
+        elif name == "renew":
+            self._renew(actor)
+        elif name == "release":
+            self.members[actor].release(JOB)
+        elif name == "adopt":
+            self._claim(actor, adopt=True)
+        elif name == "commit":
+            self._commit(actor)
+        else:
+            raise ValueError(f"unknown op {name!r}")
+
+    def _claim(self, actor: str, adopt: bool) -> None:
+        """serve._next's claim: skip jobs known terminal, take the
+        lease through the real protocol, then re-read the shared
+        journal — a terminal record that landed before our claim means
+        the job is finished; release and remember."""
+        m = self.members[actor]
+        if actor in self.done:
+            return  # serve never queues/picks a terminal job
+        if adopt:
+            ok = m.adopt(JOB)
+            if ok:
+                # every OTHER actor's era on this job ended here: the
+                # gen fence must refuse their every later renew, even
+                # (especially) a zombie twin sharing our replica id
+                for other, om in self.members.items():
+                    if other != actor and JOB in dict(om._held):
+                        self.adopted_away.add(other)
+                if self.mutant == "no_gen_bump" and \
+                        JOB in dict(m._held):
+                    self._unbump_gen(m)
+        else:
+            ok = m.acquire(JOB)
+        if ok:
+            self.adopted_away.discard(actor)  # a fresh era
+        if ok and self.journal:
+            # the post-claim journal re-check (serve._next): the
+            # terminal append happened under the lease we now hold,
+            # so it is necessarily visible here
+            self.done.add(actor)
+            m.release(JOB)
+
+    def _commit(self, actor: str) -> None:
+        """serve._run_job's terminal-commit protocol: last-gate renew,
+        then the terminal journal append; abandon on refusal.  The
+        ``no_fence`` mutant is the PR 11 zombie-commit bug — append
+        whenever the replica still believes it owns the job."""
+        m = self.members[actor]
+        if actor in self.done:
+            return
+        held = dict(m._held).get(JOB)
+        if held is None:
+            return
+        if self.mutant != "no_fence":
+            if not self._renew(actor):
+                return  # fenced: ownership moved on, abandon
+            held = dict(m._held).get(JOB)
+        self.journal.append((m.replica, held.gen))
+        self.done.add(actor)
+        m.release(JOB)
+
+    def _renew(self, actor: str) -> bool:
+        """renew with the gen-fence contract checked: a renew that
+        SUCCEEDS for an actor whose lease was adopted away revives a
+        dead era — exactly what the adopt-time gen bump exists to make
+        impossible (the zombie twin sharing a restarted replica's
+        pinned id is the case the replica check alone cannot stop)."""
+        ok = self.members[actor].renew(JOB)
+        if ok and actor in self.adopted_away:
+            self.step_violations.append((
+                "gen-fence",
+                f"{actor}'s renew succeeded after its lease was "
+                f"adopted away — the takeover did not fence the old "
+                f"owner's generation"))
+        return ok
+
+    def _unbump_gen(self, m) -> None:
+        """The ``no_gen_bump`` mutant: republish the adopted lease at
+        the PREVIOUS generation (an adopt that forgot the fence), in
+        both the file and the adopter's belief."""
+        import dataclasses as dc
+
+        lease = m.lease_of(JOB)
+        if lease is None or lease.gen <= 1:
+            return
+        stale = dc.replace(lease, gen=lease.gen - 1)
+        m._write_lease(stale)
+        with m._lock:
+            if JOB in m._held:
+                m._held[JOB] = stale
+
+    # - invariants -
+
+    def believed_owners(self) -> List[str]:
+        """Replica IDS whose belief matches the published lease: held,
+        same replica, same gen, unexpired at the virtual now.  The
+        protocol's ownership unit is the replica id (two processes
+        under one pinned id are, to the protocol, one owner — the gen
+        fence distinguishes their ERAS, checked by :meth:`_renew`)."""
+        now = self.clock.time()
+        out = set()
+        for actor, m in sorted(self.members.items()):
+            held = dict(m._held).get(JOB)
+            if held is None:
+                continue
+            cur = m.lease_of(JOB)
+            if cur is not None and cur.replica == m.replica \
+                    and cur.gen == held.gen and not cur.expired(now):
+                out.add(m.replica)
+        return sorted(out)
+
+    def check_invariants(self) -> List[Tuple[str, str]]:
+        """(invariant, detail) breaches at the current instant."""
+        out = []
+        owners = self.believed_owners()
+        if len(owners) > 1:
+            out.append(("exactly-one-owner",
+                        f"two live matching owners: {owners}"))
+        any_m = next(iter(self.members.values()))
+        cur = any_m.lease_of(JOB)
+        if cur is not None:
+            if cur.gen < self.max_gen:
+                out.append(("gen-monotonic",
+                            f"published gen {cur.gen} < previously "
+                            f"seen {self.max_gen}"))
+            self.max_gen = max(self.max_gen, cur.gen)
+        if len(self.journal) > 1:
+            out.append(("single-terminal",
+                        f"{len(self.journal)} terminal appends: "
+                        f"{self.journal}"))
+        return out
+
+    def check_append_ownership(self) -> Optional[str]:
+        """Called right after a commit op: the newest terminal append
+        must have been made by the then-current lease holder.  With
+        the fence on this holds by construction; the zombie mutant
+        appends under a lease a peer already re-owns."""
+        if not self.journal:
+            return None
+        rid, gen = self.journal[-1]
+        if gen < self.max_gen:
+            return (f"terminal append by {rid} at gen {gen} after the "
+                    f"lease moved to gen {self.max_gen} (zombie "
+                    f"commit)")
+        return None
+
+
+# -- the scenario programs ---------------------------------------------------
+
+def _rid(actor: str) -> str:
+    """Actor -> replica id: a trailing digit marks a twin instance
+    sharing the base id (``A1``/``A2`` are two processes under the
+    pinned replica id ``A`` — the restart scenario)."""
+    return actor.rstrip("0123456789")
+
+
+def scenarios(replicas: int) -> Dict[str, Dict[str, Sequence[str]]]:
+    """Per-actor op programs whose interleavings cover the protocol's
+    hazard surface: contention, expiry+failover, renew-after-expiry,
+    release/reclaim, the restarted-replica zombie twin — and with
+    three replicas, chained adoption."""
+    base = {
+        "contention": {"A": ("acquire", "commit"),
+                       "B": ("acquire", "commit")},
+        "failover": {"A": ("acquire", "commit"),
+                     "B": ("adopt", "commit"),
+                     "clock": ("tick",)},
+        "renew-refusal": {"A": ("acquire", "renew", "commit"),
+                          "B": ("adopt",),
+                          "clock": ("tick",)},
+        "release-reclaim": {"A": ("acquire", "release"),
+                            "B": ("acquire", "commit"),
+                            "clock": ("half",)},
+        # the gen fence's home turf: A1 is a paused/zombie process, A2
+        # a restarted replica under the SAME pinned id; after B's
+        # adoption moved the lease on, A1's stale renew must never
+        # match again — even once A2 (same replica id!) re-adopts
+        "twin-revival": {"A1": ("acquire", "renew", "commit"),
+                         "A2": ("adopt",),
+                         "B": ("adopt",),
+                         "clock": ("tick", "tick")},
+    }
+    if replicas >= 3:
+        base["chained-adoption"] = {"A": ("acquire", "commit"),
+                                    "B": ("adopt", "commit"),
+                                    "C": ("adopt", "commit"),
+                                    "clock": ("tick", "tick")}
+    return base
+
+
+def check(replicas: int = 2, mutant: Optional[str] = None,
+          root: Optional[str] = None) -> CheckResult:
+    """Run every scenario's every interleaving; collect violations.
+    `mutant` in {None, "no_fence", "no_gen_bump"}."""
+    schedules = 0
+    steps = 0
+    violations: List[Violation] = []
+    scen = scenarios(replicas)
+    with tempfile.TemporaryDirectory(dir=root) as tmp:
+        for name, programs in sorted(scen.items()):
+            actors = {a: _rid(a) for a in programs if a != "clock"}
+            for i, sched in enumerate(interleavings(programs)):
+                schedules += 1
+                run = _Run(os.path.join(tmp, f"{name}-{i}"), actors,
+                           mutant)
+                for step in sched:
+                    steps += 1
+                    actor, op = step.split(":", 1)
+                    run.op(actor, op)
+                    raised = run.step_violations
+                    run.step_violations = []
+                    for inv, detail in raised + run.check_invariants():
+                        violations.append(Violation(
+                            name, sched, step, inv, detail))
+                    if op == "commit":
+                        zombie = run.check_append_ownership()
+                        if zombie:
+                            violations.append(Violation(
+                                name, sched, step,
+                                "no-append-after-expiry", zombie))
+    return CheckResult(replicas=replicas, mutant=mutant,
+                       scenarios=len(scen), schedules=schedules,
+                       steps=steps, violations=violations)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.splint.interleave",
+        description="bounded-exhaustive lease-protocol interleaving "
+                    "checker (docs/fleet.md)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="virtual replicas (2 or 3)")
+    ap.add_argument("--mutant", default=None,
+                    choices=["no_fence", "no_gen_bump"],
+                    help="re-introduce a known bug class; the checker "
+                         "must FAIL (exit 1) on it")
+    args = ap.parse_args(argv)
+    res = check(replicas=args.replicas, mutant=args.mutant)
+    print(f"interleave: {res.scenarios} scenario(s), "
+          f"{res.schedules} schedule(s), {res.steps} step(s), "
+          f"{len(res.violations)} violation(s)"
+          + (f" [mutant={res.mutant}]" if res.mutant else ""))
+    for v in res.violations[:10]:
+        print(f"  {v}")
+    if len(res.violations) > 10:
+        print(f"  ... {len(res.violations) - 10} more")
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,556 @@
+"""splint lock-set analysis — the engine under SPL014/SPL015/SPL017.
+
+PRs 6 and 11 turned this codebase into a genuinely concurrent system:
+worker threads, a heartbeat thread, flock + atomic-rename lease and
+journal protocols.  The review-stage bug class that kept surfacing
+(fsync under the server lock, the zombie-commit fence, the held-lease
+leak) is *lock discipline* — which structure is guarded by which lock,
+which locks nest in which order, and what may NOT happen while one is
+held.  This module derives that discipline statically:
+
+Lock discovery
+    A lock is (a) a module-level or ``self.``-attribute binding whose
+    initializer contains a ``threading.Lock/RLock/Condition/
+    Semaphore/BoundedSemaphore`` call (wrapping helpers like
+    ``lockcheck.guard_lock(threading.Lock())`` are seen through — the
+    factory call is found anywhere inside the assignment value), or
+    (b) a ``@contextlib.contextmanager`` method whose body calls
+    ``fcntl.flock`` (the flock-sidecar wrappers: ``FleetMember.
+    _locked``), or (c) an inline ``fcntl.flock(fd, LOCK_EX)`` call.
+    Canonical ids are file- and class-qualified
+    (``splatt_tpu/serve.py::Server._lock``,
+    ``splatt_tpu/fleet.py::FleetMember._locked()``,
+    ``...::flock@append_line``) so two classes' ``self._lock`` never
+    alias.
+
+Lock-set walk (:func:`lock_walk`)
+    A must-hold analysis over one function body: ``with lock:`` holds
+    for exactly the with-body (AST nesting is the ground truth —
+    no CFG approximation needed), ``lock.acquire()``/``release()``
+    holds between the calls within one statement sequence, and flock
+    LOCK_EX/LOCK_UN likewise.  Nested ``def``/``class`` bodies start
+    EMPTY (a closure runs later, not under the enclosing lock).
+    Acquire/release effects inside a branch do not escape the branch
+    (documented imprecision — a conditional release is treated as
+    balanced).
+
+Call summaries (:class:`ProjectLocks`)
+    Per-function "locks acquired somewhere inside" and "contains a
+    blocking verb", closed transitively over a deliberately
+    conservative call resolution: ``self.f()`` resolves within the
+    class, ``self.attr.f()`` resolves only when ``self.attr =
+    ClassName(...)`` is visible in the same file, ``module.f()``
+    through the import alias map, and bare ``f()`` within the file.
+    Unresolvable receivers (``self._queue.append``) contribute
+    nothing — a list's ``append`` must never inherit
+    ``Journal.append``'s fsync.
+
+SPL014 consumes the walk + the configured shared-state map; SPL015
+consumes the acquisition-order edges (project-wide cycle check);
+SPL017 consumes the blocking summaries on configured hot paths.  The
+known imprecision is documented in docs/static-analysis.md: aliases
+(``j = self._jobs[jid]``) are not tracked, containers hide their
+elements, and caller-holds-the-lock helpers are exempted by the
+``_locked``-suffix naming convention rather than interprocedural
+lock-context inference.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+
+#: call dotted-name tails that BLOCK the calling thread (SPL017's
+#: direct verbs); `.join`/`.wait` are handled shape-sensitively below
+_BLOCKING_FNS = {
+    "os.fsync": "fsync", "fcntl.flock": "flock", "time.sleep": "sleep",
+    "subprocess.run": "subprocess", "subprocess.Popen": "subprocess",
+    "subprocess.call": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "subprocess.check_output": "subprocess",
+}
+
+
+#: factories whose locks may be re-taken by the holding thread — a
+#: self-edge on these is not a deadlock
+_REENTRANT_FACTORIES = {"threading.RLock", "threading.Condition"}
+
+
+def _contains_lock_factory(ctx, expr) -> Optional[str]:
+    """The lock-factory dotted name found anywhere inside `expr`
+    (``lockcheck.guard_lock(threading.Lock())`` is seen through), or
+    None when the expression builds no lock."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and \
+                (ctx.resolve(n.func) or "") in _LOCK_FACTORIES:
+            return ctx.resolve(n.func)
+    return None
+
+
+def _is_flock_call(ctx, call) -> Optional[str]:
+    """'acquire'/'release' when `call` is an ``fcntl.flock`` with a
+    recognizable LOCK_EX/LOCK_SH vs LOCK_UN flag, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    if (ctx.resolve(call.func) or "") != "fcntl.flock":
+        return None
+    if len(call.args) < 2:
+        return None
+    names = {getattr(n, "attr", getattr(n, "id", None))
+             for n in ast.walk(call.args[1])}
+    if "LOCK_UN" in names:
+        return "release"
+    return "acquire"
+
+
+def iter_scope_functions(tree):
+    """Yield ``(fn, class_name)`` for every module-level function and
+    class method (class_name None for module level).  Function-nested
+    defs are reached by :func:`lock_walk`'s own recursion."""
+    def visit(body, cls):
+        for s in body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield s, cls
+            elif isinstance(s, ast.ClassDef):
+                yield from visit(s.body, s.name)
+
+    yield from visit(tree.body, None)
+
+
+class FileLocks:
+    """Lock discovery for one analyzed file (see module docstring)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        #: module-global lock name -> canonical id
+        self.module_locks: Dict[str, str] = {}
+        #: (class, attr) -> canonical id for ``self.attr`` locks
+        self.attr_locks: Dict[Tuple[str, str], str] = {}
+        #: (class, fname) -> canonical id for flock-wrapper
+        #: contextmanager methods
+        self.flock_wrappers: Dict[Tuple[Optional[str], str], str] = {}
+        #: (class, attr) -> ClassName for ``self.attr = ClassName(...)``
+        #: bindings (call-summary receiver resolution)
+        self.attr_classes: Dict[Tuple[str, str], str] = {}
+        #: canonical ids built from a re-entrant factory (RLock,
+        #: Condition) — a self-edge on these is legal
+        self.reentrant: set = set()
+        rel = ctx.relpath
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                factory = _contains_lock_factory(ctx, node.value)
+                if factory is not None:
+                    name = node.targets[0].id
+                    self.module_locks[name] = f"{rel}::{name}"
+                    if factory in _REENTRANT_FACTORIES:
+                        self.reentrant.add(f"{rel}::{name}")
+        for fn, cls in iter_scope_functions(ctx.tree):
+            if self._is_flock_wrapper(fn):
+                tag = f"{cls}.{fn.name}()" if cls else f"{fn.name}()"
+                self.flock_wrappers[(cls, fn.name)] = f"{rel}::{tag}"
+            if cls is None:
+                continue
+            for s in ast.walk(fn):
+                if not (isinstance(s, ast.Assign) and len(s.targets) == 1):
+                    continue
+                t = s.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                factory = _contains_lock_factory(ctx, s.value)
+                if factory is not None:
+                    self.attr_locks[(cls, t.attr)] = \
+                        f"{rel}::{cls}.{t.attr}"
+                    if factory in _REENTRANT_FACTORIES:
+                        self.reentrant.add(f"{rel}::{cls}.{t.attr}")
+                elif isinstance(s.value, ast.Call):
+                    dotted = ctx.resolve(s.value.func) or ""
+                    tail = dotted.split(".")[-1]
+                    if tail and tail[:1].isupper():
+                        self.attr_classes[(cls, t.attr)] = tail
+
+    def _is_flock_wrapper(self, fn) -> bool:
+        decorated = any("contextmanager" in ast.dump(d)
+                        for d in fn.decorator_list)
+        if not decorated:
+            return False
+        return any(_is_flock_call(self.ctx, n) == "acquire"
+                   for n in ast.walk(fn))
+
+    def lock_of(self, expr, cls: Optional[str]) -> Optional[str]:
+        """Canonical lock id of a with-item / acquire-receiver
+        expression, or None when it is not a known lock."""
+        if isinstance(expr, ast.Name):
+            return self.module_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and cls is not None:
+            return self.attr_locks.get((cls, expr.attr))
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                return self.flock_wrappers.get((cls, f.attr)) \
+                    or (self.flock_wrappers.get((None, f.attr))
+                        if cls is None else None)
+            if isinstance(f, ast.Name):
+                return self.flock_wrappers.get((None, f.id))
+        return None
+
+
+def is_flock_id(lock_id: str) -> bool:
+    """Whether a canonical id names an inter-process flock (these are
+    excluded from SPL017's "in-process lock held" precondition)."""
+    return lock_id.endswith("()") or "flock@" in lock_id
+
+
+class LockWalkResult:
+    def __init__(self):
+        #: id(ast stmt) -> frozenset of held lock ids BEFORE the stmt
+        #: executes its own acquisitions
+        self.held_at: Dict[int, frozenset] = {}
+        #: (lock_id, line, held-before frozenset) per acquisition site
+        self.acquisitions: List[Tuple[str, int, frozenset]] = []
+
+
+def lock_walk(ctx, fn, cls: Optional[str], locks: FileLocks,
+              on_nested: Optional[Callable] = None) -> LockWalkResult:
+    """Must-hold lock sets over `fn`'s body (module docstring).  With
+    `on_nested`, nested function defs are reported (and NOT descended
+    into) instead of walked with an empty held set."""
+    res = LockWalkResult()
+
+    def acquire_from_stmt(stmt) -> Optional[Tuple[str, str]]:
+        """(verb, lock_id) for ``x.acquire()``/``x.release()`` or an
+        inline flock statement, else None."""
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)):
+            return None
+        call = stmt.value
+        fl = _is_flock_call(ctx, call)
+        if fl is not None:
+            return fl, f"{ctx.relpath}::flock@{fn.name}"
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire",
+                                                       "release"):
+            lid = locks.lock_of(f.value, cls)
+            if lid is not None:
+                return f.attr, lid
+        return None
+
+    def walk(body, held: Set[str]):
+        held = set(held)
+        for stmt in body:
+            res.held_at[id(stmt)] = frozenset(held)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                if on_nested is not None:
+                    on_nested(stmt, frozenset(held))
+                elif isinstance(stmt, ast.ClassDef):
+                    walk(stmt.body, set())
+                else:
+                    walk(stmt.body, set())  # a closure runs later
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                entered = []
+                for item in stmt.items:
+                    lid = locks.lock_of(item.context_expr, cls)
+                    if lid is not None:
+                        res.acquisitions.append(
+                            (lid, stmt.lineno, frozenset(held)))
+                        held.add(lid)
+                        entered.append(lid)
+                walk(stmt.body, held)
+                for lid in entered:
+                    held.discard(lid)
+                continue
+            if isinstance(stmt, ast.If):
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                walk(stmt.body, held)
+                walk(stmt.orelse, held)
+                continue
+            if isinstance(stmt, ast.Try):
+                walk(stmt.body, held)
+                for h in stmt.handlers:
+                    walk(h.body, held)
+                walk(stmt.orelse, held)
+                walk(stmt.finalbody, held)
+                continue
+            verb = acquire_from_stmt(stmt)
+            if verb is not None:
+                kind, lid = verb
+                if kind == "acquire":
+                    res.acquisitions.append(
+                        (lid, stmt.lineno, frozenset(held)))
+                    held.add(lid)
+                else:
+                    held.discard(lid)
+    walk(fn.body, set())
+    return res
+
+
+# -- project-wide summaries (SPL015 edges, SPL017 blocking) ------------------
+
+def _blocking_verb(ctx, call) -> Optional[str]:
+    """The blocking-verb label of one direct call, or None.  ``.join``
+    is flagged only in the thread-join shape (no args, or a single
+    numeric/keyword timeout) so ``", ".join(parts)`` never matches;
+    ``.wait`` only as a bare attribute call (Event/Condition wait)."""
+    dotted = ctx.resolve(call.func) or ""
+    if dotted in _BLOCKING_FNS:
+        return _BLOCKING_FNS[dotted]
+    if dotted.split(".")[0] == "subprocess":
+        return "subprocess"
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "join":
+        if not call.args and not call.keywords:
+            return "join"
+        if len(call.args) == 1 and not call.keywords and \
+                isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, (int, float)):
+            return "join"
+        if not call.args and all(k.arg == "timeout"
+                                 for k in call.keywords):
+            return "join"
+        return None
+    if isinstance(f, ast.Attribute) and f.attr == "wait":
+        return "wait"
+    return None
+
+
+class ProjectLocks:
+    """Cross-file lock model: per-file discovery, per-function
+    acquisition/blocking summaries closed over conservative call
+    resolution, and the project-wide lock acquisition graph."""
+
+    def __init__(self, project):
+        self.project = project
+        self.files: Dict[str, FileLocks] = {}
+        #: function key -> set of lock ids acquired anywhere inside
+        self._acquires: Dict[str, Set[str]] = {}
+        #: function key -> set of blocking verbs anywhere inside
+        self._blocks: Dict[str, Set[str]] = {}
+        #: function key -> list of callee keys (resolved)
+        self._calls: Dict[str, List[str]] = {}
+        #: function key -> (ctx, fn, cls)
+        self.functions: Dict[str, Tuple[object, object, Optional[str]]] = {}
+        for ctx in project.files:
+            self.files[ctx.relpath] = FileLocks(ctx)
+        for ctx in project.files:
+            for fn, cls in iter_scope_functions(ctx.tree):
+                self._summarize(ctx, fn, cls)
+        self._close()
+
+    @staticmethod
+    def key(relpath: str, cls: Optional[str], name: str) -> str:
+        return f"{relpath}::{cls + '.' if cls else ''}{name}"
+
+    def _summarize(self, ctx, fn, cls) -> None:
+        fl = self.files[ctx.relpath]
+        key = self.key(ctx.relpath, cls, fn.name)
+        self.functions[key] = (ctx, fn, cls)
+        acq: Set[str] = set()
+        blocks: Set[str] = set()
+        callees: List[str] = []
+        walk = lock_walk(ctx, fn, cls, fl)
+        for lid, _line, _held in walk.acquisitions:
+            acq.add(lid)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            verb = _blocking_verb(ctx, node)
+            if verb is not None:
+                blocks.add(verb)
+            callees.extend(self._resolve_call(ctx, fl, cls, node))
+        # entering a flock-wrapper contextmanager IS a flock
+        for lid in acq:
+            if is_flock_id(lid):
+                blocks.add("flock")
+        self._acquires[key] = acq
+        self._blocks[key] = blocks
+        self._calls[key] = callees
+
+    def _resolve_call(self, ctx, fl: FileLocks, cls, call) -> List[str]:
+        """Callee keys of one call — deliberately conservative (see
+        module docstring); unresolvable receivers contribute nothing."""
+        f = call.func
+        rel = ctx.relpath
+        out = []
+        if isinstance(f, ast.Name):
+            # bare name: a function in this file (module level or the
+            # alias map's import target)
+            dotted = ctx.resolve(f) or f.id
+            if "." in dotted:
+                out.extend(self._module_fn(dotted))
+            else:
+                key = self.key(rel, None, f.id)
+                if key in self._calls or self._defined(rel, None, f.id):
+                    out.append(key)
+        elif isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and cls is not None:
+                out.append(self.key(rel, cls, f.attr))
+            elif isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and cls is not None:
+                # self.attr.f(): resolve attr's class when the file
+                # binds self.attr = ClassName(...)
+                owner = fl.attr_classes.get((cls, base.attr))
+                if owner is not None:
+                    for frel, fls in self.files.items():
+                        if self._defined(frel, owner, f.attr):
+                            out.append(self.key(frel, owner, f.attr))
+            elif isinstance(base, ast.Name):
+                dotted = ctx.resolve(f) or ""
+                if dotted:
+                    out.extend(self._module_fn(dotted))
+        return out
+
+    def _defined(self, rel: str, cls: Optional[str], name: str) -> bool:
+        ctx = self.files.get(rel)
+        if ctx is None:
+            return False
+        fctx = next((c for c in self.project.files if c.relpath == rel),
+                    None)
+        if fctx is None:
+            return False
+        return any(fn.name == name and fcls == cls
+                   for fn, fcls in iter_scope_functions(fctx.tree))
+
+    def _module_fn(self, dotted: str) -> List[str]:
+        """Keys for a module-qualified call (``trace.metric_inc``,
+        ``splatt_tpu.utils.durable.append_line``): match analyzed files
+        whose module path ends with the dotted prefix."""
+        parts = dotted.split(".")
+        name = parts[-1]
+        modpath = "/".join(parts[:-1])
+        out = []
+        for rel in self.files:
+            stem = rel[:-3] if rel.endswith(".py") else rel
+            if stem.endswith(modpath) and self._defined(rel, None, name):
+                out.append(self.key(rel, None, name))
+        return out
+
+    def _close(self) -> None:
+        """Transitive closure of acquisition/blocking summaries over
+        the call graph (fixpoint; recursion-safe)."""
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in self._calls.items():
+                for callee in callees:
+                    if callee == key:
+                        continue
+                    extra_a = self._acquires.get(callee, set()) \
+                        - self._acquires[key]
+                    extra_b = self._blocks.get(callee, set()) \
+                        - self._blocks[key]
+                    if extra_a:
+                        self._acquires[key] |= extra_a
+                        changed = True
+                    if extra_b:
+                        self._blocks[key] |= extra_b
+                        changed = True
+
+    def acquires(self, key: str) -> Set[str]:
+        return self._acquires.get(key, set())
+
+    def blocks(self, key: str) -> Set[str]:
+        return self._blocks.get(key, set())
+
+    def call_targets(self, ctx, cls, call) -> List[str]:
+        return self._resolve_call(ctx, self.files[ctx.relpath], cls, call)
+
+    # -- the project-wide lock acquisition graph (SPL015) --------------------
+
+    def order_edges(self) -> Dict[Tuple[str, str], Tuple[str, int]]:
+        """(held, acquired) -> (relpath, line) of one witness site.
+        Direct edges come from acquisition sites with a non-empty held
+        set; interprocedural edges from call sites under a held lock to
+        every lock in the callee's transitive acquisition summary.
+        Memoized — SPL015 needs it twice (witness sites + the cycle
+        search) and the underlying lock walks are the dominant cost of
+        the perf-gated full-tree run."""
+        if getattr(self, "_order_edges", None) is not None:
+            return self._order_edges
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        reentrant = set()
+        for fl in self.files.values():
+            reentrant |= fl.reentrant
+
+        def add(a: str, b: str, rel: str, line: int):
+            # a self-edge on a NON-reentrant lock is the degenerate
+            # deadlock (the thread waits on itself); re-entrant locks
+            # may legally nest under themselves
+            if a == b and b in reentrant:
+                return
+            if (a, b) not in edges:
+                edges[(a, b)] = (rel, line)
+
+        for key, (ctx, fn, cls) in self.functions.items():
+            fl = self.files[ctx.relpath]
+            walk = lock_walk(ctx, fn, cls, fl)
+            for lid, line, held in walk.acquisitions:
+                for h in held:
+                    add(h, lid, ctx.relpath, line)
+            # call sites under a held lock
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                held = walk.held_at.get(id(stmt))
+                if not held:
+                    continue
+                for call in ast.walk(stmt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    for callee in self._resolve_call(
+                            ctx, fl, cls, call):
+                        for lid in self._acquires.get(callee, set()):
+                            for h in held:
+                                add(h, lid, ctx.relpath,
+                                    getattr(call, "lineno", fn.lineno))
+        self._order_edges = edges
+        return edges
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the acquisition graph (including
+        self-loops from re-acquiring a non-reentrant lock under
+        itself), shortest first."""
+        edges = self.order_edges()
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        out: List[List[str]] = []
+        seen: Set[frozenset] = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ())):
+                    if nxt == start:
+                        key = frozenset(path)
+                        if key not in seen:
+                            seen.add(key)
+                            out.append(path + [start])
+                    elif nxt not in path and len(path) < 6:
+                        stack.append((nxt, path + [nxt]))
+        out.sort(key=len)
+        return out
+
+
+def project_locks(project) -> ProjectLocks:
+    """The (cached per run) cross-file lock model."""
+    if getattr(project, "_locks", None) is None:
+        project._locks = ProjectLocks(project)
+    return project._locks

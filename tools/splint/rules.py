@@ -1280,6 +1280,540 @@ class SpanNameDrift(Rule):
         return out
 
 
+# -- SPL014 -----------------------------------------------------------------
+
+#: method names that mutate a container in place (the write verbs the
+#: shared-state rule guards, alongside subscript/attribute stores)
+_CONTAINER_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "appendleft", "popleft",
+    "sort", "reverse",
+}
+
+
+def _parse_shared_state(entries) -> Dict[str, List[Tuple[str, str]]]:
+    """Config entries ``relpath::target=lock`` → {relpath: [(target,
+    lock)]}; malformed entries raise (a typo'd map must fail loudly,
+    not silently unguard a structure)."""
+    out: Dict[str, List[Tuple[str, str]]] = {}
+    for entry in entries:
+        try:
+            loc, lock = entry.split("=", 1)
+            rel, target = loc.split("::", 1)
+        except ValueError:
+            raise ValueError(
+                f"splint: bad shared-state entry {entry!r} (want "
+                f"'relpath::target=lock')")
+        out.setdefault(rel, []).append((target.strip(), lock.strip()))
+    return out
+
+
+def _struct_root(expr) -> object:
+    """The root object being stored into: peel subscripts off an
+    assignment target (``self._jobs[jid]["state"]`` → the
+    ``self._jobs`` attribute node)."""
+    while isinstance(expr, ast.Subscript):
+        expr = expr.value
+    return expr
+
+
+def _matches_target(expr, target: str) -> bool:
+    """Whether an expression names the configured structure: a bare
+    ``NAME`` for module globals, ``self.attr`` for instance state."""
+    if target.startswith("self."):
+        return (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr == target[5:])
+    return isinstance(expr, ast.Name) and expr.id == target
+
+
+def _required_lock(rel: str, cls: Optional[str], lock: str) -> str:
+    """The canonical id the configured guard spelling must resolve to
+    at a mutation site inside class `cls`."""
+    if lock.startswith("self."):
+        return f"{rel}::{cls}.{lock[5:]}"
+    return f"{rel}::{lock}"
+
+
+class SharedStateWithoutLock(Rule):
+    """A write to a declared shared structure without its owning lock
+    held.  The ``[tool.splint] shared-state`` map records which lock
+    guards which structure (the Server job table and queue, the fleet
+    lease maps, tune's plan memo, trace's span/metric registries); the
+    lock-set analysis (tools/splint/locks.py) proves each mutation
+    site holds it.  Functions whose name ends in ``_locked`` are the
+    caller-owns-the-lock convention and are exempt, as is ``__init__``
+    (the object is not yet shared).  Known imprecision: aliases
+    (``j = self._jobs[jid]``) and container elements are not tracked —
+    the SPLATT_LOCKCHECK runtime sanitizer is the dynamic
+    cross-check."""
+
+    id = "SPL014"
+    title = "shared-state write without the owning lock"
+    hint = ("take the configured guard lock around the mutation (or "
+            "move it into a '*_locked' helper whose callers hold it); "
+            "the [tool.splint] shared-state map names the owner")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        from tools.splint.locks import (FileLocks, iter_scope_functions,
+                                        lock_walk)
+
+        entries = _parse_shared_state(
+            project.config.shared_state).get(ctx.relpath)
+        if not entries:
+            return []
+        fl = FileLocks(ctx)
+        out: List[Finding] = []
+
+        def scan(fn, cls):
+            if fn.name == "__init__" or fn.name.endswith("_locked"):
+                return
+            nested: List[Tuple[object, object]] = []
+            walk = lock_walk(ctx, fn, cls, fl,
+                             on_nested=lambda sub, held:
+                             nested.append((sub, cls)))
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                held = walk.held_at.get(id(stmt))
+                if held is None:
+                    continue  # nested-def body: scanned on its own
+                for target, lock, line in self._mutations(stmt, entries):
+                    need = _required_lock(ctx.relpath, cls, lock)
+                    if need not in held:
+                        out.append(self.finding(
+                            ctx, line,
+                            f"write to shared '{target}' without "
+                            f"holding its owning lock '{lock}' "
+                            f"(declared in [tool.splint] "
+                            f"shared-state)"))
+            for sub, subcls in nested:
+                scan(sub, subcls)
+
+        for fn, cls in iter_scope_functions(ctx.tree):
+            scan(fn, cls)
+        return _dedupe(out)
+
+    @staticmethod
+    def _mutations(stmt, entries) -> List[Tuple[str, str, int]]:
+        """(target, lock, line) for each configured-structure write in
+        ONE statement.  Simple statements are scanned whole (a mutator
+        call anywhere in them — ``jid = self._queue.pop(0)``, a return
+        value, a boolean test — is still a mutation); compound
+        statements contribute only their HEADER expressions, because
+        their bodies are separate statements the caller visits with
+        their own (possibly larger) lock sets."""
+        out = []
+
+        def hit(expr, line):
+            for target, lock in entries:
+                if _matches_target(expr, target):
+                    out.append((target, lock, line))
+
+        def scan_calls(root, line):
+            for call in ast.walk(root):
+                if isinstance(call, ast.Call) and \
+                        isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in _CONTAINER_MUTATORS:
+                    hit(_struct_root(call.func.value), line)
+
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for t in targets:
+                root = _struct_root(t)
+                if isinstance(t, ast.Subscript):
+                    hit(root, stmt.lineno)      # X[k] = ... mutates X
+                elif isinstance(stmt, ast.AugAssign):
+                    hit(root, stmt.lineno)      # X += ... rebinds X
+                else:
+                    # a direct rebind swaps the shared object under
+                    # concurrent readers — same owner, same lock
+                    hit(root, stmt.lineno)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    hit(_struct_root(t), stmt.lineno)
+        if isinstance(stmt, (ast.If, ast.While)):
+            scan_calls(stmt.test, stmt.lineno)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            scan_calls(stmt.iter, stmt.lineno)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                scan_calls(item.context_expr, stmt.lineno)
+        elif isinstance(stmt, ast.Try):
+            pass  # no header expression of its own
+        elif not isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.ClassDef)):
+            scan_calls(stmt, stmt.lineno)
+        return out
+
+
+# -- SPL015 -----------------------------------------------------------------
+
+class LockOrderCycle(Rule):
+    """A cycle in the project-wide lock acquisition graph: somewhere
+    lock A is taken while B is held and somewhere else B while A is
+    held — two threads walking the two sites deadlock.  Edges come
+    from the lock-set analysis: direct nesting (``with a: with b:``,
+    including flock sidecars entered via contextmanager wrappers) and
+    call sites under a held lock, resolved through the conservative
+    call summaries of tools/splint/locks.py.  A self-loop — taking a
+    non-reentrant lock while already holding it — is the degenerate
+    cycle and deadlocks a single thread.  The in-process-lock-before-
+    flock nesting of the cache/journal writers and the flock-before-
+    in-process nesting of the fleet lease protocol stay consistent
+    exactly because this graph is kept acyclic."""
+
+    id = "SPL015"
+    title = "lock-order cycle in the acquisition graph"
+    hint = ("pick ONE global order for the locks in the cycle and "
+            "re-nest the offending site (usually: move the inner "
+            "acquisition out of the outer lock's critical section)")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        from tools.splint.locks import project_locks
+
+        pl = project_locks(project)
+        edges = pl.order_edges()
+        out: List[Finding] = []
+        for cycle in pl.cycles():
+            pairs = list(zip(cycle, cycle[1:]))
+            rel, line = edges[pairs[0]]
+            path = " -> ".join(c.split("::", 1)[-1] for c in cycle)
+            sites = "; ".join(
+                f"{edges[p][0]}:{edges[p][1]} takes "
+                f"{p[1].split('::', 1)[-1]} under "
+                f"{p[0].split('::', 1)[-1]}" for p in pairs)
+            out.append(self.finding(
+                rel, line,
+                f"lock-order cycle {path} ({sites})"))
+        return out
+
+
+# -- SPL016 -----------------------------------------------------------------
+
+_WRITE_MODES = {"w", "wb", "x", "xb", "w+", "wb+", "w+b"}
+_APPEND_MODES = {"a", "ab", "a+", "ab+", "a+b"}
+_TMP_WRITERS = {"numpy.savez", "numpy.savez_compressed", "numpy.save"}
+
+
+class DurabilityProtocolDrift(Rule):
+    """A durable-write protocol verb outside the sanctioned helpers
+    (splatt_tpu/utils/durable.py; ``[tool.splint]``
+    durable-write-helpers): an ``os.fsync``, a tmp-write→``os.replace``
+    publish (an ``os.replace`` whose source this function itself wrote
+    — claim/.bak renames of existing files are a different verb and
+    stay clean), or a written append-mode ``open``.  Every journal
+    line, lease, checkpoint, cache file and metrics snapshot must go
+    through the one helper so the fsync/heal/atomic-rename discipline
+    cannot drift per call site — the hand-rolled copies this rule
+    replaced disagreed about fsync."""
+
+    id = "SPL016"
+    title = "durable write outside the sanctioned durable-write helpers"
+    hint = ("route the write through splatt_tpu.utils.durable "
+            "(publish_bytes/publish_json/publish_file for atomic "
+            "publishes, append_line for durable appends)")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        helpers = set(project.config.durable_write_helpers)
+        if not helpers:
+            return []
+        out: List[Finding] = []
+        for fn in _all_functions(ctx.tree):
+            if fn.name in helpers:
+                continue
+            out.extend(self._scan_fn(ctx, fn))
+        return _dedupe(out)
+
+    def _scan_fn(self, ctx, fn) -> List[Finding]:
+        out: List[Finding] = []
+        written: Set[str] = set()   # names holding a locally-written tmp
+        appended: Dict[str, int] = {}  # append-mode file object names
+        wrote_to: Set[str] = set()
+
+        def mode_of(call) -> Optional[str]:
+            if len(call.args) > 1 and isinstance(call.args[1],
+                                                 ast.Constant):
+                return str(call.args[1].value)
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    return str(kw.value.value)
+            return None
+
+        body = [s for s in _body_stmts(fn)
+                if not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef))]
+        for s in body:
+            for call in ast.walk(s):
+                if not isinstance(call, ast.Call):
+                    continue
+                dotted = ctx.resolve(call.func) or ""
+                # (a) fsync is the durability verb itself
+                if dotted == "os.fsync":
+                    out.append(self.finding(
+                        ctx, call.lineno,
+                        "os.fsync outside the sanctioned durable-write "
+                        "helpers"))
+                # track written-tmp names
+                if dotted == "open" and call.args:
+                    mode = mode_of(call)
+                    argnames = {n.id for n in ast.walk(call.args[0])
+                                if isinstance(n, ast.Name)}
+                    if mode in _WRITE_MODES:
+                        written.update(argnames)
+                    elif mode in _APPEND_MODES:
+                        for name in argnames:
+                            appended[name] = call.lineno
+                if dotted in _TMP_WRITERS and call.args:
+                    written.update(n.id for n in ast.walk(call.args[0])
+                                   if isinstance(n, ast.Name))
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in ("write_text", "write_bytes") \
+                        and isinstance(call.func.value, ast.Name):
+                    written.add(call.func.value.id)
+            if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+                vdot = (ctx.resolve(s.value.func) or "")
+                if vdot.split(".")[-1] == "mkstemp":
+                    # fd, tmp = tempfile.mkstemp(...): the tmp path is
+                    # a locally-written temp by construction
+                    for t in s.targets:
+                        written.update(n.id for n in ast.walk(t)
+                                       if isinstance(n, ast.Name)
+                                       and isinstance(n.ctx, ast.Store))
+        # which bound file objects actually got .write()?
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "write" and \
+                    isinstance(node.func.value, ast.Name):
+                wrote_to.add(node.func.value.id)
+        # with open(p, "ab") as f: ... f.write(...) — map the file
+        # object back to the opened path name
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                cexpr = item.context_expr
+                if not (isinstance(cexpr, ast.Call)
+                        and (ctx.resolve(cexpr.func) or "") == "open"
+                        and cexpr.args):
+                    continue
+                mode = None
+                if len(cexpr.args) > 1 and isinstance(cexpr.args[1],
+                                                      ast.Constant):
+                    mode = str(cexpr.args[1].value)
+                if mode in _APPEND_MODES and item.optional_vars is not None:
+                    fname = getattr(item.optional_vars, "id", None)
+                    if fname in wrote_to:
+                        out.append(self.finding(
+                            ctx, cexpr.lineno,
+                            "hand-rolled durable append (append-mode "
+                            "open + write) outside the sanctioned "
+                            "helpers"))
+        # (b) publishing a locally-written tmp by rename
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.resolve(node.func) or ""
+            src = None
+            if dotted in ("os.replace", "os.rename", "shutil.move") \
+                    and node.args:
+                src = node.args[0]
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("replace", "rename") and \
+                    isinstance(node.func.value, ast.Name) and node.args:
+                src = node.func.value
+            if src is None:
+                continue
+            names = {n.id for n in ast.walk(src)
+                     if isinstance(n, ast.Name)}
+            if names & written:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    "hand-rolled tmp-write -> rename publish outside "
+                    "the sanctioned durable-write helpers"))
+        return out
+
+
+# -- SPL017 -----------------------------------------------------------------
+
+class BlockingCallUnderLock(Rule):
+    """A blocking call — fsync, flock, sleep, a thread join, an Event
+    wait, a subprocess — made while an in-process lock is held, on a
+    configured control-plane hot path ([tool.splint] hot-lock-paths).
+    Every status poll, submission and worker dequeue serializes on
+    these locks: one fsync inside the critical section stalls the
+    whole daemon's control plane (the PR 11 submit fix — decide under
+    the lock, do the durable IO outside it — made permanent).  Calls
+    are checked transitively through the conservative call summaries,
+    so ``self.journal.append(...)`` under the server lock is caught
+    even though the fsync is two frames down."""
+
+    id = "SPL017"
+    title = "blocking call while holding an in-process lock (hot path)"
+    hint = ("decide under the lock, perform the blocking IO outside "
+            "it (serve.submit's ACCEPTING-reservation pattern), or "
+            "drop the path from hot-lock-paths with a justification")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        from tools.splint.locks import (_blocking_verb, is_flock_id,
+                                        lock_walk, project_locks)
+
+        hot = set(project.config.hot_lock_paths)
+        if not hot:
+            return []
+        pl = project_locks(project)
+        out: List[Finding] = []
+        for key, (ctx, fn, cls) in pl.functions.items():
+            if f"{ctx.relpath}::{fn.name}" not in hot:
+                continue
+            fl = pl.files[ctx.relpath]
+            walk = lock_walk(ctx, fn, cls, fl)
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                held = walk.held_at.get(id(stmt))
+                if held is None:
+                    continue
+                held = {h for h in held if not is_flock_id(h)}
+                if not held:
+                    continue
+                for call in ast.walk(stmt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    verb = _blocking_verb(ctx, call)
+                    via = None
+                    if verb is None:
+                        for callee in pl.call_targets(ctx, cls, call):
+                            blocked = pl.blocks(callee)
+                            if blocked:
+                                verb = sorted(blocked)[0]
+                                via = callee.split("::", 1)[-1]
+                                break
+                    if verb is None:
+                        continue
+                    lock = sorted(held)[0].split("::", 1)[-1]
+                    how = f" (via {via})" if via else ""
+                    out.append(self.finding(
+                        ctx, call.lineno,
+                        f"blocking {verb}{how} while holding "
+                        f"'{lock}' on hot path '{fn.name}' — the "
+                        f"control plane stalls behind it"))
+        return _dedupe(out)
+
+
+# -- SPL018 -----------------------------------------------------------------
+
+class ContextvarLeak(Rule):
+    """A ``ContextVar.set`` whose reset is not crash-safe: the token is
+    discarded, or the matching ``reset(token)`` is not inside the
+    ``finally`` of the try that immediately guards the scoped region.
+    The per-job isolation machinery (``resilience.scope``,
+    ``faults.scoped``, trace's ``enabling``) all stack per-tenant
+    state in contextvars — a set that an exception can strand leaks
+    one tenant's demotions, fault schedule or trace toggle into the
+    next job that reuses the context.  The sanctioned idiom::
+
+        token = VAR.set(value)
+        try:
+            ...
+        finally:
+            VAR.reset(token)
+
+    ``__enter__``/``__exit__`` method bodies are exempt (the pairing
+    spans two functions — trace's span-stack push/pop — which this
+    single-function analysis cannot see; documented imprecision)."""
+
+    id = "SPL018"
+    title = "ContextVar.set without a try/finally reset"
+    hint = ("bind the token and reset it in the finally of the very "
+            "next try block (resilience.scope is the exemplar); for "
+            "__enter__/__exit__ pairs keep the reset in __exit__")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        ctxvars: Set[str] = set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and (ctx.resolve(node.value.func) or "") \
+                    == "contextvars.ContextVar":
+                ctxvars.add(node.targets[0].id)
+        if not ctxvars:
+            return []
+        out: List[Finding] = []
+        for fn in _all_functions(ctx.tree):
+            if fn.name in ("__enter__", "__exit__"):
+                continue
+            self._scan_body(ctx, fn.body, ctxvars, out)
+        return _dedupe(out)
+
+    def _is_set(self, ctx, expr, ctxvars) -> Optional[str]:
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "set" and \
+                isinstance(expr.func.value, ast.Name) and \
+                expr.func.value.id in ctxvars:
+            return expr.func.value.id
+        return None
+
+    def _scan_body(self, ctx, body, ctxvars, out) -> None:
+        for i, stmt in enumerate(body):
+            # recurse into nested blocks
+            for attr in ("body", "orelse", "finalbody"):
+                nested = getattr(stmt, attr, None)
+                if isinstance(nested, list) and not isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                    self._scan_body(ctx, nested, ctxvars, out)
+            for h in getattr(stmt, "handlers", []):
+                self._scan_body(ctx, h.body, ctxvars, out)
+            # a bare set expression discards the token outright
+            if isinstance(stmt, ast.Expr):
+                var = self._is_set(ctx, stmt.value, ctxvars)
+                if var is not None:
+                    out.append(self.finding(
+                        ctx, stmt.lineno,
+                        f"{var}.set(...) discards its reset token — "
+                        f"the previous context value is "
+                        f"unrestorable"))
+                continue
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            var = self._is_set(ctx, stmt.value, ctxvars)
+            if var is None:
+                continue
+            token = stmt.targets[0].id
+            nxt = body[i + 1] if i + 1 < len(body) else None
+            if not (isinstance(nxt, ast.Try)
+                    and self._resets(nxt.finalbody, var, token)):
+                out.append(self.finding(
+                    ctx, stmt.lineno,
+                    f"{var}.set(...) is not guarded by an immediate "
+                    f"try/finally {var}.reset({token}) — an exception "
+                    f"here leaks the scoped state into the next job "
+                    f"on this context"))
+
+    @staticmethod
+    def _resets(finalbody, var: str, token: str) -> bool:
+        for s in finalbody:
+            for call in ast.walk(s):
+                if isinstance(call, ast.Call) and \
+                        isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "reset" and \
+                        isinstance(call.func.value, ast.Name) and \
+                        call.func.value.id == var and \
+                        any(isinstance(a, ast.Name) and a.id == token
+                            for a in call.args):
+                    return True
+        return False
+
+
 def _dedupe(findings: List[Finding]) -> List[Finding]:
     seen = set()
     out = []
@@ -1305,4 +1839,9 @@ RULES: List[Rule] = [
     CacheLockDiscipline(),
     RunReportEventDrift(),
     SpanNameDrift(),
+    SharedStateWithoutLock(),
+    LockOrderCycle(),
+    DurabilityProtocolDrift(),
+    BlockingCallUnderLock(),
+    ContextvarLeak(),
 ]
