@@ -41,11 +41,16 @@ enabled-but-unexported vs off and record the legs under "trace_ab" —
 the <2% tracing-overhead budget of docs/observability.md, measured).
 
 Bytes are reported per path from the ENCODED layouts
-(bench_algs.mttkrp_bytes_encoded): ``model_gb_per_path`` carries each
-path's achieved bytes/iteration, ``format`` its achieved encoding
-summary, and the regression gate compares the bytes too — a format
-change that silently re-inflates traffic >10% fails ``--gate`` exactly
-like a time regression.
+(bench_algs.mttkrp_bytes_encoded) PLUS each path's operand-prep decode
+traffic (bench_algs.mttkrp_decode_bytes, per the engine its plan
+names): ``model_gb_per_path`` carries the achieved bytes/iteration,
+``decode_overhead`` the achieved/encoded ratio (~1.0 when the plan
+consumes the compact streams natively — the fused_v2 kernel or the
+per-chunk scan decode — vs ~2x under operand-prep decode,
+docs/format.md), ``format`` the achieved encoding summary, and the
+regression gate compares the bytes too — a format OR engine change
+that silently re-inflates traffic >10% fails ``--gate`` exactly like a
+time regression.
 
 Regression gate (ROADMAP open item 1): the fresh result is compared
 against the newest prior ``BENCH_*.json`` (same metric only — unlike
@@ -825,8 +830,15 @@ def main(gate: bool = False) -> None:
     path_errors = {}
     # per-path ACHIEVED bytes/iteration + format summary, from the
     # encoded layouts (docs/format.md) — the fixed i32/f32 model would
-    # claim the compact format moves bytes it no longer does
+    # claim the compact format moves bytes it no longer does.  The
+    # achieved bytes INCLUDE each path's operand-prep decode traffic
+    # (bench_algs.mttkrp_decode_bytes, per the engine the path's plan
+    # names), so the bytes:<path> gate legs cover an engine change
+    # that silently reintroduces prep decode; decode_overhead is the
+    # achieved/encoded ratio — ~1.0 when the plan consumes the streams
+    # natively (fused_v2/xla_scan), ~2x under operand-prep decode
     path_gb = {}
+    path_decode = {}
     path_fmt = {}
     # per-path achieved balance (docs/layout-balance.md): max/mean nnz
     # and row span per block (worst layout) + the summed one-hot work
@@ -838,7 +850,9 @@ def main(gate: bool = False) -> None:
                       and jax.default_backend() == "tpu"))
 
     def note_format(label, X, pallas=None):
-        from splatt_tpu.bench_algs import mttkrp_bytes_encoded
+        from splatt_tpu.bench_algs import (mttkrp_bytes_encoded,
+                                           mttkrp_decode_bytes)
+        from splatt_tpu.ops.mttkrp import plan_mttkrp
 
         # `pallas` overrides the run-wide engine family for paths that
         # force their own (the blocked_xla fallback): the traffic model
@@ -847,11 +861,21 @@ def main(gate: bool = False) -> None:
             pallas = pallas_ran
         alg = "blocked_pallas" if pallas else "blocked"
         itemsize = jnp.dtype(X.layouts[0].vals.dtype).itemsize
-        gb = sum(mttkrp_bytes_encoded(alg, X, rank, m, itemsize)
-                 for m in range(X.nmodes)) / 1e9
+        enc_gb = sum(mttkrp_bytes_encoded(alg, X, rank, m, itemsize)
+                     for m in range(X.nmodes)) / 1e9
+        # decode traffic follows the engine each mode's plan will run
+        # (docs/format.md): plan probe factors are shape-only
+        plan_facs = [jnp.zeros((d, rank), X.layouts[0].vals.dtype)
+                     for d in X.dims]
+        dec_gb = sum(mttkrp_decode_bytes(
+                         X, rank, m, plan_mttkrp(X, plan_facs, m).engine)
+                     for m in range(X.nmodes)) / 1e9
+        gb = enc_gb + dec_gb
         # 4 decimals (0.1 MB): the gate COMPARES these values, and a
         # 2-decimal round would blind the >10% bytes leg at smoke scale
         path_gb[label] = round(gb, 4)
+        path_decode[label] = (round(gb / enc_gb, 3) if enc_gb > 0
+                              else 1.0)
         path_fmt[label] = X.format_summary()
         per_mode = X.imbalance()
         path_imb[label] = dict(
@@ -863,7 +887,8 @@ def main(gate: bool = False) -> None:
                                for d in per_mode.values()), 2),
             packing=sorted({d["packing"] for d in per_mode.values()}))
         note(f"format[{label}]: {path_fmt[label]} -> "
-             f"{path_gb[label]} GB/iter (achieved bytes); balance: "
+             f"{path_gb[label]} GB/iter (achieved bytes; decode "
+             f"overhead x{path_decode[label]}); balance: "
              f"block nnz max/mean "
              f"{path_imb[label]['block_nnz_max_mean']}, one-hot work "
              f"x{path_imb[label]['work_amp']}/nnz")
@@ -1099,8 +1124,12 @@ def main(gate: bool = False) -> None:
         rec["eff_gbs"] = round(gb / sec_per_iter, 1)
         if path_gb:
             # per-path achieved bytes + eff_gbs + format summary: what
-            # the --gate comparison and the BENCH trajectory read
+            # the --gate comparison and the BENCH trajectory read.
+            # decode_overhead is achieved/encoded bytes per path — the
+            # in-kernel-decode contract (achieved ≈ encoded, ≤ ~1.15x)
+            # made a recorded number (docs/format.md)
             rec["model_gb_per_path"] = dict(path_gb)
+            rec["decode_overhead"] = dict(path_decode)
             rec["eff_gbs_per_path"] = {
                 k: round(path_gb[k] / results[k]["median"], 1)
                 for k in path_gb if k in results}

@@ -273,6 +273,39 @@ def mttkrp_bytes_encoded(alg: str, X: BlockedSparse, rank: int, mode: int,
     return streams + rows + out
 
 
+def mttkrp_decode_bytes(X: BlockedSparse, rank: int, mode: int,
+                        engine: str) -> float:
+    """Extra HBM bytes the named engine's operand prep spends DECODING
+    an encoded layout before its kernel runs (docs/format.md) — the
+    traffic the in-kernel decode line exists to delete.  Zero for v1
+    layouts and for the stream-native engines
+    (:data:`splatt_tpu.ops.mttkrp.STREAM_NATIVE_ENGINES`: fused_v2
+    decodes in registers, xla_scan per scan chunk, the xla scatter
+    inside its fusion).  The prep-decoding Pallas engines rematerialize
+    every mode's global-i32 stream (write + read), and the transposed-
+    table kernels additionally stream the sublane-replicated request
+    tiles ``_prep_t_operands`` materializes — the reason "achieved
+    bytes ≈ 2x encoded" before the fused_v2 engine.  bench reports the
+    per-path ratio as ``decode_overhead`` next to
+    ``model_gb_per_path``."""
+    from splatt_tpu.ops.mttkrp import STREAM_NATIVE_ENGINES
+    from splatt_tpu.utils.env import ceil_to
+
+    lay = X.layout_for(mode)
+    if (getattr(lay, "encoding", "v1") == "v1"
+            or engine in STREAM_NATIVE_ENGINES or engine == "native"):
+        return 0.0
+    decoded = 2.0 * lay.nmodes * lay.nnz_pad * 4   # i32 write + read
+    if engine in ("fused_t", "fused_tg"):
+        b_pad = ceil_to(lay.block, 128)
+        for k, d in enumerate(X.dims):
+            if k != mode:
+                d_pad = ceil_to(int(d), 128)
+                ck = -(-b_pad // d_pad)
+                decoded += 2.0 * lay.nblocks * ck * 8 * d_pad * 4
+    return decoded
+
+
 def roofline_report(tt: SparseTensor, results: Dict[str, List[float]],
                     rank: int, itemsize: int,
                     layouts=None) -> List[str]:

@@ -28,7 +28,7 @@ mirroring CSF's root vs. internal/leaf mode traversals.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,124 @@ from splatt_tpu.utils.env import ceil_to as _ceil_to
 #: short dtype names for format descriptions ("mode0=u16/seg/bf16")
 _DTYPE_SHORT = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
                 "float16": "f16"}
+
+#: short integer-dtype names for achieved index widths (signed widths
+#: appear under the "delta" encoding)
+_IDX_SHORT = {"uint8": "u8", "uint16": "u16", "int8": "i8",
+              "int16": "i16", "int32": "i32", "int64": "i64"}
+
+
+# -- stream-consumer interface ----------------------------------------------
+#
+# THE single decode vocabulary of the blocked format (docs/format.md):
+# every engine — the XLA scatter/segment paths, the scanned-XLA chunk
+# decode, the Pallas operand prep, the in-kernel fused_v2 decode, and
+# the ring kernels' index widening — consumes a layout's encoded
+# streams through these helpers, so a new encoding lands in exactly
+# one place and bit parity across engines is by construction.  All are
+# pure jnp, shape-polymorphic over leading batch dims, trace-safe and
+# donation-safe, and legal inside Pallas kernel bodies (they operate
+# on values, not refs).
+
+#: per-mode stream-encoding kinds:
+#:   "glob"  — the stream holds global i32 ids (v1; base is None)
+#:   "loc"   — narrow local ids; global = local + base[block]
+#:   "seg"   — the sorted mode's within-block segment ids (base is
+#:             row_start); global = seg + base[block]
+#:   "delta" — within-block first-order differences of "loc"; decode
+#:             is an exact integer cumulative sum, then + base
+#:   "rle"   — per-block (seg_width,) run-length counts replacing the
+#:             sorted mode's per-nnz stream; decode expands counts to
+#:             nondecreasing segment ids
+STREAM_ENCODINGS = ("glob", "loc", "seg", "delta", "rle")
+
+
+class ModeStreams(NamedTuple):
+    """The stream-consumer view of one :class:`ModeLayout`: the raw
+    encoded per-mode index streams, their per-block bases (None for
+    v1) and the per-mode encoding kinds — what
+    :func:`stream_encodings` derives from the layout's static
+    ``idx_width`` policy, so consumers dispatch on static strings, not
+    array dtypes."""
+
+    streams: tuple                 # per-mode encoded index arrays
+    bases: Optional[tuple]         # per-mode (nblocks,) i32, or None
+    encs: Tuple[str, ...]          # per-mode STREAM_ENCODINGS kind
+
+
+def stream_encodings(idx_width: str, mode: int,
+                     nmodes: int) -> Tuple[str, ...]:
+    """Per-mode stream-encoding kinds for a layout built under
+    ``idx_width`` (static — derived from static metadata only)."""
+    if idx_width == "i32":
+        return ("glob",) * nmodes
+    out = []
+    for k in range(nmodes):
+        if k == mode:
+            out.append("rle" if idx_width == "rle" else "seg")
+        else:
+            out.append("delta" if idx_width == "delta" else "loc")
+    return tuple(out)
+
+
+def widen_ids(arr: jax.Array) -> jax.Array:
+    """Widen a stored index stream to the i32 the compute consumes —
+    the one sanctioned narrowing boundary (ring kernels and engines
+    share it, so a future narrow shard stream flows through the same
+    interface)."""
+    return arr.astype(jnp.int32)
+
+
+def decode_gather_ids(arr: jax.Array, base, enc: str) -> jax.Array:
+    """Decode one gather-mode chunk ``(..., B)`` to GLOBAL i32 ids.
+
+    `base` must already be broadcastable against the widened stream
+    (callers shape it: ``(..., 1)`` per-block columns in the scan
+    engine, a scalar inside the fused_v2 kernel); pass None for
+    "glob".  "delta" decodes with an exact integer cumulative sum
+    along the block axis — the chunk axis boundary IS the block
+    boundary, so chunked consumers need no carry."""
+    if enc == "glob":
+        return widen_ids(arr)
+    ids = widen_ids(arr)
+    if enc == "delta":
+        ids = jnp.cumsum(ids, axis=-1)
+    return ids + base
+
+
+def rle_expand(counts: jax.Array, block: int) -> jax.Array:
+    """Expand per-block run-length counts ``(..., S)`` into the
+    nondecreasing within-block segment ids ``(..., block)`` they
+    encode: entry j's id is the number of run ENDS at or before j.
+    Exact over integers, and monotone by construction — the
+    ``indices_are_sorted`` scatter hint stays truthful."""
+    ends = jnp.cumsum(widen_ids(counts), axis=-1)        # (..., S)
+    iota = jnp.arange(block, dtype=jnp.int32)
+    return (iota >= ends[..., None]).astype(jnp.int32).sum(axis=-2)
+
+
+def decode_segment_ids(arr: jax.Array, enc: str, block: int,
+                       row_start=None) -> jax.Array:
+    """Decode the sorted mode's chunk to within-block LOCAL segment
+    ids ``(..., block)``: "seg" widens the stored ids, "rle" expands
+    the count vector, "glob" subtracts the block run start
+    (`row_start`, shaped broadcastable like `base` above)."""
+    if enc == "rle":
+        return rle_expand(arr, block)
+    if enc == "glob":
+        return widen_ids(arr) - row_start
+    return widen_ids(arr)
+
+
+def decode_global_ids(arr: jax.Array, base, enc: str,
+                      block: int) -> jax.Array:
+    """Decode one encoded chunk of ANY kind to GLOBAL i32 ids — what a
+    consumer gathering a mode it is not sorted by needs (e.g. the
+    privatized path reading the sorted mode's segment/RLE stream as a
+    gather stream).  "glob" ignores `base`."""
+    if enc in ("seg", "rle"):
+        return decode_segment_ids(arr, enc, block) + base
+    return decode_gather_ids(arr, base, enc)
 
 
 @jax.tree_util.register_dataclass
@@ -142,37 +260,49 @@ class ModeLayout:
 
     # -- trace-safe decode (the engines' view of the format) ---------------
     #
-    # All pure jnp: callable inside jitted sweeps (no host sync —
-    # SPL003) and under donation (the layout itself is never donated).
+    # All pure jnp through the module-level stream-consumer helpers
+    # (decode_gather_ids / decode_segment_ids): callable inside jitted
+    # sweeps (no host sync — SPL003) and under donation (the layout
+    # itself is never donated).
+
+    def stream_encs(self) -> Tuple[str, ...]:
+        """Per-mode :data:`STREAM_ENCODINGS` kinds (static)."""
+        return stream_encodings(self.idx_width if self.base is not None
+                                else "i32", self.mode, self.nmodes)
 
     def mode_ids(self, k: int) -> jax.Array:
         """(nnz_pad,) int32 GLOBAL ids of mode `k` — v1 returns the
-        stored stream; v2 decodes ``local + base`` per block on the
+        stored stream; the compact encodings decode per block on the
         fly (an XLA elementwise temp fused into the consuming gather,
         not a stored rematerialization)."""
-        if self.base is None:
-            return self.inds[k]
-        loc = self.inds[k].reshape(self.nblocks, self.block)
-        return (loc.astype(jnp.int32) + self.base[k][:, None]).reshape(-1)
+        enc = self.stream_encs()[k]
+        if enc == "glob":
+            return decode_gather_ids(self.inds[k], None, enc)
+        return decode_global_ids(
+            self.inds[k].reshape(self.nblocks, -1),
+            self.base[k][:, None], enc, self.block).reshape(-1)
 
     def blocked_locals(self) -> jax.Array:
         """(nblocks, block) int32 within-block ids of the SORTED mode
         — what the one-hot engines contract against.  v2 stores these
-        directly (the segment encoding), so the per-nnz subtraction of
-        the v1 path disappears from the hot loop."""
-        if self.base is None:
-            return (self.inds[self.mode].reshape(self.nblocks, self.block)
-                    - self.row_start[:, None])
-        return self.inds[self.mode].reshape(
-            self.nblocks, self.block).astype(jnp.int32)
+        directly (the segment/RLE encodings), so the per-nnz
+        subtraction of the v1 path disappears from the hot loop."""
+        enc = self.stream_encs()[self.mode]
+        return decode_segment_ids(
+            self.inds[self.mode].reshape(self.nblocks, -1), enc,
+            self.block, row_start=(self.row_start[:, None]
+                                   if enc == "glob" else None))
 
-    def mode_streams(self):
-        """(per-mode index arrays, per-mode bases-or-None) — the raw
-        encoded streams for engines that decode per scan chunk
-        (ops/mttkrp._scan_fused) instead of whole-array."""
-        streams = [self.inds[k] for k in range(self.nmodes)]
-        bases = None if self.base is None else list(self.base)
-        return streams, bases
+    def mode_streams(self) -> ModeStreams:
+        """The :class:`ModeStreams` stream-consumer view — raw encoded
+        per-mode index arrays, bases and encoding kinds — for engines
+        that decode per scan chunk (ops/mttkrp._scan_fused) or inside
+        the kernel (ops/pallas_kernels.fused_mttkrp_v2) instead of
+        whole-array."""
+        return ModeStreams(
+            streams=tuple(self.inds[k] for k in range(self.nmodes)),
+            bases=None if self.base is None else tuple(self.base),
+            encs=self.stream_encs())
 
     def real_mask(self) -> np.ndarray:
         """(nblocks, block) bool HOST mask of real (non-pad) entries —
@@ -188,19 +318,23 @@ class ModeLayout:
         return real_mask_from_counts(B, self.block_nnz)
 
     def idx_widths(self) -> List[str]:
-        """Per-mode stored index width ("u8"/"u16"/"i32") — the
-        ACHIEVED encoding, next to the requested ``idx_width`` policy."""
-        names = {1: "u8", 2: "u16", 4: "i32", 8: "i64"}
-        return [names.get(jnp.dtype(self.inds[k].dtype).itemsize, "i32")
+        """Per-mode stored index width ("u8"/"u16"/"i8"/"i16"/"i32") —
+        the ACHIEVED encoding, next to the requested ``idx_width``
+        policy (signed widths appear under "delta")."""
+        return [_IDX_SHORT.get(jnp.dtype(self.inds[k].dtype).name, "i32")
                 for k in range(self.nmodes)]
 
     def format_desc(self) -> str:
         """Compact achieved-format summary, e.g. ``u16/seg/bf16`` (v2)
         or ``i32/glob/f32`` (v1): index width(s) / mode-row encoding /
-        stored value dtype."""
+        stored value dtype.  The delta/RLE catalog entries name their
+        encoding in the middle field (``dlt``/``rle``)."""
         widths = sorted(set(self.idx_widths()))
         idx = widths[0] if len(widths) == 1 else "+".join(widths)
-        enc = "glob" if self.base is None else "seg"
+        if self.base is None:
+            enc = "glob"
+        else:
+            enc = {"delta": "dlt", "rle": "rle"}.get(self.idx_width, "seg")
         val = _DTYPE_SHORT.get(jnp.dtype(self.vals.dtype).name,
                                jnp.dtype(self.vals.dtype).name)
         return f"{idx}/{enc}/{val}"
@@ -415,9 +549,52 @@ def plan_balanced_blocks(rows: np.ndarray, block: int, dim: int,
     return simulate(best_cap, materialize=True)
 
 
+def _delta_width(delta: np.ndarray):
+    """Narrowest signed numpy dtype holding every within-block delta
+    (the "delta" catalog entry's achieved width): i8 on smooth index
+    runs, i16/i32 as the jump range grows — fiber-boundary resets are
+    large negative deltas, so the worst jump sets the width."""
+    lo = int(delta.min()) if delta.size else 0
+    hi = int(delta.max()) if delta.size else 0
+    for width in (np.int8, np.int16):
+        info = np.iinfo(width)
+        if info.min <= lo and hi <= info.max:
+            return width
+    return np.int32
+
+
+def _encode_rle(loc: np.ndarray, seg_width: int, block: int) -> np.ndarray:
+    """Run-length encode the sorted mode's (nblocks, block) segment ids
+    into per-block (seg_width,) COUNT vectors — the bitmap/RLE hybrid
+    for dense-ish blocks (docs/format.md): seg_width counts replace
+    block per-nnz entries.  Exactness contract: the ids are
+    nondecreasing within each block (the sort + pad-clamp guarantee),
+    so the counts' expansion (:func:`rle_expand`) reproduces them
+    bit-for-bit; a violation — or a seg_width that would INVERT the
+    compression (S > block) — is an encode error the callers degrade
+    classified to v1."""
+    nb = loc.shape[0]
+    if seg_width > block:
+        raise ValueError(
+            f"idx_width=rle would invert compression: seg_width "
+            f"{seg_width} exceeds the block size {block}; use "
+            f"idx_width=auto for wide-span layouts")
+    if loc.size and np.any(np.diff(loc, axis=1) < 0):
+        raise ValueError(
+            "idx_width=rle requires nondecreasing within-block segment "
+            "ids; the sorted-mode stream is not monotone")
+    offs = loc.astype(np.int64) + np.arange(nb, dtype=np.int64)[:, None] \
+        * seg_width
+    counts = np.bincount(offs.ravel(),
+                         minlength=nb * seg_width).reshape(nb, seg_width)
+    width = np.uint16 if block <= np.iinfo(np.uint16).max else np.int32
+    return counts.astype(width)
+
+
 def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
                block: int, nnz: int, fmt: LayoutFormat,
-               real: Optional[np.ndarray] = None):
+               real: Optional[np.ndarray] = None,
+               seg_width: Optional[int] = None):
     """Encode sorted+padded GLOBAL (nmodes, nnz_pad) int32 coordinates
     into the v2 compact streams: per-mode LOCAL within-block indices at
     the narrowest width that fits (uint16 when the mode's maximum
@@ -433,6 +610,16 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
     degraded classified to v1 by the callers — the other modes keep the
     "auto" u16/i32 widths (their extents are block-offset ranges, not
     segment spans).
+
+    ``fmt.idx == "delta"`` stores the GATHER modes' local streams as
+    within-block first-order differences at the narrowest signed width
+    that fits (:func:`_delta_width`; decode is one exact per-block
+    cumulative sum — :func:`decode_gather_ids`), the sorted mode
+    keeping its "auto" segment ids.  ``fmt.idx == "rle"`` replaces the
+    sorted mode's per-nnz segment stream with per-block
+    ``(seg_width,)`` run-length counts (:func:`_encode_rle`; decode is
+    :func:`rle_expand`), the gather modes keeping "auto" widths —
+    `seg_width` is required for it.
 
     Pad entries decode to harmless rows (their values are zero): the
     sorted mode's pads clamp to the block's last real segment id —
@@ -473,6 +660,18 @@ def _encode_v2(inds: np.ndarray, row_start: np.ndarray, mode: int,
                 loc = np.where(real, loc, maxloc[:, None])
             else:
                 loc = np.where(real, loc, 0)
+        if k == mode and fmt.idx == "rle":
+            if seg_width is None:
+                raise ValueError("idx_width=rle requires the layout's "
+                                 "seg_width at encode time")
+            locs.append(_encode_rle(loc, int(seg_width), block))
+            bases.append(base)
+            continue
+        if k != mode and fmt.idx == "delta":
+            delta = np.diff(loc, axis=1, prepend=0)
+            locs.append(delta.reshape(-1).astype(_delta_width(delta)))
+            bases.append(base)
+            continue
         extent = int(loc.max()) if loc.size else 0
         if fmt.idx == "u16" and extent > u16_max:
             raise ValueError(
@@ -695,7 +894,7 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
             if block_nnz is not None:
                 real = real_mask_from_counts(block, block_nnz)
             locs, bases = _encode_v2(inds, row_start, mode, block, nnz,
-                                     fmt, real=real)
+                                     fmt, real=real, seg_width=seg_width)
             return ModeLayout(
                 inds=tuple(jnp.asarray(l) for l in locs),
                 vals=jnp.asarray(vals),
@@ -753,7 +952,8 @@ def reencode_layout(layout: ModeLayout, fmt: LayoutFormat,
         locs, bases = _encode_v2(np.asarray(layout.inds),
                                  np.asarray(layout.row_start),
                                  layout.mode, layout.block, layout.nnz,
-                                 fmt, real=layout.real_mask())
+                                 fmt, real=layout.real_mask(),
+                                 seg_width=layout.seg_width)
         return dataclasses.replace(
             layout, vals=vals,
             inds=tuple(jnp.asarray(l) for l in locs),
@@ -767,6 +967,21 @@ def reencode_layout(layout: ModeLayout, fmt: LayoutFormat,
             error=resilience.failure_message(e)[:200])
         return dataclasses.replace(layout, vals=vals, idx_width="i32",
                                    val_storage=fmt.val)
+
+
+def decode_to_v1(layout: ModeLayout) -> ModeLayout:
+    """Materialize a compact layout's GLOBAL-i32 v1 form — the
+    degrade target of the ``format.decode`` fault site: when native
+    stream consumption fails at dispatch, the run continues on the v1
+    path every engine can always consume (slower bytes, never a failed
+    run).  Pure device compute through :meth:`ModeLayout.mode_ids`
+    (the same stream-consumer decode the engines run), so the result
+    is bit-identical to the in-kernel decode by construction."""
+    if layout.encoding == "v1":
+        return layout
+    inds = jnp.stack([layout.mode_ids(k) for k in range(layout.nmodes)])
+    return dataclasses.replace(layout, inds=inds, base=None,
+                               idx_width="i32")
 
 
 @dataclasses.dataclass
@@ -818,13 +1033,12 @@ class BlockedSparse:
         for lay in self.layouts:
             real = lay.real_mask()
             counts = real.sum(axis=1)
-            # lay.inds[lay.mode] is one stream under BOTH encodings (a
-            # device slice for v1, a tuple entry for v2) — only the
-            # sorted mode's stream crosses to host
-            rows = np.asarray(lay.inds[lay.mode]).reshape(
+            # mode_ids is the stream-consumer decode shared with the
+            # engines (identity for v1, local+base / RLE expansion for
+            # the compact encodings) — only the sorted mode's decoded
+            # stream crosses to host
+            rows = np.asarray(lay.mode_ids(lay.mode)).reshape(
                 lay.nblocks, lay.block).astype(np.int64)
-            if lay.encoding == "v2":
-                rows = rows + np.asarray(lay.base[lay.mode])[:, None]
             rows = np.where(real, rows, rows.min(axis=1, keepdims=True))
             spans = np.minimum(rows.max(axis=1) - rows.min(axis=1) + 1,
                                lay.dim if lay.dim > 0 else 1)

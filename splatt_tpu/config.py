@@ -137,7 +137,41 @@ class Verbosity(enum.IntEnum):
 #: when every block's sorted-mode extent fits 255 — a block span that
 #: does not is an encode failure, degraded classified to v1); the
 #: other modes encode at the "auto" u16/i32 widths.
-IDX_WIDTHS = ("i32", "auto", "u16", "u8")
+#: "delta" stores the GATHER modes' local streams as within-block
+#: first-order differences at the narrowest signed width that fits
+#: (i8 on smooth index runs — decode is one per-block cumulative sum,
+#: exact over integers); the sorted mode keeps its "auto" segment ids.
+#: "rle" replaces the sorted mode's per-nnz segment stream with a
+#: per-block (seg_width,) run-length COUNT vector (the bitmap/RLE
+#: hybrid for dense-ish blocks: seg_width counts instead of block
+#: entries); a layout whose seg_width exceeds its block is an encode
+#: failure, degraded classified to v1 — compression must never invert.
+IDX_WIDTHS = ("i32", "auto", "u16", "u8", "delta", "rle")
+
+#: legal decode-placement policies (SPLATT_DECODE): "kernel" lets
+#: dispatch consume the compact streams natively (the fused_v2 Pallas
+#: engine and the per-chunk scan decode — achieved HBM bytes ≈ encoded
+#: bytes, docs/format.md); "prep" forces operand-prep decode (the
+#: pre-format-v2 dataflow: every engine widens to global i32 before
+#: the kernel) — the A/B lever for the decode_overhead bench model.
+DECODES = ("kernel", "prep")
+
+
+def resolve_decode() -> str:
+    """Resolve the decode-placement policy (docs/format.md): the
+    SPLATT_DECODE env default is "kernel" (native stream consumption);
+    "prep" forces operand-prep decode — dispatch materializes the
+    global-i32 form up front (blocked.decode_to_v1) so EVERY engine
+    runs the pre-format-v2 dataflow, and fused_v2 leaves the chain.
+    Centralized here like the format knobs so a typo'd policy fails
+    with one clear message."""
+    from splatt_tpu.utils.env import read_env
+
+    pol = str(read_env("SPLATT_DECODE"))
+    if pol not in DECODES:
+        raise ValueError(
+            f"SPLATT_DECODE must be one of {DECODES}, got {pol!r}")
+    return pol
 
 #: legal value-storage policies (SPLATT_VAL_STORAGE /
 #: Options.val_storage); "auto" = the resolved compute dtype

@@ -40,6 +40,15 @@ from splatt_tpu.utils.env import read_env, read_env_int
 
 PATHS = ("stream", "sorted_onehot", "privatized", "scatter", "sorted_scatter")
 
+#: engines that consume a compact layout's encoded streams NATIVELY —
+#: decode runs in registers (fused_v2 in the Pallas kernel, xla_scan
+#: per chunk inside the scan step, xla fused into the scatter/segment
+#: sum), so the decoded i32 temp never lands in HBM and achieved bytes
+#: track the encoded streams (docs/format.md).  Everything else
+#: decodes at operand prep; bench's decode_overhead model and the
+#: format_decode run-report event both read this set.
+STREAM_NATIVE_ENGINES = ("fused_v2", "xla_scan", "xla")
+
 
 def _gather_prod(inds: jax.Array, vals: jax.Array,
                  factors: Sequence[jax.Array], mode: int) -> jax.Array:
@@ -192,23 +201,37 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
     nsteps = -(-nb // C)
     nb_pad = nsteps * C
 
-    # per-mode encoded streams: v1 = global i32 rows of one array, v2 =
-    # narrow local ids + per-block bases.  Decoding happens inside the
-    # scan step, one chunk at a time — the global-i32 form never exists
-    # whole in HBM for v2 layouts.
-    streams, bases = layout.mode_streams()
+    # per-mode encoded streams through the stream-consumer interface
+    # (blocked.ModeStreams): v1 = global i32 rows, the compact
+    # encodings = narrow local/segment/delta/RLE streams + per-block
+    # bases.  Decoding happens inside the scan step via the SHARED
+    # decode helpers (blocked.decode_gather_ids/decode_segment_ids —
+    # the same functions the fused_v2 kernel body runs), one chunk at
+    # a time, so the global-i32 form never exists whole in HBM for
+    # encoded layouts.
+    from splatt_tpu.blocked import decode_global_ids, decode_segment_ids
+
+    streams, bases, encs = layout.mode_streams()
+    streams = list(streams)
     vals = layout.vals
     row_start = layout.row_start
     if nb_pad != nb:
         # pad with whole sentinel blocks: mode index = dim (falls in the
         # dropped tail rows; for v2 the BASE carries the sentinel and
-        # the stored locals stay 0), other indices 0, values 0
+        # the stored locals stay 0 — an RLE pad block's count vector is
+        # [B, 0, ...], every entry in segment 0), other indices 0,
+        # values 0
         pad = (nb_pad - nb) * B
-        streams = [jnp.pad(s, (0, pad),
-                           constant_values=(layout.dim
-                                            if bases is None and k == mode
-                                            else 0))
-                   for k, s in enumerate(streams)]
+        for k, s in enumerate(streams):
+            if encs[k] == "rle":
+                s = jnp.pad(s, ((0, nb_pad - nb), (0, 0)))
+                streams[k] = s.at[nb:, 0].set(B)
+            else:
+                streams[k] = jnp.pad(
+                    s, (0, pad),
+                    constant_values=(layout.dim
+                                     if bases is None and k == mode
+                                     else 0))
         vals = jnp.pad(vals, (0, pad))
         row_start = jnp.pad(row_start, (0, nb_pad - nb),
                             constant_values=layout.dim)
@@ -218,7 +241,7 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
                                               else 0))
                      for k, b in enumerate(bases)]
 
-    inds_s = tuple(s.reshape(nsteps, C, B) for s in streams)
+    inds_s = tuple(s.reshape(nsteps, C, -1) for s in streams)
     vals_s = vals.reshape(nsteps, C, B)
     rs_s = row_start.reshape(nsteps, C)
     base_s = (None if bases is None
@@ -228,27 +251,34 @@ def _scan_fused(layout: ModeLayout, factors: Sequence[jax.Array], mode: int,
     acc = _acc_dtype(dtype)
 
     def step(carry, xs):
-        # per-mode (C,B) chunks, (C,B) vals, (C,) run starts,
-        # per-mode (C,) bases (None for v1)
+        # per-mode (C,B) encoded chunks ((C,S) counts under RLE),
+        # (C,B) vals, (C,) run starts, per-mode (C,) bases (None for
+        # v1) — decoded here, in registers, via the shared helpers
         inds_c, vals_c, rs_c, base_c = xs
         prod = vals_c.astype(dtype)[..., None]
         for k in range(nmodes):
             if k != mode:
-                g = inds_c[k]
-                if base_c is not None:
-                    g = g.astype(jnp.int32) + base_c[k][:, None]
+                # decode_global_ids handles every stream kind — incl.
+                # gathering the layout's SORTED mode (its segment/RLE
+                # stream expands here) when dispatching another mode
+                g = decode_global_ids(
+                    inds_c[k],
+                    None if base_c is None else base_c[k][:, None],
+                    encs[k], B)
                 rows = jnp.take(factors[k], g.reshape(-1), axis=0,
                                 mode="clip").reshape(C, B, R)
                 prod = prod * rows
         if accumulate:
-            local = inds_c[mode]
-            if base_c is not None:
-                local = local.astype(jnp.int32) + base_c[mode][:, None]
+            if base_c is None:
+                local = inds_c[mode]
+            else:
+                local = decode_global_ids(inds_c[mode],
+                                          base_c[mode][:, None],
+                                          encs[mode], B)
         elif base_c is None:
             local = inds_c[mode] - rs_c[:, None]
         else:
-            # v2 segment encoding stores the within-block ids directly
-            local = inds_c[mode].astype(jnp.int32)
+            local = decode_segment_ids(inds_c[mode], encs[mode], B)
         onehot = (local[:, None, :] == iota[None, :, None]).astype(dtype)
         part = jnp.einsum("cwb,cbr->cwr", onehot, prod,
                           preferred_element_type=acc,
@@ -362,6 +392,34 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
 
     if fallback is None:
         fallback = resilience.fallback_enabled()
+    if getattr(layout, "encoding", "v1") != "v1":
+        from splatt_tpu.blocked import decode_to_v1
+        from splatt_tpu.config import resolve_decode
+
+        if resolve_decode() == "prep":
+            # the A/B lever (docs/format.md): materialize the decoded
+            # global-i32 form BEFORE any engine runs, so every path —
+            # Pallas and XLA alike — executes the pre-format-v2
+            # operand-prep dataflow the decode_overhead model prices
+            layout = decode_to_v1(layout)
+        else:
+            # the format.decode fault site (docs/format.md): native
+            # stream consumption failing at dispatch must degrade the
+            # RUN, not kill it — classify, report format_fallback
+            # evidence, and fall back to the materialized global-i32
+            # v1 path every engine can always consume (bit-identical
+            # by construction: decode_to_v1 runs the same
+            # stream-consumer decode)
+            try:
+                faults.maybe_fail("format.decode")
+            except Exception as e:
+                cls = resilience.classify_failure(e)
+                resilience.run_report().add(
+                    "format_fallback", mode=int(mode), site="decode",
+                    idx_width=getattr(layout, "idx_width", "?"),
+                    failure_class=cls.value,
+                    error=resilience.failure_message(e)[:200])
+                layout = decode_to_v1(layout)
     # regime/shape_key are computed ONCE per dispatch and threaded
     # through the chain build — this runs once per mode per sweep
     # iteration, and the three consumers must agree on the regime
@@ -384,8 +442,9 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
     interpret = impl == "pallas_interpret"
     last = len(chain) - 1
     for i, engine in enumerate(chain):
-        if i < last and not _engine_probed_ok(engine, regime, layout.block,
-                                              interpret):
+        if i < last and not _engine_probed_ok(
+                engine, regime, layout.block, interpret,
+                idx_width=getattr(layout, "idx_width", "auto")):
             continue
 
         def attempt(engine=engine):
@@ -399,6 +458,18 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             first = (engine, shape_key) not in _DEADLINE_ARMED
             if first:
                 _DEADLINE_ARMED.add((engine, shape_key))
+                if getattr(layout, "encoding", "v1") != "v1":
+                    # first (compile-bearing) dispatch over an encoded
+                    # layout: record WHERE its decode runs — natively
+                    # in-kernel/per-chunk, or at operand prep — next
+                    # to the consumed encoding (docs/format.md); once
+                    # per (engine, shape), like the deadline arming
+                    resilience.run_report().add(
+                        "format_decode", engine=engine, mode=int(mode),
+                        enc=layout.format_desc(),
+                        strategy=("kernel"
+                                  if engine in STREAM_NATIVE_ENGINES
+                                  else "prep"))
                 with resilience.deadline(f"engine.{engine}"):
                     out = _mttkrp_blocked_jit(layout, factors, mode,
                                               path, impl, scan_target,
@@ -428,7 +499,9 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             from splatt_tpu import trace
 
             with trace.span("mttkrp.dispatch", mode=int(mode), path=path,
-                            engine=engine, block=int(layout.block)):
+                            engine=engine, block=int(layout.block),
+                            enc=getattr(layout, "format_desc",
+                                        lambda: "i32/glob/?")()):
                 return resilience.retry_transient(attempt,
                                                   label=f"engine.{engine}")
         except Exception as e:
@@ -484,6 +557,12 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
 
     if path == "privatized":
         width = -(-(dim + 1) // 8) * 8  # +1: room for the sentinel row
+        if plan == "fused_v2":
+            from splatt_tpu.ops.pallas_kernels import fused_mttkrp_v2
+
+            return fused_mttkrp_v2(layout, factors, mode, width,
+                                   accumulate=True,
+                                   interpret=interpret)[:dim]
         if plan == "fused_t":
             return fused_mttkrp_t(layout, factors, mode, width,
                                   accumulate=True,
@@ -511,7 +590,12 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
         if mode != layout.mode:
             raise ValueError("sorted_onehot requires the layout's own mode")
         S = layout.seg_width
-        if plan == "fused_t":
+        if plan == "fused_v2":
+            from splatt_tpu.ops.pallas_kernels import fused_mttkrp_v2
+
+            parts = fused_mttkrp_v2(layout, factors, mode, S,
+                                    accumulate=False, interpret=interpret)
+        elif plan == "fused_t":
             parts = fused_mttkrp_t(layout, factors, mode, S,
                                    accumulate=False, interpret=interpret)
         elif plan == "fused_tg":
@@ -590,19 +674,25 @@ def _engine_shape_key(layout: ModeLayout, factors: Sequence[jax.Array],
 
 
 def _engine_probed_ok(engine: str, regime: str, block: int,
-                      interpret: bool) -> bool:
+                      interpret: bool, idx_width: str = "auto") -> bool:
     """Capability gate of one chain candidate, probed LAZILY: each
     probe costs a remote compile attempt on the tunneled TPU service
     (~35 s, 240 s wedged) — an engine never reached because an earlier
     one won must not be probed at all, which is why engine_chain defers
     this check to selection/fallback time instead of resolving the
-    whole chain eagerly."""
+    whole chain eagerly.  `idx_width` scopes the fused_v2 probe to the
+    layout's encoding family (the stream kinds are static kernel
+    params — an "auto" verdict never vouches for delta/RLE)."""
     from splatt_tpu.ops.pallas_kernels import (fused_gather_supported,
                                                fused_t_supported,
                                                fused_tg_supported)
 
+    from splatt_tpu.ops.pallas_kernels import fused_v2_supported
+
     if interpret or engine in ("unfused_pallas", "xla_scan", "xla"):
         return True
+    if engine == "fused_v2":
+        return fused_v2_supported(regime, block, idx_width)
     if engine == "fused_t":
         return fused_t_supported(regime, block)
     if engine == "fused_tg":
@@ -617,9 +707,11 @@ def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
                  *, shape_key: Optional[str] = None) -> List[str]:
     """The ORDERED engine fallback chain for this call: every engine
     whose cheap gates (VMEM plan, HBM budget, runtime demotions) pass,
-    best first — fused Pallas (fused_t → fused_tg → experimental fused)
-    → unfused Pallas → xla_scan → the terminal "xla" stream/scatter
-    formulation, which has no preconditions and cannot fail to apply.
+    best first — the decode-in-kernel fused_v2 engine (compact layouts
+    only, docs/format.md) → fused Pallas (fused_t → fused_tg →
+    experimental fused) → unfused Pallas → xla_scan → the terminal
+    "xla" stream/scatter formulation, which has no preconditions and
+    cannot fail to apply.
     Capability probes are NOT consulted here (they cost a remote
     compile each); :func:`_engine_probed_ok` runs them lazily when an
     engine is actually selected.  :func:`mttkrp_blocked` walks this
@@ -649,6 +741,18 @@ def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
         return not resilience.is_demoted(name, shape_key)
 
     chain = []
+    # the decode-in-kernel engine heads the chain for compact layouts
+    # (docs/format.md): it consumes the raw encoded streams natively —
+    # achieved HBM bytes ≈ encoded bytes — where the prep-decoding
+    # kernels below first rematerialize global i32.  SPLATT_DECODE=
+    # "prep" is the A/B lever forcing the old dataflow.
+    from splatt_tpu.config import resolve_decode
+    from splatt_tpu.ops.pallas_kernels import fused_v2_vmem_ok
+
+    if (pallas and getattr(layout, "encoding", "v1") != "v1"
+            and resolve_decode() == "kernel" and live("fused_v2")
+            and fused_v2_vmem_ok(factors, mode, width, B)):
+        chain.append("fused_v2")
     if pallas and live("fused_t") and fused_t_vmem_ok(factors, mode,
                                                       width, B):
         chain.append("fused_t")
@@ -692,7 +796,9 @@ def engine_plan(layout: ModeLayout, factors: List[jax.Array], mode: int,
     if tuned is not None and tuned.engine in chain:
         chain = [tuned.engine] + [e for e in chain if e != tuned.engine]
     for engine in chain[:-1]:
-        if _engine_probed_ok(engine, regime, layout.block, interpret):
+        if _engine_probed_ok(engine, regime, layout.block, interpret,
+                             idx_width=getattr(layout, "idx_width",
+                                               "auto")):
             return engine
     return chain[-1]
 

@@ -303,10 +303,14 @@ def _prep_t_operands(layout, factors, mode: int, accumulate: bool):
     dtype = factors[0].dtype
     others = [k for k in range(layout.nmodes) if k != mode]
 
-    # the layout decodes its own encoding (v2 local+base, bf16 values):
-    # mode_ids/blocked_locals are identity reads for v1 and trace-fused
-    # decodes for v2 — the kernel operands below are i32/compute-dtype
-    # either way, so the Mosaic kernels are format-agnostic
+    # OPERAND-PREP decode through the stream-consumer interface
+    # (blocked.decode_* via mode_ids/blocked_locals): identity reads
+    # for v1, trace-fused decodes for the compact encodings — the
+    # kernel operands below are i32/compute-dtype either way, so these
+    # Mosaic kernels are format-agnostic.  The decoded i32 streams and
+    # replicated request tiles DO round-trip HBM here — the traffic
+    # bench's decode_overhead prices, and what fused_mttkrp_v2's
+    # in-kernel decode deletes (docs/format.md)
     if accumulate:
         local = layout.mode_ids(mode).reshape(nb, B)
     else:
@@ -533,6 +537,226 @@ def fused_mttkrp_tg(layout, factors, mode: int, width: int,
         interpret=interpret,
         compiler_params=_compiler_params(),
     )(local, vals, *gidxs, *uts)
+    # back to the (…, width, R) contract of the untransposed kernels
+    if accumulate:
+        return out.T[:, :R]
+    return jnp.swapaxes(out, 1, 2)[:, :, :R]
+
+
+# -- decode-in-kernel fused MTTKRP (format v2 consumed natively) ------------
+#
+# The flagship of the in-kernel-decode line (ROADMAP item 3,
+# docs/format.md): the kernel's HBM inputs are the RAW encoded streams
+# — u8/u16 locals or segment ids (i8/i16 deltas, u16 RLE counts),
+# per-block i32 bases, bf16/f32 values — and the widen/base-add/
+# segment-expand decode runs in REGISTERS on the VMEM-resident chunk,
+# so the decoded global-i32 form never exists in HBM and achieved
+# bytes per MTTKRP track the encoded streams (~8 B/nnz at the compact
+# format) instead of the ~2x the operand-prep decode of the fused_t
+# family spends re-widening first.  The grid pipeline double-buffers
+# the HBM→VMEM stream DMA (block s+1 lands while block s computes) —
+# the programmable-memory-controller idea (PAPERS.md arXiv 2207.08298)
+# with Pallas's pipeline emitter as the DMA engine.
+#
+# The decode vocabulary is the SHARED stream-consumer interface
+# (blocked.decode_gather_ids / decode_segment_ids — the same functions
+# the scanned-XLA engine runs per chunk), so interpret mode is
+# bit-identical to the XLA dataflow by construction and tier-1
+# exercises the exact kernel math on CPU — the async-ring pattern
+# (docs/ring.md).  On real TPUs the kernel is capability-probed per
+# (regime, block) like the fused_t family; gather requests are built
+# in-kernel at 128-aligned static offsets in the same-shaped
+# take_along_axis form Mosaic lowers.
+
+from splatt_tpu.blocked import decode_global_ids, decode_segment_ids
+
+
+def _gather_rows_t_inkernel(u_t, g, B: int):
+    """rows_t = u_t[:, g] built INSIDE the kernel from an in-register
+    (1, B) i32 request vector (the decoded stream) — the in-kernel
+    counterpart of :func:`_tile_gather`, whose request tiles are
+    materialized in HBM by :func:`_prep_t_operands`.  The request is
+    replicated across sublanes and padded to whole d_pad lane chunks
+    in registers; every take_along_axis is the same-shaped (8, D)
+    form, and all slice offsets are 128-aligned statics."""
+    R8, D = u_t.shape
+    ck = -(-B // D)
+    g8 = jnp.broadcast_to(g, (_SUBLANE, B))
+    pieces = []
+    for c in range(ck):
+        w = min(B - c * D, D)
+        idx = g8 if ck == 1 else g8[:, c * D:c * D + w]
+        if w < D:
+            idx = jnp.concatenate(
+                [idx, jnp.zeros((_SUBLANE, D - w), jnp.int32)], axis=1)
+        tiles = [jnp.take_along_axis(u_t[r0:r0 + _SUBLANE, :], idx, axis=1)
+                 for r0 in range(0, R8, _SUBLANE)]
+        rows = tiles[0] if len(tiles) == 1 \
+            else jnp.concatenate(tiles, axis=0)           # (R8, D)
+        pieces.append(rows[:, :w])
+    return pieces[0] if ck == 1 else jnp.concatenate(pieces, axis=1)
+
+
+def _fused_v2_kernel(seg_ref, vals_ref, base_ref, *refs,
+                     width: int, accumulate: bool, nother: int,
+                     encs: tuple, seg_enc: str, mode: int, block: int,
+                     dims: tuple):
+    """One block's decode + gather + Hadamard + one-hot reduce, all on
+    the VMEM-staged ENCODED chunk.  `encs`/`seg_enc` are the static
+    per-stream encoding kinds (blocked.STREAM_ENCODINGS); `base_ref`
+    holds the block's per-mode i32 bases in SMEM."""
+    loc_refs = refs[:nother]
+    ut_refs = refs[nother:2 * nother]
+    out_ref = refs[2 * nother]
+    dtype = ut_refs[0].dtype if nother else vals_ref.dtype
+    vals = vals_ref[0, :, :]                      # (1, B) stored dtype
+    prod = vals.astype(dtype)                     # (1, B) → (R8, B)
+    for j in range(nother):
+        u_t = ut_refs[j][...]                     # (R8, D_j) resident
+        # widen + base-add (+ delta cumsum / RLE expand) in registers —
+        # the decoded i32 request never round-trips HBM.  Each stream
+        # decodes by its OWN kind: gathering the layout's sorted mode
+        # (the privatized path) expands its segment/RLE stream here.
+        g = decode_global_ids(loc_refs[j][0, :, :],
+                              base_ref[0, dims[j][1]], encs[j], block)
+        g = jnp.minimum(g, dims[j][0] - 1)        # pad-entry clamp
+        prod = prod * _gather_rows_t_inkernel(u_t, g, block)
+    # the one-hot coordinates: within-block segment ids for the sorted
+    # path, decoded GLOBAL ids for the accumulating privatized path —
+    # u8/u16 widen (or RLE counts expand) in registers either way
+    if accumulate:
+        local = decode_global_ids(seg_ref[0, :, :], base_ref[0, mode],
+                                  seg_enc, block)
+    else:
+        local = decode_segment_ids(seg_ref[0, :, :], seg_enc, block)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (width, block), 0)
+    onehot = (jnp.broadcast_to(local, (width, block)) == iota).astype(dtype)
+    # (R8, B) · (S, B)ᵀ on the MXU → (R8, S) transposed block partials
+    part = jax.lax.dot_general(
+        prod, onehot,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=out_ref.dtype,
+        precision=onehot_precision(dtype, "rhs"))
+    if not accumulate:
+        out_ref[...] = part[None]
+        return
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = part
+
+    @pl.when(pl.program_id(0) != 0)
+    def _accum():
+        out_ref[...] += part
+
+
+def fused_v2_vmem_ok(factors, mode: int, width: int, block: int,
+                     budget_bytes: int = None) -> bool:
+    """VMEM plan of the decode-in-kernel engine: resident transposed
+    tables like fused_t, plus the per-step working set — the REGISTER-
+    built (8, d_pad) request tiles and gathered rows per lane chunk,
+    the accumulating (R8, B) product, one-hot and partials.  The
+    streamed operands themselves are the narrow encoded chunks (u8/u16
+    + bf16), a sliver of the i32 tiles _prep_t_operands streams."""
+    if budget_bytes is None:
+        budget_bytes = _vmem_budget()
+    R = int(factors[0].shape[1])
+    r8 = ceil_to(R, _SUBLANE)
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
+    b_pad = ceil_to(block, 128)
+    fac = 0
+    work = 0
+    for k, f in enumerate(factors):
+        if k != mode:
+            d = ceil_to(int(f.shape[0]), 128)
+            ck = -(-b_pad // d)
+            fac += r8 * d * itemsize                  # resident table
+            work += ck * _SUBLANE * d * 4             # request tiles
+            work += r8 * ck * d * itemsize            # gathered rows
+    work += (r8 * b_pad * itemsize                    # product
+             + ceil_to(width, _SUBLANE) * b_pad * itemsize   # one-hot
+             + r8 * ceil_to(width, 128) * 4                  # partials
+             + 4 * b_pad * 4)                    # decoded ids + streams
+    return fac + work <= budget_bytes
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "width", "accumulate",
+                                             "interpret"))
+def fused_mttkrp_v2(layout, factors, mode: int, width: int,
+                    accumulate: bool, interpret: bool = False) -> jax.Array:
+    """Decode-in-kernel fused MTTKRP over a compact (v2-family)
+    layout: the pallas_call's HBM inputs are the layout's RAW encoded
+    streams — double-buffered into VMEM by the grid pipeline — and
+    decode runs in registers next to the gather (docs/format.md).
+
+    Same contract as :func:`fused_mttkrp_t`: (nb, width, R) block
+    partials, or (width, R) totals when `accumulate`.  Requires a
+    v2-family encoding (``layout.base`` present).
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    streams, bases, encs = layout.mode_streams()
+    if bases is None:
+        raise ValueError(
+            "fused_mttkrp_v2 consumes the compact encoded streams; "
+            "build the layout at a v2-family idx_width (docs/format.md)")
+    nb, B = layout.nblocks, layout.block
+    R = int(factors[0].shape[1])
+    R8 = ceil_to(R, _SUBLANE)
+    dtype = factors[0].dtype
+    others = [k for k in range(layout.nmodes) if k != mode]
+    grid = (nb,)
+
+    # RAW encoded operands at their stored widths — no host-side
+    # widening, no request-tile materialization: what lands in VMEM is
+    # what the format stores in HBM
+    seg = streams[mode].reshape(nb, 1, -1)      # ids (nb,1,B) / counts
+    vals = layout.vals.reshape(nb, 1, B)
+    # gather streams keep their stored shapes too: (nb,1,B) locals, or
+    # (nb,1,S) counts when the privatized path gathers the sorted
+    # mode's RLE stream
+    locs = [streams[k].reshape(nb, 1, -1) for k in others]
+    base_mat = jnp.stack(bases, axis=1).astype(jnp.int32)  # (nb, nmodes)
+    uts = []
+    for k in others:
+        d = int(factors[k].shape[0])
+        uts.append(jnp.pad(factors[k].T,
+                           ((0, R8 - R), (0, ceil_to(d, 128) - d))))
+    # (clamp dim, base column) per gather mode — static for the kernel
+    dims_o = tuple((int(factors[k].shape[0]), k) for k in others)
+    encs_o = tuple(encs[k] for k in others)
+
+    loc_specs = [pl.BlockSpec((1,) + l.shape[1:], lambda i: (i, 0, 0))
+                 for l in locs]
+    ut_specs = [pl.BlockSpec(u.shape, lambda i: (0, 0)) for u in uts]
+
+    acc = _acc_dtype(dtype)
+    if accumulate:
+        out_spec = pl.BlockSpec((R8, width), lambda i: (0, 0))
+        out_shape = jax.ShapeDtypeStruct((R8, width), acc)
+    else:
+        out_spec = pl.BlockSpec((1, R8, width), lambda i: (i, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((nb, R8, width), acc)
+
+    out = pl.pallas_call(
+        functools.partial(_fused_v2_kernel, width=width,
+                          accumulate=accumulate, nother=len(others),
+                          encs=encs_o, seg_enc=encs[mode], mode=mode,
+                          block=B, dims=dims_o),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,) + seg.shape[1:], lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, B), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, base_mat.shape[1]), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            *loc_specs,
+            *ut_specs,
+        ],
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(seg, vals, base_mat, *locs, *uts)
     # back to the (…, width, R) contract of the untransposed kernels
     if accumulate:
         return out.T[:, :R]
@@ -806,9 +1030,11 @@ def probe_regime(dims, block: int) -> str:
             else "ck1")
 
 
-def _probe_case(kernel_fn, regime: str, block: int) -> bool:
+def _probe_case(kernel_fn, regime: str, block: int, fmt=None) -> bool:
     """The probe compile itself — module-level so tests can substitute
-    it without touching the thread/deadline/cache machinery around it."""
+    it without touching the thread/deadline/cache machinery around it.
+    `fmt` builds the probe layout at a specific encoding (the fused_v2
+    probe compiles against real compact streams)."""
     import numpy as np
 
     from splatt_tpu.blocked import build_layout
@@ -835,7 +1061,7 @@ def _probe_case(kernel_fn, regime: str, block: int) -> bool:
                             for d in dims[1:]])
     tt = SparseTensor(inds=inds.astype(np.int64),
                       vals=np.ones(nnz), dims=dims)
-    lay = build_layout(tt, 0, block=block, val_dtype=np.float32)
+    lay = build_layout(tt, 0, block=block, val_dtype=np.float32, fmt=fmt)
     fac = [jnp.zeros((d, rank), jnp.float32) for d in dims]
     kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
                     accumulate=False, interpret=False).compile()
@@ -843,7 +1069,7 @@ def _probe_case(kernel_fn, regime: str, block: int) -> bool:
 
 
 def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
-                    block: int = 4096) -> bool:
+                    block: int = 4096, fmt=None) -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
     interpret)` COMPILES for this backend at a shape representative of
     `regime` at the CALLER's block size.  Lowering alone is not
@@ -898,7 +1124,12 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
 
     def attempt():
         faults.maybe_fail("probe_compile")
-        return _probe_case(kernel_fn, regime, block)
+        # fmt is only threaded through when a probe needs an encoded
+        # layout (fused_v2): the default call keeps the documented
+        # 3-arg substitution contract tests stub _probe_case with
+        if fmt is None:
+            return _probe_case(kernel_fn, regime, block)
+        return _probe_case(kernel_fn, regime, block, fmt=fmt)
 
     def runner():
         try:
@@ -975,6 +1206,26 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
     PROBE_STATES[state_key] = state
     probe_cache_store(state_key, state)
     return bool(result[0])
+
+
+@functools.cache
+def fused_v2_supported(regime: str = "ck1", block: int = 4096,
+                       idx_width: str = "auto") -> bool:
+    """Whether the decode-in-kernel engine compiles here: the in-
+    register widen/base-add/segment-expand plus the in-kernel-built
+    same-shaped take_along_axis gather, probed per (lane-chunk regime,
+    block, ENCODING) against REAL compact streams.  The encoding is
+    part of the probe key because the stream kinds are static kernel
+    params tracing different Mosaic code — u8/u16 widens, the delta
+    lane cumsum, the RLE broadcast-compare expansion — so an "auto"
+    verdict must never vouch for a delta or RLE dispatch."""
+    from splatt_tpu.config import IDX_WIDTHS, LayoutFormat
+
+    if idx_width not in IDX_WIDTHS or idx_width == "i32":
+        idx_width = "auto"
+    return _probe_compiles(fused_mttkrp_v2, f"fused_v2_{idx_width}",
+                           regime, block,
+                           fmt=LayoutFormat(idx=idx_width))
 
 
 @functools.cache

@@ -44,6 +44,12 @@ def ring_gather_rows(U_l: jax.Array, idx: jax.Array, axis: str,
     # drill armed past the async engine must land here next and degrade
     # the sweep to all2all (docs/ring.md fallback ladder)
     faults.maybe_fail("comm.ring_exchange")
+    # widen through the blocked format's stream-consumer boundary
+    # (blocked.widen_ids): the sync ring consumes index streams via
+    # the same interface as the async kernels and single-chip engines
+    from splatt_tpu.blocked import widen_ids
+
+    idx = widen_ids(idx)
     block = U_l.shape[0]
     my_id = jax.lax.axis_index(axis)
     perm = [(i, (i + 1) % ndev) for i in range(ndev)]
@@ -68,8 +74,10 @@ def blockwise_reduce_rows(prod: jax.Array, idx: jax.Array, axis: str,
     """Row-sharded MTTKRP output without the full (dim_pad, R) partial:
     for each row block j, every device reduces its local contribution
     and the block-psum is kept only by the owner."""
+    from splatt_tpu.blocked import widen_ids
     from splatt_tpu.ops.mttkrp import acc_dtype
 
+    idx = widen_ids(idx)
     my_id = jax.lax.axis_index(axis)
     out_dtype = acc_dtype(prod.dtype)
 

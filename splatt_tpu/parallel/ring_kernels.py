@@ -402,12 +402,17 @@ def _ring_reduce_kernel(idx_div_ref, idx_loc_ref, prod_ref, out_ref,
 
 def _pad_streams(idx: jax.Array, block: int):
     """(idx // block, idx % block) padded to whole _NNZ_CHUNKs with an
-    owner id of -1 (matches no shard: padding rows contribute zero)."""
+    owner id of -1 (matches no shard: padding rows contribute zero).
+    The stream widens through the blocked format's stream-consumer
+    boundary (blocked.widen_ids — the same interface the single-chip
+    engines decode through), so a narrow encoded shard stream flows
+    into the ring kernels unchanged."""
+    from splatt_tpu.blocked import widen_ids
     from splatt_tpu.utils.env import ceil_to
 
     n = int(idx.shape[0])
     n_pad = max(_NNZ_CHUNK, ceil_to(n, _NNZ_CHUNK))
-    padded = jnp.pad(idx.astype(jnp.int32), (0, n_pad - n))
+    padded = jnp.pad(widen_ids(idx), (0, n_pad - n))
     div = jnp.where(jnp.arange(n_pad) < n, padded // block, -1)
     return div.astype(jnp.int32), jnp.mod(padded, block), n_pad
 

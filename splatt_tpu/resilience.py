@@ -526,10 +526,20 @@ RUN_REPORT_EVENTS = {
                  "(compact v2 local/segment indices and/or narrowed "
                  "value storage, docs/format.md); carries the achieved "
                  "per-mode format descriptions",
-    "format_fallback": "a v2 compact-format encode failed and the "
-                       "build degraded CLASSIFIED to the v1 i32 "
-                       "encoding (blocked.py, the format.encode fault "
-                       "site) — slower bytes, never a failed build",
+    "format_fallback": "a compact-format encode failed (blocked.py, "
+                       "the format.encode fault site) or its native "
+                       "stream consumption failed at dispatch "
+                       "(ops/mttkrp.py, the format.decode site — "
+                       "site=decode) and the run degraded CLASSIFIED "
+                       "to the v1 i32 path — slower bytes, never a "
+                       "failed build or run",
+    "format_decode": "first dispatch of an engine over a compact "
+                     "layout: records the consumed encoding and "
+                     "whether decode runs natively in-kernel/per-"
+                     "chunk (fused_v2/xla_scan/xla) or at operand "
+                     "prep (the fused_t family) — the achieved-"
+                     "bytes≈encoded-bytes contract made observable "
+                     "(ops/mttkrp.py, docs/format.md)",
     "packing_fallback": "a balanced fiber pack failed and the build "
                         "degraded CLASSIFIED to the fixed slicing "
                         "(blocked.py, the layout.pack fault site; "
@@ -774,11 +784,18 @@ class RunReport:
                          f"({e['failure_class']}: {e['error'][:80]}); "
                          f"remaining paths continued")
         for e in self.events("format_fallback"):
-            lines.append(f"  compact-format encode failed for mode "
-                         f"{e.get('mode')} "
-                         f"(requested {e.get('idx_width')}; "
-                         f"{e['failure_class']}: {e['error'][:80]}); "
-                         f"degraded to the v1 i32 encoding")
+            if e.get("site") == "decode":
+                lines.append(f"  compact-format decode failed at "
+                             f"dispatch for mode {e.get('mode')} "
+                             f"({e['failure_class']}: "
+                             f"{e['error'][:80]}); degraded to the "
+                             f"materialized v1 i32 path")
+            else:
+                lines.append(f"  compact-format encode failed for mode "
+                             f"{e.get('mode')} "
+                             f"(requested {e.get('idx_width')}; "
+                             f"{e['failure_class']}: {e['error'][:80]}); "
+                             f"degraded to the v1 i32 encoding")
         for e in self.events("packing_fallback"):
             lines.append(f"  balanced fiber pack failed for mode "
                          f"{e.get('mode')} ({e['failure_class']}: "

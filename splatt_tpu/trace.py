@@ -105,9 +105,11 @@ SPANS = {
     "guard.deadline.disarm": "cancelling the watchdog timer (and "
                              "absorbing a raced interrupt) on exit",
     "mttkrp.dispatch": "one blocked-MTTKRP engine-chain dispatch "
-                       "(attrs: mode, path, block, chosen engine); "
-                       "under a jitted sweep this records trace-time, "
-                       "once per compilation",
+                       "(attrs: mode, path, block, chosen engine, and "
+                       "enc — the consumed layout encoding, e.g. "
+                       "u16/seg/bf16, docs/format.md); under a jitted "
+                       "sweep this records trace-time, once per "
+                       "compilation",
     "tune.measure": "one autotuner candidate measurement (warm + "
                     "timed forced-engine MTTKRP calls)",
     "dist.als": "one distributed convergence loop (run_distributed_als)",

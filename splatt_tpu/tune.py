@@ -60,7 +60,12 @@ import numpy as np
 #: recipe, docs/layout-balance.md) and the plan key gains a slice-skew
 #: regime component so uniform-tuned plans never steer power-law
 #: tensors.
-PLAN_CACHE_VERSION = 3
+#: v4: the delta/RLE catalog entries join the format candidates and
+#: winners were measured in the in-kernel-decode era (the fused_v2
+#: engine heads compact chains, docs/format.md) — plans tuned when
+#: every engine paid operand-prep decode are re-earned, not
+#: reinterpreted.
+PLAN_CACHE_VERSION = 4
 
 #: candidate nnz blocks (build_layout clamps small tensors; duplicate
 #: effective blocks are measured once)
@@ -71,11 +76,13 @@ NNZ_BLOCKS = (1024, 2048, 4096, 8192, 16384)
 SCAN_TARGETS = (1 << 21, 1 << 23, 1 << 25)
 
 #: candidate index widths when the policy is not pinned: the v1 global
-#: encoding, the compact v2 local/segment encoding, and the u8
-#: segment-id narrowing (docs/format.md) — when a regime's block spans
-#: exceed uint8 the u8 candidate's encode degrades to v1 and collapses
-#: into the i32 candidate (measured once via the seen-dedup)
-IDX_CANDIDATES = ("i32", "auto", "u8")
+#: encoding, the compact v2 local/segment encoding, the u8 segment-id
+#: narrowing, and the delta/RLE catalog entries (docs/format.md) —
+#: when a regime's block spans exceed uint8 (or RLE would invert
+#: compression, or a delta stream cannot narrow below "auto") the
+#: candidate's encode degrades and collapses into an already-measured
+#: one via the seen-dedup
+IDX_CANDIDATES = ("i32", "auto", "u8", "delta", "rle")
 
 #: candidate fiber-packing policies when the knob is not pinned
 #: (docs/layout-balance.md): the fixed slicing and the nnz-balanced

@@ -104,8 +104,29 @@ ENV_VARS = {
                                "sorted mode's segment-id stream at "
                                "uint8 (legal when every block's span "
                                "fits 255) and the other modes at the "
-                               "auto widths (encode failures degrade "
-                               "classified to v1)"),
+                               "auto widths; delta = v2 with the "
+                               "gather modes' local streams stored as "
+                               "within-block differences at the "
+                               "narrowest signed width (i8 on smooth "
+                               "runs; decode = one exact per-block "
+                               "cumsum); rle = v2 with the sorted "
+                               "mode's segment stream replaced by "
+                               "per-block run-length counts (seg_width "
+                               "entries instead of block entries — the "
+                               "dense-ish-block hybrid).  All encode "
+                               "failures degrade classified to v1"),
+    "SPLATT_DECODE": EnvVar("kernel", "decode placement for compact "
+                            "layouts (docs/format.md): kernel = "
+                            "dispatch consumes the encoded streams "
+                            "natively (the fused_v2 Pallas engine "
+                            "decodes in registers; the xla_scan "
+                            "engine decodes per chunk inside the "
+                            "scan) so achieved HBM bytes track the "
+                            "encoded bytes; prep = force operand-"
+                            "prep decode (the pre-v2 dataflow: "
+                            "global i32 materialized before the "
+                            "kernel) — the A/B lever behind bench's "
+                            "decode_overhead model"),
     "SPLATT_VAL_STORAGE": EnvVar("auto", "blocked-layout value-storage "
                                  "dtype (docs/format.md): auto = the "
                                  "resolved compute dtype; f32/bf16 pin "

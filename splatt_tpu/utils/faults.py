@@ -147,6 +147,14 @@ SITES = {
                      "a raised fault must degrade the build classified "
                      "to the v1 i32 encoding (format_fallback event), "
                      "never fail it",
+    "format.decode": "native stream consumption of a compact layout "
+                     "at MTTKRP dispatch (ops/mttkrp.py "
+                     "mttkrp_blocked, docs/format.md); a raised fault "
+                     "must degrade the dispatch classified to the "
+                     "materialized global-i32 v1 path "
+                     "(blocked.decode_to_v1, format_fallback event "
+                     "with site=decode) — slower bytes, never a "
+                     "failed run",
     "layout.pack": "the balanced fiber packing of one blocked layout "
                    "(blocked.py build_layout, docs/layout-balance.md); "
                    "a raised fault must degrade the build classified "

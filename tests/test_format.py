@@ -614,3 +614,368 @@ def test_u8_registry_and_validation():
     from splatt_tpu.utils.env import ENV_VARS
 
     assert "u8" in ENV_VARS["SPLATT_IDX_WIDTH"].doc
+
+
+# -- in-kernel decode: the fused_v2 engine + delta/RLE catalog (ISSUE 13) ----
+
+ALL_V2 = ("auto", "u8", "delta", "rle")
+
+
+def _enc_layouts(tt, mode, block=128, dtype=np.float32):
+    v1 = build_layout(tt, mode, block=block, val_dtype=dtype)
+    encoded = {idx: build_layout(tt, mode, block=block, val_dtype=dtype,
+                                 fmt=LayoutFormat(idx=idx))
+               for idx in ALL_V2}
+    return v1, encoded
+
+
+@pytest.mark.parametrize("idx", ["delta", "rle"])
+def test_delta_rle_bitparity_all_paths(idx):
+    """The delta and RLE catalog entries are pure relabelings: BIT-
+    IDENTICAL f32 MTTKRP to the v1 layout on every path and the
+    forced xla_scan per-chunk decode, for every mode."""
+    tt = _tensor()
+    facs = init_factors(tt.dims, 5, 3, dtype=jnp.float32)
+    for mode in range(tt.nmodes):
+        l1 = build_layout(tt, mode, block=128, val_dtype=np.float32)
+        l2 = build_layout(tt, mode, block=128, val_dtype=np.float32,
+                          fmt=LayoutFormat(idx=idx))
+        assert l2.encoding == "v2" and l2.idx_width == idx
+        for path in ("sorted_onehot", "sorted_scatter"):
+            a = np.asarray(mttkrp_blocked(l1, facs, mode, path=path,
+                                          impl="xla"))
+            b = np.asarray(mttkrp_blocked(l2, facs, mode, path=path,
+                                          impl="xla"))
+            np.testing.assert_array_equal(a, b, err_msg=f"{path}/{mode}")
+        other = (mode + 1) % tt.nmodes
+        for eng in ("xla", "xla_scan"):
+            a = np.asarray(_mttkrp_blocked_jit(l1, facs, other, "scatter"
+                                               if eng == "xla"
+                                               else "privatized",
+                                               "xla", 1 << 21, eng))
+            b = np.asarray(_mttkrp_blocked_jit(l2, facs, other, "scatter"
+                                               if eng == "xla"
+                                               else "privatized",
+                                               "xla", 1 << 21, eng))
+            np.testing.assert_array_equal(a, b, err_msg=f"{eng}/{other}")
+
+
+@pytest.mark.parametrize("tt_name", ["med", "med4", "wide"])
+def test_fused_v2_interpret_bit_identical_to_v1_reference(tt_name):
+    """ACCEPTANCE: the decode-in-kernel fused_v2 engine (interpret
+    mode — the exact kernel dataflow on CPU) is bit-identical to the
+    v1 reference on the sorted path, for EVERY catalog encoding."""
+    tt = _wide_tensor() if tt_name == "wide" else gen.fixture_tensor(tt_name)
+    facs = init_factors(tt.dims, 5, 3, dtype=jnp.float32)
+    v1, encoded = _enc_layouts(tt, 0)
+    ref_scan = np.asarray(_mttkrp_blocked_jit(
+        v1, facs, 0, "sorted_onehot", "xla", 1 << 21, "xla_scan"))
+    ref_scatter = np.asarray(mttkrp_blocked(
+        v1, facs, 0, path="sorted_scatter", impl="xla"))
+    for idx, lay in encoded.items():
+        got = np.asarray(_mttkrp_blocked_jit(
+            lay, facs, 0, "sorted_onehot", "pallas_interpret", 1 << 21,
+            "fused_v2"))
+        np.testing.assert_array_equal(ref_scan, got, err_msg=idx)
+        # and against the v1 scatter formulation (reassociation-free
+        # on the sorted stream: both accumulate in stream order)
+        np.testing.assert_allclose(ref_scatter, got, rtol=1e-6,
+                                   err_msg=idx)
+
+
+def test_fused_v2_privatized_same_engine_parity():
+    """The accumulating (privatized) fused_v2 path: bit-identical
+    ACROSS encodings (same engine, same reduction order) and within
+    reassociation tolerance of the scan engine — the fused_t
+    standard."""
+    tt = _tensor()
+    facs = init_factors(tt.dims, 4, 1, dtype=jnp.float32)
+    v1, encoded = _enc_layouts(tt, 0)
+    outs = {idx: np.asarray(_mttkrp_blocked_jit(
+                lay, facs, 1, "privatized", "pallas_interpret", 1 << 21,
+                "fused_v2"))
+            for idx, lay in encoded.items()}
+    for idx in ("u8", "delta", "rle"):
+        np.testing.assert_array_equal(outs["auto"], outs[idx],
+                                      err_msg=idx)
+    ref = np.asarray(_mttkrp_blocked_jit(v1, facs, 1, "privatized",
+                                         "xla", 1 << 21, "xla_scan"))
+    np.testing.assert_allclose(ref, outs["auto"], rtol=1e-5)
+
+
+def test_fused_v2_requires_encoded_layout():
+    from splatt_tpu.ops.pallas_kernels import fused_mttkrp_v2
+
+    tt = _tensor()
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float32)
+    v1 = build_layout(tt, 0, block=128, val_dtype=np.float32)
+    with pytest.raises(ValueError, match="compact encoded streams"):
+        fused_mttkrp_v2(v1, facs, 0, v1.seg_width, accumulate=False,
+                        interpret=True)
+
+
+def test_engine_chain_heads_with_fused_v2(monkeypatch):
+    """Chain position: fused_v2 heads the Pallas chain for compact
+    layouts only, and SPLATT_DECODE=prep (the operand-prep A/B lever)
+    removes it."""
+    from splatt_tpu.ops.mttkrp import engine_chain
+
+    tt = _tensor()
+    facs = init_factors(tt.dims, 4, 0, dtype=jnp.float32)
+    v1, encoded = _enc_layouts(tt, 0)
+    for idx, lay in encoded.items():
+        chain = engine_chain(lay, facs, 0, "sorted_onehot",
+                             "pallas_interpret")
+        assert chain[0] == "fused_v2", idx
+    assert "fused_v2" not in engine_chain(v1, facs, 0, "sorted_onehot",
+                                          "pallas_interpret")
+    # the xla family never runs it (no Pallas)
+    assert "fused_v2" not in engine_chain(encoded["auto"], facs, 0,
+                                          "sorted_onehot", "xla")
+    monkeypatch.setenv("SPLATT_DECODE", "prep")
+    assert "fused_v2" not in engine_chain(encoded["auto"], facs, 0,
+                                          "sorted_onehot",
+                                          "pallas_interpret")
+    # prep is a REAL lever: dispatch materializes the decoded v1 form
+    # up front for every engine — and stays bit-identical
+    ref = np.asarray(mttkrp_blocked(v1, facs, 0, path="sorted_onehot",
+                                    impl="xla"))
+    got = np.asarray(mttkrp_blocked(encoded["auto"], facs, 0,
+                                    path="sorted_onehot", impl="xla"))
+    np.testing.assert_array_equal(ref, got)
+    monkeypatch.setenv("SPLATT_DECODE", "nope")
+    with pytest.raises(ValueError, match="SPLATT_DECODE"):
+        engine_chain(encoded["auto"], facs, 0, "sorted_onehot",
+                     "pallas_interpret")
+
+
+def test_decode_fault_degrades_to_v1_path():
+    """Chaos drill for the format.decode site: a decode failure at
+    dispatch degrades CLASSIFIED to the materialized v1 path —
+    format_fallback evidence with site=decode, bit-identical result,
+    never a failed run; the next dispatch is native again."""
+    tt = _tensor()
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float32)
+    v1 = build_layout(tt, 0, block=128, val_dtype=np.float32)
+    l2 = build_layout(tt, 0, block=128, val_dtype=np.float32, fmt=V2)
+    ref = np.asarray(mttkrp_blocked(v1, facs, 0, path="sorted_onehot",
+                                    impl="xla"))
+    with faults.inject("format.decode", "runtime", times=1):
+        got = np.asarray(mttkrp_blocked(l2, facs, 0,
+                                        path="sorted_onehot", impl="xla"))
+    np.testing.assert_array_equal(ref, got)
+    evs = resilience.run_report().events("format_fallback")
+    assert evs and evs[-1]["site"] == "decode" and evs[-1]["failure_class"]
+    assert any("decode failed at" in ln.replace("\n", " ") or
+               "decode failed" in ln
+               for ln in resilience.run_report().summary())
+    # fault exhausted: native consumption again, same bits
+    got2 = np.asarray(mttkrp_blocked(l2, facs, 0, path="sorted_onehot",
+                                     impl="xla"))
+    np.testing.assert_array_equal(ref, got2)
+
+
+def test_decode_to_v1_matches_streams():
+    """decode_to_v1 (the degrade target) reproduces every mode's
+    global ids exactly, for every catalog encoding."""
+    from splatt_tpu.blocked import decode_to_v1
+
+    tt = _tensor()
+    _, encoded = _enc_layouts(tt, 1, block=256)
+    for idx, lay in encoded.items():
+        dv = decode_to_v1(lay)
+        assert dv.encoding == "v1" and dv.idx_width == "i32"
+        for k in range(tt.nmodes):
+            np.testing.assert_array_equal(
+                np.asarray(lay.mode_ids(k)), np.asarray(dv.mode_ids(k)),
+                err_msg=f"{idx}/mode{k}")
+
+
+def test_rle_inverted_compression_degrades_classified():
+    """A layout whose seg_width exceeds its block would make the RLE
+    counts BIGGER than the raw stream: encode error, degraded
+    classified to v1 (format_fallback), never a crash."""
+    inds = np.stack([np.arange(1000) * 2 % 2000,
+                     np.arange(1000) % 7, np.arange(1000) % 5])
+    inds[0].sort()
+    tt = SparseTensor(inds.astype(np.int64), np.ones(1000),
+                      (2000, 7, 5))
+    lay = build_layout(tt, 0, block=128, val_dtype=np.float32,
+                       fmt=LayoutFormat(idx="rle"))
+    assert lay.encoding == "v1"
+    evs = resilience.run_report().events("format_fallback")
+    assert evs and evs[-1]["idx_width"] == "rle"
+
+
+def test_delta_narrows_below_auto():
+    """On per-block index runs that fit i8 deltas, the delta streams
+    really are narrower than the auto u16 encoding — and still decode
+    bit-exactly (covered by the parity tests above)."""
+    tt = _tensor()
+    auto = build_layout(tt, 0, block=128, val_dtype=np.float32, fmt=V2)
+    delta = build_layout(tt, 0, block=128, val_dtype=np.float32,
+                         fmt=LayoutFormat(idx="delta"))
+    assert delta.idx_width == "delta"
+    widths = delta.idx_widths()
+    assert any(w == "i8" for w in widths), widths
+    assert delta.storage_bytes() < auto.storage_bytes()
+    assert "dlt" in delta.format_desc()
+
+
+def test_rle_counts_shape_and_shrink():
+    """The RLE sorted-mode stream is a per-block (seg_width,) count
+    vector — fewer bytes than the per-nnz u16 stream on dense-ish
+    blocks — and rle_expand round-trips it exactly."""
+    from splatt_tpu.blocked import rle_expand
+
+    tt = _tensor()
+    auto = build_layout(tt, 0, block=256, val_dtype=np.float32, fmt=V2)
+    rle = build_layout(tt, 0, block=256, val_dtype=np.float32,
+                       fmt=LayoutFormat(idx="rle"))
+    assert rle.inds[0].shape == (rle.nblocks, rle.seg_width)
+    assert rle.storage_bytes() < auto.storage_bytes()
+    np.testing.assert_array_equal(
+        np.asarray(rle_expand(jnp.asarray(rle.inds[0]), rle.block)),
+        np.asarray(auto.blocked_locals()))
+
+
+def test_format_decode_event_names_strategy():
+    """The first dispatch over a compact layout records WHERE decode
+    ran: 'kernel' for the stream-native engines, 'prep' for the
+    fused_t family (docs/format.md)."""
+    from splatt_tpu.ops.mttkrp import _DEADLINE_ARMED
+
+    tt = _tensor()
+    l2 = build_layout(tt, 0, block=128, val_dtype=np.float32, fmt=V2)
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float32)
+    _DEADLINE_ARMED.clear()
+    mttkrp_blocked(l2, facs, 0, path="sorted_onehot", impl="xla")
+    evs = resilience.run_report().events("format_decode")
+    assert evs and evs[-1]["strategy"] == "kernel"
+    assert "seg" in evs[-1]["enc"]
+    n = len(evs)
+    # warm dispatch: no second event for the same (engine, shape)
+    mttkrp_blocked(l2, facs, 0, path="sorted_onehot", impl="xla")
+    assert len(resilience.run_report().events("format_decode")) == n
+    # the interpret-Pallas chain heads with fused_v2 — also 'kernel'
+    _DEADLINE_ARMED.clear()
+    mttkrp_blocked(l2, facs, 0, path="sorted_onehot",
+                   impl="pallas_interpret")
+    evs = resilience.run_report().events("format_decode")
+    assert evs[-1]["engine"] == "fused_v2"
+    assert evs[-1]["strategy"] == "kernel"
+
+
+def test_delta_rle_cpd_bitparity_under_donation():
+    """End to end: CPD over delta and RLE layouts equals the v1 run
+    bit for bit under the donated sweep — in-kernel/per-chunk decode
+    is trace- and donation-safe."""
+    tt = _tensor()
+    init = init_factors(tt.dims, 3, 11, dtype=jnp.float32)
+    fits = {}
+    for name, kw in (("v1", {}), ("delta", dict(idx_width="delta")),
+                     ("rle", dict(idx_width="rle"))):
+        opts = Options(random_seed=42, max_iterations=4,
+                       verbosity=Verbosity.NONE, use_pallas=False,
+                       autotune=False, nnz_block=256,
+                       block_alloc=BlockAlloc.ALLMODE, **kw)
+        out = cpd_als(BlockedSparse.from_coo(tt, opts), 3, opts=opts,
+                      init=init)
+        fits[name] = (float(out.fit),
+                      [np.asarray(u) for u in out.factors])
+    assert fits["v1"][0] == fits["delta"][0] == fits["rle"][0]
+    for name in ("delta", "rle"):
+        for ua, ub in zip(fits["v1"][1], fits[name][1]):
+            np.testing.assert_array_equal(ua, ub, err_msg=name)
+    assert not any(u.is_deleted() for u in init)
+
+
+def test_delta_rle_strict_plan_match_and_scope():
+    """Plans carry the delta/RLE policy and the match stays strict —
+    a delta plan never steers an RLE (or auto) layout; all compact
+    encodings share the :v2 demotion scope suffix."""
+    tt = _tensor()
+    facs = init_factors(tt.dims, 4, 0, dtype=jnp.float64)
+    lays = {idx: build_layout(tt, 0, block=512, val_dtype=np.float64,
+                              fmt=LayoutFormat(idx=idx))
+            for idx in ("auto", "delta", "rle")}
+    plan = tune.TunedPlan(path="sorted_scatter", engine="xla",
+                          nnz_block=512, scan_target=1 << 21, sec=0.001,
+                          idx_width="delta", val_storage="auto")
+    tune._entry_store(tune.plan_key(tt.dims, tt.nnz, 0, 4, jnp.float64,
+                                    skew=tune.skew_of(tt, 0)),
+                      {"plan": dataclasses.asdict(plan)})
+    assert _tuned_plan_for(lays["delta"], facs, 0, "sorted_scatter",
+                           autotune=True) is not None
+    for idx in ("auto", "rle"):
+        assert _tuned_plan_for(lays[idx], facs, 0, "sorted_scatter",
+                               autotune=True) is None, idx
+    for idx in ("delta", "rle"):
+        assert _engine_shape_key(lays[idx], facs, 0).endswith(":v2")
+    assert "delta" in tune.IDX_CANDIDATES
+    assert "rle" in tune.IDX_CANDIDATES
+    assert tune.PLAN_CACHE_VERSION >= 4
+
+
+def test_decode_bytes_model():
+    """bench_algs.mttkrp_decode_bytes: zero for v1 layouts and the
+    stream-native engines; positive (the re-widened i32 streams +
+    request tiles) for the prep-decoding kernels over compact
+    layouts — what bench's decode_overhead ratio reads."""
+    from splatt_tpu.bench_algs import mttkrp_bytes_encoded, \
+        mttkrp_decode_bytes
+    from splatt_tpu.ops.mttkrp import STREAM_NATIVE_ENGINES
+
+    assert "fused_v2" in STREAM_NATIVE_ENGINES
+    tt = _tensor()
+    opts_v1 = Options(verbosity=Verbosity.NONE, use_pallas=False,
+                      autotune=False, block_alloc=BlockAlloc.ALLMODE)
+    opts_v2 = Options(verbosity=Verbosity.NONE, use_pallas=False,
+                      autotune=False, block_alloc=BlockAlloc.ALLMODE,
+                      idx_width="auto")
+    bs1 = BlockedSparse.from_coo(tt, opts_v1)
+    bs2 = BlockedSparse.from_coo(tt, opts_v2)
+    assert mttkrp_decode_bytes(bs1, 4, 0, "fused_t") == 0.0
+    for eng in STREAM_NATIVE_ENGINES:
+        assert mttkrp_decode_bytes(bs2, 4, 0, eng) == 0.0
+    enc = mttkrp_bytes_encoded("blocked_pallas", bs2, 4, 0, 4)
+    for eng in ("fused_t", "fused_tg", "unfused_pallas"):
+        dec = mttkrp_decode_bytes(bs2, 4, 0, eng)
+        assert dec > 0.0, eng
+    # the transposed-table kernels' replicated request tiles dominate:
+    # the achieved/encoded ratio is the ~2x the in-kernel decode cuts
+    assert (enc + mttkrp_decode_bytes(bs2, 4, 0, "fused_t")) / enc > 1.3
+
+
+def test_fused_v2_probe_keys_per_encoding():
+    """The fused_v2 capability probe is scoped per ENCODING family:
+    the stream kinds are static kernel params tracing different
+    Mosaic code, so an "auto" verdict never vouches for a delta or
+    RLE dispatch (off-TPU every probe honestly reports not_tpu, under
+    its own state key)."""
+    import splatt_tpu.ops.pallas_kernels as pk
+
+    pk.fused_v2_supported.cache_clear()
+    for idx in ("auto", "u8", "delta", "rle"):
+        assert pk.fused_v2_supported("ck1", 256, idx) is False  # no TPU
+    for idx in ("auto", "u8", "delta", "rle"):
+        assert pk.PROBE_STATES[f"fused_v2_{idx}:ck1:b256"] == "not_tpu"
+    # an i32 (or unknown) request collapses to the auto family
+    assert pk.fused_v2_supported("ck1", 256, "i32") is False
+    assert "fused_v2_i32:ck1:b256" not in pk.PROBE_STATES
+
+
+def test_decode_registries_declared():
+    from splatt_tpu.config import DECODES, IDX_WIDTHS, resolve_decode
+    from splatt_tpu.resilience import RUN_REPORT_EVENTS
+    from splatt_tpu.utils.env import ENV_VARS
+    from splatt_tpu.utils.faults import SITES
+
+    assert "SPLATT_DECODE" in ENV_VARS
+    assert "format_decode" in RUN_REPORT_EVENTS
+    assert "format.decode" in SITES
+    assert "delta" in IDX_WIDTHS and "rle" in IDX_WIDTHS
+    assert DECODES == ("kernel", "prep")
+    assert resolve_decode() == "kernel"
+    Options(idx_width="delta").validate()
+    Options(idx_width="rle").validate()
