@@ -554,6 +554,12 @@ class FleetChaosResult:
     affinity: Dict[str, dict]     # adopted tune jobs' warm-cache stats
     violations: List[str]         # invariant breaches (empty = pass)
     error: Optional[str] = None
+    #: fleet-aggregate evidence the kill is visible end-to-end
+    #: (docs/observability.md): merged adoption/lease/slo-burn
+    #: counters, the liveness census, and the victim's flight-ring
+    #: event count
+    observability: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -626,7 +632,16 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
        health events and no demotions;
     5. the fleet's observability accounts for the failover: the
        adopter's Prometheus snapshot counts the adoption and its span
-       trace carries the ``job_adopted`` point event.
+       trace carries the ``job_adopted`` point event;
+    6. the kill is visible END-TO-END in the fleet observability
+       plane (docs/observability.md): the merged fleet aggregate
+       shows the lease expiry + adoption + an ``slo_burn`` spike (the
+       replicas run with tight ``SPLATT_SLO_*`` knobs, so the
+       adoption's queue-wait outage burns the error budget) that
+       RECOVERS once the fleet is quiet; the victim's flight-recorder
+       ring replays its timeline up to the kill — the pinned job's
+       ``job_started`` liveness mark included; and ``splatt status``
+       agrees with the journal about every job's state.
     """
     import json
     import os
@@ -659,17 +674,28 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
     base_env = dict(os.environ)
     # shared WARM caches (the point of the fleet) but throwaway ones
     # (soak plans must not leak into the real caches); short leases so
-    # failover fits a smoke budget
+    # failover fits a smoke budget.  The observability plane runs at
+    # soak scale too: metrics/aggregation/SLO ticks sub-second, TIGHT
+    # SLO knobs (any queue wait past 1s — e.g. the adoption outage —
+    # burns the whole error budget at once, so the kill must show as
+    # an slo_burn spike), and a flush-every-record flight ring so the
+    # victim's black box is current up to the SIGKILL.
     base_env.update(
         SPLATT_TUNE_CACHE=os.path.join(tmp, "tune_cache.json"),
         SPLATT_PROBE_CACHE=os.path.join(tmp, "probe_cache.json"),
         SPLATT_FLEET_LEASE_S="2.0", SPLATT_FLEET_HEARTBEAT_S="0.5",
-        SPLATT_SERVE_POLL_S="0.25")
+        SPLATT_SERVE_POLL_S="0.25",
+        SPLATT_METRICS_INTERVAL_S="0.7",
+        SPLATT_SLO_QUEUE_WAIT_P95_S="1.0",
+        SPLATT_SLO_WINDOW_S="3.0", SPLATT_SLO_LONG_WINDOWS="4",
+        SPLATT_SLO_BURN="1.5", SPLATT_FLIGHT_FLUSH="1")
+    # SPLATT_METRICS_PATH stays UNSET: fleet mode defaults each
+    # replica's snapshot into <root>/fleet/metrics/<rid>.prom, which
+    # is where the aggregator (and this soak's post-mortem) finds
+    # them — retired/killed replicas' files included
 
     def spawn(rid: str):
-        env = dict(base_env,
-                   SPLATT_METRICS_PATH=os.path.join(
-                       tmp, f"metrics-{rid}.prom"))
+        env = dict(base_env)
         log = open(os.path.join(tmp, f"{rid}.log"), "w")
         logs.append(log)
         cmd = [sys.executable, "-m", "splatt_tpu.cli", "serve", tmp,
@@ -852,7 +878,8 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
     # 5. the adopter's metrics + trace account for the failover
     pin_replica = states().get("fleet-1-pin", (None, None))[1]
     if pin_replica and pin_replica != victim:
-        mpath = os.path.join(tmp, f"metrics-{pin_replica}.prom")
+        mpath = os.path.join(tmp, "fleet", "metrics",
+                             f"{pin_replica}.prom")
         try:
             with open(mpath) as f:
                 mtext = f.read()
@@ -876,11 +903,88 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         except (OSError, ValueError) as e:
             violations.append(f"no loadable span trace from the "
                               f"adopter {pin_replica}: {e}")
+    # 6. the fleet observability plane shows the kill end-to-end
+    # (docs/observability.md): merged aggregate + SLO burn/recovery +
+    # the victim's flight-recorder black box + status↔journal agreement
+    from splatt_tpu import fleetobs
+
+    agg = fleetobs.aggregate(tmp)
+    observability: Dict[str, float] = {
+        "adoptions": agg.counter("splatt_fleet_adoptions_total"),
+        "lease_expired": agg.counter(
+            "splatt_fleet_lease_expired_total"),
+        "slo_burns": agg.counter("splatt_slo_burn_total"),
+        "replicas_dead": float(agg.samples.get(
+            ("splatt_fleet_replicas", (("state", "dead"),)), 0.0)),
+    }
+    if victim is not None:
+        if observability["adoptions"] < 1:
+            violations.append(
+                "the merged fleet aggregate counts no "
+                "splatt_fleet_adoptions_total — the failover is "
+                "invisible fleet-wide")
+        if observability["lease_expired"] < 1:
+            violations.append(
+                "the merged fleet aggregate counts no "
+                "splatt_fleet_lease_expired_total — the lease expiry "
+                "is invisible fleet-wide")
+        if observability["replicas_dead"] < 1:
+            violations.append(
+                "the liveness census counts no dead replica — the "
+                "SIGKILLed victim's expired heartbeat went uncounted")
+        if observability["slo_burns"] < 1:
+            violations.append(
+                "no slo_burn was counted anywhere in the fleet — the "
+                "adoption outage burned no error budget, so a real "
+                "incident would page nobody")
+        else:
+            # ...and the burn RECOVERS: a fresh two-point evaluation
+            # over the now-quiet fleet (identical samples = zero new
+            # errors in the window) must not be burning
+            ev = fleetobs.SloEvaluator(window_s=3.0, long_windows=4,
+                                       burn=1.5)
+            t0 = time.time()
+            ev.evaluate(agg.samples, now=t0)
+            res2 = ev.evaluate(agg.samples, now=t0 + 60.0)
+            still = [n for n, s in res2["slos"].items()
+                     if s["burning"]]
+            if still:
+                violations.append(
+                    f"SLOs {still} still burning over a quiet window "
+                    f"— the burn evaluator cannot recover")
+        # the victim's black box: its flight ring must replay the
+        # timeline up to the kill, the pinned job's liveness mark
+        # included (SPLATT_FLIGHT_FLUSH=1 makes every record durable
+        # before the 0.5s kill window)
+        fpath = os.path.join(tmp, "fleet", "flight",
+                             f"{victim}.jsonl")
+        try:
+            fevs = trace.load_flight(fpath)
+            observability["flight_events"] = float(len(fevs))
+            if not any((e.get("args") or {}).get("job")
+                       == "fleet-1-pin" and e.get("name")
+                       == "job_started" for e in fevs):
+                violations.append(
+                    "the victim's flight ring carries no job_started "
+                    "mark for the pinned job — the black box does "
+                    "not show what the victim was running when killed")
+        except (OSError, ValueError) as e:
+            violations.append(
+                f"the victim {victim}'s flight ring is unreadable — "
+                f"the SIGKILL erased the black box: {e}")
+    st = fleetobs.fleet_status(tmp)
+    jstates = states()
+    for jid in accepted:
+        if st["jobs"].get(jid) != jstates.get(jid, (None,))[0]:
+            violations.append(
+                f"splatt status disagrees with the journal about "
+                f"{jid}: {st['jobs'].get(jid)!r} vs "
+                f"{jstates.get(jid, (None,))[0]!r}")
     verdict = "violated" if violations else "survived"
     return FleetChaosResult(verdict=verdict, jobs=jobs, replicas=rids,
                             victim=victim, adopted=adopted,
                             affinity=affinity, violations=violations,
-                            error=error)
+                            error=error, observability=observability)
 
 
 def format_fleet_report(res: FleetChaosResult) -> List[str]:
@@ -895,6 +999,14 @@ def format_fleet_report(res: FleetChaosResult) -> List[str]:
                      f"measured={ev['measured']} "
                      f"adopted_from={ev['adopted_from']} "
                      f"ran_on={ev['replica']}")
+    if res.observability:
+        ob = res.observability
+        lines.append(
+            f"  observability: adoptions={ob.get('adoptions', 0):g} "
+            f"lease_expired={ob.get('lease_expired', 0):g} "
+            f"slo_burns={ob.get('slo_burns', 0):g} "
+            f"dead_replicas={ob.get('replicas_dead', 0):g} "
+            f"victim_flight_events={ob.get('flight_events', 0):g}")
     for v in res.violations:
         lines.append(f"INVARIANT VIOLATED: {v}")
     lines.append(f"fleet chaos verdict: {res.verdict.upper()}")

@@ -354,19 +354,56 @@ def cmd_serve(args) -> int:
                        fleet=args.fleet, replica=args.replica,
                        lease_s=args.lease, heartbeat_s=args.heartbeat,
                        tenant_quota=args.tenant_quota)
+    if args.fleet:
+        # fleet observability wiring (docs/observability.md): stamp
+        # every span/point with this replica's id (what merged traces
+        # key on) and arm the flight recorder — span recording on + a
+        # bounded per-replica ring in the spool, so a SIGKILLed
+        # replica leaves a readable black box.  SPLATT_FLIGHT=0/off
+        # opts out of the ring; done here (the daemon entry) rather
+        # than in Server so library/test constructions never flip
+        # process-wide tracing state behind the caller's back.
+        import os as _os
+
+        from splatt_tpu import trace
+        from splatt_tpu.utils.env import read_env
+
+        trace.set_replica(srv.fleet.replica)
+        flight = str(read_env("SPLATT_FLIGHT") or "auto").lower()
+        trace_off = str(read_env("SPLATT_TRACE") or "").lower() in (
+            "0", "off", "false", "no")
+        if flight not in ("0", "off", "false", "no") and trace_off:
+            # an EXPLICIT SPLATT_TRACE=0 wins over the flight
+            # recorder's auto-arm: the documented recording switch
+            # must not be silently overridden — say so instead
+            print("splatt-serve: flight recorder off — SPLATT_TRACE "
+                  "is explicitly disabled (set SPLATT_FLIGHT=0 to "
+                  "silence this, or drop SPLATT_TRACE=0 to arm the "
+                  "black box)", file=sys.stderr)
+        elif flight not in ("0", "off", "false", "no"):
+            fdir = _os.path.join(args.dir, "fleet", "flight")
+            _os.makedirs(fdir, exist_ok=True)
+            trace.set_enabled(True)
+            trace.set_flight(_os.path.join(
+                fdir, f"{srv.fleet.replica}.jsonl"))
     srv.install_signal_handlers()
     try:
         summary = srv.run_once() if args.once else srv.serve_forever()
+        if args.once:
+            # batch mode exits without the daemon loop's exit
+            # snapshot: force one here — BEFORE the fleet retirement
+            # below, so the exit aggregation still sees this replica's
+            # heartbeat (docs/observability.md)
+            srv.write_metrics_now()
     finally:
         if args.fleet:
             # retire the membership lease on the way out: peers route
-            # around this replica immediately (docs/fleet.md)
+            # around this replica immediately (docs/fleet.md), and the
+            # black box keeps everything recorded up to this exit
             srv.shutdown()
-    if args.once:
-        # batch mode exits without the daemon loop's exit snapshot:
-        # force one here so SPLATT_METRICS_PATH always holds the final
-        # registry state (docs/observability.md)
-        srv.write_metrics_now()
+            from splatt_tpu import trace
+
+            trace.flight_flush()
     from splatt_tpu import resilience
 
     lines = resilience.run_report().summary()
@@ -430,13 +467,34 @@ def cmd_bench(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    """`splatt trace <file>` — summarize a Chrome trace-event JSON file
-    written by ``--trace <path>`` (docs/observability.md): top spans by
-    self-time, the per-iteration breakdown, the guard-overhead share,
-    and point-event counts."""
+    """`splatt trace <file>...` — summarize (and with multiple inputs,
+    MERGE) recorded traces (docs/observability.md): top spans by
+    self-time, per-iteration breakdown, guard-overhead share,
+    point-event counts, and — for fleet traces — per-replica job
+    counts and adoption lineage.  Inputs may be Chrome trace-event
+    JSON files (``--trace`` exports), flight-recorder ``.jsonl`` rings
+    (a SIGKILLed replica's black box), or a directory holding both;
+    multiple sources merge onto one wall-clock timeline with flow
+    events linking each adopted job's victim and adopter rows."""
     from splatt_tpu import trace
 
-    s = trace.summarize_file(args.file)
+    files = trace.expand_trace_paths(args.file)
+    if not files:
+        raise ValueError(f"no trace files under {args.file}")
+    if len(files) == 1 and not files[0].endswith(".jsonl"):
+        events = trace.load_trace(files[0])
+    else:
+        events = trace.merge_trace_files(files)
+    if args.out:
+        from splatt_tpu.utils.durable import publish_json
+
+        publish_json(args.out, {"traceEvents": events,
+                                "displayTimeUnit": "ms"})
+        # stderr: --json's stdout is a machine-readable contract
+        print(f"merged trace ({len(files)} source(s)) written to "
+              f"{args.out} — load it in ui.perfetto.dev",
+              file=sys.stderr)
+    s = trace.summarize(events)
     if args.json:
         import json as _json
 
@@ -447,6 +505,51 @@ def cmd_trace(args) -> int:
     for line in trace.format_summary(s, top_n=args.top):
         print(line)
     return 0
+
+
+def cmd_status(args) -> int:
+    """`splatt status DIR` / `splatt top DIR` — the fleet dashboard,
+    read ONLY from the shared spool (docs/fleet.md): replicas with
+    lease freshness, queue depths, per-tenant usage, running jobs with
+    age, recent terminal jobs, SLO verdicts.  ``--metrics-out`` writes
+    the merged fleet Prometheus exposition; ``--watch`` refreshes
+    (`top` watches by default)."""
+    import json as _json
+    import time as _time
+
+    from splatt_tpu import fleetobs
+    from splatt_tpu.utils.env import read_env_float
+
+    interval = float(args.interval if args.interval is not None
+                     else read_env_float("SPLATT_STATUS_WATCH_S"))
+
+    def once(clear: bool = False) -> None:
+        # ONE aggregation pass feeds both the status view and the
+        # optional merged-exposition write (the spool is scanned once
+        # per tick, not twice)
+        agg = fleetobs.aggregate(args.dir)
+        st = fleetobs.fleet_status(args.dir, agg=agg)
+        out = []
+        if args.metrics_out:
+            path = fleetobs.write_fleet_metrics(agg, args.metrics_out)
+            out.append(f"fleet metrics written to {path}")
+        if args.json:
+            out.append(_json.dumps(st))
+        else:
+            out.extend(fleetobs.format_status(st))
+        if clear:
+            print("\x1b[2J\x1b[H", end="")
+        print("\n".join(out), flush=True)
+
+    if not args.watch:
+        once()
+        return 0
+    try:
+        while True:
+            once(clear=not args.json)
+            _time.sleep(max(interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_check(args) -> int:
@@ -815,20 +918,66 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(
-        "trace", help="summarize a recorded span-trace file",
-        epilog="Reads a Chrome trace-event JSON file (the --trace "
-               "<path> export of cpd/tune/bench/serve/chaos) and "
-               "prints top spans by self-time, the per-iteration "
-               "breakdown, the guard-overhead share, and point-event "
-               "counts.  Load the same file in ui.perfetto.dev for the "
-               "interactive view (docs/observability.md).")
-    p.add_argument("file", help="Chrome trace-event JSON written by "
-                                "--trace")
+        "trace", help="summarize (and merge) recorded span traces",
+        epilog="Reads Chrome trace-event JSON files (the --trace "
+               "<path> export of cpd/tune/bench/serve/chaos), flight-"
+               "recorder .jsonl rings (a SIGKILLed replica's black "
+               "box), or a directory of both; prints top spans by "
+               "self-time, the per-iteration breakdown, the guard-"
+               "overhead share, point-event counts, and the fleet "
+               "block (per-replica jobs, adoption lineage).  Multiple "
+               "inputs merge onto one wall-clock timeline with flow "
+               "events linking an adopted job's victim and adopter "
+               "(docs/observability.md).  Load the (merged) file in "
+               "ui.perfetto.dev for the interactive view.")
+    p.add_argument("file", nargs="+",
+                   help="trace file(s): Chrome JSON, flight .jsonl, "
+                        "or a directory holding them")
+    p.add_argument("--out", metavar="OUT_JSON",
+                   help="also write the merged Chrome trace-event "
+                        "file (atomic) for perfetto")
     p.add_argument("--top", type=int, default=12, metavar="N",
                    help="rows in the top-spans table (default 12)")
     p.add_argument("--json", action="store_true",
                    help="print the aggregate summary as JSON instead")
     p.set_defaults(fn=cmd_trace)
+
+    for verb, watching in (("status", False), ("top", True)):
+        p = sub.add_parser(
+            verb,
+            help=("watch-mode textual fleet dashboard" if watching
+                  else "one-shot fleet status from the shared spool"),
+            epilog="Reads ONLY the shared serve spool (journal, "
+                   "fleet/ heartbeats + leases, per-replica metrics "
+                   "snapshots, persisted SLO verdicts) — no daemon "
+                   "RPC, so it works on a live fleet, a draining one "
+                   "and a post-mortem alike (docs/fleet.md, "
+                   "docs/observability.md).  Shows replicas with "
+                   "lease freshness, queue depths, per-tenant usage, "
+                   "running jobs with age, recent terminal jobs and "
+                   "the SLO burn summary.")
+        p.add_argument("dir", help="the serve spool directory")
+        p.add_argument("--json", action="store_true",
+                       help="print the machine-readable status object")
+        p.add_argument("--metrics-out", dest="metrics_out",
+                       metavar="PROM",
+                       help="also write the merged fleet Prometheus "
+                            "exposition (counters summed, gauges "
+                            "per-replica, histograms bucket-merged, "
+                            "dead replicas' gauges dropped) to this "
+                            "file, atomically")
+        if watching:
+            p.add_argument("--once", dest="watch",
+                           action="store_false",
+                           help="one-shot instead of watching")
+        else:
+            p.add_argument("--watch", action="store_true",
+                           help="refresh continuously (the `splatt "
+                                "top` default)")
+        p.add_argument("--interval", type=float, metavar="S",
+                       help="watch refresh seconds (default: "
+                            "$SPLATT_STATUS_WATCH_S)")
+        p.set_defaults(fn=cmd_status, watch=watching)
 
     p = sub.add_parser("check", help="check for duplicates/empty slices")
     _common_opts(p)

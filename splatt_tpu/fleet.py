@@ -139,6 +139,11 @@ class FleetMember:
             set(), self._lock, "fleet.FleetMember._lost")
         self._regimes: set = lockcheck.guard(
             set(), self._lock, "fleet.FleetMember._regimes")
+        #: this replica's Prometheus snapshot path, advertised in the
+        #: heartbeat so the fleet aggregator (fleetobs.py) finds every
+        #: replica's metrics without configuration; write-once at
+        #: serve startup, before the heartbeat thread exists
+        self.metrics_path: Optional[str] = None
 
     # -- flock + atomic-rename primitives ------------------------------------
 
@@ -323,6 +328,8 @@ class FleetMember:
             rec = {"replica": self.replica, "pid": os.getpid(),
                    "ts": now, "expires": now + self.lease_s,
                    "regimes": regimes, "active": active}
+            if self.metrics_path:
+                rec["metrics"] = self.metrics_path
             from splatt_tpu.utils.durable import publish_json
 
             publish_json(os.path.join(self.replicas_dir,

@@ -591,6 +591,12 @@ RUN_REPORT_EVENTS = {
     "job_resumed": "journal replay re-enqueued a non-terminal job "
                    "after a daemon restart; the job resumes from its "
                    "last hardened checkpoint (docs/serve.md)",
+    "job_started": "a worker began running an accepted job (emitted "
+                   "next to the journal's started record); as a trace "
+                   "point event it is the flight recorder's "
+                   "deterministic 'this job was live HERE' mark — the "
+                   "fleet soak post-mortems a SIGKILLed replica's "
+                   "ring for it (docs/observability.md)",
     "queue_full": "the serve daemon's bounded queue load-shed a "
                   "submission (SPLATT_SERVE_QUEUE_MAX); the client "
                   "gets an explicit rejection instead of unbounded "
@@ -639,9 +645,12 @@ RUN_REPORT_EVENTS = {
                     "(docs/ring.md; carried into MULTICHIP artifacts "
                     "and `splatt cpd --json`)",
     "bench_noisy": "a bench --gate timing comparison was too noisy to "
-                   "judge: the coefficient of variation of one side "
-                   "exceeded the threshold, so the slowdown is a "
-                   "warning, not a gate failure (bench.py)",
+                   "judge: one side's coefficient of variation "
+                   "exceeded the absolute ceiling, or the delta was "
+                   "smaller than CV_NOISE_MULT x the measured CV (the "
+                   "carried threshold names whichever bound fired), "
+                   "so the slowdown is a warning, not a gate failure "
+                   "(bench.py)",
     "trace_written": "a Chrome trace-event JSON export "
                      "(trace.write_chrome_trace, the --trace <path> "
                      "flag; docs/observability.md) was written, or "
@@ -655,6 +664,19 @@ RUN_REPORT_EVENTS = {
                         "docs/observability.md); a write failure "
                         "degrades classified, never kills the daemon "
                         "it observes",
+    "slo_burn": "an SLO's error-budget burn rate exceeded the alert "
+                "threshold on BOTH the short and long windows "
+                "(fleetobs.SloEvaluator, the multi-window burn-rate "
+                "policy of docs/observability.md): carries the slo "
+                "name, both burn rates and the window; counted into "
+                "splatt_slo_burn_total so a burn spike is visible in "
+                "every later fleet aggregate",
+    "flight_degraded": "a flight-recorder ring flush failed (the "
+                       "trace.flight fault site): the recorder is "
+                       "DISARMED for the rest of the process and the "
+                       "failure classified — the black box must never "
+                       "take down the run it records "
+                       "(docs/observability.md)",
 }
 
 
@@ -893,6 +915,23 @@ class RunReport:
                 lines.append(f"  metrics snapshot to {e.get('path')} "
                              f"FAILED ({e.get('failure_class')}: "
                              f"{str(e.get('error', ''))[:80]})")
+        burns = self.events("slo_burn")
+        if burns:
+            by_slo: Dict[str, int] = {}
+            for e in burns:
+                by_slo[e.get("slo", "?")] = \
+                    by_slo.get(e.get("slo", "?"), 0) + 1
+            worst = max(burns, key=lambda e: e.get("burn_short", 0))
+            lines.append(f"  SLO BURN: " + ", ".join(
+                f"{k}x{v}" for k, v in sorted(by_slo.items()))
+                + f" (worst {worst.get('slo')}: "
+                f"{worst.get('burn_short', 0):g}x short / "
+                f"{worst.get('burn_long', 0):g}x long over "
+                f"{worst.get('window_s', 0):g}s)")
+        for e in self.events("flight_degraded"):
+            lines.append(f"  flight recorder {e.get('path')} DISARMED "
+                         f"({e.get('failure_class')}: "
+                         f"{str(e.get('error', ''))[:80]})")
         return lines
 
 

@@ -317,8 +317,27 @@ class Server:
                                  else str(read_env(
                                      "SPLATT_FLEET_AFFINITY")).lower()
                                  not in ("0", "off", "false", "no"))
+            # fleet observability wiring (docs/observability.md):
+            # default the snapshot into the shared spool — the fleet
+            # aggregator scans heartbeats for it.  (The process-wide
+            # replica stamp on spans/points is the CLI daemon entry's
+            # to set — cli.cmd_serve — so library/test constructions
+            # never flip global trace state behind the caller's back.)
+            if not self.metrics_path:
+                mdir = os.path.join(self.root, "fleet", "metrics")
+                os.makedirs(mdir, exist_ok=True)
+                self.metrics_path = os.path.join(
+                    mdir, f"{self.fleet.replica}.prom")
+            self.fleet.metrics_path = self.metrics_path
         else:
             self.affinity = False
+        # the SLO layer rides the metrics cadence (the "aggregator's
+        # cadence" of docs/observability.md): fleet replicas evaluate
+        # over the merged fleet samples, a single daemon over its own
+        from splatt_tpu.fleetobs import SloEvaluator
+
+        self._slo = SloEvaluator(
+            replica=self.fleet.replica if self.fleet else None)
         self._replay()
         if self.fleet is not None:
             self.fleet.beat()
@@ -334,7 +353,8 @@ class Server:
         j = {"spec": spec, "state": state, "status": None,
              "resumed": False, "tenant": "default", "priority": "normal",
              "seq": self._seq, "owner": None, "adopt_from": None,
-             "adopted_from": None, "deferred": 0, "regime": None}
+             "adopted_from": None, "deferred": 0, "regime": None,
+             "t_accepted": None}
         self._seq += 1
         if spec is not None:
             self._fill_admission(j, spec)
@@ -367,6 +387,9 @@ class Server:
                 j["spec"] = rec.get("spec")
                 self._fill_admission(j, j["spec"])
             j["state"] = ACCEPTED
+            # the journaled accept time feeds the queue-wait histogram
+            # for replayed/peer-accepted jobs too (docs/observability.md)
+            j["t_accepted"] = rec.get("ts")
         else:
             j["state"] = kind
             if kind in (DONE, FAILED):
@@ -527,6 +550,7 @@ class Server:
         resilience.run_report().add("job_accepted", job=jid)
         with self._lock:
             self._jobs[jid]["state"] = ACCEPTED
+            self._jobs[jid]["t_accepted"] = time.time()
             # a fleet peer's journal sync may have surfaced the id
             # while our accept append fsynced — never queue it twice
             if jid not in self._queue and jid not in self._running:
@@ -1069,14 +1093,66 @@ class Server:
     def write_metrics_now(self) -> Optional[dict]:
         """Force one Prometheus-text snapshot (atomic replace; a write
         failure degrades classified inside write_metrics — metrics must
-        never kill the daemon they observe).  No-op without
-        ``SPLATT_METRICS_PATH``."""
+        never kill the daemon they observe), then run the fleet
+        aggregation + SLO tick on the same cadence.  No-op without
+        ``SPLATT_METRICS_PATH`` (fleet mode defaults it into the
+        spool)."""
         if not self.metrics_path:
             return None
         from splatt_tpu import trace
 
         self._metrics_last = time.monotonic()
-        return trace.write_metrics(self.metrics_path)
+        ev = trace.write_metrics(self.metrics_path)
+        self._slo_tick()
+        return ev
+
+    def _slo_tick(self) -> bool:
+        """One aggregator-cadence pass (docs/observability.md): merge
+        the fleet's snapshots into ``fleet/metrics.prom``, evaluate
+        the multi-window SLO burn rates over the MERGED samples (a
+        peer's outage must burn this replica's alerts too), and
+        persist the verdicts for `splatt status`.  Single-replica
+        daemons evaluate over their own registry.  A tick that BURNS
+        re-snapshots this replica (and re-merges before publishing),
+        so a final-tick burn is durable in the per-replica snapshot
+        AND the published fleet/metrics.prom — never lost to a
+        post-mortem.  Any failure degrades classified — observing the
+        fleet must never kill a member of it."""
+        from splatt_tpu import fleetobs, resilience, trace
+
+        try:
+            if self.fleet is not None:
+                agg = fleetobs.aggregate(self.root)
+                # mirror the census into THIS member's registry (its
+                # next snapshot carries its last fleet view); the
+                # aggregator itself stays a side-effect-free reader
+                for state in ("alive", "dead"):
+                    trace.metric_set(
+                        "splatt_fleet_replicas",
+                        float(agg.samples.get(
+                            ("splatt_fleet_replicas",
+                             (("state", state),)), 0.0)),
+                        state=state)
+                res = self._slo.evaluate(agg.samples)
+                self._slo.write_state(fleetobs.slo_state_path(
+                    self.root, self.fleet.replica))
+                if any(s.get("burning") for s in res["slos"].values()):
+                    # the burn incremented splatt_slo_burn_total AFTER
+                    # this tick's snapshot: re-snapshot and re-merge
+                    # so the published exposition carries it even when
+                    # this was the daemon's last tick
+                    trace.write_metrics(self.metrics_path)
+                    agg = fleetobs.aggregate(self.root)
+                fleetobs.write_fleet_metrics(agg)
+            else:
+                res = self._slo.evaluate(trace.samples())
+                if any(s.get("burning") for s in res["slos"].values()):
+                    trace.write_metrics(self.metrics_path)
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            self._log(f"slo/aggregation tick degraded ({cls.value}: "
+                      f"{resilience.failure_message(e)[:120]})",
+                      error=True)
 
     def drain(self) -> None:
         """Begin a graceful drain: stop pulling queued jobs, interrupt
@@ -1111,6 +1187,8 @@ class Server:
             j = self._jobs[jid]
             spec, resumed = j["spec"], j["resumed"]
             regime = j.get("regime")
+            adopted_from = j.get("adopted_from")
+            t_accepted = j.get("t_accepted")
             j["state"] = STARTED
         try:
             self.journal.append(self._rec(STARTED, jid))
@@ -1122,26 +1200,59 @@ class Server:
         self._log(f"job {jid}: started" + (" (resumed)" if resumed else ""))
         from splatt_tpu import trace
 
+        # the flight recorder's deterministic liveness mark: a point
+        # event on THIS replica's ring saying the job went live here
+        # (rides the next ring flush) — what the fleet soak's
+        # post-mortem reads off a SIGKILLed victim (docs/observability.md)
+        resilience.run_report().add("job_started", job=jid,
+                                    resumed=resumed)
+
+        # queue-wait SLO observation (docs/observability.md): seconds
+        # accepted-to-started — an adoption after a kill lands the
+        # victim's whole outage here, which is what makes the burn-rate
+        # spike the fleet soak asserts on
+        if t_accepted is not None:
+            trace.metric_observe("splatt_serve_queue_wait_seconds",
+                                 max(time.time() - float(t_accepted),
+                                     0.0))
         # one span per supervised job (docs/observability.md): with
         # tracing on, a tenant's whole run — cpd.als and its guard
         # spans nested under it — carries the job id (and, in fleet
-        # mode, the replica that ran it — the `splatt trace` fleet
-        # summary groups on it)
+        # mode, the replica that ran it plus the adoption lineage —
+        # the `splatt trace` fleet summary and the merged-trace flow
+        # events key on replica/adopted_from/status)
         attrs = dict(job=jid, resumed=resumed)
         if self.fleet is not None:
             attrs["replica"] = self.fleet.replica
-        with trace.span("serve.job", **attrs):
+            if adopted_from:
+                attrs["adopted_from"] = adopted_from
+        with trace.span("serve.job", **attrs) as sp:
             record, stopped = self._execute(jid, spec, resumed)
-        if self.fleet is not None and record is not None \
-                and not self.fleet.renew(jid):
-            # commit fence: a terminal record may only be journaled
-            # under a live lease.  A stalled heartbeat (paused
-            # process, busy host) can let the lease expire mid-run
-            # unnoticed by the cooperative poll — the renew refusal
-            # here catches it at the last gate, so a zombie owner can
-            # never double-commit a job a peer already adopted
-            stopped["lease"] = True
-            record = None
+            if self.fleet is not None and record is not None \
+                    and not self.fleet.renew(jid):
+                # commit fence: a terminal record may only be journaled
+                # under a live lease.  A stalled heartbeat (paused
+                # process, busy host) can let the lease expire mid-run
+                # unnoticed by the cooperative poll — the renew refusal
+                # here catches it at the last gate, so a zombie owner
+                # can never double-commit a job a peer already adopted
+                stopped["lease"] = True
+                record = None
+            if record is not None:
+                self._write_result(jid, record)
+                kind = FAILED if record["status"] == "failed" else DONE
+                try:
+                    self.journal.append(self._rec(
+                        kind, jid, status=record["status"]))
+                    # the span carries the terminal verdict only once
+                    # it is durably journaled — the merged-trace
+                    # lineage audit counts COMMITTED verdicts (exactly
+                    # one per job), so a failed finish-append (replay
+                    # re-runs the job) must not leave a span claiming
+                    # a commit that never happened
+                    sp.set(status=record["status"])
+                except Exception as e:
+                    self._warn_journal("finish", jid, e)
         if record is None and stopped.get("lease"):
             # ownership moved on (lease expired; possibly adopted):
             # abandon WITHOUT committing anything — no terminal
@@ -1169,13 +1280,6 @@ class Server:
             self._log(f"job {jid}: interrupted by drain (checkpointed; "
                       f"resumes next start)")
             return
-        self._write_result(jid, record)
-        kind = FAILED if record["status"] == "failed" else DONE
-        try:
-            self.journal.append(self._rec(kind, jid,
-                                          status=record["status"]))
-        except Exception as e:
-            self._warn_journal("finish", jid, e)
         with self._lock:
             self._jobs[jid]["state"] = kind
             self._jobs[jid]["status"] = record["status"]

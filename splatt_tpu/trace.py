@@ -51,10 +51,28 @@ Overhead contract
     (bench.py ``trace_ab``) measures enabled-but-unexported tracing on
     the blocked path — the <2 % budget docs/observability.md documents.
 
+Flight recorder
+    :func:`set_flight` arms a bounded, incrementally-appended ring of
+    recent span/point records (JSONL, atomic rotation to ``<path>.1``)
+    so a SIGKILLed replica leaves a readable black box — the fleet
+    chaos soak post-mortems the victim's timeline up to the kill from
+    it (docs/fleet.md).  Flushes follow the ``trace.export`` fault-site
+    discipline (site ``trace.flight``): a failure disarms the recorder
+    with a classified ``flight_degraded`` event, never killing the run.
+
+Fleet merge
+    Every span/point is stamped with the replica id
+    (:func:`set_replica`), and :func:`merge_trace_files` merges many
+    replicas' traces — Chrome exports and flight rings alike — onto
+    one timeline via the shared wall-clock↔perf_counter anchor, with
+    flow events linking an adopted job's pre-kill spans on the victim
+    to its continuation on the adopter (``splatt trace f1 f2 ...``).
+
 Span names are a registry (:data:`SPANS`), statically checked by
 splint rule SPL013 exactly like fault sites (SPL006) and run-report
 events (SPL012): an undeclared ``trace.span("...")`` literal — or a
-declared name no production code opens — is a finding.
+declared name no production code opens — is a finding.  Metric names
+(:data:`METRICS`) get the same treatment from SPL019.
 
 This module imports nothing heavy at import time (no jax, no numpy);
 jax is touched lazily only for the optional TPU trace annotation.
@@ -66,6 +84,7 @@ import contextlib
 import contextvars
 import itertools
 import json
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -164,6 +183,28 @@ METRICS = {
                    "replica's renew refused, job abandoned "
                    "uncommitted; adopter: an expired lease was taken "
                    "over)"),
+    "splatt_serve_queue_wait_seconds": (
+        "histogram", "serve: seconds a job waited accepted-to-started "
+                     "— the queue-wait SLO's histogram; an adoption "
+                     "after a kill lands the victim's wait here "
+                     "(docs/observability.md)"),
+    "splatt_slo_burn_total": (
+        "counter", "SLO burn-rate alerts by slo name and emitting "
+                   "replica: the error budget burned at >= the alert "
+                   "multiple on both windows (fleetobs.SloEvaluator). "
+                   "Counts burning EVALUATIONS (alert-ticks) per "
+                   "replica — every fleet member evaluates the same "
+                   "merged samples, so sum across replicas only "
+                   "knowingly; nonzero anywhere = the incident was "
+                   "visible"),
+    "splatt_fleet_replicas": (
+        "gauge", "fleet: replica count by liveness state (alive = "
+                 "unexpired heartbeat lease, dead = present-but-"
+                 "expired) — synthesized into every merged "
+                 "exposition; serve members mirror their last census "
+                 "into their own registry (the merge drops the "
+                 "per-replica copies, so the census never "
+                 "double-counts)"),
 }
 
 #: histogram bucket upper bounds (seconds); +Inf is implicit
@@ -248,12 +289,69 @@ _POINTS: List[dict] = _lockcheck.guard([], _LOCK, "trace._POINTS")
 #: (wall-clock, perf_counter) anchor pair: spans time with the
 #: monotonic perf_counter and the exporter maps onto the epoch once
 _ANCHOR: Tuple[float, float] = (time.time(), time.perf_counter())
+
+#: in-memory recorder bound (SPLATT_TRACE_MAX_RECORDS): a fleet
+#: daemon runs with recording on for its whole life (the flight
+#: recorder needs records to exist), so _DONE/_POINTS must not grow
+#: without bound — past the cap the OLDEST records are dropped in
+#: chunks (the flight ring already persisted them) and the drop is
+#: counted, surfaced on the trace_written event.  None = not read yet.
+_record_cap: Optional[int] = None
+_DROPPED = {"spans": 0, "points": 0}
+
+
+def _cap() -> int:
+    global _record_cap
+    if _record_cap is None:
+        from splatt_tpu.utils.env import read_env_int
+
+        _record_cap = max(int(read_env_int("SPLATT_TRACE_MAX_RECORDS")),
+                          1000)
+    return _record_cap
+
+
+def _bound_locked(lst: List[dict], what: str) -> None:
+    """Drop the oldest ~10% once `lst` outgrows the cap (callers hold
+    _LOCK; chunked so the O(n) front-delete amortizes)."""
+    cap = _cap()
+    if len(lst) > cap:
+        drop = max(cap // 10, 1)
+        del lst[:drop]
+        _DROPPED[what] += drop
 _STACK: contextvars.ContextVar = contextvars.ContextVar(
     "splatt_trace_stack", default=())
 
 #: memoized "emit jax.profiler.TraceAnnotation?" verdict: None =
 #: undecided, False = no (CPU, or jax unhappy), True = TPU backend
 _annotate_verdict: Optional[bool] = None
+
+#: the replica id stamped on every span/point record (fleet mode,
+#: docs/fleet.md): None outside a fleet replica.  Write-once per
+#: process in practice (serve stamps it at startup), so a bare global
+#: is race-free enough.
+_replica: Optional[str] = None
+
+#: flight-recorder state (docs/observability.md): empty = disarmed;
+#: armed it holds path/max_bytes/flush_every/buf, every key mutated
+#: under _LOCK ([tool.splint] shared-state).  buf accumulates raw
+#: span/point records; _flight_flush drains it to the ring file.
+_FLIGHT: Dict[str, object] = _lockcheck.guard({}, _LOCK, "trace._FLIGHT")
+#: serializes ring-file IO across flushing threads (taken only after
+#: _LOCK is released — no nesting, no ordering cycle)
+_FLIGHT_IO_LOCK = _lockcheck.guard_lock(threading.Lock())
+
+
+def set_replica(rid: Optional[str]) -> None:
+    """Stamp every subsequent span/point record (and the Chrome
+    export's process row) with this replica id — what lets
+    :func:`merge_trace_files` render N replicas' traces as one fleet
+    timeline (docs/fleet.md)."""
+    global _replica
+    _replica = str(rid) if rid else None
+
+
+def replica() -> Optional[str]:
+    return _replica
 
 
 def _should_annotate() -> bool:
@@ -312,7 +410,8 @@ class SpanHandle:
         job = attrs.pop("job", None) or _job()
         self.rec = {"name": name, "sid": next(_SIDS), "parent": None,
                     "t0": 0.0, "dur": None, "args": attrs,
-                    "tid": threading.get_ident(), "job": job}
+                    "tid": threading.get_ident(), "job": job,
+                    "replica": _replica}
         self._ann = None
 
     def set(self, **attrs):
@@ -355,9 +454,16 @@ class SpanHandle:
             # stop A): drop OUR sid wherever it sits; leaked children
             # clean themselves up on their own exit
             _STACK.set(tuple(s for s in stack if s != sid))
+        flush_now = False
         with _LOCK:
             _OPEN.pop(sid, None)
             _DONE.append(self.rec)
+            _bound_locked(_DONE, "spans")
+            if _FLIGHT:
+                _FLIGHT["buf"].append(self.rec)
+                flush_now = len(_FLIGHT["buf"]) >= _FLIGHT["flush_every"]
+        if flush_now:
+            _flight_flush()
         return False
 
 
@@ -401,9 +507,17 @@ def point(kind: str, info: Optional[dict] = None) -> None:
     stack = _STACK.get()
     rec = {"name": kind, "t": time.perf_counter(),
            "parent": stack[-1] if stack else None,
-           "tid": threading.get_ident(), "args": info}
+           "tid": threading.get_ident(), "args": info,
+           "job": _job(), "replica": _replica}
+    flush_now = False
     with _LOCK:
         _POINTS.append(rec)
+        _bound_locked(_POINTS, "points")
+        if _FLIGHT:
+            _FLIGHT["buf"].append(rec)
+            flush_now = len(_FLIGHT["buf"]) >= _FLIGHT["flush_every"]
+    if flush_now:
+        _flight_flush()
 
 
 def spans(name: Optional[str] = None) -> List[dict]:
@@ -428,10 +542,13 @@ def reset() -> None:
     """Drop every recorded span/point (a fresh run in one process;
     tests).  Open handles close harmlessly into the cleared recorder.
     Metrics are NOT cleared — use :func:`reset_metrics`."""
+    global _record_cap
     with _LOCK:
         _DONE.clear()
         _OPEN.clear()
         _POINTS.clear()
+    _DROPPED["spans"] = _DROPPED["points"] = 0
+    _record_cap = None  # re-earn the env verdict (tests flip it)
 
 
 # -- metrics registry --------------------------------------------------------
@@ -505,6 +622,16 @@ def reset_metrics() -> None:
         _SAMPLES.clear()
 
 
+def samples() -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object]:
+    """The raw registry samples, ``(name, label-key) -> value`` with
+    histogram states copied — the form the fleet aggregator and the
+    SLO evaluator consume (splatt_tpu/fleetobs.py)."""
+    with _MET_LOCK:
+        return {k: (dict(v, buckets=list(v["buckets"]))
+                    if isinstance(v, dict) else v)
+                for k, v in _SAMPLES.items()}
+
+
 def _event_metrics(kind: str, info: dict) -> None:
     """Event-kind -> metric mapping: every run-report event counts into
     ``splatt_events_total``; load-bearing kinds get their own series."""
@@ -523,6 +650,14 @@ def _event_metrics(kind: str, info: dict) -> None:
         metric_inc("splatt_health_rollbacks_total", **labels)
     elif kind == "health_degraded":
         metric_inc("splatt_health_degraded_total", **labels)
+    elif kind == "slo_burn":
+        # the replica label keeps the merged counter per-emitter:
+        # every fleet member evaluates the same merged samples, so an
+        # unlabelled cross-replica sum would scale one incident by
+        # fleet size (None outside a fleet — the label is dropped)
+        metric_inc("splatt_slo_burn_total",
+                   slo=info.get("slo", "?"),
+                   replica=info.get("replica"), **labels)
 
 
 def _fmt_labels(lk: Tuple[Tuple[str, str], ...]) -> str:
@@ -549,6 +684,15 @@ def metrics_text(job: Optional[str] = None) -> str:
     never appear)."""
     with _MET_LOCK:
         samples = dict(_SAMPLES)
+    return render_samples(samples, job=job)
+
+
+def render_samples(samples: Dict, job: Optional[str] = None) -> str:
+    """Render a raw sample map (:func:`samples`-shaped) as Prometheus
+    text exposition.  Only :data:`METRICS`-declared names are emitted —
+    the registry is the exposition contract (splint SPL019), for the
+    fleet aggregator's merged samples exactly as for this process's
+    own (splatt_tpu/fleetobs.py)."""
     lines: List[str] = []
     for name in METRICS:
         typ, doc = METRICS[name]
@@ -621,6 +765,45 @@ def write_metrics(path: str, job: Optional[str] = None) -> dict:
 
 # -- Chrome trace-event export -----------------------------------------------
 
+def _us(t: float) -> int:
+    """perf_counter time -> epoch microseconds via the shared anchor —
+    the one mapping every exporter (Chrome trace, flight ring) uses,
+    which is what makes cross-replica merges line up on wall clock."""
+    wall0, perf0 = _ANCHOR
+    return int((wall0 + (t - perf0)) * 1e6)
+
+
+def _span_event(rec: dict, pid: Optional[int] = None) -> dict:
+    """One finished-span record -> its Chrome complete event."""
+    args = dict(rec["args"], sid=rec["sid"])
+    if rec["parent"] is not None:
+        args["parent"] = rec["parent"]
+    if rec["job"] is not None:
+        args["job"] = rec["job"]
+    if rec.get("replica") is not None:
+        args["replica"] = rec["replica"]
+    return {"name": rec["name"], "cat": "span", "ph": "X",
+            "ts": _us(rec["t0"]),
+            "dur": max(int((rec["dur"] or 0.0) * 1e6), 1),
+            "pid": pid if pid is not None else os.getpid(),
+            "tid": rec["tid"], "args": args}
+
+
+def _point_event(rec: dict, pid: Optional[int] = None) -> dict:
+    """One point-event record -> its Chrome instant event."""
+    args = dict(rec["args"])
+    if rec["parent"] is not None:
+        args["parent"] = rec["parent"]
+    if rec.get("job") is not None:
+        args.setdefault("job", rec["job"])
+    if rec.get("replica") is not None:
+        args["replica"] = rec["replica"]
+    return {"name": rec["name"], "cat": "event", "ph": "i",
+            "s": "t", "ts": _us(rec["t"]),
+            "pid": pid if pid is not None else os.getpid(),
+            "tid": rec["tid"], "args": args}
+
+
 def chrome_events() -> List[dict]:
     """The recorder as Chrome trace-event dicts: one complete event
     (``ph: "X"``) per finished span — still-open spans ride along with
@@ -628,14 +811,8 @@ def chrome_events() -> List[dict]:
     one instant event (``ph: "i"``) per point event.  ``args`` carries
     the span attributes plus ``sid``/``parent`` so the summarizer (and
     perfetto queries) can rebuild the tree without guessing from
-    timestamps."""
-    import os
-
-    wall0, perf0 = _ANCHOR
-
-    def us(t: float) -> int:
-        return int((wall0 + (t - perf0)) * 1e6)
-
+    timestamps.  With a :func:`set_replica` stamp, a ``process_name``
+    metadata row names the process row after the replica."""
     now = time.perf_counter()
     with _LOCK:
         done = list(_DONE)
@@ -644,25 +821,13 @@ def chrome_events() -> List[dict]:
                       for rec in _OPEN.values()]
         pts = list(_POINTS)
     pid = os.getpid()
-    evs = []
-    for rec in done + still_open:
-        args = dict(rec["args"], sid=rec["sid"])
-        if rec["parent"] is not None:
-            args["parent"] = rec["parent"]
-        if rec["job"] is not None:
-            args["job"] = rec["job"]
-        evs.append({"name": rec["name"], "cat": "span", "ph": "X",
-                    "ts": us(rec["t0"]),
-                    "dur": max(int((rec["dur"] or 0.0) * 1e6), 1),
-                    "pid": pid, "tid": rec["tid"], "args": args})
-    for p in pts:
-        args = dict(p["args"])
-        if p["parent"] is not None:
-            args["parent"] = p["parent"]
-        evs.append({"name": p["name"], "cat": "event", "ph": "i",
-                    "s": "t", "ts": us(p["t"]), "pid": pid,
-                    "tid": p["tid"], "args": args})
+    evs = [_span_event(rec, pid) for rec in done + still_open]
+    evs += [_point_event(p, pid) for p in pts]
     evs.sort(key=lambda e: e["ts"])
+    if _replica is not None:
+        evs.insert(0, {"name": "process_name", "ph": "M", "ts": 0,
+                       "pid": pid,
+                       "args": {"name": f"replica {_replica}"}})
     return evs
 
 
@@ -688,10 +853,121 @@ def write_chrome_trace(path: str) -> dict:
                 "trace_written", path=str(path), ok=False,
                 failure_class=cls.value,
                 error=resilience.failure_message(e)[:200])
+    extra = {}
+    if _DROPPED["spans"] or _DROPPED["points"]:
+        # the in-memory recorder hit SPLATT_TRACE_MAX_RECORDS and
+        # dropped its oldest records (a long-lived daemon's bound):
+        # the export is honest about being a suffix, and the flight
+        # ring holds what fell off
+        extra = {"dropped_spans": _DROPPED["spans"],
+                 "dropped_points": _DROPPED["points"]}
     return resilience.run_report().add(
         "trace_written", path=str(path), ok=True,
         spans=sum(1 for e in evs if e["ph"] == "X"),
-        events=sum(1 for e in evs if e["ph"] == "i"))
+        events=sum(1 for e in evs if e["ph"] == "i"), **extra)
+
+
+# -- flight recorder (docs/observability.md) ---------------------------------
+#
+# The Chrome export above only exists if the process lives to write it;
+# a SIGKILLed fleet replica's telemetry used to simply vanish.  The
+# flight recorder is the black box: every FINISHED span and point event
+# is also appended (buffered, JSONL, already wall-clock-anchored Chrome
+# events) to a bounded ring file that rotates atomically — so after a
+# kill, the victim's timeline up to its last flush is readable by
+# load_flight / `splatt trace` and the fleet soak's post-mortem.
+
+def set_flight(path: Optional[str], max_bytes: Optional[int] = None,
+               flush_every: Optional[int] = None) -> None:
+    """Arm (or with ``path=None`` disarm) the flight recorder.  Spans
+    must be enabled for records to exist — fleet-mode serve arms both
+    (cli.py).  `max_bytes` bounds the ring file before rotation
+    (``SPLATT_FLIGHT_BYTES``); `flush_every` is the buffered-record
+    flush threshold (``SPLATT_FLIGHT_FLUSH``) — a SIGKILL loses at
+    most that many trailing records."""
+    from splatt_tpu.utils.env import read_env_int
+
+    if path:
+        mb = int(max_bytes if max_bytes is not None
+                 else read_env_int("SPLATT_FLIGHT_BYTES"))
+        fe = max(int(flush_every if flush_every is not None
+                     else read_env_int("SPLATT_FLIGHT_FLUSH")), 1)
+    with _LOCK:
+        _FLIGHT.clear()
+        if path:
+            _FLIGHT.update(path=str(path), max_bytes=mb,
+                           flush_every=fe, buf=[])
+
+
+def flight_path() -> Optional[str]:
+    with _LOCK:
+        return _FLIGHT.get("path") if _FLIGHT else None
+
+
+def flight_flush() -> None:
+    """Drain the buffered flight records to the ring file now (drain/
+    exit paths; a no-op while disarmed)."""
+    _flight_flush()
+
+
+def _flight_flush() -> None:
+    """One ring flush: drain the buffer under the recorder lock, write
+    outside it (ring IO serialized by its own lock).  ANY failure —
+    the ``trace.flight`` fault site drills it — disarms the recorder
+    and degrades classified (``flight_degraded``): the black box must
+    never take down the run it records."""
+    from splatt_tpu import resilience
+    from splatt_tpu.utils import faults
+    from splatt_tpu.utils.durable import ring_append
+
+    with _LOCK:
+        if not _FLIGHT or not _FLIGHT["buf"]:
+            return
+        recs = list(_FLIGHT["buf"])
+        _FLIGHT["buf"].clear()
+        path, max_bytes = _FLIGHT["path"], _FLIGHT["max_bytes"]
+    lines = [json.dumps(_span_event(r) if "t0" in r
+                        else _point_event(r)).encode() for r in recs]
+    try:
+        with _FLIGHT_IO_LOCK:
+            faults.maybe_fail("trace.flight")
+            ring_append(path, lines, max_bytes)
+    except Exception as e:
+        # disarm FIRST: the classified report below flows through
+        # point(), which must find the recorder already off
+        set_flight(None)
+        cls = resilience.classify_failure(e)
+        resilience.run_report().add(
+            "flight_degraded", path=str(path), failure_class=cls.value,
+            error=resilience.failure_message(e)[:200])
+
+
+def load_flight(path: str) -> List[dict]:
+    """Read a flight ring (the rotated ``<path>.1`` generation first,
+    then the live file) back into Chrome trace events.  A torn final
+    line — the record a SIGKILL interrupted mid-append — is skipped,
+    never fatal: the black box is read exactly as the crash left it."""
+    out: List[dict] = []
+    found = False
+    for p in (path + ".1", path):
+        try:
+            with open(p, "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        found = True
+        for raw in data.split(b"\n"):
+            if not raw.strip():
+                continue
+            try:
+                ev = json.loads(raw.decode(errors="replace"))
+            except ValueError:
+                continue  # torn/garbled line: crash debris, skipped
+            if isinstance(ev, dict) and ev.get("ph"):
+                out.append(ev)
+    if not found:
+        raise FileNotFoundError(f"no flight ring at {path} (or .1)")
+    return out
 
 
 # -- trace summarization (`splatt trace <file>`) -----------------------------
@@ -707,6 +983,127 @@ def load_trace(path: str) -> List[dict]:
     if not isinstance(data, list):
         raise ValueError(f"{path} is not a Chrome trace-event file")
     return data
+
+
+# -- cross-replica merge (`splatt trace f1 f2 ...`, docs/fleet.md) -----------
+
+def expand_trace_paths(paths: List[str]) -> List[str]:
+    """CLI path resolution: files pass through, a directory expands to
+    its ``*.json`` Chrome traces and ``*.jsonl`` flight rings.  A ring
+    is identified by its BASE path even when only the rotated
+    ``.jsonl.1`` generation survives (a SIGKILL in the window between
+    rotation and the next flush leaves exactly that) — load_flight
+    reads whichever generations exist, so the victim's black box is
+    never silently dropped from a merge."""
+    import glob as _glob
+
+    def rings_in(d: str) -> List[str]:
+        rings = set(_glob.glob(os.path.join(d, "*.jsonl")))
+        rings |= {q[:-len(".1")] for q in
+                  _glob.glob(os.path.join(d, "*.jsonl.1"))}
+        return sorted(rings)
+
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out += sorted(_glob.glob(os.path.join(p, "*.json")))
+            out += rings_in(p)
+            # a serve SPOOL keeps its flight rings one level down
+            # (fleet/flight/<replica>.jsonl): `splatt trace <spool>`
+            # must merge the victims' black boxes without the
+            # operator knowing the layout (docs/fleet.md)
+            flight = os.path.join(p, "fleet", "flight")
+            if os.path.isdir(flight):
+                out += rings_in(flight)
+        elif p.endswith(".jsonl.1"):
+            out.append(p[:-len(".1")])  # the ring's base names it
+        else:
+            out.append(p)
+    return out
+
+
+def _source_replica(events: List[dict]) -> Optional[str]:
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            name = str((e.get("args") or {}).get("name") or "")
+            return name.replace("replica ", "") or None
+        rep = (e.get("args") or {}).get("replica")
+        if rep:
+            return str(rep)
+    return None
+
+
+def merge_trace_files(paths: List[str]) -> List[dict]:
+    """Merge N trace sources — Chrome exports (``.json``) and flight
+    rings (``.jsonl``) — into ONE timeline.  Every exporter stamps
+    timestamps through the shared wall-clock↔perf_counter anchor, so
+    the merge is a sort, not a re-clock; each source gets its own
+    process row (pid = source index, named by its replica id) so pid
+    reuse across restarted replicas can never collapse two replicas
+    onto one row.  Flow events (:func:`_job_flows`) then link each
+    adopted job's pre-kill events on the victim to its continuation on
+    the adopter — the failover rendered as one logical job timeline."""
+    merged: List[dict] = []
+    pid_next = 1
+    for path in expand_trace_paths(paths):
+        events = (load_flight(path) if path.endswith(".jsonl")
+                  else load_trace(path))
+        if not any(e.get("ph") in ("X", "i") for e in events):
+            continue  # e.g. a spool's journal.jsonl swept up by a
+            #            directory expansion: no trace events, no row
+        i, pid_next = pid_next, pid_next + 1
+        label = _source_replica(events) or \
+            os.path.splitext(os.path.basename(path))[0]
+        merged.append({"name": "process_name", "ph": "M", "ts": 0,
+                       "pid": i, "args": {"name": f"replica {label}",
+                                          "source": path}})
+        for e in events:
+            if e.get("ph") == "M":
+                continue  # re-rowed above
+            merged.append(dict(e, pid=i))
+    merged += _job_flows(merged)
+    merged.sort(key=lambda e: (e.get("ts", 0), e.get("ph") != "M"))
+    return merged
+
+
+def _job_flows(events: List[dict]) -> List[dict]:
+    """Chrome flow events linking an adopted job across replicas: for
+    every ``serve.job`` span carrying ``adopted_from``, draw an arrow
+    from the previous owner's LAST event for that job (the victim's
+    final pre-kill span or point, typically straight out of its
+    flight ring) to the adopter's span start (docs/fleet.md)."""
+    by_job: Dict[str, List[dict]] = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "serve.job":
+            job = (e.get("args") or {}).get("job")
+            if job:
+                by_job.setdefault(str(job), []).append(e)
+    flows: List[dict] = []
+    fid = 0
+    for job, spans in sorted(by_job.items()):
+        spans.sort(key=lambda e: e.get("ts", 0))
+        for b in spans:
+            src = (b.get("args") or {}).get("adopted_from")
+            if not src:
+                continue
+            prior = [e for e in events
+                     if e is not b and e.get("ph") in ("X", "i")
+                     and (e.get("args") or {}).get("job") == job
+                     and (e.get("args") or {}).get("replica") == src]
+            if not prior:
+                continue
+            a = max(prior,
+                    key=lambda e: e.get("ts", 0) + int(e.get("dur", 0)))
+            fid += 1
+            t_from = min(a.get("ts", 0) + int(a.get("dur", 0)),
+                         b.get("ts", 0))
+            common = {"name": "job_lineage", "cat": "fleet", "id": fid,
+                      "args": {"job": job, "from_replica": src}}
+            flows.append(dict(common, ph="s", pid=a["pid"],
+                              tid=a.get("tid", 0), ts=t_from))
+            flows.append(dict(common, ph="f", bp="e", pid=b["pid"],
+                              tid=b.get("tid", 0), ts=b.get("ts", 0)))
+    return flows
 
 
 def _is_guard(name: str) -> bool:
@@ -761,11 +1158,25 @@ def summarize(events: List[dict]) -> dict:
     # the failover story — `splatt trace` must account for every
     # adoption next to the per-replica job counts
     replicas: Dict[str, int] = {}
+    jobs: Dict[str, List[dict]] = {}
     for e in sp:
         if e["name"] == "serve.job":
-            rid = (e.get("args") or {}).get("replica")
+            args = e.get("args") or {}
+            rid = args.get("replica")
             if rid:
                 replicas[str(rid)] = replicas.get(str(rid), 0) + 1
+            if args.get("job"):
+                # per-job ownership lineage across a merged trace: one
+                # entry per serve.job span, in time order — an adopted
+                # job renders as victim(open) -> adopter(status), with
+                # exactly one terminal commit (docs/fleet.md)
+                jobs.setdefault(str(args["job"]), []).append({
+                    "ts": int(e.get("ts", 0)),
+                    "replica": rid, "status": args.get("status"),
+                    "adopted_from": args.get("adopted_from"),
+                    "open": bool(args.get("open"))})
+    for rl in jobs.values():
+        rl.sort(key=lambda r: r["ts"])
     fleet = None
     if replicas or kinds.get("job_adopted") or kinds.get("lease_expired"):
         fleet = {"replicas": replicas,
@@ -773,6 +1184,7 @@ def summarize(events: List[dict]) -> dict:
                  "lease_expired": kinds.get("lease_expired", 0)}
     return {"spans": sum(a["count"] for a in names.values()),
             "fleet": fleet,
+            "jobs": jobs,
             "names": names,
             "top": sorted(names.items(), key=lambda kv: -kv[1]["self_us"]),
             "iters": iters,
@@ -823,6 +1235,18 @@ def format_summary(s: dict, top_n: int = 12) -> List[str]:
                      f"{fl['lease_expired']} lease expir"
                      f"{'y' if fl['lease_expired'] == 1 else 'ies'}; "
                      f"jobs per replica: {per}")
+        for job, rl in sorted((s.get("jobs") or {}).items()):
+            if len(rl) < 2 and not any(r.get("adopted_from")
+                                       for r in rl):
+                continue  # single-owner jobs need no lineage line
+            hops = " -> ".join(
+                f"{r.get('replica') or '?'}"
+                + (f"[adopted_from={r['adopted_from']}]"
+                   if r.get("adopted_from") else "")
+                + (f":{r['status']}" if r.get("status")
+                   else (":open" if r.get("open") else ""))
+                for r in rl)
+            lines.append(f"  job {job}: {hops}")
     if s["points"]:
         evs = ", ".join(f"{k}x{v}"
                         for k, v in sorted(s["points"].items()))

@@ -120,3 +120,32 @@ def append_line(path: str, data: bytes, heal_tail: bool = True,
         finally:
             if _fcntl is not None and use_flock:
                 _fcntl.flock(f.fileno(), _fcntl.LOCK_UN)
+
+
+def ring_append(path: str, lines: list, max_bytes: int) -> int:
+    """Flight-recorder ring append (docs/observability.md): write a
+    batch of newline-terminated records to `path` and, once the file
+    outgrows `max_bytes`, rotate it atomically to ``<path>.1`` (one
+    previous generation kept) so the recorder stays bounded.  Returns
+    the file's size after the append.
+
+    Deliberately NO fsync and NO flock: the flight recorder is a
+    single-writer per-replica black box on the span hot path, and a
+    write()+flush() reaches the kernel page cache — which survives the
+    writing process being SIGKILLed (the black-box scenario); only a
+    host power loss can eat the tail, and the reader tolerates a torn
+    final line either way.  This is a sanctioned durable-write helper
+    (splint SPL016) precisely so the weaker contract is declared in
+    one audited place instead of hand-rolled per call site."""
+    path = str(path)
+    with open(path, "ab") as f:
+        for line in lines:
+            if not line.endswith(b"\n"):
+                line = line + b"\n"
+            f.write(line)
+        f.flush()
+        size = f.tell()
+    if size >= max_bytes:
+        os.replace(path, path + ".1")
+        size = 0
+    return size

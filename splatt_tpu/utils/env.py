@@ -194,12 +194,86 @@ ENV_VARS = {
                                         "SPLATT_METRICS_PATH; <= 0 "
                                         "snapshots only at daemon "
                                         "exit"),
+    "SPLATT_TRACE_MAX_RECORDS": EnvVar(100000, "in-memory span/point "
+                                       "recorder bound: past this "
+                                       "many finished records the "
+                                       "OLDEST are dropped (counted, "
+                                       "surfaced on trace_written) — "
+                                       "what lets a fleet daemon run "
+                                       "with recording + the flight "
+                                       "ring armed for its whole "
+                                       "life without unbounded RSS"),
+    # flight recorder (splatt_tpu/trace.py, docs/observability.md)
+    "SPLATT_FLIGHT": EnvVar("auto", "flight recorder — the bounded, "
+                            "incrementally-appended ring of recent "
+                            "spans/point events that survives a "
+                            "SIGKILL (docs/observability.md): auto = "
+                            "armed by fleet-mode `splatt serve` at "
+                            "<root>/fleet/flight/<replica>.jsonl, off "
+                            "elsewhere; 0/off disables even in fleet "
+                            "mode; 1/on keeps the fleet default "
+                            "explicit"),
+    "SPLATT_FLIGHT_BYTES": EnvVar(1 << 20, "flight recorder: rotate "
+                                  "the ring file atomically to "
+                                  "<path>.1 once it outgrows this "
+                                  "many bytes (one previous "
+                                  "generation kept — the bound on "
+                                  "the black box)"),
+    "SPLATT_FLIGHT_FLUSH": EnvVar(32, "flight recorder: buffered "
+                                  "records per ring-file flush; a "
+                                  "SIGKILL loses at most this many "
+                                  "trailing records (smaller = "
+                                  "fresher black box, more write "
+                                  "calls on the span path)"),
+    # SLO layer (splatt_tpu/fleetobs.py, docs/observability.md)
+    "SPLATT_SLO_QUEUE_WAIT_P95_S": EnvVar(30.0, "SLO objective: 95% "
+                                          "of jobs start within this "
+                                          "many seconds of acceptance "
+                                          "(the splatt_serve_queue_"
+                                          "wait_seconds histogram; "
+                                          "threshold rounds up to a "
+                                          "histogram bucket bound)"),
+    "SPLATT_SLO_JOB_WALL_P95_S": EnvVar(600.0, "SLO objective: 95% of "
+                                        "terminal jobs finish within "
+                                        "this many wall seconds (the "
+                                        "splatt_job_seconds "
+                                        "histogram)"),
+    "SPLATT_SLO_AVAILABILITY": EnvVar(0.99, "SLO objective: the "
+                                     "accepted fraction of "
+                                     "submissions — availability = "
+                                     "1 - (queue_full + "
+                                     "quota_rejected) / offered"),
+    "SPLATT_SLO_WINDOW_S": EnvVar(300.0, "SLO burn-rate short window "
+                                  "in seconds; the long window is "
+                                  "SPLATT_SLO_LONG_WINDOWS times "
+                                  "this (docs/observability.md)"),
+    "SPLATT_SLO_LONG_WINDOWS": EnvVar(12, "SLO burn-rate long window, "
+                                     "as a multiple of "
+                                     "SPLATT_SLO_WINDOW_S (default "
+                                     "12: a 5-minute short window "
+                                     "pairs with a 1-hour long one)"),
+    "SPLATT_SLO_BURN": EnvVar(2.0, "SLO alert threshold: emit "
+                              "slo_burn when the error-budget burn "
+                              "rate meets/exceeds this multiple on "
+                              "BOTH windows (multi-window gating "
+                              "suppresses blips and stale burns "
+                              "alike)"),
+    # fleet status / top (splatt_tpu/fleetobs.py, docs/fleet.md)
+    "SPLATT_STATUS_JOBS": EnvVar(8, "splatt status/top: how many "
+                                 "recent terminal jobs the dashboard "
+                                 "lists"),
+    "SPLATT_STATUS_WATCH_S": EnvVar(2.0, "splatt top / status --watch: "
+                                    "seconds between dashboard "
+                                    "refreshes"),
     "SPLATT_BENCH_TRACE_AB": EnvVar(None, "bench.py: 1 = time cpd_als "
                                     "with span recording enabled-but-"
-                                    "unexported vs off over the same "
+                                    "unexported vs off — plus a third "
+                                    "leg with the flight-recorder "
+                                    "ring armed — over the same "
                                     "blocked layouts and record the "
                                     "legs under 'trace_ab' "
-                                    "(trace_overhead_pct vs the <2% "
+                                    "(trace_overhead_pct / "
+                                    "flight_overhead_pct vs the <2% "
                                     "budget of docs/observability.md)"),
     # serve daemon knobs (splatt_tpu/serve.py, docs/serve.md)
     "SPLATT_SERVE_WORKERS": EnvVar(1, "serve: concurrent job-supervisor "

@@ -217,6 +217,13 @@ SITES = {
                     "degrade classified to a trace_written ok=False "
                     "event — losing the trace must never lose the run "
                     "it observed (docs/observability.md)",
+    "trace.flight": "one flight-recorder ring flush (trace.py "
+                    "_flight_flush: the bounded per-replica black box "
+                    "of docs/observability.md); a raised fault must "
+                    "disarm the recorder and degrade classified to a "
+                    "flight_degraded event — the trace.export "
+                    "discipline: losing the black box must never lose "
+                    "the run it records",
 }
 
 

@@ -184,6 +184,29 @@ def test_quiet_cv_still_gates():
     assert regs2 and not any(r.get("noisy") for r in regs2)
 
 
+def test_delta_under_2x_cv_is_noise_by_default():
+    """ISSUE 14 satellite — the ROADMAP variance note made the gate's
+    default: a slowdown SMALLER than CV_NOISE_MULT x the measured CV
+    is one draw from the timing distribution, not a verdict, even when
+    the CV itself sits under the absolute NOISE_CV ceiling."""
+    # +13% delta, cv 0.10 (< NOISE_CV): 2 x 0.10 = 0.20 > 0.13 → noise
+    rec = {"metric": "M1", "value": 1.13, "unit": "sec/iter",
+           "best_path": "blocked",
+           "timing_stats": {"blocked": {"median": 1.13, "cv": 0.10}}}
+    prior = {"metric": "M1", "value": 1.0, "unit": "sec/iter",
+             "best_path": "blocked",
+             "timing_stats": {"blocked": {"median": 1.0}}}
+    regs = bench._bench_regressions(rec, prior)
+    assert regs and all(r.get("noisy") for r in regs)
+    assert all(r["cv"] == 0.10 for r in regs)
+    # +25% against the same cv 0.10: 0.25 > 0.20 → a real verdict
+    rec2 = {"metric": "M1", "value": 1.25, "unit": "sec/iter",
+            "best_path": "blocked",
+            "timing_stats": {"blocked": {"median": 1.25, "cv": 0.10}}}
+    regs2 = bench._bench_regressions(rec2, prior)
+    assert regs2 and not any(r.get("noisy") for r in regs2)
+
+
 def test_bytes_legs_are_never_noisy():
     """Encoded-bytes comparisons are deterministic: CV hygiene applies
     to timing legs only."""

@@ -244,7 +244,15 @@ def test_fleet_chaos_smoke_kill_and_failover():
     journal's single-owner lineage is clean for every job, the adopted
     same-regime job hits the warm shared caches (cache_hits > 0, zero
     re-measurements), tenant isolation holds, and the adopter's
-    metrics snapshot + span trace account for the takeover."""
+    metrics snapshot + span trace account for the takeover.
+
+    ISSUE 14: the kill must additionally be visible END-TO-END in the
+    fleet observability plane — the merged aggregate counts the lease
+    expiry, the adoption and an slo_burn spike that recovers; the
+    victim's flight-recorder ring replays its timeline up to the kill
+    (the pinned job's job_started mark included); and `splatt status`
+    agrees with the journal throughout (assertions inside
+    run_fleet_chaos; the evidence rides `observability`)."""
     res = chaos.run_fleet_chaos(smoke=True)
     assert res.ok, res.violations
     assert res.verdict == "survived"
@@ -257,8 +265,28 @@ def test_fleet_chaos_smoke_kill_and_failover():
     aff = res.affinity["fleet-1-pin"]
     assert aff["cache_hits"] and not aff["measured"]
     assert aff["adopted_from"] == res.victim
+    ob = res.observability
+    assert ob["adoptions"] >= 1 and ob["lease_expired"] >= 1
+    assert ob["slo_burns"] >= 1          # the burn spike was counted
+    assert ob["replicas_dead"] >= 1      # the census saw the victim
+    assert ob["flight_events"] >= 1      # the black box is readable
     rec = res.to_json()
     assert rec["verdict"] == "survived" and not rec["violations"]
+
+
+@pytest.mark.slow
+def test_fleet_chaos_three_replicas():
+    """The same kill-and-failover invariant at 3 replicas (slow tier;
+    the ISSUE 14 acceptance runs the soak at 2 AND 3): more scanners
+    racing the same adoption, same single-owner lineage, same
+    end-to-end observability evidence."""
+    res = chaos.run_fleet_chaos(smoke=True, replicas=3)
+    assert res.ok, res.violations
+    assert res.verdict == "survived"
+    assert "fleet-1-pin" in res.adopted
+    assert res.observability["adoptions"] >= 1
+    assert res.observability["slo_burns"] >= 1
+    assert res.observability["flight_events"] >= 1
 
 
 def test_fleet_chaos_cli_flag_parses():

@@ -107,7 +107,7 @@ def test_baseline_has_no_stale_or_overcounted_entries():
 RULE_IDS = ["SPL000", "SPL001", "SPL002", "SPL003", "SPL004", "SPL005",
             "SPL006", "SPL007", "SPL008", "SPL009", "SPL010", "SPL011",
             "SPL012", "SPL013", "SPL014", "SPL015", "SPL016", "SPL017",
-            "SPL018"]
+            "SPL018", "SPL019"]
 
 
 @pytest.mark.parametrize("rule", RULE_IDS)
@@ -260,6 +260,90 @@ def test_spl013_span_registry_matches_runtime():
     for name, (typ, doc) in METRICS.items():
         assert typ in ("counter", "gauge", "histogram"), name
         assert isinstance(doc, str) and len(doc) > 10, name
+
+
+def _spl019_project(tmp_path, docs: str = None):
+    (tmp_path / "pkg").mkdir(exist_ok=True)
+    (tmp_path / "pkg" / "trace.py").write_text(
+        "METRICS = {'splatt_used_total': ('counter', 'doc'),\n"
+        "           'splatt_dead_total': ('counter', 'doc'),\n"
+        "           'splatt_depth': ('gauge', 'doc')}\n"
+        "def metric_inc(name, value=1.0, **labels): ...\n"
+        "def metric_set(name, value, **labels): ...\n"
+        "def metric_observe(name, value, **labels): ...\n")
+    (tmp_path / "pkg" / "prod.py").write_text(
+        "from pkg import trace\n"
+        "def f():\n"
+        "    trace.metric_inc('splatt_used_total')\n"
+        "    trace.metric_set('splatt_depth', 1.0)\n"
+        "    trace.metric_inc('splatt_rogue_total')\n"
+        "    trace.metric_inc('splatt_depth')\n")
+    kw = {}
+    if docs is not None:
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "obs.md").write_text(docs)
+        kw["metrics_doc"] = "docs/obs.md"
+    return Config(root=tmp_path, paths=["pkg"],
+                  trace_module="pkg/trace.py", **kw)
+
+
+def test_spl019_metric_drift(tmp_path):
+    """Both registry directions plus the type check, on a
+    mini-project: an undeclared recorded name fires at the call site,
+    a declared-but-never-recorded name fires at the registry, and a
+    counter recorded through the gauge verb (a runtime raise) is a
+    finding before anything runs."""
+    cfg = _spl019_project(tmp_path)
+    msgs = [f.message for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL019"]
+    assert any("splatt_rogue_total" in m and "not declared" in m
+               for m in msgs)
+    assert any("splatt_dead_total" in m and "never recorded" in m
+               for m in msgs)
+    assert any("splatt_depth" in m and "declared as a gauge" in m
+               and "metric_inc" in m for m in msgs)
+    assert not any("splatt_used_total" in m for m in msgs)
+
+
+def test_spl019_docs_table_both_directions(tmp_path):
+    """The docs legs: a declared metric missing from the configured
+    metrics doc fires at the registry, and a doc-table metric the
+    registry never declares is a dead promise."""
+    docs = ("# metrics\n"
+            "| metric | type |\n|---|---|\n"
+            "| `splatt_used_total` | counter |\n"
+            "| `splatt_ghost_total{x=y}` | counter |\n"
+            "| `splatt_depth` | gauge |\n")
+    cfg = _spl019_project(tmp_path, docs=docs)
+    msgs = [f.message for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL019"]
+    assert any("splatt_dead_total" in m and "no row" in m
+               for m in msgs)
+    assert any("splatt_ghost_total" in m and "never declares" in m
+               for m in msgs)
+    # documented + declared names are clean on the docs legs
+    assert not any("splatt_used_total" in m and "row" in m
+                   for m in msgs)
+    # completing the table and dropping the ghost clears the docs legs
+    (tmp_path / "docs" / "obs.md").write_text(
+        docs.replace("| `splatt_ghost_total{x=y}` | counter |\n", "")
+        + "| `splatt_dead_total` | counter |\n")
+    msgs2 = [f.message for f in run(cfg, baseline={}).findings
+             if f.rule == "SPL019"]
+    assert not any("row" in m or "never declares" in m for m in msgs2)
+
+
+def test_spl019_registry_matches_runtime_and_docs():
+    """The real registry is importable and the real docs table is in
+    sync (the full-tree zero gate enforces this too; this pins the
+    wiring: metrics-doc configured, every metric typed + documented)."""
+    cfg = _cfg()
+    assert cfg.metrics_doc == "docs/observability.md"
+    from splatt_tpu.trace import METRICS
+
+    text = (REPO / "docs" / "observability.md").read_text()
+    for name in METRICS:
+        assert name in text, f"{name} missing from the docs table"
 
 
 def test_spl006_declaration_drift(tmp_path):

@@ -47,7 +47,11 @@ class Config:
     #: the resilience module declaring RUN_REPORT_EVENTS (SPL012)
     resilience_module: str = "splatt_tpu/resilience.py"
     #: the trace module declaring the SPANS name registry (SPL013)
+    #: and the METRICS registry (SPL019)
     trace_module: str = "splatt_tpu/trace.py"
+    #: the markdown file whose metrics table SPL019 checks against
+    #: trace.METRICS in both directions ("" disables the docs legs)
+    metrics_doc: str = "docs/observability.md"
     #: functions returning shared-cache file paths; values derived
     #: from them must only reach IO through the locked helpers (SPL011)
     cache_path_functions: List[str] = dataclasses.field(

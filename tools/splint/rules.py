@@ -1280,6 +1280,161 @@ class SpanNameDrift(Rule):
         return out
 
 
+# -- SPL019 -----------------------------------------------------------------
+
+#: the metric-recording verbs, each bound to the one sample type it
+#: may record (trace.py raises on the mismatch at runtime; SPL019
+#: catches it before anything runs)
+_METRIC_FNS = {"metric_inc": "counter", "metric_set": "gauge",
+               "metric_observe": "histogram"}
+
+
+def _declared_metric_types(ctx: FileCtx) -> Dict[str, Tuple[Optional[str], int]]:
+    """name -> (declared type, line) of the trace module's
+    ``METRICS = {"name": ("type", "doc"), ...}`` registry."""
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "METRICS"
+                and isinstance(node.value, ast.Dict)):
+            out: Dict[str, Tuple[Optional[str], int]] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    continue
+                typ = None
+                if isinstance(v, ast.Tuple) and v.elts and \
+                        isinstance(v.elts[0], ast.Constant):
+                    typ = str(v.elts[0].value)
+                out[k.value] = (typ, k.lineno)
+            return out
+    return {}
+
+
+def _metric_emissions(ctx: FileCtx, is_trace_module: bool
+                      ) -> List[Tuple[Optional[str], str, int]]:
+    """(name, verb, lineno) for every ``trace.metric_inc/metric_set/
+    metric_observe`` call in `ctx` (bare spellings inside the trace
+    module itself count too — _event_metrics records there)."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        tail = dotted.split(".")[-1]
+        if tail not in _METRIC_FNS:
+            continue
+        if not ("trace" in dotted.split(".")[:-1]
+                or (is_trace_module and dotted == tail)):
+            continue
+        arg = node.args[0] if node.args else None
+        name: Optional[str] = None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+        elif isinstance(arg, ast.Name):
+            name = ctx.str_consts.get(arg.id)
+        out.append((name, tail, node.lineno))
+    return out
+
+
+class MetricNameDrift(Rule):
+    """Metric-name drift: every name the code records through
+    ``trace.metric_inc``/``metric_set``/``metric_observe`` must be
+    declared in the trace module's METRICS registry — with the verb
+    matching the declared type (incrementing a gauge would raise at
+    runtime; here it is a finding before anything runs) — and every
+    declared metric must still be recorded somewhere.  The docs
+    metrics table ([tool.splint] ``metrics-doc``) is checked in both
+    directions too: a declared metric missing from the docs is
+    invisible to operators, and a documented-but-undeclared one is a
+    dead promise.  The SPL013 span-name discipline, applied to the
+    Prometheus surface that dashboards and the fleet aggregator are
+    built on (docs/observability.md)."""
+
+    id = "SPL019"
+    title = "metric-name drift against trace.py:METRICS / the docs table"
+    hint = ("declare the metric (name -> (type, doc)) in "
+            "splatt_tpu/trace.py:METRICS and add its row to the docs "
+            "metrics table; the registry is the exposition contract")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        import re as _re
+
+        cfg = project.config
+        trace_ctx = project.ctx_for(cfg.trace_module)
+        if trace_ctx is None:
+            return []
+        declared = _declared_metric_types(trace_ctx)
+        if not declared:
+            return []  # registry-less mini-projects: nothing to check
+        out: List[Finding] = []
+        used: Set[str] = set()
+        ctxs = project.files + ([trace_ctx]
+                                if trace_ctx not in project.files else [])
+        for ctx in ctxs:
+            in_trace = ctx.relpath == cfg.trace_module
+            for name, verb, line in _metric_emissions(ctx, in_trace):
+                if name is None:
+                    if not in_trace and ctx in project.files:
+                        out.append(self.finding(
+                            ctx, line,
+                            "metric name is not statically resolvable "
+                            "— splint cannot check it against "
+                            "trace.METRICS"))
+                    continue
+                used.add(name)
+                if name not in declared:
+                    if ctx in project.files:
+                        out.append(self.finding(
+                            ctx, line,
+                            f"metric '{name}' is not declared in "
+                            f"{cfg.trace_module}:METRICS"))
+                    continue
+                want = declared[name][0]
+                if want and _METRIC_FNS[verb] != want \
+                        and ctx in project.files:
+                    out.append(self.finding(
+                        ctx, line,
+                        f"metric '{name}' is declared as a {want} but "
+                        f"recorded via {verb} (the "
+                        f"{_METRIC_FNS[verb]} verb) — this raises at "
+                        f"runtime"))
+        for name, (typ, line) in declared.items():
+            if name not in used:
+                out.append(self.finding(
+                    trace_ctx, line,
+                    f"declared metric '{name}' is never recorded — "
+                    f"dead declaration or renamed emission site"))
+        # the docs table, both directions (skipped when the configured
+        # doc does not exist — fixture mini-projects)
+        doc_path = (cfg.resolve(cfg.metrics_doc)
+                    if getattr(cfg, "metrics_doc", "") else None)
+        if doc_path is not None and doc_path.exists():
+            text = doc_path.read_text()
+            table_names = set()
+            for line_txt in text.splitlines():
+                if line_txt.lstrip().startswith("|"):
+                    table_names.update(
+                        _re.findall(r"splatt_[a-z0-9_]+", line_txt))
+            for name, (typ, line) in declared.items():
+                # membership is judged against TABLE rows, not prose:
+                # a metric merely name-dropped in body text is still
+                # missing its row
+                if name not in table_names:
+                    out.append(self.finding(
+                        trace_ctx, line,
+                        f"declared metric '{name}' has no row in "
+                        f"{cfg.metrics_doc} — the metrics table "
+                        f"renders from the registry"))
+            for name in sorted(table_names - set(declared)):
+                out.append(self.finding(
+                    trace_ctx, 1,
+                    f"{cfg.metrics_doc} documents metric '{name}' "
+                    f"which {cfg.trace_module}:METRICS never declares "
+                    f"— a dead promise to operators"))
+        return out
+
+
 # -- SPL014 -----------------------------------------------------------------
 
 #: method names that mutate a container in place (the write verbs the
@@ -1839,6 +1994,7 @@ RULES: List[Rule] = [
     CacheLockDiscipline(),
     RunReportEventDrift(),
     SpanNameDrift(),
+    MetricNameDrift(),
     SharedStateWithoutLock(),
     LockOrderCycle(),
     DurabilityProtocolDrift(),
