@@ -1284,3 +1284,172 @@ class BlockedSparse:
         """
         v = np.asarray(self.layouts[0].vals).astype(np.float64)
         return float(np.dot(v, v))
+
+
+# -- batched fleets (docs/batched.md) ----------------------------------------
+#
+# The million-tenant shape: MANY small same-regime tensors, each too
+# small to amortize its own compile.  K slots are padded to the
+# regime's bucket shape and stacked along a leading batch axis so ONE
+# jitted vmapped sweep serves all of them — per-slot semantics
+# (independent fits, independent health verdicts) ride the batch axis
+# as data, never as control flow.
+
+
+def bucket_dims(dims: Sequence[int]) -> Tuple[int, ...]:
+    """The regime's padded bucket shape: each mode padded to the
+    power of two just above its :func:`splatt_tpu.tune.shape_regime`
+    bucket (``1 << bit_length``), so every tensor in one regime pads
+    to the SAME static shape and a later batch of that regime reuses
+    the jit cache — one compile across batches, not just within one."""
+    return tuple(1 << int(d).bit_length() for d in dims)
+
+
+def bucket_nnz_pad(nnz: int, block: int) -> int:
+    """The regime's padded nnz count: the nnz bucket (``1 <<
+    bit_length``) rounded up to whole blocks — shared by every slot
+    of every batch in the regime, for the same jit-reuse reason."""
+    return _ceil_to(1 << int(max(nnz, 1)).bit_length(), block)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BatchedBlocked:
+    """K same-regime tensors stacked into one static-shape batch.
+
+    Each slot is built through :func:`build_layout` (the same sort /
+    block / pad / clamp machinery every single-tensor run uses) at a
+    COMMON configuration — one sort mode, one block, v1 global-i32
+    index streams (per-slot narrow v2 widths would differ across
+    slots and cannot stack), one value-storage dtype (bf16 supported:
+    factors derive from it and accumulate f32 exactly like the
+    single-tensor sweep) — then padded to the regime bucket shape and
+    stacked.  Pad entries are additive identities by the same sentinel
+    policy as ModeLayout: zero values, sorted-mode ids at the slot's
+    true ``dim`` (a padded row), zeros elsewhere.
+    """
+
+    inds: jax.Array               # (K, nmodes, nnz_pad) int32 GLOBAL ids
+    vals: jax.Array               # (K, nnz_pad) storage dtype, zero-pad
+    dims: Tuple[int, ...] = dataclasses.field(
+        default=(), metadata=dict(static=True))     # bucket (padded) dims
+    slot_dims: Tuple[Tuple[int, ...], ...] = dataclasses.field(
+        default=(), metadata=dict(static=True))     # true per-slot dims
+    slot_nnz: Tuple[int, ...] = dataclasses.field(
+        default=(), metadata=dict(static=True))
+    sort_mode: int = dataclasses.field(default=0,
+                                       metadata=dict(static=True))
+    block: int = dataclasses.field(default=4096,
+                                   metadata=dict(static=True))
+    regime: str = dataclasses.field(default="",
+                                    metadata=dict(static=True))
+    val_storage: str = dataclasses.field(default="auto",
+                                         metadata=dict(static=True))
+
+    @property
+    def k(self) -> int:
+        return int(self.vals.shape[0])
+
+    @property
+    def nmodes(self) -> int:
+        return len(self.dims)
+
+    @property
+    def nnz_pad(self) -> int:
+        return int(self.vals.shape[1])
+
+    def slot_frobsq(self) -> np.ndarray:
+        """(K,) per-slot squared Frobenius norms, f64 host
+        accumulation like :meth:`BlockedSparse.frobsq` (pads are zero,
+        so whole-row dots equal real-entry dots)."""
+        from splatt_tpu.config import host_acc_dtype
+
+        v = np.asarray(self.vals).astype(host_acc_dtype())
+        return np.einsum("kz,kz->k", v, v)
+
+    def __repr__(self) -> str:
+        return (f"BatchedBlocked(k={self.k}, dims={self.dims}, "
+                f"nnz_pad={self.nnz_pad}, block={self.block}, "
+                f"sort_mode={self.sort_mode}, regime={self.regime!r}, "
+                f"val={jnp.dtype(self.vals.dtype).name})")
+
+
+def batch_compile(tensors: Sequence[SparseTensor],
+                  opts: Optional[Options] = None,
+                  rank: Optional[int] = None) -> BatchedBlocked:
+    """Stack K same-regime COO tensors into one :class:`BatchedBlocked`.
+
+    Every slot must share one :func:`splatt_tpu.tune.shape_regime`
+    (the coalescing precondition serve enforces before dispatching a
+    batch — docs/batched.md); a mixed-regime batch raises ValueError.
+    The block consults the autotuner's plan cache once for the whole
+    batch (:func:`splatt_tpu.tune.batched_block_for` — the batch axis
+    is part of the plan key, so a batched verdict never steers
+    single-tensor dispatch and vice versa).
+    """
+    from splatt_tpu import tune as _tune
+
+    if not tensors:
+        raise ValueError("batch_compile needs at least one tensor")
+    opts = (opts or default_opts()).validate()
+    nmodes = tensors[0].nmodes
+    regime = _tune.shape_regime(tensors[0].dims, tensors[0].nnz)
+    for i, tt in enumerate(tensors):
+        if tt.nmodes != nmodes:
+            raise ValueError(
+                f"batch slot {i} has {tt.nmodes} modes, slot 0 has "
+                f"{nmodes} — a batch must be mode-count homogeneous")
+        r = _tune.shape_regime(tt.dims, tt.nnz)
+        if r != regime:
+            raise ValueError(
+                f"batch slot {i} is in shape regime {r}, slot 0 in "
+                f"{regime} — a batch must share one regime "
+                f"(docs/batched.md)")
+    dims_pad = bucket_dims(tensors[0].dims)
+    # one sort mode for every slot: the smallest BUCKET mode (ties to
+    # the lowest index) — deterministic across slots by regime equality
+    sort_mode = int(np.argmin(np.asarray(dims_pad)))
+    # storage dtype: the explicit/env policy, exactly like from_coo
+    # (bf16 stores bf16 and the factors/accumulation rules follow)
+    fmt = layout_format(opts)
+    compute = resolve_dtype(opts, tensors[0].vals.dtype)
+    storage = resolve_storage_dtype(fmt.val, compute)
+    block = _tune.batched_block_for(
+        tensors[0].dims, tensors[0].nnz, sort_mode, rank,
+        compute, len(tensors), autotune=opts.autotune)
+    if block is None:
+        block = opts.nnz_block
+    block = max(128, min(int(block),
+                         _ceil_to(max(t.nnz for t in tensors), 128)))
+    nnz_pad = bucket_nnz_pad(max(t.nnz for t in tensors), block)
+
+    from splatt_tpu.config import host_staging_dtype
+
+    inds = np.zeros((len(tensors), nmodes, nnz_pad), dtype=np.int32)
+    vals = np.zeros((len(tensors), nnz_pad),
+                    dtype=host_staging_dtype(storage))
+    slot_dims = []
+    slot_nnz = []
+    for i, tt in enumerate(tensors):
+        lay = build_layout(tt, sort_mode, block=block, val_dtype=storage,
+                           mode_order=opts.mode_order,
+                           mode_order_custom=opts.mode_order_custom,
+                           fmt=LayoutFormat(idx="i32", val=fmt.val),
+                           packing="fixed", record_stats=False)
+        n = lay.nnz_pad
+        for m in range(nmodes):
+            inds[i, m, :n] = np.asarray(lay.mode_ids(m))
+        # tail padding past the slot's own blocks keeps the layout's
+        # sentinel policy: sorted-mode ids at the slot's true dim
+        # (dim < bucket always, so the sentinel row is in range and
+        # collects only zeros), zeros elsewhere
+        inds[i, sort_mode, n:] = tt.dims[sort_mode]
+        vals[i, :n] = np.asarray(lay.vals, dtype=vals.dtype)
+        slot_dims.append(tuple(tt.dims))
+        slot_nnz.append(tt.nnz)
+    return BatchedBlocked(
+        inds=jnp.asarray(inds),
+        vals=jnp.asarray(vals).astype(storage),
+        dims=dims_pad, slot_dims=tuple(slot_dims),
+        slot_nnz=tuple(slot_nnz), sort_mode=sort_mode, block=block,
+        regime=regime, val_storage=fmt.val)

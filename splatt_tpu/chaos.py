@@ -688,7 +688,11 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         SPLATT_METRICS_INTERVAL_S="0.7",
         SPLATT_SLO_QUEUE_WAIT_P95_S="1.0",
         SPLATT_SLO_WINDOW_S="3.0", SPLATT_SLO_LONG_WINDOWS="4",
-        SPLATT_SLO_BURN="1.5", SPLATT_FLIGHT_FLUSH="1")
+        SPLATT_SLO_BURN="1.5", SPLATT_FLIGHT_FLUSH="1",
+        # batched + update tenant mix (docs/batched.md): two queued
+        # same-regime jobs coalesce into one vmapped batch, and the
+        # update tenant exercises the model store under failover
+        SPLATT_SERVE_BATCH_MIN="2", SPLATT_UPDATE_SWEEPS="2")
     # SPLATT_METRICS_PATH stays UNSET: fleet mode defaults each
     # replica's snapshot into <root>/fleet/metrics/<rid>.prom, which
     # is where the aggregator (and this soak's post-mortem) finds
@@ -752,7 +756,16 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         clean = {"id": "fleet-3-clean", "tenant": "coyote",
                  "rank": rank, "iters": iters,
                  "synthetic": dict(syn, seed=seed + 3)}
-        for spec in (pin, nan, clean):
+        # the update tenant's base model: a plain cpd job whose
+        # checkpoint becomes the model store the later update advances
+        # (iters offset by one: a distinct coalescing key, so the base
+        # never rides a batch — batched runs do not checkpoint, and
+        # the update wants the warm model)
+        base_job = {"id": "fleet-4-base", "tenant": "epsilon",
+                    "rank": rank, "iters": iters + 1,
+                    "checkpoint_every": 2,
+                    "synthetic": dict(syn, seed=seed + 4)}
+        for spec in (pin, nan, clean, base_job):
             serve.file_request(tmp, spec)
         if not wait_for(
                 lambda: states().get("fleet-1-pin",
@@ -766,14 +779,41 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         time.sleep(0.5)  # well inside the 5 s slow-fault window
         procs[victim].kill()  # SIGKILL: no drain, no lease release
         procs[victim].wait(timeout=60)
+        # batched tenant mix (docs/batched.md): filed in one burst
+        # while the victim is dead, so one survivor ingests the set
+        # together and its >= SPLATT_SERVE_BATCH_MIN same-key queue
+        # coalesces into one vmapped batch (ingestion races across
+        # replicas can still split the set — the post-mortem records
+        # achieved coverage, the lineage audit holds either way)
+        bsyn = {"dims": [16, 12, 10], "nnz": 800}
+        batch_jobs = [f"fleet-b{i}" for i in range(3)]
+        for i, bid in enumerate(batch_jobs):
+            serve.file_request(tmp, {
+                "id": bid, "tenant": "delta", "rank": 3, "iters": 4,
+                "synthetic": dict(bsyn, seed=seed + 10 + i),
+                "seed": seed + 10 + i})
         # kill-and-RESTART: a replacement joins under a fresh id (a
         # new incarnation — the dead id's leases must EXPIRE, not be
         # silently re-owned)
         restart = f"{victim}b"
         rids.append(restart)
         spawn(restart)
+        # the update tenant needs its base model DONE first: the
+        # journal/checkpoint store must hold the model to advance
         all_jobs = ["fleet-0-warm", "fleet-1-pin", "fleet-2-nan",
-                    "fleet-3-clean"]
+                    "fleet-3-clean", "fleet-4-base", *batch_jobs]
+        if wait_for(lambda: states().get("fleet-4-base",
+                                         (None,))[0]
+                    in serve.TERMINAL, 300, "the update base job"):
+            serve.file_request(tmp, {
+                "id": "fleet-5-up", "kind": "update",
+                "base": "fleet-4-base", "tenant": "epsilon",
+                "delta": {"dims": list(dims), "nnz": max(nnz // 20, 8),
+                          "seed": seed + 99}})
+            # only a FILED update is waited on: a base-job timeout is
+            # its own (already recorded) violation, not a reason to
+            # burn the final wait polling a job that never existed
+            all_jobs.append("fleet-5-up")
         wait_for(lambda: all(states().get(j, (None,))[0]
                              in serve.TERMINAL for j in all_jobs),
                  300 if smoke else 900, "all jobs to finish")
@@ -972,6 +1012,40 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
             violations.append(
                 f"the victim {victim}'s flight ring is unreadable — "
                 f"the SIGKILL erased the black box: {e}")
+    # 7. batched + update tenant mix (docs/batched.md): the lineage
+    # audit above already proves no batch member double-ran or double-
+    # committed; here the batch/update evidence itself is checked.
+    # (Spool-claim races can split the batched set across replicas, so
+    # achieved coalescing coverage is recorded — and required of the
+    # full-size soak, where the burst lands on the lone survivor.)
+    batched_jobs = 0
+    for jid in accepted:
+        res = serve.read_result(tmp, jid)
+        if res and res.get("batched"):
+            batched_jobs += 1
+            if res["batched"].get("k", 0) < 2:
+                violations.append(
+                    f"job {jid} claims a coalesced batch of "
+                    f"k={res['batched'].get('k')} — a batch is >= 2")
+    observability["batched_jobs"] = float(batched_jobs)
+    if not smoke and batched_jobs < 2:
+        violations.append(
+            "no coalesced batch formed in the full soak — the batched "
+            "tenant mix exercised nothing")
+    if "fleet-5-up" in accepted:
+        up = serve.read_result(tmp, "fleet-5-up")
+        if up is not None:
+            kinds = {e["kind"] for e in up.get("events", [])}
+            if not kinds & {"update_applied", "refit_scheduled"}:
+                violations.append(
+                    "the update job left no update_applied/"
+                    "refit_scheduled evidence — the model-store "
+                    "lineage is unauditable")
+            if not os.path.exists(os.path.join(
+                    tmp, "ckpt", "fleet-4-base.npz")):
+                violations.append(
+                    "the update base model checkpoint is missing from "
+                    "the store after the update committed")
     st = fleetobs.fleet_status(tmp)
     jstates = states()
     for jid in accepted:

@@ -353,7 +353,8 @@ def cmd_serve(args) -> int:
                        verbose=args.verbose > 0,
                        fleet=args.fleet, replica=args.replica,
                        lease_s=args.lease, heartbeat_s=args.heartbeat,
-                       tenant_quota=args.tenant_quota)
+                       tenant_quota=args.tenant_quota,
+                       batch_min=args.batch_min)
     if args.fleet:
         # fleet observability wiring (docs/observability.md): stamp
         # every span/point with this replica's id (what merged traces
@@ -541,7 +542,10 @@ def cmd_status(args) -> int:
             print("\x1b[2J\x1b[H", end="")
         print("\n".join(out), flush=True)
 
-    if not args.watch:
+    if not args.watch or interval <= 0:
+        # SPLATT_STATUS_WATCH_S=0 (or --interval 0) means run-once even
+        # for the watch-by-default `splatt top` — what tests and
+        # scripted status reads set instead of killing a sleep loop
         once()
         return 0
     try:
@@ -857,6 +861,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "tenant, shed past it with a quota_rejected "
                         "event (default: $SPLATT_FLEET_TENANT_QUOTA; "
                         "<= 0 off)")
+    p.add_argument("--batch-min", type=int, dest="batch_min",
+                   help="auto-coalescing (docs/batched.md): dispatch "
+                        ">= this many queued same-regime jobs as ONE "
+                        "vmapped batched CPD (default: "
+                        "$SPLATT_SERVE_BATCH_MIN; <= 0 off)")
     p.add_argument("--submit", metavar="SPEC_JSON",
                    help="client mode: file this job-spec JSON into "
                         "DIR/requests/ and exit")

@@ -298,6 +298,34 @@ def resolve_storage_dtype(val_storage: str, compute_dtype):
     return jnp.dtype(compute_dtype)
 
 
+def fit_dtype():
+    """The λ/fit bookkeeping dtype of the ALS drivers: solve/normalize
+    emit f32 even under bf16 storage (the engines' f32-accumulation
+    contract), so λ, fit and the batched drivers' per-slot reg vectors
+    live in f32 — one policy decision, owned here (docs/batched.md)."""
+    import jax.numpy as jnp
+
+    return jnp.dtype(jnp.float32)
+
+
+def host_acc_dtype():
+    """Host-side accumulator dtype for fit deltas and Frobenius norms:
+    f64, matching BlockedSparse.frobsq's full-precision contract."""
+    return np.dtype(np.float64)
+
+
+def host_staging_dtype(dtype):
+    """A numpy-representable staging dtype that round-trips `dtype`
+    exactly (numpy has no bfloat16 — bf16 device arrays stage through
+    f32, an exact widening)."""
+    import jax.numpy as jnp
+
+    d = jnp.dtype(dtype)
+    if d == jnp.dtype(jnp.bfloat16):
+        return np.dtype(np.float32)
+    return np.dtype(d)
+
+
 @dataclasses.dataclass
 class Options:
     """Run-time options (≙ splatt_default_opts, src/opts.c:10-47).

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from functools import partial
-from typing import Callable, List, Optional, Tuple, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -931,4 +931,430 @@ def _cpd_als_traced(X: Union[SparseTensor, BlockedSparse], rank: int,
         # internal layout optimization, invisible at the API boundary
         out = dataclasses.replace(
             out, factors=reorder_perm.undo_factors(out.factors))
+    return out
+
+
+# -- batched fleet CPD (docs/batched.md) -------------------------------------
+#
+# The serving fleet's million-tenant shape: K small same-regime tensors
+# decomposed as ONE jitted vmapped computation.  Each slot keeps
+# independent semantics — its own init seed, fit trajectory,
+# convergence stop and health verdict — as DATA along the batch axis,
+# while compile, probe and tuned-plan costs are paid once for the
+# whole batch.
+
+
+@dataclasses.dataclass
+class BatchedCPD:
+    """Per-slot results + the batch-level evidence the serving layer
+    audits: ``compiles`` counts python traces of the one jitted sweep
+    (the "K tenants share a single compile" acceptance is
+    ``compiles == 1``), ``rollbacks`` the per-slot health-rollback
+    counts (a NaN slot's rollbacks never appear on a neighbor)."""
+
+    results: List[KruskalTensor]
+    statuses: List[str]            # "converged" | "degraded" per slot
+    fits: List[float]
+    iterations: int
+    compiles: int
+    rollbacks: List[int]
+    stopped: bool = False          # a cooperative stop() interrupted
+
+    @property
+    def k(self) -> int:
+        return len(self.results)
+
+
+@jax.jit
+def _health_pack_batched(factors, lam, fit):
+    """Per-slot finite flags ``(K, nmodes + 2)`` — the batch-axis
+    vectorization of :func:`_health_pack`: one column per factor, then
+    λ, then fit.  Fetched at the same fit-check host sync the fit
+    already pays for; one slot's NaN trips only its own row."""
+    flags = [jnp.isfinite(U).all(axis=(1, 2)) for U in factors]
+    flags.append(jnp.isfinite(lam).all(axis=1))
+    flags.append(jnp.isfinite(fit))
+    return jnp.stack(flags, axis=1).astype(fit.dtype)
+
+
+def _make_batched_sweep(bb, rank: int, donate: bool, xnormsq,
+                        counter: dict) -> Callable:
+    """Build the ONE jitted vmapped sweep of a batch (docs/batched.md).
+
+    Per-slot MTTKRP is the segment-sum consumption of the stacked v1
+    streams (pads are additive identities, so each slot's lanes compute
+    exactly the single-tensor scatter dataflow over its own layout
+    order); solve/normalize/gram ride ``jax.vmap`` over the stock
+    single-tensor math.  Three contracts keep per-slot semantics intact
+    inside one compiled program:
+
+    - ``first`` is a TRACED scalar (both norms computed, selected with
+      ``where``), so iteration 0 shares the compile with every later
+      sweep — ``counter["traces"]`` counts python traces, which is the
+      compile-count evidence the batched acceptance audits;
+    - ``reg`` is a ``(K,)`` argument, so a health rollback bumps one
+      slot's regularization without rebuilding (= recompiling) the
+      sweep;
+    - ``active`` is a ``(K,)`` mask: converged/degraded slots are
+      frozen bit-exactly (their committed state is re-selected, never
+      recomputed), so a slot stopping early keeps the same result the
+      sequential loop would have returned.
+
+    With `donate`, the stacked factor/gram/λ buffers are donated — the
+    same whole-sweep aliasing the single-tensor fused sweep uses; the
+    driver keeps the usual last-good host snapshot as the rollback
+    target.
+    """
+    from splatt_tpu.config import fit_dtype
+    from splatt_tpu.ops.mttkrp import mttkrp_batched_stream
+
+    nmodes = bb.nmodes
+    dims_pad = bb.dims
+    inds_c = bb.inds
+    vals_c = bb.vals
+    fdt_fit = fit_dtype()
+    # kept as plain PYTHON tuples in the closure: the trace
+    # materializes them as constants at the asarray inside `sweep`,
+    # so the jit never closes over an enclosing-scope array (SPL010)
+    xn_t = tuple(float(x) for x in
+                 np.sqrt(np.maximum(xnormsq, 1e-300)))
+    xn_sq_t = tuple(float(x) for x in xnormsq)
+
+    def norm_sel(U, first):
+        # both norms, one compile: `first` is traced, so the 2-norm /
+        # max-norm pick is a select, not a retrace (zero-padded bucket
+        # rows change neither: they add 0 to the 2-norm sum and the
+        # max-norm clamps at 1.0 either way)
+        lam2 = jnp.sqrt(jnp.sum(U * U, axis=0))
+        lamm = jnp.maximum(jnp.max(U, axis=0), 1.0)
+        lam = jnp.where(first, lam2, lamm)
+        safe = jnp.where(lam > 0, lam, 1.0)
+        return U / safe, lam
+
+    def sweep(factors, grams, lam, reg, active, first):
+        counter["traces"] += 1
+        keep2 = active[:, None]
+        keep3 = active[:, None, None]
+        M = None
+        for m in range(nmodes):
+            fdt = factors[m].dtype
+            M = mttkrp_batched_stream(inds_c, vals_c, factors, m,
+                                      dims_pad[m])
+            lhs = jax.vmap(form_normal_lhs, in_axes=(0, None))(grams, m)
+            lhs = lhs + (reg.astype(lhs.dtype)[:, None, None]
+                         * jnp.eye(rank, dtype=lhs.dtype))
+            U = jax.vmap(solve_normals)(lhs, M)
+            U, lam_m = jax.vmap(norm_sel, in_axes=(0, None))(U, first)
+            U = U.astype(fdt)
+            factors[m] = jnp.where(keep3, U, factors[m])
+            grams[m] = jnp.where(keep3, jax.vmap(gram)(factors[m]),
+                                 grams[m])
+            lam = jnp.where(keep2, lam_m.astype(lam.dtype), lam)
+        # frozen slots recompute the same M from their frozen factors,
+        # so the fit below is their committed fit, bit-stable
+        znormsq, inner = jax.vmap(_zz_inner)(lam, grams, M, factors[-1])
+        fit = 1.0 - jnp.sqrt(jnp.maximum(
+            jnp.asarray(xn_sq_t, dtype=fdt_fit)
+            + znormsq.astype(fdt_fit)
+            - 2.0 * inner.astype(fdt_fit), 0.0)) \
+            / jnp.asarray(xn_t, dtype=fdt_fit)
+        return factors, grams, lam, fit
+
+    return jax.jit(sweep, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def cpd_als_batched(tensors, rank: int, opts: Optional[Options] = None,
+                    seeds: Optional[List[int]] = None,
+                    inits: Optional[List[List[jax.Array]]] = None,
+                    stop: Optional[Callable[[], bool]] = None
+                    ) -> BatchedCPD:
+    """Decompose K same-regime tensors as ONE vmapped ALS
+    (docs/batched.md) — the batched half of ROADMAP open item 2.
+
+    `tensors` is a list of COO tensors (stacked here via
+    :func:`splatt_tpu.blocked.batch_compile`) or an already-built
+    :class:`splatt_tpu.blocked.BatchedBlocked`.  `seeds` gives each
+    slot its own factor-init seed (default ``opts.seed() + slot``);
+    `inits` overrides with explicit per-slot factor lists at each
+    slot's TRUE dims.  `stop` is the serve drain hook, polled at fit
+    checks like :func:`cpd_als`.
+
+    Per-slot guarantees:
+
+    - fits, convergence stops and results are independent — a
+      converged slot is frozen (bit-stable) while neighbors iterate;
+    - the PR 5 health sentinel vectorizes over the batch axis: a
+      non-finite slot rolls back ALONE to its last-good snapshot
+      (reg bump + re-randomize of the offending factor, per slot),
+      and an exhausted budget degrades ONLY that slot to its
+      last-good state (status "degraded") — a NaN tenant cannot
+      poison its batch neighbors;
+    - one compile: ``BatchedCPD.compiles`` counts sweep traces.
+    """
+    from splatt_tpu.blocked import BatchedBlocked, batch_compile
+
+    opts = (opts or default_opts()).validate()
+    with trace.enabling(opts.trace):
+        with trace.span("cpd.batch", rank=int(rank),
+                        k=(tensors.k if isinstance(tensors, BatchedBlocked)
+                           else len(tensors)),
+                        max_iterations=int(opts.max_iterations)):
+            bb = (tensors if isinstance(tensors, BatchedBlocked)
+                  else batch_compile(list(tensors), opts, rank=rank))
+            return _cpd_als_batched_traced(bb, rank, opts, seeds, inits,
+                                           stop)
+
+
+def _cpd_als_batched_traced(bb, rank: int, opts: Options, seeds, inits,
+                            stop) -> BatchedCPD:
+    from splatt_tpu import resilience as _resilience
+    from splatt_tpu.config import fit_dtype, host_acc_dtype, \
+        host_staging_dtype
+    from splatt_tpu.kruskal import unstack_batched
+    from splatt_tpu.utils import faults as _faults
+
+    K, nmodes = bb.k, bb.nmodes
+    dtype = bb.vals.dtype
+    staging = host_staging_dtype(dtype)
+    fdt_fit = fit_dtype()
+    hacc = host_acc_dtype()
+    if seeds is None:
+        base = opts.seed()
+        seeds = [base + i for i in range(K)]
+    if len(seeds) != K or (inits is not None and len(inits) != K):
+        raise ValueError(f"need one seed/init per slot (k={K})")
+
+    # per-slot init at TRUE dims (parity with each slot's own
+    # sequential run), zero-padded into the bucket rows — zero rows are
+    # fixed points of the whole sweep (zero MTTKRP rows → zero solve
+    # rows → zero gram contribution), so the padding never leaks into
+    # a slot's math
+    factors = []
+    for m in range(nmodes):
+        F = np.zeros((K, bb.dims[m], rank), dtype=staging)
+        for i in range(K):
+            d = bb.slot_dims[i][m]
+            if inits is not None:
+                Ui = np.asarray(inits[i][m], dtype=staging)
+                if Ui.shape != (d, rank):
+                    raise ValueError(
+                        f"init for slot {i} mode {m} has shape "
+                        f"{Ui.shape}, want {(d, rank)}")
+                F[i, :d] = Ui
+            else:
+                # exactly init_factors' draw for this (seed, mode) at
+                # the slot's true dims — widened exactly into the
+                # staging buffer, cast back to the storage dtype below
+                F[i, :d] = np.asarray(jax.random.uniform(
+                    jax.random.fold_in(
+                        jax.random.PRNGKey(seeds[i]), m),
+                    (d, rank), dtype=dtype), dtype=staging)
+        factors.append(jnp.asarray(F).astype(dtype))
+    grams = [jax.vmap(gram)(F) for F in factors]
+    lam = jnp.ones((K, rank), dtype=fdt_fit)
+
+    xnormsq = bb.slot_frobsq()
+    counter = {"traces": 0}
+    donate = opts.donate_sweep if opts.donate_sweep is not None else True
+    sweep = _make_batched_sweep(bb, rank, donate, xnormsq, counter)
+
+    guard = health_retries()
+    reg = np.full(K, float(opts.regularization),
+                  dtype=np.dtype(fdt_fit))
+    active = np.ones(K, dtype=bool)
+    degraded = np.zeros(K, dtype=bool)
+    attempts = np.zeros(K, dtype=np.int64)
+    fit_prev = np.zeros(K, dtype=hacc)
+    fits = np.zeros(K, dtype=hacc)
+    last_check_it = 0
+    stopped = False
+
+    def snapshot():
+        with trace.span("cpd.guard.snapshot", host_copy=True):
+            # np.array (not asarray): the per-slot refresh writes
+            # individual lanes, so the snapshot must be a WRITABLE
+            # host copy, not a read-only device view
+            return ([np.array(F) for F in factors],
+                    [np.array(G) for G in grams], np.array(lam))
+
+    snap = snapshot() if guard > 0 else None
+
+    def restore_slot(i: int):
+        """Put slot i's last-good lanes back into the stacked state."""
+        nonlocal factors, grams, lam
+        factors = [F.at[i].set(jnp.asarray(snap[0][m][i]))
+                   for m, F in enumerate(factors)]
+        grams = [G.at[i].set(jnp.asarray(snap[1][m][i]))
+                 for m, G in enumerate(grams)]
+        lam = lam.at[i].set(jnp.asarray(snap[2][i]))
+
+    kchk = opts.fit_check_every
+    it = -1
+    for it in range(opts.max_iterations):
+        if not bool(active.any()):
+            break
+        it_span = trace.begin("cpd.batch.sweep", it=it + 1)
+        try:
+            f_new, g_new, lam_new, fit_dev = sweep(
+                factors, grams, lam, jnp.asarray(reg),
+                jnp.asarray(active), it == 0)
+            # chaos hook (docs/guarded-als.md): a poison-armed
+            # cpd.batch.sweep fault corrupts SLOT 0's last factor —
+            # the per-slot isolation drill: slot 0 must roll back
+            # alone while every neighbor stays bit-clean.  Only while
+            # the slot is live: a frozen (converged/degraded) slot's
+            # committed lanes are no longer the sweep's to corrupt.
+            # The sentinel is a host SCALAR, so the unarmed hot path
+            # pays a dict lookup — never a device gather or a
+            # whole-buffer functional update.
+            if bool(active[0]):
+                p = _faults.poison("cpd.batch.sweep", 1.0)
+                if not np.isfinite(p):
+                    f_new[-1] = f_new[-1].at[:1].set(f_new[-1][:1] * p)
+            factors, grams, lam = f_new, g_new, lam_new
+            check = ((it + 1) % kchk == 0
+                     or it + 1 == opts.max_iterations)
+            if not check:
+                continue
+            fitv = np.asarray(fit_dev, dtype=hacc)
+            if guard > 0:
+                # the per-slot sentinel pack runs on the COMMITTED
+                # state (the poison hook above included) and rides the
+                # fit fetch this check already pays for; with the
+                # sentinel disabled (guard == 0) it is skipped entirely
+                # — guards must be free when off
+                with trace.span("cpd.guard.health_pack"):
+                    flags = np.asarray(_health_pack_batched(
+                        factors, lam, fit_dev))
+            else:
+                flags = np.ones((K, nmodes + 2))
+            if guard > 0:
+                for i in np.flatnonzero(active):
+                    if flags[i].min() > 0.5:
+                        continue
+                    offending = [m for m in range(nmodes)
+                                 if flags[i][m] <= 0.5]
+                    attempts[i] += 1
+                    _resilience.run_report().add(
+                        "health_nonfinite", iteration=it + 1,
+                        slot=int(i), modes=offending,
+                        error="non-finite batched sweep outputs")
+                    if attempts[i] > guard:
+                        degraded[i] = True
+                        active[i] = False
+                        restore_slot(int(i))
+                        fits[i] = fit_prev[i]
+                        _resilience.run_report().add(
+                            "health_degraded", iteration=it + 1,
+                            slot=int(i),
+                            action="slot frozen at its last-good "
+                                   "snapshot; batch neighbors continue")
+                        continue
+                    with trace.span("cpd.guard.rollback", it=it + 1,
+                                    slot=int(i),
+                                    attempt=int(attempts[i])):
+                        restore_slot(int(i))
+                        reg[i] = ((opts.regularization
+                                   if opts.regularization > 0 else 1e-6)
+                                  * (10.0 ** attempts[i]))
+                        key = jax.random.PRNGKey(seeds[i] + 7919)
+                        for m in offending:
+                            d = bb.slot_dims[i][m]
+                            U = jax.random.uniform(
+                                jax.random.fold_in(
+                                    key, int(attempts[i]) * 64 + m),
+                                (d, rank), dtype=dtype)
+                            pad = jnp.zeros((bb.dims[m], rank),
+                                            dtype=dtype)
+                            pad = pad.at[:d].set(U)
+                            factors[m] = factors[m].at[i].set(pad)
+                            grams[m] = grams[m].at[i].set(gram(pad))
+                    _resilience.run_report().add(
+                        "health_rollback", iteration=it + 1,
+                        slot=int(i), attempt=int(attempts[i]),
+                        regularization=float(reg[i]),
+                        rerandomized=offending)
+                    if opts.verbosity >= Verbosity.LOW:
+                        print(f"  batch slot {i}: non-finite at "
+                              f"iteration {it + 1}; rolled back alone "
+                              f"(attempt {int(attempts[i])}/{guard})")
+            window = max((it + 1) - last_check_it, 1)
+            last_check_it = it + 1
+            healthy = active & (flags.min(axis=1) > 0.5)
+            for i in np.flatnonzero(healthy):
+                fits[i] = fitv[i]
+                if it > 0 and abs(fitv[i] - fit_prev[i]) \
+                        < opts.tolerance * window:
+                    active[i] = False   # converged: frozen from here
+                fit_prev[i] = fitv[i]
+            if guard > 0 and healthy.any():
+                # refresh only verified-finite slots' lanes: the
+                # snapshot stays last-GOOD per slot
+                hs = np.flatnonzero(healthy)
+                for m in range(nmodes):
+                    snap[0][m][hs] = np.asarray(factors[m])[hs]
+                    snap[1][m][hs] = np.asarray(grams[m])[hs]
+                snap[2][hs] = np.asarray(lam)[hs]
+            if opts.verbosity >= Verbosity.LOW:
+                done = K - int(active.sum())
+                print(f"  batch its = {it + 1:3d}  "
+                      f"fit[0] = {fitv[0]:0.5f}  "
+                      f"done {done}/{K}")
+            if stop is not None and stop():
+                stopped = True
+                break
+        finally:
+            trace.end(it_span)
+
+    statuses = ["degraded" if degraded[i] else "converged"
+                for i in range(K)]
+    results = unstack_batched(factors, lam, fits, bb.slot_dims)
+    return BatchedCPD(results=results, statuses=statuses,
+                      fits=[float(f) for f in fits],
+                      iterations=it + 1, compiles=counter["traces"],
+                      rollbacks=[int(a) for a in attempts],
+                      stopped=stopped)
+
+
+# -- incremental model updates (docs/batched.md) -----------------------------
+
+def touched_rows(delta, nmodes: int) -> Dict[int, np.ndarray]:
+    """Per-mode sorted unique row indices a delta COO touches — the
+    rows :func:`refresh_touched_rows` re-solves first."""
+    return {m: np.unique(np.asarray(delta.inds[m]))
+            for m in range(nmodes)}
+
+
+def refresh_touched_rows(X, factors: List[jax.Array],
+                         touched: Dict[int, np.ndarray],
+                         reg: float = 0.0) -> List[jax.Array]:
+    """The warm-update pre-pass (docs/batched.md): re-solve ONLY the
+    rows a delta touched, before the global warm-started sweeps run.
+
+    For each mode the full MTTKRP runs (small tensors — the point of
+    the update path is skipping re-CONVERGENCE, not one matvec), but
+    only the touched rows of the factor are committed, normalized into
+    the warm factors' column scale so untouched rows keep their
+    converged values exactly.  Runs under the ``cpd.update`` fault
+    site: a raised fault surfaces to the serve update path, which
+    degrades CLASSIFIED to the full-refit repair path
+    (``refit_scheduled`` event) — never a failed job."""
+    from splatt_tpu.ops.mttkrp import mttkrp
+    from splatt_tpu.utils import faults as _faults
+
+    _faults.maybe_fail("cpd.update")
+    out = list(factors)
+    grams = [gram(U) for U in out]
+    for m in sorted(touched):
+        rows = np.asarray(touched[m], dtype=np.int64)
+        if rows.size == 0:
+            continue
+        M = mttkrp(X, out, m)
+        lhs = form_normal_lhs(grams, m, reg)
+        U = solve_normals(lhs, M)
+        U, _ = normalize_columns(U, "max")
+        rows_j = jnp.asarray(rows)
+        out[m] = out[m].at[rows_j].set(
+            U[rows_j].astype(out[m].dtype))
+        grams[m] = gram(out[m])
     return out

@@ -573,7 +573,8 @@ def fleet_status(root: str, now: Optional[float] = None,
                                   "tenant": None, "priority": None,
                                   "replica": None, "t_accepted": None,
                                   "t_started": None, "t_last": None,
-                                  "adopted_from": None})
+                                  "adopted_from": None, "kind": "cpd",
+                                  "base": None, "batch": None})
         ts = rec.get("ts")
         j["state"], j["t_last"] = kind, ts
         if rec.get("replica"):
@@ -583,8 +584,15 @@ def fleet_status(root: str, now: Optional[float] = None,
             spec = rec.get("spec") or {}
             j["tenant"] = str(spec.get("tenant") or "default")
             j["priority"] = str(spec.get("priority") or "normal")
+            # model-store lineage (docs/batched.md): update jobs name
+            # their base model; batched starts name their leader —
+            # what `splatt status --json` audits
+            j["kind"] = str(spec.get("kind") or "cpd")
+            j["base"] = spec.get("base")
         elif kind == serve.STARTED:
             j["t_started"] = ts
+            if rec.get("batch"):
+                j["batch"] = rec["batch"]
         elif kind == serve.ADOPTED:
             j["adopted_from"] = rec.get("from_replica")
         if kind in (serve.DONE, serve.FAILED):
@@ -602,7 +610,9 @@ def fleet_status(root: str, now: Optional[float] = None,
             terminal.append(dict(job=jid, status=j["status"],
                                  replica=j["replica"],
                                  t=j["t_last"],
-                                 adopted_from=j["adopted_from"]))
+                                 adopted_from=j["adopted_from"],
+                                 kind=j["kind"], base=j["base"],
+                                 batch=j["batch"]))
             continue
         tenants[j["tenant"] or "default"] = \
             tenants.get(j["tenant"] or "default", 0) + 1
@@ -671,6 +681,10 @@ def format_status(st: dict) -> List[str]:
         for r in st["recent"]:
             ad = (f" adopted_from={r['adopted_from']}"
                   if r.get("adopted_from") else "")
+            if r.get("kind") == "update":
+                ad += f" update_of={r.get('base')}"
+            if r.get("batch"):
+                ad += f" batch={r['batch']}"
             lines.append(f"  {r['status'] or '?':<10s} {r['job']:<20s} "
                          f"on {r['replica'] or '?'}{ad}")
     ft = st["fleet_totals"]

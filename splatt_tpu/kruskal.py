@@ -91,6 +91,21 @@ class KruskalTensor:
         return jnp.sum(had)
 
 
+def unstack_batched(factors, lam, fits, dims_list) -> List["KruskalTensor"]:
+    """Split stacked batched-ALS state (docs/batched.md) into per-slot
+    :class:`KruskalTensor` results: `factors` is the per-mode list of
+    ``(K, dim_pad, R)`` stacked arrays, `lam` the ``(K, R)`` stacked λ,
+    `fits` the per-slot fit scalars, and `dims_list` each slot's TRUE
+    dims — :func:`post_process` crops the bucket padding and folds the
+    remaining column norms into λ exactly as every single-tensor driver
+    does."""
+    out = []
+    for i, dims in enumerate(dims_list):
+        out.append(post_process([F[i] for F in factors], lam[i],
+                                jnp.asarray(fits[i]), dims=tuple(dims)))
+    return out
+
+
 def post_process(factors, lam, fit, dims=None) -> "KruskalTensor":
     """Fold remaining column norms into λ (≙ cpd_post_process,
     src/cpd.c:391-411), optionally cropping padded rows first.  The

@@ -137,6 +137,26 @@ def mttkrp_stream(inds: jax.Array, vals: jax.Array,
                                num_segments=dim)
 
 
+def mttkrp_batched_stream(inds: jax.Array, vals: jax.Array,
+                          factors: Sequence[jax.Array], mode: int,
+                          dim: int) -> jax.Array:
+    """Vmapped stream MTTKRP over a stacked same-regime batch
+    (docs/batched.md): `inds` is ``(K, nmodes, nnz_pad)`` global i32,
+    `vals` ``(K, nnz_pad)``, `factors` per-mode ``(K, dim_m, R)`` —
+    each slot computes exactly :func:`mttkrp_stream`'s gather/
+    segment-sum dataflow over its own lane (pads are additive
+    identities), with the engines' f32 accumulation under bf16
+    storage.  Pure jnp and un-jitted here: the batched sweep
+    (cpd._make_batched_sweep) owns the one jit wrapping K tenants."""
+    def one(inds_s, vals_s, factors_s):
+        prod = _gather_prod(inds_s, vals_s, factors_s, mode)
+        acc = _acc_dtype(prod.dtype)
+        return jax.ops.segment_sum(prod.astype(acc), inds_s[mode],
+                                   num_segments=dim)
+
+    return jax.vmap(one)(inds, vals, list(factors))
+
+
 @partial(jax.jit, static_argnames=("mode", "dim"))
 def mttkrp_ttbox(inds: jax.Array, vals: jax.Array,
                  factors: List[jax.Array], mode: int, dim: int) -> jax.Array:

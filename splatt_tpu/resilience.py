@@ -677,6 +677,33 @@ RUN_REPORT_EVENTS = {
                        "failure classified — the black box must never "
                        "take down the run it records "
                        "(docs/observability.md)",
+    "batch_dispatched": "the serve daemon coalesced >= "
+                        "SPLATT_SERVE_BATCH_MIN queued same-regime "
+                        "jobs into ONE vmapped batched CPD "
+                        "(serve.py _run_batch -> cpd.cpd_als_batched; "
+                        "docs/batched.md): carries the member job "
+                        "ids, the regime key and k — per-job journal "
+                        "lineage, results and quotas are preserved "
+                        "through the batch",
+    "batch_degraded": "a coalesced batch failed at dispatch or "
+                      "mid-run (the serve.batch fault site included) "
+                      "and degraded CLASSIFIED to per-tensor "
+                      "dispatch of its members (docs/batched.md) — "
+                      "batching is an optimization, never a new way "
+                      "to lose a job",
+    "update_applied": "an `update` job appended its delta COO to a "
+                      "checkpointed model and committed the "
+                      "warm-started sweeps (serve.py _run_update; "
+                      "docs/batched.md): carries base, update "
+                      "ordinal, sweep count, delta nnz and the "
+                      "reached fit — the model-store lineage `splatt "
+                      "status --json` audits",
+    "refit_scheduled": "an `update` job took the full-refit repair "
+                       "path instead of (or after) the warm update: "
+                       "reason records why — no_model, the periodic "
+                       "SPLATT_UPDATE_REFIT_EVERY boundary, a "
+                       "health-sentinel degrade, or a classified "
+                       "warm-path failure (docs/batched.md)",
 }
 
 
@@ -932,6 +959,28 @@ class RunReport:
             lines.append(f"  flight recorder {e.get('path')} DISARMED "
                          f"({e.get('failure_class')}: "
                          f"{str(e.get('error', ''))[:80]})")
+        for e in self.events("batch_dispatched"):
+            lines.append(f"  batch of {e.get('k')} same-regime jobs "
+                         f"dispatched as one vmapped CPD "
+                         f"(regime {e.get('regime')})")
+        for e in self.events("batch_degraded"):
+            lines.append(f"  BATCH DEGRADED to per-tensor dispatch "
+                         f"({e.get('failure_class')}: "
+                         f"{str(e.get('error', ''))[:80]}; "
+                         f"{len(e.get('jobs') or [])} member(s) re-run "
+                         f"individually)")
+        for e in self.events("update_applied"):
+            lines.append(f"  update #{e.get('update_n')} applied to "
+                         f"model {e.get('base')}: {e.get('delta_nnz')} "
+                         f"delta nnz folded in over {e.get('sweeps')} "
+                         f"warm sweeps (fit {e.get('fit'):.5f})"
+                         if e.get("fit") is not None else
+                         f"  update #{e.get('update_n')} applied to "
+                         f"model {e.get('base')}")
+        for e in self.events("refit_scheduled"):
+            lines.append(f"  model {e.get('base')}: full refit "
+                         f"scheduled at update #{e.get('update_n')} "
+                         f"({e.get('reason')})")
         return lines
 
 

@@ -69,6 +69,18 @@ Fleet mode (docs/fleet.md)
     once, and keeps the Nth-request-is-free property through
     adoption.
 
+Batched + incremental serving (docs/batched.md)
+    A replica whose queue holds >= ``SPLATT_SERVE_BATCH_MIN``
+    batchable jobs sharing one regime key dispatches them as ONE
+    vmapped :func:`splatt_tpu.cpd.cpd_als_batched` batch — K tenants
+    share a single compile while per-job journal lineage, results,
+    quotas and health verdicts stay per-member; any batch-path
+    failure degrades CLASSIFIED to per-tensor dispatch.  An
+    ``update`` job appends a delta COO to an existing checkpointed
+    model and runs a few warm-started sweeps (delta-touched rows
+    re-solved first, sentinel-gated, full refits as the repair path)
+    — the journal/checkpoint store acting as a model store.
+
 A job spec is a JSON object::
 
     {"id": "j1", "rank": 8, "iters": 25, "seed": 0,
@@ -77,6 +89,12 @@ A job spec is a JSON object::
      "tol": 1e-5, "checkpoint_every": 5, "tune": false,
      "autotune": null, "health_retries": null, "deadline_s": null,
      "faults": "", "tenant": "default", "priority": "normal"}
+
+    # incremental model update (docs/batched.md):
+    {"id": "up1", "kind": "update", "base": "j1",
+     "delta": {"dims": [40, 32, 24], "nnz": 100, "seed": 42},
+     # or "delta_tensor": "/path/to/delta.tns",
+     "iters": 5}
 """
 
 from __future__ import annotations
@@ -117,6 +135,17 @@ _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
 #: deferred to that peer before this replica takes it anyway —
 #: affinity is a routing preference, never a starvation mechanism
 AFFINITY_DEFER_MAX = 3
+
+#: hard cap on one coalesced batch (docs/batched.md): K slots stack
+#: K× the bucket-padded tensor in device memory, so a flooded queue
+#: must coalesce in bounded bites, not one unbounded vmap
+BATCH_MAX = 32
+
+#: job kinds a spec may declare (docs/batched.md): "cpd" decomposes a
+#: workload from scratch (the default), "update" appends a delta COO
+#: to an existing checkpointed model and runs a few warm-started
+#: sweeps — the journal/checkpoint store acting as a model store
+JOB_KINDS = ("cpd", "update")
 
 
 def _job_id(spec: dict) -> str:
@@ -242,7 +271,8 @@ class Server:
                  lease_s: Optional[float] = None,
                  heartbeat_s: Optional[float] = None,
                  tenant_quota: Optional[int] = None,
-                 affinity: Optional[bool] = None):
+                 affinity: Optional[bool] = None,
+                 batch_min: Optional[int] = None):
         from splatt_tpu.utils.env import read_env_float, read_env_int
 
         self.root = os.path.abspath(root)
@@ -262,6 +292,11 @@ class Server:
         self.job_deadline_s = float(
             job_deadline_s if job_deadline_s is not None
             else read_env_float("SPLATT_SERVE_JOB_DEADLINE_S"))
+        # auto coalescing (docs/batched.md): when the queue holds >=
+        # batch_min batchable jobs sharing one regime key, a worker
+        # dispatches them as ONE vmapped batch; 0 disables
+        self.batch_min = int(batch_min if batch_min is not None
+                             else read_env_int("SPLATT_SERVE_BATCH_MIN"))
         # metrics cadence (docs/observability.md): with a path set, the
         # registry is snapshotted in Prometheus text format every
         # interval seconds and at daemon exit; interval <= 0 snapshots
@@ -502,7 +537,18 @@ class Server:
                         "duplicate": True}
             tenant = str(spec.get("tenant") or "default")
             prio = spec.get("priority")
-            if not (spec.get("synthetic") or spec.get("tensor")):
+            kind = str(spec.get("kind") or "cpd")
+            if kind not in JOB_KINDS:
+                reason = (f"invalid: unknown kind {kind!r} (want one "
+                          f"of {sorted(JOB_KINDS)})")
+            elif kind == "update" and not spec.get("base"):
+                reason = "invalid: update job needs 'base': <job id>"
+            elif kind == "update" and not (spec.get("delta")
+                                           or spec.get("delta_tensor")):
+                reason = ("invalid: update job needs 'delta': "
+                          "{dims, nnz, seed} or 'delta_tensor': <path>")
+            elif kind == "cpd" and not (spec.get("synthetic")
+                                        or spec.get("tensor")):
                 reason = ("invalid: no workload (give 'synthetic' or "
                           "'tensor')")
             elif prio is not None and str(prio) not in PRIORITIES:
@@ -805,6 +851,91 @@ class Server:
                     self._queue.remove(done)
             return self._jobs[jid]["state"] in TERMINAL
 
+    # -- auto coalescing (docs/batched.md) -----------------------------------
+
+    def _batchable(self, jid: str, j: dict) -> bool:
+        """Whether one job table entry may ride a coalesced batch: a
+        plain synthetic ``cpd`` job with no per-job machinery a batch
+        cannot honor slot-wise — no declared fault schedule (scoped
+        per job, the batch runs in one scope), no pre-tune, no
+        explicit deadline, no per-job health budget.  A resumed job
+        qualifies only when it left NO checkpoint (a crashed daemon's
+        never-started small jobs re-batch on restart — the journal
+        round-trip; a mid-run checkpoint wants the single-job resume
+        path, batched runs do not checkpoint)."""
+        spec = j.get("spec") or {}
+        if j.get("resumed") and os.path.exists(
+                os.path.join(self.ckpt_dir, f"{jid}.npz")):
+            return False
+        return (j.get("regime") is not None
+                and str(spec.get("kind") or "cpd") == "cpd"
+                and bool(spec.get("synthetic"))
+                and not spec.get("tensor")
+                and not spec.get("faults")
+                and not spec.get("tune")
+                and spec.get("deadline_s") is None
+                and spec.get("health_retries") is None
+                # fields the batch body cannot honor per-slot: engine
+                # knobs would silently follow the leader's defaults,
+                # and an EXPLICIT checkpoint cadence is a durability
+                # request batched runs (which never checkpoint) must
+                # not swallow
+                and spec.get("use_pallas") is None
+                and spec.get("engine_fallback") is None
+                and spec.get("autotune") is None
+                and "checkpoint_every" not in spec)
+
+    def _batch_key(self, j: dict) -> tuple:
+        """The coalescing key: jobs batch together only when ONE
+        vmapped computation can honor every slot's contract — same
+        shape regime (same bucket shape, same rank: the stacking
+        precondition) and same iteration/tolerance budget."""
+        spec = j["spec"]
+        return (j["regime"], int(spec.get("iters", 25)),
+                float(spec.get("tol", 1e-5)))
+
+    def _next_batch(self) -> List[str]:
+        """Pick the next unit of work: usually ``[jid]``, but when the
+        queue holds >= ``batch_min`` batchable jobs sharing the picked
+        job's coalescing key, up to BATCH_MAX of them dispatch as ONE
+        batch (docs/batched.md).  In fleet mode every batch mate is
+        individually lease-claimed exactly like a single pick — a mate
+        a peer wins simply stays out of this batch."""
+        jid = self._next()
+        if jid is None:
+            return []
+        mates: List[str] = []
+        with self._lock:
+            j = self._jobs[jid]
+            if self.batch_min >= 1 and self._batchable(jid, j):
+                key = self._batch_key(j)
+                mates = [q for q in self._order_locked()
+                         if self._batchable(q, self._jobs[q])
+                         and self._batch_key(self._jobs[q]) == key]
+                if 1 + len(mates) < self.batch_min:
+                    mates = []
+                mates = mates[:BATCH_MAX - 1]
+                for q in mates:
+                    self._queue.remove(q)
+                    self._running.add(q)
+                if mates:
+                    self._queue_metric(len(self._queue))
+        batch = [jid]
+        for q in mates:
+            if self.fleet is None:
+                batch.append(q)
+                continue
+            if self._claim(q):
+                if not self._terminal_after_claim(q):
+                    batch.append(q)
+                    continue
+                self.fleet.release(q)
+                self._log(f"job {q}: finished by a peer before our "
+                          f"batch claim; dropped")
+            with self._lock:
+                self._running.discard(q)
+        return batch
+
     def _route_event(self, reason: str, jid: str, regime: str,
                      peer: Optional[str]) -> None:
         """One ``affinity_routed`` audit event (docs/fleet.md)."""
@@ -902,42 +1033,49 @@ class Server:
 
             def loop():
                 while not self._draining.is_set():
-                    jid = self._next()
-                    if jid is None:
+                    jids = self._next_batch()
+                    if not jids:
                         return
                     try:
-                        self._run_job(jid)
+                        if len(jids) > 1:
+                            self._run_batch(jids)
+                        else:
+                            self._run_job(jids[0])
                     except Exception as e:
-                        # backstop: _run_job handles job failures
-                        # itself, so anything landing here is a
-                        # supervisor bug — mark the job failed
-                        # (classified) rather than dying silently and
-                        # stranding the rest of the queue behind a
-                        # dead worker
+                        # backstop: _run_job/_run_batch handle job
+                        # failures themselves, so anything landing
+                        # here is a supervisor bug — mark the job(s)
+                        # failed (classified) rather than dying
+                        # silently and stranding the rest of the
+                        # queue behind a dead worker
                         cls = resilience.classify_failure(e)
                         msg = resilience.failure_message(e)[:200]
-                        self._log(f"job {jid}: supervisor error "
-                                  f"({cls.value}: {msg})", error=True)
-                        self._backstop_fail(jid, cls, msg)
+                        for jid in jids:
+                            self._log(f"job {jid}: supervisor error "
+                                      f"({cls.value}: {msg})",
+                                      error=True)
+                            self._backstop_fail(jid, cls, msg)
                     finally:
-                        with self._lock:
-                            self._running.discard(jid)
-                        if self.fleet is not None:
-                            try:
-                                # never leak a held lease past the
-                                # job (a heartbeat renewing a
-                                # finished job forever); a failing
-                                # release must not kill the worker
-                                self.fleet.release(jid)
-                            except Exception as e:
-                                from splatt_tpu import resilience \
-                                    as _res
+                        for jid in jids:
+                            with self._lock:
+                                self._running.discard(jid)
+                            if self.fleet is not None:
+                                try:
+                                    # never leak a held lease past
+                                    # the job (a heartbeat renewing a
+                                    # finished job forever); a
+                                    # failing release must not kill
+                                    # the worker
+                                    self.fleet.release(jid)
+                                except Exception as e:
+                                    from splatt_tpu import resilience \
+                                        as _res
 
-                                self._log(
-                                    f"job {jid}: lease release "
-                                    f"degraded "
-                                    f"({_res.classify_failure(e).value}"
-                                    f": {e})", error=True)
+                                    self._log(
+                                        f"job {jid}: lease release "
+                                        f"degraded "
+                                        f"({_res.classify_failure(e).value}"
+                                        f": {e})", error=True)
 
             threads = [threading.Thread(target=loop, daemon=True,
                                         name=f"splatt-serve-w{i}")
@@ -1180,7 +1318,7 @@ class Server:
 
     # -- one supervised job --------------------------------------------------
 
-    def _run_job(self, jid: str) -> None:
+    def _run_job(self, jid: str, journal_start: bool = True) -> None:
         from splatt_tpu import resilience
 
         with self._lock:
@@ -1190,13 +1328,18 @@ class Server:
             adopted_from = j.get("adopted_from")
             t_accepted = j.get("t_accepted")
             j["state"] = STARTED
-        try:
-            self.journal.append(self._rec(STARTED, jid))
-        except Exception as e:
-            # non-fatal: without this line a crash replays the job from
-            # ACCEPTED — it re-runs, and checkpoint resume makes the
-            # re-run cheap
-            self._warn_journal("start", jid, e)
+        if journal_start:
+            # False on the batch-degrade path: the batch already
+            # journaled STARTED, marked liveness and observed the
+            # queue wait for every member — the per-tensor re-run is
+            # the same execution attempt, not a second start
+            try:
+                self.journal.append(self._rec(STARTED, jid))
+            except Exception as e:
+                # non-fatal: without this line a crash replays the job
+                # from ACCEPTED — it re-runs, and checkpoint resume
+                # makes the re-run cheap
+                self._warn_journal("start", jid, e)
         self._log(f"job {jid}: started" + (" (resumed)" if resumed else ""))
         from splatt_tpu import trace
 
@@ -1204,14 +1347,15 @@ class Server:
         # event on THIS replica's ring saying the job went live here
         # (rides the next ring flush) — what the fleet soak's
         # post-mortem reads off a SIGKILLed victim (docs/observability.md)
-        resilience.run_report().add("job_started", job=jid,
-                                    resumed=resumed)
+        if journal_start:
+            resilience.run_report().add("job_started", job=jid,
+                                        resumed=resumed)
 
         # queue-wait SLO observation (docs/observability.md): seconds
         # accepted-to-started — an adoption after a kill lands the
         # victim's whole outage here, which is what makes the burn-rate
         # spike the fleet soak asserts on
-        if t_accepted is not None:
+        if journal_start and t_accepted is not None:
             trace.metric_observe("splatt_serve_queue_wait_seconds",
                                  max(time.time() - float(t_accepted),
                                      0.0))
@@ -1296,6 +1440,194 @@ class Server:
                   + (f" fit={record['fit']:.5f}"
                      if record.get("fit") is not None else ""))
 
+    # -- one coalesced batch (docs/batched.md) -------------------------------
+
+    def _run_batch(self, jids: List[str]) -> None:
+        """Run >= 2 coalesced same-regime jobs as ONE vmapped batch.
+
+        Per-job lineage is preserved end to end: every member gets its
+        own STARTED journal record (stamped with the batch leader), its
+        own queue-wait observation, its own result file, its own
+        terminal journal record behind the fleet commit fence, and its
+        own per-slot health evidence.  ANY batch-path failure degrades
+        CLASSIFIED to per-tensor dispatch (``batch_degraded``) — the
+        batch is an optimization, never a new way to lose a job."""
+        from splatt_tpu import resilience, trace
+        from splatt_tpu.utils import faults
+
+        lead = jids[0]
+        t0 = time.time()
+        with self._lock:
+            specs = {jid: self._jobs[jid]["spec"] for jid in jids}
+            regime = self._jobs[lead].get("regime")
+            t_acc = {jid: self._jobs[jid].get("t_accepted")
+                     for jid in jids}
+            resumed = {jid: bool(self._jobs[jid].get("resumed"))
+                       for jid in jids}
+            for jid in jids:
+                self._jobs[jid]["state"] = STARTED
+        for jid in jids:
+            try:
+                self.journal.append(self._rec(STARTED, jid, batch=lead))
+            except Exception as e:
+                self._warn_journal("start", jid, e)
+            resilience.run_report().add("job_started", job=jid,
+                                        resumed=resumed[jid])
+            if t_acc[jid] is not None:
+                trace.metric_observe(
+                    "splatt_serve_queue_wait_seconds",
+                    max(time.time() - float(t_acc[jid]), 0.0))
+        resilience.run_report().add("batch_dispatched", jobs=list(jids),
+                                    regime=regime, k=len(jids))
+        trace.metric_inc("splatt_serve_batches_total",
+                         outcome="dispatched")
+        self._log(f"batch [{lead} +{len(jids) - 1}]: dispatched "
+                  f"(regime {regime}, k={len(jids)})")
+        try:
+            faults.maybe_fail("serve.batch")
+            records = self._execute_batch(jids, specs, t0, resumed)
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            msg = resilience.failure_message(e)[:200]
+            resilience.run_report().add(
+                "batch_degraded", jobs=list(jids),
+                failure_class=cls.value, error=msg)
+            trace.metric_inc("splatt_serve_batches_total",
+                             outcome="degraded")
+            self._log(f"batch [{lead} +{len(jids) - 1}]: degraded to "
+                      f"per-tensor dispatch ({cls.value}: {msg})",
+                      error=True)
+            for jid in jids:
+                self._run_job(jid, journal_start=False)
+            return
+        if records is None:
+            # drain interrupt mid-batch: members are journaled
+            # interrupted (no batched checkpoints — small jobs restart
+            # fresh on resume, which is the documented trade)
+            for jid in jids:
+                try:
+                    self.journal.append(self._rec(INTERRUPTED, jid))
+                except Exception as e:
+                    self._warn_journal("interrupt", jid, e)
+                with self._lock:
+                    self._jobs[jid]["state"] = INTERRUPTED
+                if self.fleet is not None:
+                    self.fleet.release(jid)
+            self._log(f"batch [{lead} +{len(jids) - 1}]: interrupted "
+                      f"by drain; members resume next start")
+            return
+        for jid in jids:
+            self._commit_batch_member(jid, records[jid], regime)
+
+    def _commit_batch_member(self, jid: str, record: dict,
+                             regime: Optional[str]) -> None:
+        """One member's terminal commit — the same fences as
+        :meth:`_run_job`'s tail: in fleet mode a terminal record is
+        journaled only under a live lease (a renew refusal abandons
+        THIS member uncommitted; its adopter owns it now), and a DONE
+        member advertises the now-warm regime."""
+        from splatt_tpu import resilience, trace
+
+        if self.fleet is not None and not self.fleet.renew(jid):
+            with self._lock:
+                self._jobs[jid]["state"] = ACCEPTED
+            self._log(f"job {jid}: lease lost mid-batch; abandoned "
+                      f"uncommitted (the adopter owns it now)",
+                      error=True)
+            return
+        # terminal metrics + the job's own registry cut, inside a
+        # per-job scope so the samples carry THIS member's job label
+        # (per-tenant isolation: a neighbor's counters never appear)
+        with resilience.scope(jid):
+            trace.metric_inc("splatt_serve_jobs_total",
+                             status=record["status"])
+            trace.metric_inc("splatt_serve_batch_jobs_total")
+            trace.metric_observe("splatt_job_seconds",
+                                 float(record["seconds"]))
+            record["metrics"] = trace.metrics_snapshot(job=jid)
+        if self.fleet is not None:
+            record["replica"] = self.fleet.replica
+            # a mate claimed through an expired-lease adoption carries
+            # the same lineage stamp the single-job commit writes
+            with self._lock:
+                adopted_from = self._jobs[jid].get("adopted_from")
+            if adopted_from:
+                record["adopted_from"] = adopted_from
+        self._write_result(jid, record)
+        kind = FAILED if record["status"] == "failed" else DONE
+        try:
+            self.journal.append(self._rec(kind, jid,
+                                          status=record["status"]))
+        except Exception as e:
+            self._warn_journal("finish", jid, e)
+        with self._lock:
+            self._jobs[jid]["state"] = kind
+            self._jobs[jid]["status"] = record["status"]
+        if self.fleet is not None:
+            self.fleet.release(jid)
+            if kind == DONE:
+                self.fleet.add_regime(regime)
+        self._log(f"job {jid}: {record['status']}"
+                  + (f" fit={record['fit']:.5f}"
+                     if record.get("fit") is not None else ""))
+
+    def _execute_batch(self, jids: List[str], specs: Dict[str, dict],
+                       t0: float, resumed: Dict[str, bool]
+                       ) -> Optional[Dict[str, dict]]:
+        """The batch body: stack every member's workload and run ONE
+        :func:`splatt_tpu.cpd.cpd_als_batched` under a batch-scoped
+        resilience scope.  Returns per-job result records (slot-cut
+        health events included), or None when a drain interrupted the
+        run.  Any exception escapes to :meth:`_run_batch`'s classified
+        per-tensor degrade."""
+        from splatt_tpu import resilience, trace
+        from splatt_tpu.config import Options, Verbosity
+        from splatt_tpu.cpd import cpd_als_batched
+
+        lead = jids[0]
+        spec0 = specs[lead]
+
+        def _stop() -> bool:
+            return self._draining.is_set()
+
+        with resilience.scope(f"batch-{lead}") as sc:
+            tensors = [_load_workload(specs[jid]) for jid in jids]
+            seeds = [int(specs[jid].get("seed", 0)) for jid in jids]
+            rank = int(spec0.get("rank", 8))
+            opts = Options(
+                random_seed=seeds[0],
+                max_iterations=int(spec0.get("iters", 25)),
+                tolerance=float(spec0.get("tol", 1e-5)),
+                verbosity=(Verbosity.LOW if self.verbose
+                           else Verbosity.NONE),
+                autotune=spec0.get("autotune"))
+            with trace.span("serve.batch", k=len(jids), leader=lead):
+                res = cpd_als_batched(tensors, rank=rank, opts=opts,
+                                      seeds=seeds, stop=_stop)
+            if res.stopped or self._draining.is_set():
+                return None
+            events = [{k: v for k, v in e.items() if k != "ts"}
+                      for e in sc.report.events()]
+        records: Dict[str, dict] = {}
+        for i, jid in enumerate(jids):
+            status = res.statuses[i]
+            slot_events = [e for e in events
+                           if e.get("slot") in (None, i)]
+            rec = {"job": jid, "status": status,
+                   "fit": float(res.fits[i]),
+                   "resumed": bool(resumed.get(jid)),
+                   "seconds": round(time.time() - t0, 3),
+                   "degraded": status != "converged",
+                   "batched": {"k": res.k, "leader": lead, "slot": i,
+                               "compiles": res.compiles,
+                               "iterations": res.iterations,
+                               "rollbacks": res.rollbacks[i]},
+                   "events": slot_events, "demotions": []}
+            if status == "degraded":
+                rec["failure_class"] = "numerical"
+            records[jid] = rec
+        return records
+
     def _execute(self, jid: str, spec: dict, resumed: bool):
         """Run one job under its own resilience scope and fault
         schedule; returns ``(record, stopped)`` — the result record,
@@ -1355,8 +1687,14 @@ class Server:
                                              deadline_s
                                              if deadline_s > 0 else 0):
                         faults.maybe_fail("serve.job_run")
-                        out, tune_info = self._run_cpd(
-                            jid, spec, _stop_or_deadline)
+                        update_info = None
+                        if str(spec.get("kind") or "cpd") == "update":
+                            out, update_info = self._run_update(
+                                jid, spec, _stop_or_deadline)
+                            tune_info = None
+                        else:
+                            out, tune_info = self._run_cpd(
+                                jid, spec, _stop_or_deadline)
                         if stopped["deadline"]:
                             # the cooperative stop beat the post-hoc
                             # timer raise: convert explicitly (with
@@ -1383,6 +1721,8 @@ class Server:
                               fit=float(out.fit))
                 if tune_info is not None:
                     record["tune"] = tune_info
+                if update_info is not None:
+                    record["update"] = update_info
             except Exception as e:
                 cls = resilience.classify_failure(e)
                 msg = resilience.failure_message(e)[:200]
@@ -1464,6 +1804,166 @@ class Server:
                       stop=stop)
         return out, tune_info
 
+    # -- one incremental model update (docs/batched.md) ----------------------
+
+    def _run_update(self, jid: str, spec: dict,
+                    stop: Callable[[], bool]):
+        """The ``update`` job body: append the delta COO to the base
+        job's model, run a few warm-started ALS sweeps (delta-touched
+        rows re-solved first), and advance the model store — the
+        journal/checkpoint machinery acting as a model store, the
+        incremental half of ROADMAP open item 2.
+
+        Repair path: a missing model, a periodic-refit boundary
+        (``SPLATT_UPDATE_REFIT_EVERY``), a health-sentinel degrade, or
+        ANY warm-path failure (the ``cpd.update`` fault site included)
+        degrades CLASSIFIED to a from-scratch refit of the merged
+        tensor (``refit_scheduled`` event) — an update can cost extra
+        sweeps, never the model."""
+        from splatt_tpu import resilience, trace
+        from splatt_tpu.blocked import BlockedSparse
+        from splatt_tpu.config import Options, Verbosity
+        from splatt_tpu.cpd import (_save_checkpoint, cpd_als,
+                                    load_checkpoint_resilient,
+                                    refresh_touched_rows, touched_rows)
+        from splatt_tpu.utils.env import read_env_int
+
+        base = str(spec.get("base") or "")
+        with self._lock:
+            bj = self._jobs.get(base)
+            base_spec = (dict(bj["spec"])
+                         if bj is not None and bj.get("spec") else None)
+            # ordinal of THIS update against the base model: prior DONE
+            # updates + 1 — what the periodic-refit cadence counts
+            nup = 1 + sum(
+                1 for q, j2 in self._jobs.items()
+                if q != jid and j2["state"] == DONE
+                and str((j2.get("spec") or {}).get("kind")
+                        or "cpd") == "update"
+                and str((j2.get("spec") or {}).get("base")
+                        or "") == base)
+        if base_spec is None:
+            raise ValueError(
+                f"update base {base!r} is unknown to this spool (the "
+                f"base job's accepted spec must be in the journal)")
+        delta = _load_delta(spec)
+        ckpt = os.path.join(self.ckpt_dir, f"{base}.npz")
+        tpath = os.path.join(self.ckpt_dir, f"{base}.model.npz")
+        tt, applied = _load_model_tensor(tpath)
+        if tt is None:
+            tt = _load_workload(base_spec)
+            applied = []
+        if jid in applied:
+            # crash idempotency: a re-run of an update whose persist
+            # landed but whose terminal journal record did not must
+            # not merge its delta a second time
+            merged = tt
+        else:
+            merged = _merge_delta(tt, delta)
+        rank = int(base_spec.get("rank", 8))
+        sweeps = int(spec.get("iters")
+                     or read_env_int("SPLATT_UPDATE_SWEEPS"))
+        refit_every = int(read_env_int("SPLATT_UPDATE_REFIT_EVERY"))
+        info = {"base": base, "delta_nnz": int(delta.nnz),
+                "update_n": int(nup), "sweeps": int(sweeps)}
+
+        def make_opts(iters: int) -> Options:
+            # reorder pinned to identity: the touched-row refresh runs
+            # in ORIGINAL row space against the checkpointed factors —
+            # a tuned relabeling would permute the model against the
+            # delta's rows
+            return Options(
+                random_seed=int(spec.get("seed",
+                                         base_spec.get("seed", 0))),
+                max_iterations=int(iters),
+                tolerance=float(spec.get("tol",
+                                         base_spec.get("tol", 1e-5))),
+                verbosity=(Verbosity.LOW if self.verbose
+                           else Verbosity.NONE),
+                autotune=spec.get("autotune"),
+                reorder="identity")
+
+        reason = None
+        out = None
+        model = None
+        if not (os.path.exists(ckpt) or os.path.exists(ckpt + ".bak")):
+            reason = "no_model"
+        else:
+            # expect_reorder pins the row-label space: a base model
+            # checkpointed under a RELABELED order (SPLATT_REORDER or
+            # a tuned recipe) must not be consumed as identity-space
+            # factors — the mismatch degrades here to None, i.e. the
+            # full-refit repair path (docs/layout-balance.md)
+            model = load_checkpoint_resilient(
+                ckpt, expect_reorder="identity")
+            if model is None:
+                reason = "no_model"
+        if reason is None and refit_every > 0 and nup % refit_every == 0:
+            reason = "periodic"
+        if reason is None:
+            try:
+                factors = model[0]
+                ck_dims = tuple(int(u.shape[0]) for u in factors)
+                if ck_dims != tuple(merged.dims) \
+                        or int(factors[0].shape[1]) != rank:
+                    raise ValueError(
+                        f"model checkpoint is for dims={ck_dims} "
+                        f"rank={int(factors[0].shape[1])}, merged "
+                        f"tensor wants dims={tuple(merged.dims)} "
+                        f"rank={rank}")
+                opts = make_opts(sweeps)
+                bs = BlockedSparse.compile(merged, opts, rank=rank)
+                with trace.span("cpd.update", job=jid, base=base,
+                                delta_nnz=int(delta.nnz)):
+                    warm = refresh_touched_rows(
+                        bs, factors,
+                        touched_rows(delta, merged.nmodes),
+                        reg=opts.regularization)
+                    out = cpd_als(bs, rank=rank, opts=opts, init=warm,
+                                  stop=stop)
+                if resilience.run_report().events("health_degraded"):
+                    # the sentinel gates acceptance: a warm update that
+                    # blew up numerically is repaired by a full refit,
+                    # not committed
+                    reason = "health"
+                    out = None
+            except Exception as e:
+                cls = resilience.classify_failure(e)
+                reason = f"failed:{cls.value}"
+                self._log(f"job {jid}: warm update failed "
+                          f"({cls.value}: "
+                          f"{resilience.failure_message(e)[:120]}); "
+                          f"repairing with a full refit", error=True)
+        if reason is not None:
+            resilience.run_report().add(
+                "refit_scheduled", job=jid, base=base, reason=reason,
+                update_n=int(nup))
+            trace.metric_inc("splatt_serve_updates_total",
+                             outcome="refit")
+            info["refit"] = reason
+            opts = make_opts(int(base_spec.get("iters", 25)))
+            bs = BlockedSparse.compile(merged, opts, rank=rank)
+            out = cpd_als(bs, rank=rank, opts=opts, stop=stop)
+        else:
+            resilience.run_report().add(
+                "update_applied", job=jid, base=base, update_n=int(nup),
+                sweeps=int(sweeps), delta_nnz=int(delta.nnz),
+                fit=float(out.fit))
+            trace.metric_inc("splatt_serve_updates_total",
+                             outcome="applied")
+        if not (stop is not None and stop()):
+            # advance the model store only for COMPLETE runs: an
+            # interrupted update re-runs whole, and its delta must not
+            # be double-merged (`applied` stamps make the re-run
+            # idempotent even across a crash between these writes and
+            # the terminal journal record)
+            _save_checkpoint(ckpt, out.factors, out.lam, 0,
+                             float(out.fit))
+            if jid not in applied:
+                applied = list(applied) + [jid]
+            _save_model_tensor(tpath, merged, applied)
+        return out, info
+
     # -- plumbing ------------------------------------------------------------
 
     def _write_result(self, jid: str, record: dict) -> None:
@@ -1519,6 +2019,101 @@ def _load_workload(spec: dict):
     return synthetic_tensor(tuple(int(d) for d in syn["dims"]),
                             int(syn.get("nnz", 1000)),
                             int(syn.get("seed", 0)))
+
+
+# -- the model store's delta/tensor plumbing (docs/batched.md) ---------------
+
+def _load_delta(spec: dict):
+    """The update job's delta COO: an on-disk file (``delta_tensor``)
+    or a seeded synthetic (``delta: {dims, nnz, seed}``)."""
+    if spec.get("delta_tensor"):
+        from splatt_tpu.io import load
+
+        return load(spec["delta_tensor"])
+    d = spec.get("delta")
+    if not isinstance(d, dict) or not d.get("dims"):
+        raise ValueError("update job needs 'delta': {dims, nnz, seed} "
+                         "or 'delta_tensor': <path>")
+    from splatt_tpu.chaos import synthetic_tensor
+
+    return synthetic_tensor(tuple(int(x) for x in d["dims"]),
+                            int(d.get("nnz", 100)),
+                            int(d.get("seed", 0)))
+
+
+def _merge_delta(tt, delta):
+    """Append a delta COO to the model tensor (additive semantics:
+    a delta hitting an existing coordinate ADDS to its value — the
+    engines' segment sums make duplicates additive by construction).
+    The delta may not grow any mode past the model's dims: the
+    checkpointed factors have no rows for new indices."""
+    import numpy as np
+
+    from splatt_tpu.coo import SparseTensor
+
+    if delta.nmodes != tt.nmodes:
+        raise ValueError(f"delta has {delta.nmodes} modes, the model "
+                         f"tensor has {tt.nmodes}")
+    for m in range(tt.nmodes):
+        if delta.dims[m] > tt.dims[m]:
+            raise ValueError(
+                f"delta grows mode {m} to {delta.dims[m]} past the "
+                f"model's dim {tt.dims[m]} — the checkpointed factors "
+                f"have no rows for new indices")
+    return SparseTensor(
+        inds=np.concatenate([np.asarray(tt.inds),
+                             np.asarray(delta.inds)], axis=1),
+        vals=np.concatenate([np.asarray(tt.vals),
+                             np.asarray(delta.vals)]),
+        dims=tt.dims)
+
+
+def _save_model_tensor(path: str, tt, applied) -> None:
+    """Persist the model's CURRENT merged COO beside its checkpoint
+    (atomic publish through the sanctioned durable helper), with the
+    ids of every applied update — the idempotency stamp a crashed
+    update's re-run checks before re-merging its delta."""
+    import io as _io
+
+    import numpy as np
+
+    from splatt_tpu.utils.durable import publish_bytes
+
+    buf = _io.BytesIO()
+    np.savez(buf, inds=np.asarray(tt.inds), vals=np.asarray(tt.vals),
+             dims=np.asarray(tt.dims),
+             applied=np.asarray(list(applied), dtype="U64"))
+    publish_bytes(path, buf.getvalue())
+
+
+def _load_model_tensor(path: str):
+    """Load a persisted model tensor → (SparseTensor, applied ids), or
+    ``(None, [])`` when absent or unreadable — a corrupt model tensor
+    degrades CLASSIFIED to rebuilding from the base workload (the
+    refit repair path), never a failed update."""
+    import numpy as np
+
+    from splatt_tpu.coo import SparseTensor
+
+    try:
+        with np.load(path) as z:
+            tt = SparseTensor(inds=np.asarray(z["inds"]),
+                              vals=np.asarray(z["vals"]),
+                              dims=tuple(int(d) for d in z["dims"]))
+            applied = [str(s) for s in z["applied"]]
+        return tt, applied
+    except FileNotFoundError:
+        return None, []
+    except Exception as e:
+        from splatt_tpu import resilience
+
+        resilience.run_report().add(
+            "checkpoint_recovery", path=path,
+            error=(f"{resilience.classify_failure(e).value}: "
+                   f"{resilience.failure_message(e)[:120]}"),
+            action="model tensor unreadable; rebuilding from the "
+                   "base workload")
+        return None, []
 
 
 # -- client-side filed-request API -------------------------------------------

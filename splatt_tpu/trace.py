@@ -139,6 +139,16 @@ SPANS = {
                             "ring-variant sweep (docs/ring.md)",
     "serve.job": "one supervised serve job end-to-end (attrs: job, "
                  "resumed)",
+    "serve.batch": "one coalesced batch's vmapped CPD invocation "
+                   "(attrs: k, leader job id; docs/batched.md)",
+    "cpd.batch": "one cpd_als_batched run end-to-end (attrs: rank, k; "
+                 "the batched counterpart of cpd.als)",
+    "cpd.batch.sweep": "one batched ALS iteration — the vmapped sweep "
+                       "dispatch through the per-slot commit (attrs: "
+                       "it)",
+    "cpd.update": "one incremental model update's warm path: the "
+                  "touched-row refresh + warm-started sweeps (attrs: "
+                  "job, base, delta_nnz; docs/batched.md)",
     "trace.export": "writing one Chrome-trace JSON file",
     "timer.*": "legacy utils/timers.py brackets routed through the "
                "span layer (timer.cpd, timer.mttkrp, ...)",
@@ -205,6 +215,20 @@ METRICS = {
                  "into their own registry (the merge drops the "
                  "per-replica copies, so the census never "
                  "double-counts)"),
+    "splatt_serve_batches_total": (
+        "counter", "serve: coalesced batch dispatches by outcome "
+                   "(dispatched = ran as one vmapped CPD, degraded = "
+                   "fell back classified to per-tensor dispatch; "
+                   "docs/batched.md)"),
+    "splatt_serve_batch_jobs_total": (
+        "counter", "serve: jobs whose terminal commit rode a "
+                   "coalesced batch — amortization coverage next to "
+                   "splatt_serve_jobs_total (docs/batched.md)"),
+    "splatt_serve_updates_total": (
+        "counter", "serve: incremental `update` jobs by outcome "
+                   "(applied = warm sweeps committed, refit = the "
+                   "full-refit repair path ran — no_model/periodic/"
+                   "health/failure; docs/batched.md)"),
 }
 
 #: histogram bucket upper bounds (seconds); +Inf is implicit

@@ -187,17 +187,22 @@ def skew_of(tt, mode: int) -> str:
 
 
 def plan_key(dims: Sequence[int], nnz: int, mode: int, rank: int,
-             dtype, skew: str = "") -> str:
+             dtype, skew: str = "", batch: int = 1) -> str:
     """The cache key of one tuned dispatch site.  Device kind and
     kernel-source hash live in the environment key (shared with the
     probe cache), so this only carries the workload shape — plus the
     mode's slice-skew regime (:func:`skew_regime`; "" for
-    near-uniform, keeping legacy keys byte-identical)."""
+    near-uniform, keeping legacy keys byte-identical) and, for the
+    batched fleet engine (docs/batched.md), a power-of-two batch-size
+    bucket: a plan measured under one vmapped batch never steers
+    single-tensor dispatch (or the reverse) — ``batch=1`` (every
+    pre-batch caller) keeps legacy keys byte-identical."""
     import jax.numpy as jnp
 
     sk = skew_regime(skew)
+    bt = f":bk{int(batch).bit_length()}" if int(batch) > 1 else ""
     return (f"{shape_regime(dims, nnz)}:mode{mode}:r{int(rank)}"
-            f":{jnp.dtype(dtype).name}" + (f":{sk}" if sk else ""))
+            f":{jnp.dtype(dtype).name}" + (f":{sk}" if sk else "") + bt)
 
 
 def _negative_key(key: str, engine: str, block: int, scan_target: int,
@@ -406,6 +411,31 @@ def tuned_blocks_for(tt, rank: int, dtype) -> Dict[int, int]:
     (the block-only view of :func:`tuned_build_for`)."""
     return {m: p.nnz_block
             for m, p in tuned_build_for(tt, rank, dtype).items()}
+
+
+def batched_block_for(dims: Sequence[int], nnz: int, mode: int,
+                      rank: Optional[int], dtype, k: int,
+                      autotune: Optional[bool] = None) -> Optional[int]:
+    """The tuned ``nnz_block`` for a BATCHED dispatch of `k` same-regime
+    tensors (docs/batched.md), or None (untuned — the caller falls back
+    to the options default).
+
+    Consults the batch-axis plan key first (a verdict measured under
+    vmapped batching), then the single-tensor key for the same site
+    (a reasonable prior: the batch axis multiplies work per block but
+    does not change the block's internal shape).  The batched engine
+    consumes only the block size today; the full candidate walk stays
+    single-tensor (``splatt tune``)."""
+    if rank is None or not autotune_enabled(autotune):
+        return None
+    entry = _entry_get(plan_key(dims, nnz, mode, rank, dtype, batch=k))
+    if entry and "plan" in entry:
+        try:
+            return int(entry["plan"]["nnz_block"])
+        except (KeyError, TypeError, ValueError) as e:
+            _cache_io_error("load", e)
+    plan = cached_plan(dims, nnz, mode, rank, dtype)
+    return plan.nnz_block if plan is not None else None
 
 
 # -- measurement ------------------------------------------------------------

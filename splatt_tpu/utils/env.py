@@ -298,6 +298,35 @@ ENV_VARS = {
                                           "TIMEOUT and the job is "
                                           "marked failed, releasing "
                                           "its worker; <= 0 disables"),
+    "SPLATT_SERVE_BATCH_MIN": EnvVar(0, "serve auto-coalescing "
+                                     "(docs/batched.md): when a "
+                                     "replica's queue holds >= this "
+                                     "many batchable jobs sharing one "
+                                     "regime key, a worker dispatches "
+                                     "them as ONE vmapped batched CPD "
+                                     "(per-job journal lineage, "
+                                     "results, deadlines and quotas "
+                                     "preserved; failure degrades "
+                                     "classified to per-tensor "
+                                     "dispatch); <= 0 disables"),
+    "SPLATT_UPDATE_SWEEPS": EnvVar(5, "update jobs (docs/batched.md): "
+                                   "warm-started ALS sweeps an "
+                                   "incremental model update runs "
+                                   "when its spec gives no iters — "
+                                   "the point of warm-starting is "
+                                   "that a few sweeps suffice where "
+                                   "a refit needs dozens"),
+    "SPLATT_UPDATE_REFIT_EVERY": EnvVar(0, "update jobs "
+                                        "(docs/batched.md): every Nth "
+                                        "update of one base model "
+                                        "runs a from-scratch refit of "
+                                        "the merged tensor instead of "
+                                        "the warm path (drift "
+                                        "repair; refit_scheduled "
+                                        "event); <= 0 disables the "
+                                        "periodic cadence (the "
+                                        "health/failure repair paths "
+                                        "stay active)"),
     # fleet-mode serve knobs (splatt_tpu/fleet.py, docs/fleet.md)
     "SPLATT_FLEET_REPLICA": EnvVar(None, "fleet: this replica's "
                                    "stable id (file-name-safe); "
@@ -373,6 +402,11 @@ ENV_VARS = {
                                     "gate only compares like "
                                     "workloads, and the JSON carries "
                                     "per-scenario imbalance stats"),
+    "SPLATT_BENCH_BATCH_K": EnvVar(32, "bench.py batched scenario "
+                                   "(SPLATT_BENCH_SCENARIO=batched, "
+                                   "docs/batched.md): how many small "
+                                   "same-regime tensors the "
+                                   "batched-vs-sequential A/B stacks"),
     "SPLATT_BENCH_GUARD_AB": EnvVar(None, "bench.py: 1 = run the "
                                     "guard-cost A/B legs (ROADMAP "
                                     "open item 1): cpd_als timed with "

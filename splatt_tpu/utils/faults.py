@@ -224,6 +224,25 @@ SITES = {
                     "flight_degraded event — the trace.export "
                     "discipline: losing the black box must never lose "
                     "the run it records",
+    "serve.batch": "one coalesced batch dispatch (serve.py "
+                   "_run_batch, docs/batched.md); a raised fault must "
+                   "degrade the batch CLASSIFIED to per-tensor "
+                   "dispatch of its members (batch_degraded event) — "
+                   "every member still reaches its own terminal "
+                   "record, never a lost job",
+    "cpd.update": "the warm incremental-update pre-pass of a served "
+                  "model (cpd.py refresh_touched_rows, consumed by "
+                  "serve.py _run_update; docs/batched.md); a raised "
+                  "fault must degrade the update CLASSIFIED to the "
+                  "full-refit repair path (refit_scheduled event) — "
+                  "an update can cost extra sweeps, never the model",
+    "cpd.batch.sweep": "poisoned (not raised): corrupt SLOT 0 of a "
+                       "batched ALS sweep's last factor output with "
+                       "non-finite values (cpd.py "
+                       "_cpd_als_batched_traced) — the per-slot "
+                       "isolation drill: slot 0 must roll back ALONE "
+                       "while every batch neighbor stays bit-clean "
+                       "(docs/batched.md)",
 }
 
 

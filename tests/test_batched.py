@@ -1,0 +1,334 @@
+"""Batched fleet CPD (docs/batched.md) — cpd_als_batched + the
+blocked batch stacking.
+
+The contracts under test:
+
+- PARITY: each slot of a batch equals its own sequential cpd_als run
+  (fit and reconstruction within float tolerance), under donation
+  on/off (bit-identical to each other) and bf16 storage;
+- ONE COMPILE: a whole batched run traces its vmapped sweep exactly
+  once (``BatchedCPD.compiles == 1``) — the amortization the serving
+  layer exists to exploit;
+- PER-SLOT HEALTH ISOLATION: a NaN slot (the ``cpd.batch.sweep``
+  poison drill) rolls back ALONE — batch neighbors stay bit-identical
+  to a clean run — and an exhausted budget degrades only that slot;
+- per-slot convergence freezing, regime validation, the batch axis in
+  tuner plan keys, and the new registry entries.
+"""
+
+import numpy as np
+import pytest
+
+from splatt_tpu import resilience, tune
+from splatt_tpu.blocked import (BatchedBlocked, batch_compile,
+                                bucket_dims, bucket_nnz_pad)
+from splatt_tpu.chaos import synthetic_tensor
+from splatt_tpu.config import Options, Verbosity
+from splatt_tpu.cpd import cpd_als, cpd_als_batched, init_factors
+from splatt_tpu.utils import faults
+
+DIMS = (20, 16, 12)
+NNZ = 600
+RANK = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    def clean():
+        faults.reset()
+        resilience.reset_demotions()
+        resilience.run_report().clear()
+
+    clean()
+    yield
+    clean()
+
+
+def _tensors(k, seed0=0):
+    return [synthetic_tensor(DIMS, NNZ, seed=seed0 + i) for i in range(k)]
+
+
+def _opts(seed=0, iters=8, tol=0.0, **kw):
+    return Options(random_seed=seed, max_iterations=iters, tolerance=tol,
+                   verbosity=Verbosity.NONE, autotune=False, **kw)
+
+
+def _bit_equal(kt_a, kt_b):
+    return (all(np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(kt_a.factors, kt_b.factors))
+            and np.array_equal(np.asarray(kt_a.lam), np.asarray(kt_b.lam)))
+
+
+# -- stacking ----------------------------------------------------------------
+
+def test_bucket_shapes():
+    assert bucket_dims((20, 16, 12)) == (32, 32, 16)
+    assert bucket_nnz_pad(600, 128) == 1024
+    assert bucket_nnz_pad(600, 300) == 1200  # rounded to whole blocks
+
+
+def test_batch_compile_stacks_to_regime_bucket():
+    k = 3
+    bb = batch_compile(_tensors(k), _opts())
+    assert isinstance(bb, BatchedBlocked)
+    assert bb.k == k and bb.nmodes == 3
+    assert bb.dims == bucket_dims(DIMS)
+    assert bb.inds.shape == (k, 3, bb.nnz_pad)
+    assert bb.vals.shape == (k, bb.nnz_pad)
+    # per-slot nnz/frobsq match each tensor's own (synthetic_tensor
+    # dedups, so true nnz can undershoot the request; pads are zero)
+    for i, tt in enumerate(_tensors(k)):
+        assert bb.slot_nnz[i] == tt.nnz
+        assert bb.slot_frobsq()[i] == pytest.approx(tt.normsq())
+    assert "BatchedBlocked" in repr(bb)
+
+
+def test_batch_compile_rejects_mixed_regime():
+    tensors = _tensors(2) + [synthetic_tensor((64, 50, 40), 5000, seed=9)]
+    with pytest.raises(ValueError, match="regime"):
+        batch_compile(tensors, _opts())
+    with pytest.raises(ValueError, match="at least one"):
+        batch_compile([], _opts())
+
+
+def test_batch_compile_rejects_mixed_mode_count():
+    tensors = [synthetic_tensor(DIMS, NNZ, seed=0),
+               synthetic_tensor((20, 16, 12, 8), NNZ, seed=1)]
+    with pytest.raises(ValueError, match="mode"):
+        batch_compile(tensors, _opts())
+
+
+# -- parity (the batched acceptance) -----------------------------------------
+
+def test_batched_parity_with_sequential_loop():
+    k = 4
+    tensors = _tensors(k)
+    seeds = [100 + i for i in range(k)]
+    res = cpd_als_batched(tensors, rank=RANK, opts=_opts(), seeds=seeds)
+    assert res.compiles == 1          # K tenants, ONE compile
+    assert res.k == k
+    assert res.statuses == ["converged"] * k
+    for i, tt in enumerate(tensors):
+        out = cpd_als(tt, rank=RANK, opts=_opts(seed=seeds[i]))
+        assert res.fits[i] == pytest.approx(float(out.fit), abs=2e-4)
+        np.testing.assert_allclose(res.results[i].to_dense(),
+                                   out.to_dense(), atol=5e-3, rtol=1e-2)
+        # results are cropped back to TRUE dims
+        assert res.results[i].dims == tuple(tt.dims)
+
+
+def test_batched_donation_off_bit_identical():
+    k = 3
+    tensors = _tensors(k)
+    seeds = [7 + i for i in range(k)]
+    a = cpd_als_batched(tensors, rank=RANK, opts=_opts(), seeds=seeds)
+    b = cpd_als_batched(tensors, rank=RANK,
+                        opts=_opts(donate_sweep=False), seeds=seeds)
+    assert all(_bit_equal(x, y) for x, y in zip(a.results, b.results))
+    assert a.fits == b.fits
+
+
+def test_batched_bf16_storage():
+    k = 3
+    tensors = _tensors(k)
+    seeds = [11 + i for i in range(k)]
+    res = cpd_als_batched(tensors, rank=RANK,
+                          opts=_opts(val_storage="bf16"), seeds=seeds)
+    assert res.compiles == 1
+    for i, kt in enumerate(res.results):
+        assert all(str(f.dtype) == "bfloat16" for f in kt.factors)
+        assert np.isfinite(res.fits[i])
+    # close to the f32 batch within bf16 resolution
+    f32 = cpd_als_batched(tensors, rank=RANK, opts=_opts(), seeds=seeds)
+    for a, b in zip(res.fits, f32.fits):
+        assert a == pytest.approx(b, abs=0.03)
+
+
+def test_batched_explicit_inits_validated():
+    tensors = _tensors(2)
+    inits = [init_factors(t.dims, RANK, 5) for t in tensors]
+    res = cpd_als_batched(tensors, rank=RANK, opts=_opts(), inits=inits,
+                          seeds=[5, 5])
+    assert res.statuses == ["converged"] * 2
+    bad = [init_factors((8, 8, 8), RANK, 5), inits[1]]
+    with pytest.raises(ValueError, match="shape"):
+        cpd_als_batched(tensors, rank=RANK, opts=_opts(), inits=bad,
+                        seeds=[5, 5])
+    with pytest.raises(ValueError, match="per slot"):
+        cpd_als_batched(tensors, rank=RANK, opts=_opts(), seeds=[1])
+
+
+# -- per-slot convergence ----------------------------------------------------
+
+def test_batched_per_slot_convergence_freeze():
+    """With a real tolerance, slots stop independently — and a frozen
+    slot's result equals its own sequential run with the same tol."""
+    k = 3
+    tensors = _tensors(k)
+    seeds = [31 + i for i in range(k)]
+    res = cpd_als_batched(tensors, rank=RANK,
+                          opts=_opts(iters=30, tol=1e-4), seeds=seeds)
+    for i, tt in enumerate(tensors):
+        out = cpd_als(tt, rank=RANK,
+                      opts=_opts(seed=seeds[i], iters=30, tol=1e-4))
+        assert res.fits[i] == pytest.approx(float(out.fit), abs=2e-4)
+
+
+def test_batched_stop_hook():
+    calls = {"n": 0}
+
+    def stop():
+        calls["n"] += 1
+        return calls["n"] >= 2
+
+    res = cpd_als_batched(_tensors(2), rank=RANK,
+                          opts=_opts(iters=20), seeds=[1, 2], stop=stop)
+    assert res.stopped
+    assert res.iterations < 20
+
+
+# -- per-slot health isolation (the sentinel, vectorized) --------------------
+
+def test_batched_nan_slot_rolls_back_alone(monkeypatch):
+    monkeypatch.setenv("SPLATT_HEALTH_RETRIES", "3")
+    k = 3
+    tensors = _tensors(k)
+    seeds = [100 + i for i in range(k)]
+    clean = cpd_als_batched(tensors, rank=RANK, opts=_opts(), seeds=seeds)
+    with resilience.scope("nan-batch") as sc:
+        with faults.scoped("cpd.batch.sweep:nan:iter=2"):
+            res = cpd_als_batched(tensors, rank=RANK, opts=_opts(),
+                                  seeds=seeds)
+    # slot 0 rolled back (alone) and recovered
+    assert res.rollbacks[0] >= 1
+    assert res.rollbacks[1:] == [0, 0]
+    assert res.statuses == ["converged"] * k
+    assert all(np.isfinite(np.asarray(f)).all()
+               for f in res.results[0].factors)
+    # the evidence carries the slot, and only slot 0
+    kinds = {(e["kind"], e.get("slot")) for e in sc.report.events()}
+    assert ("health_nonfinite", 0) in kinds
+    assert ("health_rollback", 0) in kinds
+    assert not any(s not in (0, None) for _, s in kinds)
+    # neighbors are BIT-identical to the clean run — the isolation
+    # acceptance: a NaN tenant cannot poison its batch
+    for i in (1, 2):
+        assert _bit_equal(clean.results[i], res.results[i])
+        assert clean.fits[i] == res.fits[i]
+
+
+def test_batched_budget_exhaustion_degrades_one_slot(monkeypatch):
+    monkeypatch.setenv("SPLATT_HEALTH_RETRIES", "1")
+    k = 3
+    tensors = _tensors(k)
+    seeds = [100 + i for i in range(k)]
+    with resilience.scope("degrade-batch") as sc:
+        with faults.scoped("cpd.batch.sweep:nan:*"):
+            res = cpd_als_batched(tensors, rank=RANK, opts=_opts(),
+                                  seeds=seeds)
+    assert res.statuses[0] == "degraded"
+    assert res.statuses[1:] == ["converged"] * 2
+    kinds = {e["kind"] for e in sc.report.events()}
+    assert "health_degraded" in kinds
+    # the degraded slot still returns finite last-good factors
+    assert all(np.isfinite(np.asarray(f)).all()
+               for f in res.results[0].factors)
+    # neighbors unaffected
+    clean = cpd_als_batched(tensors, rank=RANK, opts=_opts(), seeds=seeds)
+    for i in (1, 2):
+        assert _bit_equal(clean.results[i], res.results[i])
+
+
+def test_batched_guard_off_flows_through(monkeypatch):
+    """SPLATT_HEALTH_RETRIES=0 disables the sentinel: the poisoned
+    slot's NaN flows to its own result, neighbors stay clean."""
+    monkeypatch.setenv("SPLATT_HEALTH_RETRIES", "0")
+    tensors = _tensors(2)
+    with faults.scoped("cpd.batch.sweep:nan:iter=2"):
+        res = cpd_als_batched(tensors, rank=RANK, opts=_opts(),
+                              seeds=[1, 2])
+    assert not np.isfinite(np.asarray(res.results[0].factors[0])).all() \
+        or not np.isfinite(res.fits[0])
+    assert np.isfinite(res.fits[1])
+    assert res.rollbacks == [0, 0]
+
+
+# -- tuner plan keys: the batch axis -----------------------------------------
+
+def test_plan_key_batch_axis():
+    base = tune.plan_key(DIMS, NNZ, 0, RANK, np.float32)
+    assert tune.plan_key(DIMS, NNZ, 0, RANK, np.float32, batch=1) == base
+    k32 = tune.plan_key(DIMS, NNZ, 0, RANK, np.float32, batch=32)
+    assert k32 == base + ":bk6"
+    assert tune.plan_key(DIMS, NNZ, 0, RANK, np.float32,
+                         batch=2) == base + ":bk2"
+
+
+def test_batched_block_for_fallbacks(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPLATT_TUNE_CACHE", str(tmp_path / "tc.json"))
+    tune.reset_memo()
+    try:
+        # untuned: None (caller falls back to opts default)
+        assert tune.batched_block_for(DIMS, NNZ, 0, RANK, np.float32,
+                                      8) is None
+        # autotune off / no rank: None without touching the cache
+        assert tune.batched_block_for(DIMS, NNZ, 0, RANK, np.float32,
+                                      8, autotune=False) is None
+        assert tune.batched_block_for(DIMS, NNZ, 0, None, np.float32,
+                                      8) is None
+        # a single-tensor plan is the batched prior
+        key = tune.plan_key(DIMS, NNZ, 0, RANK, np.float32)
+        tune._entry_store(key, {"plan": {
+            "path": "sorted_scatter", "engine": "xla", "nnz_block": 2048,
+            "scan_target": 1 << 21, "sec": 0.1}})
+        assert tune.batched_block_for(DIMS, NNZ, 0, RANK, np.float32,
+                                      8) == 2048
+        # an explicit batch-axis plan wins over the single-tensor prior
+        bkey = tune.plan_key(DIMS, NNZ, 0, RANK, np.float32, batch=8)
+        tune._entry_store(bkey, {"plan": {
+            "path": "sorted_scatter", "engine": "xla", "nnz_block": 1024,
+            "scan_target": 1 << 21, "sec": 0.1}})
+        assert tune.batched_block_for(DIMS, NNZ, 0, RANK, np.float32,
+                                      8) == 1024
+    finally:
+        tune.reset_memo()
+
+
+# -- registries --------------------------------------------------------------
+
+def test_batched_registry_entries():
+    from splatt_tpu import trace
+    from splatt_tpu.resilience import RUN_REPORT_EVENTS
+    from splatt_tpu.utils.env import ENV_VARS
+
+    for var in ("SPLATT_SERVE_BATCH_MIN", "SPLATT_UPDATE_SWEEPS",
+                "SPLATT_UPDATE_REFIT_EVERY", "SPLATT_BENCH_BATCH_K"):
+        assert var in ENV_VARS
+    for ev in ("batch_dispatched", "batch_degraded", "update_applied",
+               "refit_scheduled"):
+        assert ev in RUN_REPORT_EVENTS
+    for site in ("serve.batch", "cpd.update", "cpd.batch.sweep"):
+        assert site in faults.SITES
+    for metric in ("splatt_serve_batches_total",
+                   "splatt_serve_batch_jobs_total",
+                   "splatt_serve_updates_total"):
+        assert metric in trace.METRICS
+    for span in ("cpd.batch", "cpd.batch.sweep", "cpd.update",
+                 "serve.batch"):
+        assert span in trace.SPANS
+
+
+def test_summary_lines_for_batch_events():
+    rep = resilience.run_report()
+    rep.add("batch_dispatched", jobs=["a", "b"], regime="r", k=2)
+    rep.add("batch_degraded", jobs=["a", "b"], failure_class="unknown",
+            error="boom")
+    rep.add("update_applied", job="u", base="m", update_n=2, sweeps=3,
+            delta_nnz=10, fit=0.5)
+    rep.add("refit_scheduled", job="u", base="m", reason="periodic",
+            update_n=3)
+    text = "\n".join(rep.summary())
+    assert "batch of 2" in text
+    assert "BATCH DEGRADED" in text
+    assert "update #2 applied" in text
+    assert "full refit scheduled" in text

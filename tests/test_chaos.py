@@ -258,10 +258,19 @@ def test_fleet_chaos_smoke_kill_and_failover():
     assert res.verdict == "survived"
     assert res.victim is not None        # the kill genuinely landed
     assert "fleet-1-pin" in res.adopted  # and forced an adoption
+    # ISSUE 15: the batched + update tenant mix rides the same soak —
+    # three same-regime jobs filed as a burst (coalescing candidates)
+    # plus an update chain against the base model (docs/batched.md)
     assert set(res.jobs) == {"fleet-0-warm", "fleet-1-pin",
-                             "fleet-2-nan", "fleet-3-clean"}
+                             "fleet-2-nan", "fleet-3-clean",
+                             "fleet-4-base", "fleet-5-up",
+                             "fleet-b0", "fleet-b1", "fleet-b2"}
     assert all(s in ("converged", "degraded")
                for s in res.jobs.values())
+    # batched coverage is recorded (spool-claim races can split the
+    # burst across replicas, so smoke records rather than requires;
+    # the 3-replica slow leg and tests/test_serve_batched.py pin it)
+    assert "batched_jobs" in res.observability
     aff = res.affinity["fleet-1-pin"]
     assert aff["cache_hits"] and not aff["measured"]
     assert aff["adopted_from"] == res.victim
@@ -279,7 +288,10 @@ def test_fleet_chaos_three_replicas():
     """The same kill-and-failover invariant at 3 replicas (slow tier;
     the ISSUE 14 acceptance runs the soak at 2 AND 3): more scanners
     racing the same adoption, same single-owner lineage, same
-    end-to-end observability evidence."""
+    end-to-end observability evidence.  ISSUE 15 makes this the
+    batched soak leg: the same-regime burst must actually coalesce
+    (>= 2 jobs committed through a batch) and the update chain must
+    leave auditable model-store lineage."""
     res = chaos.run_fleet_chaos(smoke=True, replicas=3)
     assert res.ok, res.violations
     assert res.verdict == "survived"
@@ -287,6 +299,8 @@ def test_fleet_chaos_three_replicas():
     assert res.observability["adoptions"] >= 1
     assert res.observability["slo_burns"] >= 1
     assert res.observability["flight_events"] >= 1
+    assert res.observability["batched_jobs"] >= 2
+    assert res.jobs.get("fleet-5-up") in ("converged", "degraded")
 
 
 def test_fleet_chaos_cli_flag_parses():

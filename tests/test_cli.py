@@ -236,3 +236,19 @@ def test_cpd_stem(tns, tmp_path, capsys):
     assert rc == 0
     assert os.path.exists(str(tmp_path / "run1.mode1.mat"))
     assert os.path.exists(str(tmp_path / "run1.lambda.mat"))
+
+def test_top_watch_zero_interval_runs_once(tmp_path, capsys, monkeypatch):
+    """SPLATT_STATUS_WATCH_S=0 makes the watch-by-default `splatt top`
+    (and `status --watch`) run ONCE and exit — what tests and scripts
+    set instead of killing a sleep loop (docs/batched.md CI satellite)."""
+    monkeypatch.setenv("SPLATT_STATUS_WATCH_S", "0")
+    root = str(tmp_path / "spool")
+    os.makedirs(root, exist_ok=True)
+    rc = main(["top", root])
+    assert rc == 0
+    assert "splatt fleet" in capsys.readouterr().out
+    # an explicit --interval 0 behaves the same without the env var
+    monkeypatch.delenv("SPLATT_STATUS_WATCH_S")
+    rc = main(["status", root, "--watch", "--interval", "0"])
+    assert rc == 0
+    assert "splatt fleet" in capsys.readouterr().out

@@ -1,0 +1,236 @@
+"""Serve auto-coalescing — SPLATT_SERVE_BATCH_MIN (docs/batched.md).
+
+The contracts under test:
+
+- >= batch_min queued batchable jobs sharing one regime key dispatch
+  as ONE vmapped batch, each member keeping its OWN journal lineage
+  (started/terminal records), result file, and metrics;
+- eligibility: jobs with per-job machinery a batch cannot honor
+  slot-wise (fault schedules, pre-tune, deadlines, per-job health
+  budgets) run singly, as do mixed-key jobs and sub-threshold queues;
+- partial-batch failure (the ``serve.batch`` fault site) degrades
+  CLASSIFIED to per-tensor dispatch — every member still reaches
+  exactly ONE terminal record;
+- the journal ROUND-TRIP: a crashed daemon's accepted-but-never-run
+  jobs re-coalesce on restart (no checkpoints -> still batchable);
+- a NaN batch member degrades alone, neighbors converge.
+"""
+
+import json
+import os
+
+import pytest
+
+from splatt_tpu import resilience, serve
+from splatt_tpu.utils import faults
+
+SYN = {"dims": [20, 16, 12], "nnz": 600}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    def clean():
+        faults.reset()
+        resilience.reset_demotions()
+        resilience.run_report().clear()
+
+    clean()
+    yield
+    clean()
+
+
+def _spec(jid, seed, **kw):
+    spec = {"id": jid, "rank": 3, "iters": 6, "tol": 0.0,
+            "seed": seed, "synthetic": dict(SYN, seed=seed)}
+    spec.update(kw)
+    return spec
+
+
+def _journal(root):
+    recs, _ = serve.Journal(os.path.join(root, "journal.jsonl")).replay()
+    return recs
+
+
+def _kinds(root, jid):
+    return [r["rec"] for r in _journal(root) if r.get("job") == jid]
+
+
+def test_coalesced_dispatch_preserves_per_job_lineage(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    ids = [f"b{i}" for i in range(3)]
+    for i, jid in enumerate(ids):
+        assert srv.submit(_spec(jid, seed=i))["state"] == serve.ACCEPTED
+    srv.run_once()
+    leaders = set()
+    for jid in ids:
+        # per-job journal lineage: one started (batch-stamped), one
+        # terminal — exactly like a single job
+        assert _kinds(str(tmp_path), jid) == [
+            serve.ACCEPTED, serve.STARTED, serve.DONE]
+        started = next(r for r in _journal(str(tmp_path))
+                       if r.get("job") == jid
+                       and r["rec"] == serve.STARTED)
+        leaders.add(started.get("batch"))
+        res = serve.read_result(str(tmp_path), jid)
+        assert res["status"] == "converged"
+        assert res["batched"]["k"] == 3
+        assert res["batched"]["compiles"] == 1
+        assert res["fit"] == pytest.approx(res["fit"])
+        # per-tenant metric isolation: the member's own registry cut
+        # carries its batch-job counter
+        assert any("splatt_serve_batch_jobs_total" in k
+                   for k in res["metrics"])
+    assert leaders == {"b0"}  # one batch, one leader
+    ev = resilience.run_report().events("batch_dispatched")
+    assert ev and ev[-1]["k"] == 3 and set(ev[-1]["jobs"]) == set(ids)
+
+
+def test_batch_min_not_met_runs_singly(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1, batch_min=5)
+    for i in range(3):
+        srv.submit(_spec(f"s{i}", seed=i))
+    srv.run_once()
+    for i in range(3):
+        res = serve.read_result(str(tmp_path), f"s{i}")
+        assert res["status"] == "converged"
+        assert "batched" not in res
+    assert not resilience.run_report().events("batch_dispatched")
+
+
+def test_ineligible_jobs_stay_single(tmp_path):
+    """A job carrying per-job machinery (here: a fault schedule and a
+    per-job health budget) never rides a batch — its eligible
+    neighbors still coalesce."""
+    srv = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    srv.submit(_spec("e0", seed=0))
+    srv.submit(_spec("e1", seed=1))
+    srv.submit(_spec("odd1", seed=2, faults="cpd.sweep:nan:iter=99"))
+    srv.submit(_spec("odd2", seed=3, health_retries=2))
+    srv.run_once()
+    for jid in ("e0", "e1"):
+        assert serve.read_result(str(tmp_path), jid)["batched"]["k"] == 2
+    for jid in ("odd1", "odd2"):
+        res = serve.read_result(str(tmp_path), jid)
+        assert res["status"] == "converged" and "batched" not in res
+
+
+def test_mixed_key_jobs_do_not_coalesce(tmp_path):
+    """Same regime but a different iters budget = a different
+    coalescing key: one vmapped program cannot honor both."""
+    srv = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    srv.submit(_spec("k0", seed=0))
+    srv.submit(_spec("k1", seed=1, iters=9))
+    srv.run_once()
+    for jid in ("k0", "k1"):
+        res = serve.read_result(str(tmp_path), jid)
+        assert res["status"] == "converged" and "batched" not in res
+
+
+def test_batch_fault_degrades_to_per_tensor(tmp_path):
+    """The serve.batch chaos drill: the batch path dying degrades
+    CLASSIFIED to per-tensor dispatch — every member still reaches
+    exactly one terminal record and a result."""
+    srv = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    ids = [f"d{i}" for i in range(3)]
+    for i, jid in enumerate(ids):
+        srv.submit(_spec(jid, seed=i))
+    with faults.inject("serve.batch", "runtime"):
+        srv.run_once()
+    ev = resilience.run_report().events("batch_degraded")
+    assert ev and ev[-1]["failure_class"] == "unknown"
+    for jid in ids:
+        res = serve.read_result(str(tmp_path), jid)
+        assert res["status"] == "converged"
+        assert "batched" not in res
+        # exactly ONE started + ONE terminal — the degrade re-ran the
+        # members without double-journaling their start
+        kinds = _kinds(str(tmp_path), jid)
+        assert kinds.count(serve.STARTED) == 1
+        assert kinds.count(serve.DONE) == 1
+
+
+def test_journal_roundtrip_recoalesces_after_restart(tmp_path):
+    """Kill-and-restart round-trip: accepted-but-never-run jobs replay
+    on the next start and — having no checkpoints — coalesce again."""
+    a = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    ids = [f"r{i}" for i in range(3)]
+    for i, jid in enumerate(ids):
+        assert a.submit(_spec(jid, seed=i))["state"] == serve.ACCEPTED
+    del a  # crash: nothing ran, the journal holds three ACCEPTED
+    b = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    assert {j for j, _ in
+            [(jid, None) for jid in ids]} <= set(b.summary()["jobs"])
+    b.run_once()
+    for jid in ids:
+        res = serve.read_result(str(tmp_path), jid)
+        assert res["status"] == "converged"
+        assert res["batched"]["k"] == 3
+        assert res["resumed"] is True
+        kinds = _kinds(str(tmp_path), jid)
+        assert kinds[0] == serve.ACCEPTED
+        assert kinds[-1] == serve.DONE
+        assert kinds.count(serve.DONE) + kinds.count(serve.FAILED) == 1
+
+
+def test_checkpointed_resume_stays_single(tmp_path):
+    """A resumed job that left a checkpoint takes the single-job
+    resume path (batched runs do not checkpoint)."""
+    a = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    for i in range(2):
+        a.submit(_spec(f"c{i}", seed=i))
+    # plant a checkpoint for c0, as an interrupted run would have
+    from splatt_tpu.cpd import _save_checkpoint, init_factors
+    import jax.numpy as jnp
+
+    dims = [d for d in SYN["dims"]]
+    fac = init_factors(tuple(dims), 3, 0)
+    _save_checkpoint(os.path.join(a.ckpt_dir, "c0.npz"), fac,
+                     jnp.ones((3,)), 2, 0.1)
+    del a
+    b = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    b.run_once()
+    r0 = serve.read_result(str(tmp_path), "c0")
+    r1 = serve.read_result(str(tmp_path), "c1")
+    assert r0["status"] == "converged" and "batched" not in r0
+    assert r1["status"] == "converged" and "batched" not in r1
+
+
+def test_nan_member_degrades_alone_in_batch(tmp_path, monkeypatch):
+    """Per-slot health isolation THROUGH serve: slot 0 of the batch is
+    poisoned persistently; its job degrades, neighbors converge."""
+    monkeypatch.setenv("SPLATT_HEALTH_RETRIES", "1")
+    srv = serve.Server(str(tmp_path), workers=1, batch_min=2)
+    ids = [f"n{i}" for i in range(3)]
+    for i, jid in enumerate(ids):
+        srv.submit(_spec(jid, seed=i))
+    with faults.inject("cpd.batch.sweep", "nan", times=faults.ALWAYS):
+        srv.run_once()
+    # slot 0 == the first member of the dispatch order (n0)
+    r0 = serve.read_result(str(tmp_path), "n0")
+    assert r0["status"] == "degraded"
+    assert r0["batched"]["rollbacks"] >= 1
+    assert any(e["kind"] == "health_degraded" and e.get("slot") == 0
+               for e in r0["events"])
+    for jid in ("n1", "n2"):
+        res = serve.read_result(str(tmp_path), jid)
+        assert res["status"] == "converged"
+        assert not any(e["kind"].startswith("health_")
+                       for e in res["events"])
+
+
+def test_cli_batch_min_flag(tmp_path):
+    """`splatt serve --batch-min` reaches the Server."""
+    from splatt_tpu import cli
+
+    root = str(tmp_path / "spool")
+    spec = _spec("cli0", seed=0)
+    os.makedirs(root, exist_ok=True)
+    serve.file_request(root, spec)
+    serve.file_request(root, _spec("cli1", seed=1))
+    rc = cli.main(["serve", root, "--once", "--workers", "1",
+                   "--batch-min", "2"])
+    assert rc == 0
+    for jid in ("cli0", "cli1"):
+        res = serve.read_result(root, jid)
+        assert res["status"] == "converged"
+        assert res["batched"]["k"] == 2

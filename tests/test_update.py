@@ -1,0 +1,309 @@
+"""Incremental model updates — the `update` job kind (docs/batched.md).
+
+The contracts under test:
+
+- ACCEPTANCE: a seeded delta applied via an `update` job reaches fit
+  within 1e-3 of a from-scratch refit of the merged tensor while
+  running <= 25% of its sweeps, warm-started from the checkpointed
+  model (delta-touched rows re-solved first);
+- the journal/checkpoint store acts as a MODEL STORE: the update
+  advances ckpt/<base>.npz and persists the merged COO beside it,
+  updates chain (each loads the previous merge), re-runs are
+  idempotent (the `applied` stamp), and the lineage is auditable
+  through `splatt status --json` / fleetobs.fleet_status;
+- repair paths: a missing model, the periodic
+  SPLATT_UPDATE_REFIT_EVERY boundary, and a classified warm-path
+  failure (the ``cpd.update`` fault site) all degrade to a full refit
+  (``refit_scheduled``) — never a failed job;
+- admission: update specs without base/delta (or with an unknown
+  kind, or a dim-growing delta) are rejected/failed loudly.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from splatt_tpu import fleetobs, resilience, serve
+from splatt_tpu.chaos import synthetic_tensor
+from splatt_tpu.config import Options, Verbosity
+from splatt_tpu.cpd import cpd_als, refresh_touched_rows, touched_rows
+from splatt_tpu.utils import faults
+
+DIMS = (20, 16, 12)
+BASE = {"dims": list(DIMS), "nnz": 900, "seed": 3}
+DELTA = {"dims": list(DIMS), "nnz": 60, "seed": 42}
+ITERS = 20          # the base/refit budget
+UP_SWEEPS = 5       # <= 25% of ITERS — the acceptance bound
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    def clean():
+        faults.reset()
+        resilience.reset_demotions()
+        resilience.run_report().clear()
+
+    clean()
+    yield
+    clean()
+
+
+def _base_spec(**kw):
+    spec = {"id": "base", "rank": 3, "iters": ITERS, "seed": 7,
+            "checkpoint_every": 2, "synthetic": dict(BASE)}
+    spec.update(kw)
+    return spec
+
+
+def _up_spec(jid="up1", **kw):
+    spec = {"id": jid, "kind": "update", "base": "base",
+            "delta": dict(DELTA), "iters": UP_SWEEPS}
+    spec.update(kw)
+    return spec
+
+
+def _merged_tensor(extra_deltas=()):
+    tt = synthetic_tensor(tuple(BASE["dims"]), BASE["nnz"], BASE["seed"])
+    for d in (DELTA, *extra_deltas):
+        dt = synthetic_tensor(tuple(d["dims"]), d["nnz"], d["seed"])
+        tt = serve._merge_delta(tt, dt)
+    return tt
+
+
+def _run(srv, *specs):
+    for spec in specs:
+        r = srv.submit(spec)
+        assert r["state"] == serve.ACCEPTED, r
+    srv.run_once()
+    return [serve.read_result(srv.root, s["id"]) for s in specs]
+
+
+# -- the update acceptance ---------------------------------------------------
+
+def test_update_converges_within_epsilon_of_refit(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    (base_res,) = _run(srv, _base_spec())
+    assert base_res["status"] == "converged"
+    (up_res,) = _run(srv, _up_spec())
+    assert up_res["status"] == "converged"
+    info = up_res["update"]
+    assert info["base"] == "base" and info["sweeps"] == UP_SWEEPS
+    assert UP_SWEEPS <= ITERS // 4
+    kinds = {e["kind"] for e in up_res["events"]}
+    assert "update_applied" in kinds and "refit_scheduled" not in kinds
+    # from-scratch refit of the SAME merged tensor, full budget
+    refit = cpd_als(_merged_tensor(), rank=3,
+                    opts=Options(random_seed=7, max_iterations=ITERS,
+                                 tolerance=1e-5, autotune=None,
+                                 verbosity=Verbosity.NONE))
+    assert abs(up_res["fit"] - float(refit.fit)) < 1e-3
+
+
+def test_update_advances_model_store_and_chains(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _base_spec())
+    ckpt = os.path.join(srv.ckpt_dir, "base.npz")
+    model0 = open(ckpt, "rb").read()
+    (up1,) = _run(srv, _up_spec("up1"))
+    assert up1["update"]["update_n"] == 1
+    # the model checkpoint advanced and the merged COO is persisted
+    assert open(ckpt, "rb").read() != model0
+    tpath = os.path.join(srv.ckpt_dir, "base.model.npz")
+    tt, applied = serve._load_model_tensor(tpath)
+    assert applied == ["up1"]
+    assert tt.nnz == _merged_tensor().nnz
+    # a second update CHAINS: it merges into the persisted tensor
+    d2 = {"dims": list(DIMS), "nnz": 40, "seed": 43}
+    (up2,) = _run(srv, _up_spec("up2", delta=d2))
+    assert up2["status"] == "converged"
+    assert up2["update"]["update_n"] == 2
+    tt2, applied2 = serve._load_model_tensor(tpath)
+    assert applied2 == ["up1", "up2"]
+    assert tt2.nnz == _merged_tensor((d2,)).nnz
+
+
+def test_update_rerun_is_idempotent(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _base_spec())
+    (up1,) = _run(srv, _up_spec("up1"))
+    assert up1["status"] == "converged"
+    tpath = os.path.join(srv.ckpt_dir, "base.model.npz")
+    nnz_once = serve._load_model_tensor(tpath)[0].nnz
+    # a crashed update's re-run (persist landed, terminal record did
+    # not): the applied stamp stops the delta merging twice
+    out, info = srv._run_update("up1", _up_spec("up1"), lambda: False)
+    assert serve._load_model_tensor(tpath)[0].nnz == nnz_once
+    assert float(out.fit) == pytest.approx(up1["fit"], abs=1e-3)
+
+
+def test_update_lineage_auditable_via_status(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _base_spec())
+    _run(srv, _up_spec("up1"))
+    # journal lineage: one accepted/started/done chain for the update
+    recs, _ = serve.Journal(os.path.join(
+        srv.root, "journal.jsonl")).replay()
+    kinds = [r["rec"] for r in recs if r.get("job") == "up1"]
+    assert kinds == [serve.ACCEPTED, serve.STARTED, serve.DONE]
+    # client-side status audit (what `splatt status --json` prints)
+    st = fleetobs.fleet_status(str(tmp_path))
+    assert st["jobs"]["up1"] == serve.DONE
+    rec = next(r for r in st["recent"] if r["job"] == "up1")
+    assert rec["kind"] == "update" and rec["base"] == "base"
+    assert any("update_of=base" in line
+               for line in fleetobs.format_status(st))
+    # read_status rides the result along
+    out = serve.read_status(str(tmp_path), "up1")
+    assert out["state"] == serve.DONE
+    assert out["result"]["update"]["base"] == "base"
+
+
+# -- repair paths ------------------------------------------------------------
+
+def test_update_without_model_refits(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    # base too short to ever checkpoint -> no model in the store
+    _run(srv, _base_spec(iters=1, checkpoint_every=10))
+    (up,) = _run(srv, _up_spec())
+    assert up["status"] == "converged"
+    assert up["update"]["refit"] == "no_model"
+    kinds = {e["kind"] for e in up["events"]}
+    assert "refit_scheduled" in kinds and "update_applied" not in kinds
+
+
+def test_periodic_refit_cadence(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPLATT_UPDATE_REFIT_EVERY", "2")
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _base_spec())
+    (up1,) = _run(srv, _up_spec("up1"))
+    assert "refit" not in up1["update"]          # update #1: warm
+    (up2,) = _run(srv, _up_spec("up2"))
+    assert up2["update"]["refit"] == "periodic"  # update #2: boundary
+    assert up2["status"] == "converged"
+
+
+def test_update_fault_degrades_to_refit(tmp_path):
+    """The cpd.update fault site: a raised fault in the warm pre-pass
+    repairs via a classified full refit, never a failed job."""
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _base_spec())
+    (up,) = _run(srv, _up_spec("up1", faults="cpd.update:runtime"))
+    assert up["status"] == "converged"
+    assert up["update"]["refit"].startswith("failed:")
+    kinds = {e["kind"] for e in up["events"]}
+    assert "refit_scheduled" in kinds
+    # the refit still advanced the model store
+    assert os.path.exists(os.path.join(srv.ckpt_dir, "base.model.npz"))
+
+
+def test_update_admission_validation(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    r = srv.submit({"id": "u1", "kind": "update",
+                    "delta": dict(DELTA)})
+    assert r["state"] == serve.REJECTED and "base" in r["reason"]
+    r = srv.submit({"id": "u2", "kind": "update", "base": "base"})
+    assert r["state"] == serve.REJECTED and "delta" in r["reason"]
+    r = srv.submit({"id": "u3", "kind": "nope",
+                    "synthetic": dict(BASE)})
+    assert r["state"] == serve.REJECTED and "kind" in r["reason"]
+
+
+def test_update_unknown_base_and_growing_delta_fail(tmp_path):
+    from splatt_tpu.io import save
+
+    srv = serve.Server(str(tmp_path), workers=1)
+    (up,) = _run(srv, _up_spec("u1", base="ghost"))
+    assert up["status"] == "failed"
+    assert "unknown" in up["error"]
+    _run(srv, _base_spec())
+    # a delta whose indices name rows past the model's dims (on-disk
+    # tensor: the synthetic generator compacts empty slices, so a
+    # genuinely growing delta needs explicit coordinates)
+    from splatt_tpu.coo import SparseTensor
+
+    big = SparseTensor(np.array([[39], [2], [3]]), np.array([1.0]),
+                       (40, 16, 12))
+    path = str(tmp_path / "grow.tns")
+    save(big, path)
+    spec = _up_spec("u2")
+    del spec["delta"]
+    spec["delta_tensor"] = path
+    (up2,) = _run(srv, spec)
+    # the growing delta fails the warm path AND the refit path, loudly
+    assert up2["status"] == "failed"
+    assert "grows mode" in up2["error"]
+
+
+# -- the warm pre-pass (cpd.refresh_touched_rows) ----------------------------
+
+def test_touched_rows_and_refresh():
+    tt = synthetic_tensor(DIMS, 400, seed=0)
+    delta = synthetic_tensor(DIMS, 30, seed=1)
+    touched = touched_rows(delta, tt.nmodes)
+    for m in range(tt.nmodes):
+        assert np.array_equal(touched[m],
+                              np.unique(np.asarray(delta.inds[m])))
+    opts = Options(random_seed=0, max_iterations=6, autotune=False,
+                   verbosity=Verbosity.NONE)
+    out = cpd_als(tt, rank=3, opts=opts)
+    merged = serve._merge_delta(tt, delta)
+    from splatt_tpu.blocked import BlockedSparse
+
+    bs = BlockedSparse.from_coo(merged, opts)
+    warm = refresh_touched_rows(bs, out.factors, touched)
+    # untouched rows keep their converged values EXACTLY
+    for m in range(tt.nmodes):
+        untouched = np.setdiff1d(np.arange(DIMS[m]), touched[m])
+        np.testing.assert_array_equal(
+            np.asarray(warm[m])[untouched],
+            np.asarray(out.factors[m])[untouched])
+        # touched rows were re-solved (generically different)
+        if touched[m].size:
+            assert not np.array_equal(
+                np.asarray(warm[m])[touched[m]],
+                np.asarray(out.factors[m])[touched[m]])
+
+
+def test_refresh_rows_fault_site_raises():
+    """The cpd.update site fires inside the warm pre-pass itself —
+    what the serve repair path classifies into a refit."""
+    tt = synthetic_tensor(DIMS, 200, seed=0)
+    delta = synthetic_tensor(DIMS, 10, seed=1)
+    opts = Options(random_seed=0, max_iterations=2, autotune=False,
+                   verbosity=Verbosity.NONE)
+    out = cpd_als(tt, rank=3, opts=opts)
+    with faults.inject("cpd.update", "runtime"):
+        with pytest.raises(RuntimeError, match="injected"):
+            refresh_touched_rows(tt, out.factors,
+                                 touched_rows(delta, tt.nmodes))
+
+
+def test_merge_delta_additive_and_validated():
+    tt = synthetic_tensor(DIMS, 100, seed=0)
+    delta = synthetic_tensor(DIMS, 10, seed=1)
+    merged = serve._merge_delta(tt, delta)
+    assert merged.nnz == tt.nnz + delta.nnz
+    assert merged.dims == tt.dims
+    assert merged.normsq() == pytest.approx(
+        float(np.dot(np.concatenate([tt.vals, delta.vals]),
+                     np.concatenate([tt.vals, delta.vals]))))
+    from splatt_tpu.coo import SparseTensor
+
+    grow = SparseTensor(np.array([[39], [2], [3]]), np.array([1.0]),
+                        (40, 16, 12))
+    with pytest.raises(ValueError, match="grows mode"):
+        serve._merge_delta(tt, grow)
+    fourmode = SparseTensor(np.array([[1], [2], [3], [0]]),
+                            np.array([1.0]), (20, 16, 12, 4))
+    with pytest.raises(ValueError, match="modes"):
+        serve._merge_delta(tt, fourmode)
+
+
+def test_corrupt_model_tensor_degrades(tmp_path):
+    path = str(tmp_path / "m.model.npz")
+    with open(path, "wb") as f:
+        f.write(b"not an npz")
+    tt, applied = serve._load_model_tensor(path)
+    assert tt is None and applied == []
+    assert resilience.run_report().events("checkpoint_recovery")
