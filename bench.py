@@ -22,7 +22,11 @@ SPLATT_BENCH_SHAPE (nell2 default | enron4 — the 4-mode Enron-shaped
 workload of BASELINE.md row 2), SPLATT_BENCH_SCENARIO (uniform default
 | zipf:<a> | powerlaw | amazon-like — named nnz-distribution scenarios,
 docs/layout-balance.md; non-uniform scenarios tag the metric string and
-carry per-scenario imbalance stats), SPLATT_BENCH_PATHS
+carry per-scenario imbalance stats; batched — the K-tenant fleet A/B,
+docs/batched.md; predict — the prediction plane's hot-cache vs
+direct-fenced-read request-latency A/B with p50/p99 + cache hit rate,
+docs/predict.md, sized by SPLATT_BENCH_PREDICT_B entries/request and
+SPLATT_BENCH_PREDICT_N requests/leg), SPLATT_BENCH_PATHS
 ("blocked,balanced,compact,tuned,stream" default — which
 representations to measure; "balanced" is the load-balanced row:
 nnz-packed fibers with long-fiber splitting (docs/layout-balance.md);
@@ -73,9 +77,10 @@ import time
 
 import numpy as np
 
-from splatt_tpu.utils.env import apply_env_platform
+from splatt_tpu.utils.env import apply_compile_cache, apply_env_platform
 
 apply_env_platform()
+apply_compile_cache()
 
 
 def synthetic_tensor(dims, nnz: int, seed: int = 0):
@@ -780,6 +785,161 @@ def _run_batched_bench(gate: bool) -> None:
         raise SystemExit(1)
 
 
+def _run_predict_bench(gate: bool) -> None:
+    """SPLATT_BENCH_SCENARIO=predict (docs/predict.md): the prediction
+    plane's request latency — N requests, each a B-entry batched
+    reconstruct plus one top-k slice scan against a committed model
+    generation, served (a) through the hot-factor cache (the steady
+    state) and (b) through the direct fenced read EVERY request (the
+    cache-miss/degrade arm: stamp read + checkpoint load + sha verify
+    per request).  Reports p50/p99 per stage and per arm, the achieved
+    cache hit rate, and a CV-aware in-run verdict: under --gate a hot
+    arm slower than the direct read beyond 2x the worse CV fails the
+    run — a cache that does not beat re-reading the store from disk is
+    pure overhead."""
+    import tempfile
+
+    from splatt_tpu import predict, resilience
+    from splatt_tpu.cpd import _save_checkpoint
+
+    rank = int(os.environ.get("SPLATT_BENCH_RANK") or 16)
+    B = int(os.environ.get("SPLATT_BENCH_PREDICT_B") or 256)
+    N = int(os.environ.get("SPLATT_BENCH_PREDICT_N") or 120)
+    topk = 10
+    reps = 3
+    dims = (2048, 1024, 512)
+    rng = np.random.default_rng(11)
+    factors = [np.asarray(rng.standard_normal((d, rank)),
+                          dtype=np.float32) for d in dims]
+    lam = np.asarray(rng.uniform(0.5, 2.0, rank), dtype=np.float32)
+    root = tempfile.mkdtemp(prefix="splatt-bench-predict-")
+    ckdir = os.path.join(root, "ckpt")
+    os.makedirs(ckdir, exist_ok=True)
+    _save_checkpoint(os.path.join(ckdir, "m.npz"), factors, lam,
+                     0, 0.9)
+    gen = predict.advance_generation(ckdir, "m", factors, lam)
+    coords = np.stack([rng.integers(0, d, size=N * B) for d in dims],
+                      axis=1)
+
+    cache = predict.HotFactorCache(8)
+    hit_miss = [0, 0]
+
+    def hot_entry():
+        entry = cache.get("m", gen)
+        if entry is None:
+            hit_miss[1] += 1
+            entry = predict.load_model_generation(ckdir, "m")
+            cache.put("m", gen, entry)
+        else:
+            hit_miss[0] += 1
+        return entry
+
+    def leg(lookup):
+        # per-request stage latencies: (lookup, reconstruct, top-k)
+        lat = {"lookup": [], "reconstruct": [], "topk": [],
+               "request": []}
+        for i in range(N):
+            req = coords[i * B:(i + 1) * B]
+            t0 = time.perf_counter()
+            entry = lookup()
+            t1 = time.perf_counter()
+            predict.reconstruct_entries(entry["factors"],
+                                        entry["lam"], req)
+            t2 = time.perf_counter()
+            predict.top_k_slice(entry["factors"], entry["lam"],
+                                {1: int(req[0][1]), 2: int(req[0][2])},
+                                0, topk)
+            t3 = time.perf_counter()
+            lat["lookup"].append(t1 - t0)
+            lat["reconstruct"].append(t2 - t1)
+            lat["topk"].append(t3 - t2)
+            lat["request"].append(t3 - t0)
+        return lat
+
+    def direct_entry():
+        return predict.load_model_generation(ckdir, "m")
+
+    print("bench: predict warmup pass", file=sys.stderr, flush=True)
+    leg(hot_entry)
+    leg(direct_entry)
+    hit_miss[0] = hit_miss[1] = 0
+    # alternating legs so drift on a shared host hits both arms alike
+    hot_legs, direct_legs = [], []
+    for r in range(reps):
+        hot_legs.append(leg(hot_entry))
+        direct_legs.append(leg(direct_entry))
+        print(f"bench: predict rep {r + 1}/{reps}: hot p99 "
+              f"{1e3 * np.percentile(hot_legs[-1]['request'], 99):.3f}"
+              f"ms, direct p99 "
+              f"{1e3 * np.percentile(direct_legs[-1]['request'], 99):.3f}"
+              f"ms", file=sys.stderr, flush=True)
+
+    def pcts(legs, key):
+        allv = np.concatenate([lg[key] for lg in legs])
+        return (round(float(np.percentile(allv, 50)) * 1e3, 4),
+                round(float(np.percentile(allv, 99)) * 1e3, 4))
+
+    hot_p50, hot_p99 = pcts(hot_legs, "request")
+    dir_p50, dir_p99 = pcts(direct_legs, "request")
+    rec_p50, rec_p99 = pcts(hot_legs, "reconstruct")
+    top_p50, top_p99 = pcts(hot_legs, "topk")
+    # the CV legs for the noise rule: per-rep median request latency
+    cv_hot = _timing_cv([float(np.median(lg["request"]))
+                         for lg in hot_legs])
+    cv_dir = _timing_cv([float(np.median(lg["request"]))
+                         for lg in direct_legs])
+    hit_rate = hit_miss[0] / max(hit_miss[0] + hit_miss[1], 1)
+    rec = {
+        "metric": f"predict request p99 latency (hot-cache arm), "
+                  f"B={B} entries/request + top-{topk}, rank {rank} "
+                  f"model dims {dims}, f32, host-side numpy",
+        "value": hot_p99,
+        "unit": "ms/request p99",
+        "predict": {
+            "requests_per_leg": N, "entries_per_request": B,
+            "reps": reps, "cache_hit_rate": round(hit_rate, 4),
+            "hot_p50_ms": hot_p50, "hot_p99_ms": hot_p99,
+            "direct_p50_ms": dir_p50, "direct_p99_ms": dir_p99,
+            "reconstruct_p50_ms": rec_p50,
+            "reconstruct_p99_ms": rec_p99,
+            "topk_p50_ms": top_p50, "topk_p99_ms": top_p99,
+            "cv_hot": round(cv_hot, 4), "cv_direct": round(cv_dir, 4),
+        },
+    }
+    # CV-aware in-run verdict (the same noise rule as the prior gate):
+    # the hot arm must not lose to re-reading the store per request
+    hot_med = float(np.median([np.median(lg["request"])
+                               for lg in hot_legs]))
+    dir_med = float(np.median([np.median(lg["request"])
+                               for lg in direct_legs]))
+    noise = 2.0 * max(cv_hot, cv_dir)
+    delta = (hot_med - dir_med) / max(dir_med, 1e-12)
+    if delta > 0 and delta <= noise:
+        resilience.record_bench_noisy(
+            "predict", cv=max(cv_hot, cv_dir), threshold=noise,
+            sec=hot_med, prior_sec=dir_med,
+            prior_file="(in-run direct-read baseline)")
+        rec["predict"]["verdict"] = "noisy"
+    elif delta > 0:
+        resilience.record_bench_regression(
+            "predict", sec=hot_med, prior_sec=dir_med,
+            pct=100 * delta, prior_file="(in-run direct-read baseline)")
+        rec["predict"]["verdict"] = "fail"
+    else:
+        rec["predict"]["verdict"] = ("pass" if -delta > noise
+                                     else "pass-within-noise")
+    regressions = []
+    try:
+        regressions = _apply_regression_gate(rec)
+    except Exception as e:
+        print(f"bench: regression gate skipped "
+              f"({resilience.classify_failure(e).value}: {e})",
+              file=sys.stderr, flush=True)
+    print(json.dumps(rec))
+    if gate and (rec["predict"]["verdict"] == "fail" or regressions):
+        raise SystemExit(1)
+
+
 def _device_precheck(timeout_sec: int = 180) -> None:
     """Probe device availability in a subprocess so a wedged accelerator
     lease cannot hang the benchmark; fall back to CPU on failure.
@@ -854,6 +1014,11 @@ def main(gate: bool = False) -> None:
         # one big tensor
         _device_precheck()
         _run_batched_bench(gate)
+        return
+    if os.environ.get("SPLATT_BENCH_SCENARIO", "").strip() == "predict":
+        # the prediction plane's request-latency A/B is host-side
+        # numpy over a committed model store — no device needed
+        _run_predict_bench(gate)
         return
     _device_precheck()
     import jax

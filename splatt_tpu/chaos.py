@@ -423,6 +423,11 @@ def run_serve_chaos(seed: int = 0, smoke: bool = True,
     # a throwaway plan cache: plans the soak's jobs measure must never
     # leak into the real shared cache
     env["SPLATT_TUNE_CACHE"] = os.path.join(tmp, "tune_cache.json")
+    # persistent executable cache shared across the kill (utils/
+    # env.py): the restarted daemon re-adopts its jobs WITHOUT paying
+    # the original's XLA compiles — single-device programs only, the
+    # CPU-safe scope (see run_fleet_chaos)
+    env["SPLATT_COMPILE_CACHE"] = os.path.join(tmp, "xla_cache")
     try:
         # the NaN job's id sorts FIRST ("0" < "c" in the spool's
         # sorted-filename ingest order), so with one worker it is the
@@ -606,6 +611,40 @@ def _fleet_lineage_violations(recs: List[dict]) -> List[str]:
     return out
 
 
+def _predict_staleness_violations(recs: List[dict]) -> List[str]:
+    """Audit the generation fence from the journal ALONE
+    (docs/predict.md): every served prediction's generation must be >=
+    the newest generation COMMITTED before that predict was accepted.
+    Commit ``done`` records carry ``model``/``model_gen``; predict
+    ``accepted`` records carry ``gen_pinned`` (the marker) and their
+    spec; predict ``done`` records carry the served ``gen``.  The
+    journal is totally ordered (one flocked file), so walking it in
+    order reconstructs what any reader could have known."""
+    from splatt_tpu import serve
+
+    out: List[str] = []
+    committed: Dict[str, int] = {}
+    floor: Dict[str, int] = {}
+    for r in recs:
+        k, jid = r.get("rec"), r.get("job")
+        if k == serve.ACCEPTED and "gen_pinned" in r:
+            model = str((r.get("spec") or {}).get("model") or "")
+            floor[jid] = committed.get(model, 0)
+        elif k == serve.DONE:
+            if r.get("model_gen") is not None:
+                m = str(r.get("model"))
+                committed[m] = max(committed.get(m, 0),
+                                   int(r["model_gen"]))
+            if r.get("status") == "served" and jid in floor:
+                gen = int(r.get("gen") or 0)
+                if gen < floor[jid]:
+                    out.append(
+                        f"predict {jid} served generation {gen} but "
+                        f"generation {floor[jid]} was committed before "
+                        f"it was accepted — a STALE read")
+    return out
+
+
 def run_fleet_chaos(seed: int = 0, smoke: bool = True,
                     replicas: Optional[int] = None,
                     verbose: bool = False) -> FleetChaosResult:
@@ -641,7 +680,15 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
        RECOVERS once the fleet is quiet; the victim's flight-recorder
        ring replays its timeline up to the kill — the pinned job's
        ``job_started`` liveness mark included; and ``splatt status``
-       agrees with the journal about every job's state.
+       agrees with the journal about every job's state;
+    7. the generation-fenced predict plane (docs/predict.md) under
+       the same kill: a predict filed in the mid-kill burst is never
+       lost, predicts interleaved with the update commit violate no
+       staleness (every served generation >= the newest generation
+       committed before acceptance, audited from the journal alone),
+       >= 1 predict is served once the base model commits, and a
+       predict against a shredded model (checkpoint + .bak + both
+       generation stamps) REFUSES instead of serving garbage.
     """
     import json
     import os
@@ -692,7 +739,16 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         # batched + update tenant mix (docs/batched.md): two queued
         # same-regime jobs coalesce into one vmapped batch, and the
         # update tenant exercises the model store under failover
-        SPLATT_SERVE_BATCH_MIN="2", SPLATT_UPDATE_SWEEPS="2")
+        SPLATT_SERVE_BATCH_MIN="2", SPLATT_UPDATE_SWEEPS="2",
+        # shared persistent executable cache (utils/env.py,
+        # ROADMAP item 4): replica 0's first compile of the common
+        # job regime warms every peer, respawn and failover adoptee —
+        # the cold-replica-skips-compile path, exercised under kills.
+        # Safe here because replica jobs are single-device programs;
+        # the suite's own process must NOT set this (sharded CPU
+        # executables corrupt the heap when deserialized — see
+        # tests/conftest.py)
+        SPLATT_COMPILE_CACHE=os.path.join(tmp, "xla_cache"))
     # SPLATT_METRICS_PATH stays UNSET: fleet mode defaults each
     # replica's snapshot into <root>/fleet/metrics/<rid>.prom, which
     # is where the aggregator (and this soak's post-mortem) finds
@@ -792,6 +848,13 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
                 "id": bid, "tenant": "delta", "rank": 3, "iters": 4,
                 "synthetic": dict(bsyn, seed=seed + 10 + i),
                 "seed": seed + 10 + i})
+        # ...plus a predict riding the SAME mid-kill burst: accepted
+        # while the victim is dead, it must reach a terminal answer
+        # (served if the base model commits first, REFUSED if it runs
+        # before the commit — either is honest; losing it is not)
+        serve.file_request(tmp, {
+            "id": "fleet-p0", "kind": "predict", "tenant": "epsilon",
+            "model": "fleet-4-base", "coords": [[0, 0, 0], [1, 1, 1]]})
         # kill-and-RESTART: a replacement joins under a fresh id (a
         # new incarnation — the dead id's leases must EXPIRE, not be
         # silently re-owned)
@@ -801,22 +864,59 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         # the update tenant needs its base model DONE first: the
         # journal/checkpoint store must hold the model to advance
         all_jobs = ["fleet-0-warm", "fleet-1-pin", "fleet-2-nan",
-                    "fleet-3-clean", "fleet-4-base", *batch_jobs]
+                    "fleet-3-clean", "fleet-4-base", "fleet-p0",
+                    *batch_jobs]
         if wait_for(lambda: states().get("fleet-4-base",
                                          (None,))[0]
                     in serve.TERMINAL, 300, "the update base job"):
+            # the predict stream around the update commit: p1 is
+            # pinned at the base generation, the update advances it,
+            # p2 files after the update — the journal staleness audit
+            # must hold across the whole interleaving
+            serve.file_request(tmp, {
+                "id": "fleet-p1", "kind": "predict",
+                "tenant": "epsilon", "model": "fleet-4-base",
+                "coords": [[0, 0, 0], [1, 2, 3]]})
             serve.file_request(tmp, {
                 "id": "fleet-5-up", "kind": "update",
                 "base": "fleet-4-base", "tenant": "epsilon",
                 "delta": {"dims": list(dims), "nnz": max(nnz // 20, 8),
                           "seed": seed + 99}})
+            serve.file_request(tmp, {
+                "id": "fleet-p2", "kind": "predict",
+                "tenant": "epsilon", "model": "fleet-4-base",
+                "top_k": {"fixed": {"1": 0, "2": 0}, "mode": 0,
+                          "k": 3}})
             # only a FILED update is waited on: a base-job timeout is
             # its own (already recorded) violation, not a reason to
             # burn the final wait polling a job that never existed
-            all_jobs.append("fleet-5-up")
+            all_jobs += ["fleet-p1", "fleet-5-up", "fleet-p2"]
         wait_for(lambda: all(states().get(j, (None,))[0]
                              in serve.TERMINAL for j in all_jobs),
                  300 if smoke else 900, "all jobs to finish")
+        # phase 5 — corrupt-model refusal drill (docs/predict.md):
+        # shred the base model's checkpoint AND its .bak, drop both
+        # generation stamps, then predict against it — the fenced read
+        # finds no intact (checkpoint, stamp) pair and must REFUSE
+        # classified, never serve garbage
+        ckdir = os.path.join(tmp, "ckpt")
+        for name in ("fleet-4-base.npz", "fleet-4-base.npz.bak"):
+            fp = os.path.join(ckdir, name)
+            if os.path.exists(fp):
+                with open(fp, "wb") as f:
+                    f.write(b"shredded by the chaos drill")
+        for name in ("fleet-4-base.gen.json",
+                     "fleet-4-base.gen.json.bak"):
+            try:
+                os.remove(os.path.join(ckdir, name))
+            except FileNotFoundError:
+                pass
+        serve.file_request(tmp, {
+            "id": "fleet-p3", "kind": "predict", "tenant": "epsilon",
+            "model": "fleet-4-base", "coords": [[0, 0, 0]]})
+        all_jobs.append("fleet-p3")
+        wait_for(lambda: states().get("fleet-p3", (None,))[0]
+                 in serve.TERMINAL, 180, "the corrupt-model predict")
     except Exception as e:  # the harness itself must not crash the CLI
         error = (f"{resilience.classify_failure(e).value}: "
                  f"{resilience.failure_message(e)[:300]}")
@@ -867,12 +967,26 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
                 "no adopted record shows the pinned job taken over "
                 "from the killed replica — adoption lineage missing")
     violations.extend(_fleet_lineage_violations(recs))
+    violations.extend(_predict_staleness_violations(recs))
     # 3./4. per-job evidence: warm-cache affinity + tenant isolation
     for jid, status in sorted(jobs.items()):
         res = serve.read_result(tmp, jid)
         if res is None:
             continue
         kinds = {e["kind"] for e in res.get("events", [])}
+        if jid.startswith("fleet-p"):
+            # predicts answer "served" or an honest classified
+            # "refused" — anything else (or a served answer with no
+            # generation stamp) breaks the fence contract
+            if status not in ("served", "refused"):
+                violations.append(
+                    f"predict {jid} finished {status!r} — a predict "
+                    f"either serves or refuses, never fails open")
+            elif status == "served" and not res.get("gen"):
+                violations.append(
+                    f"predict {jid} served with no generation stamp "
+                    f"— the answer is unauditable")
+            continue
         if jid == "fleet-2-nan":
             if status == "converged" \
                     and not kinds & {"health_rollback",
@@ -1046,6 +1160,36 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
                 violations.append(
                     "the update base model checkpoint is missing from "
                     "the store after the update committed")
+    # 8. the generation-fenced predict plane (docs/predict.md): the
+    # staleness audit above already walked the journal; here the
+    # predict stream's coverage and refusal honesty are checked
+    served = refused = 0
+    for jid in accepted:
+        if not jid.startswith("fleet-p"):
+            continue
+        res = serve.read_result(tmp, jid)
+        if res and res.get("status") == "served":
+            served += 1
+        elif res and res.get("status") == "refused":
+            refused += 1
+    observability["predicts_served"] = float(served)
+    observability["predicts_refused"] = float(refused)
+    observability["predict_latency_obs"] = float(sum(
+        int(v.get("count", 0)) for (n, _lk), v in agg.samples.items()
+        if n == "splatt_predict_latency_seconds"
+        and isinstance(v, dict)))
+    if "fleet-p1" in accepted and served < 1:
+        violations.append(
+            "no predict was served across the kill despite a "
+            "committed base model — the prediction plane never "
+            "answered")
+    if "fleet-p3" in accepted:
+        p3 = serve.read_result(tmp, "fleet-p3")
+        if p3 is None or p3.get("status") != "refused":
+            violations.append(
+                f"the corrupt-model predict finished "
+                f"{(p3 or {}).get('status')!r} instead of refusing — "
+                f"a torn model must REFUSE, never serve garbage")
     st = fleetobs.fleet_status(tmp)
     jstates = states()
     for jid in accepted:
@@ -1081,6 +1225,10 @@ def format_fleet_report(res: FleetChaosResult) -> List[str]:
             f"slo_burns={ob.get('slo_burns', 0):g} "
             f"dead_replicas={ob.get('replicas_dead', 0):g} "
             f"victim_flight_events={ob.get('flight_events', 0):g}")
+        lines.append(
+            f"  predict plane: served={ob.get('predicts_served', 0):g} "
+            f"refused={ob.get('predicts_refused', 0):g} "
+            f"latency_obs={ob.get('predict_latency_obs', 0):g}")
     for v in res.violations:
         lines.append(f"INVARIANT VIOLATED: {v}")
     lines.append(f"fleet chaos verdict: {res.verdict.upper()}")

@@ -18,7 +18,7 @@ from typing import List, Optional
 import numpy as np
 
 from splatt_tpu.reorder import PERM_TYPES
-from splatt_tpu.utils.env import apply_env_platform
+from splatt_tpu.utils.env import apply_compile_cache, apply_env_platform
 
 apply_env_platform()
 
@@ -556,6 +556,50 @@ def cmd_status(args) -> int:
         return 0
 
 
+def cmd_predict(args) -> int:
+    """`splatt predict DIR` — file one generation-fenced predict
+    against a committed model and (optionally) wait for the answer
+    (docs/predict.md).  Speaks only the spool filed-request API
+    (file_request + read_status), so it works against any replica of
+    a live fleet, exactly like `splatt serve --submit`."""
+    import json as _json
+    import time as _time
+
+    from splatt_tpu import serve
+
+    spec: dict = {"kind": "predict", "model": args.model}
+    if args.id:
+        spec["id"] = args.id
+    if args.tenant:
+        spec["tenant"] = args.tenant
+    if args.coords:
+        spec["coords"] = [[int(x) for x in c.split(",")]
+                          for c in args.coords]
+    if args.top_k:
+        fixed = {}
+        for kv in (args.fix or []):
+            m, _, i = kv.partition("=")
+            fixed[int(m)] = int(i)
+        spec["top_k"] = {"mode": args.mode, "k": args.top_k,
+                         "fixed": fixed}
+    jid = serve.file_request(args.dir, spec)
+    if not args.wait:
+        print(_json.dumps({"job": jid, "filed": True}))
+        return 0
+    end = _time.time() + float(args.wait)
+    while _time.time() < end:
+        st = serve.read_status(args.dir, jid)
+        if st.get("state") in serve.TERMINAL:
+            out = st.get("result") or {"job": jid,
+                                       "state": st.get("state")}
+            print(_json.dumps(out))
+            return 0 if out.get("status") == "served" else 1
+        _time.sleep(0.2)
+    print(_json.dumps({"job": jid, "state": "pending",
+                       "error": "timed out waiting for the answer"}))
+    return 1
+
+
 def cmd_check(args) -> int:
     """≙ splatt_check_cmd (src/cmds/cmd_check.c:63-116): find (and
     optionally fix) duplicate nonzeros and empty slices."""
@@ -988,6 +1032,34 @@ def build_parser() -> argparse.ArgumentParser:
                             "$SPLATT_STATUS_WATCH_S)")
         p.set_defaults(fn=cmd_status, watch=watching)
 
+    p = sub.add_parser(
+        "predict",
+        help="query a served model: reconstruct entries / top-k",
+        epilog="Files a generation-fenced predict job into DIR's serve "
+               "spool (docs/predict.md): a daemon answers from an "
+               "intact model generation or refuses classified — never "
+               "stale, never torn.  --coords reconstructs entries "
+               "x̂ = Σ_r λ_r Π_m U_m[i_m,r]; --top-k scans one mode "
+               "with every other mode pinned by --fix.")
+    p.add_argument("dir", help="the serve spool directory")
+    p.add_argument("--model", required=True,
+                   help="the committed model's job id")
+    p.add_argument("--id", help="predict job id (default: generated)")
+    p.add_argument("--tenant", help="tenant label for quota accounting")
+    p.add_argument("--coords", action="append", metavar="I,J,K",
+                   help="an index tuple to reconstruct (repeatable)")
+    p.add_argument("--top-k", dest="top_k", type=int, metavar="K",
+                   help="return the K best indices along --mode")
+    p.add_argument("--mode", type=int, default=0,
+                   help="the scanned mode for --top-k (default 0)")
+    p.add_argument("--fix", action="append", metavar="MODE=INDEX",
+                   help="pin a non-scanned mode for --top-k "
+                        "(repeatable; every mode but --mode needs one)")
+    p.add_argument("--wait", type=float, default=0.0, metavar="S",
+                   help="poll up to S seconds for the answer "
+                        "(default: file-and-exit, exit 0 on served)")
+    p.set_defaults(fn=cmd_predict)
+
     p = sub.add_parser("check", help="check for duplicates/empty slices")
     _common_opts(p)
     p.add_argument("--fix", metavar="OUT",
@@ -1027,6 +1099,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # A config-update failure is classified and logged by the helper —
     # it used to be swallowed here, losing the error entirely.
     apply_env_platform()
+    # SPLATT_COMPILE_CACHE: share serialized executables across splatt
+    # processes (fleet replicas, restarts) — must also precede backend
+    # initialization
+    apply_compile_cache()
     args = build_parser().parse_args(argv)
     if getattr(args, "rank", 1) < 1:
         print(f"splatt-tpu: error: rank must be >= 1 (got {args.rank})",

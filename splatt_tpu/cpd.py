@@ -479,6 +479,72 @@ def load_checkpoint_resilient(path: str,
     return None
 
 
+def factor_content_sha(factors, lam) -> str:
+    """Content sha over the factor matrices + weights alone — the
+    identity a model-generation stamp records (docs/predict.md).
+    Deliberately narrower than the checkpoint checksum: two commits of
+    bit-identical factors get the SAME sha regardless of iteration
+    count or fit, which is what makes a re-commit idempotent at the
+    generation fence."""
+    payload = {f"factor{m}": np.asarray(U) for m, U in enumerate(factors)}
+    payload["lam"] = np.asarray(lam)
+    return _checkpoint_digest(payload)
+
+
+def load_checkpoint_resilient_gen(path: str, stamp: Optional[dict],
+                                  bak_stamp: Optional[dict] = None,
+                                  expect_reorder: Optional[str] = None):
+    """The generation-aware variant of :func:`load_checkpoint_resilient`
+    (docs/predict.md): load the newest checkpoint generation whose
+    factor CONTENT verifies against a generation stamp, or refuse.
+
+    `stamp` / `bak_stamp` are the parsed current / previous generation
+    stamps (``{"gen": int, "sha": str}``, read by predict.py).  Pairs
+    are tried newest-first — (path, stamp), then (path.bak, stamp) for
+    the commit that advanced the checkpoint but died before the stamp,
+    then (path.bak, bak_stamp) — and every torn/mismatched pair
+    degrades with a classified ``model_torn`` event.  Returns
+    ``(factors, lam, it, fit, gen, sha)`` for the first intact pair,
+    or None when nothing survives the fence: a reader must REFUSE
+    rather than serve stale-or-torn factors, so a checkpoint with no
+    verifying stamp is not servable."""
+    import os
+
+    from splatt_tpu import resilience
+
+    candidates = []
+    if stamp is not None:
+        candidates.append((path, stamp))
+        candidates.append((path + ".bak", stamp))
+    if bak_stamp is not None:
+        candidates.append((path + ".bak", bak_stamp))
+    for cpath, cstamp in candidates:
+        want = str(cstamp.get("sha") or "")
+        try:
+            gen = int(cstamp["gen"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if not want or not os.path.exists(cpath):
+            continue
+        try:
+            factors, lam, it, fit = load_checkpoint(
+                cpath, expect_reorder=expect_reorder)
+            got = factor_content_sha(factors, lam)
+            if got != want:
+                raise CheckpointError(
+                    f"checkpoint {cpath} factor content {got[:12]} does "
+                    f"not match generation {gen} stamp {want[:12]} "
+                    f"(torn commit or stale stamp)")
+            return factors, lam, it, fit, gen, want
+        except CheckpointError as e:
+            resilience.run_report().add(
+                "model_torn", path=cpath, piece="checkpoint-vs-stamp",
+                gen=gen,
+                failure_class=resilience.classify_failure(e).value,
+                error=str(e)[:200])
+    return None
+
+
 def cpd_als(X: Union[SparseTensor, BlockedSparse], rank: int,
             opts: Optional[Options] = None,
             init: Optional[List[jax.Array]] = None,

@@ -442,7 +442,11 @@ def job_regime(spec: dict) -> Optional[str]:
     signal (docs/fleet.md).  Matches the tune/probe cache granularity
     (power-of-two dim/nnz buckets + rank), so 'same regime' means
     'hits the same warm plans'.  File-tensor jobs return None (the
-    shape is unknown without loading; they route by load only)."""
+    shape is unknown without loading; they route by load only), and
+    so do predicts — the low-latency read lane must never wait on
+    affinity deferral or coalescing (docs/predict.md)."""
+    if str(spec.get("kind") or "cpd") == "predict":
+        return None
     syn = spec.get("synthetic")
     if not isinstance(syn, dict) or not syn.get("dims"):
         return None

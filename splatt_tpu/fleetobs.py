@@ -328,6 +328,13 @@ def slo_specs() -> List[SloSpec]:
                 "accepted (not queue_full/quota shed)",
                 kind="availability", metric="",
                 objective=float(read_env_float("SPLATT_SLO_AVAILABILITY"))),
+        SloSpec("predict_latency_p99",
+                "99% of predicts are served within "
+                "SPLATT_SLO_PREDICT_P99_S seconds of acceptance "
+                "(the low-latency lane, docs/predict.md)",
+                kind="latency", metric="splatt_predict_latency_seconds",
+                threshold_env="SPLATT_SLO_PREDICT_P99_S",
+                objective=0.99),
     ]
 
 

@@ -82,6 +82,23 @@ class KruskalTensor:
         return KruskalTensor(factors=factors, lam=lam,
                              fit=jnp.asarray(np.nan, dtype=lam.dtype))
 
+    def reconstruct(self, coords) -> np.ndarray:
+        """Estimate entries at `coords` (``(B, nmodes)`` indices):
+        ``x̂ = Σ_r λ_r Π_m U_m[i_m, r]`` — the prediction plane's
+        batched gather-matmul (predict.reconstruct_entries,
+        docs/predict.md)."""
+        from splatt_tpu.predict import reconstruct_entries
+
+        return reconstruct_entries(self.factors, self.lam, coords)
+
+    def top_k(self, fixed, mode: int, k: int):
+        """Top-k completion scan of one slice: fix every mode but
+        `mode` via ``fixed={mode: index}``, return the k best
+        ``(indices, scores)`` (predict.top_k_slice, docs/predict.md)."""
+        from splatt_tpu.predict import top_k_slice
+
+        return top_k_slice(self.factors, self.lam, fixed, mode, k)
+
     def normsq(self) -> jax.Array:
         """⟨Z,Z⟩ = λᵀ (⊛_m UᵐᵀUᵐ) λ (≙ p_kruskal_norm, src/cpd.c:116-152)."""
         rank = self.factors[0].shape[1]

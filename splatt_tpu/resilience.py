@@ -557,6 +557,12 @@ RUN_REPORT_EVENTS = {
                         "amplification; docs/layout-balance.md) — "
                         "carried by splatt cpd --json, bench and "
                         "MULTICHIP artifacts",
+    "compile_cache_error": "SPLATT_COMPILE_CACHE could not be applied "
+                           "to jax's persistent compilation cache "
+                           "(utils/env.py:apply_compile_cache — "
+                           "read-only path, older jax); the run "
+                           "continues, recompiling instead of loading "
+                           "shared executables",
     "env_platform_error": "JAX_PLATFORMS could not be mirrored into "
                           "jax.config (utils/env.py:"
                           "apply_env_platform); the run continues on "
@@ -704,6 +710,39 @@ RUN_REPORT_EVENTS = {
                        "SPLATT_UPDATE_REFIT_EVERY boundary, a "
                        "health-sentinel degrade, or a classified "
                        "warm-path failure (docs/batched.md)",
+    "model_torn": "a model-store artifact failed its integrity fence "
+                  "— a checkpoint whose factor content does not "
+                  "match the generation stamp, a stamp-less or "
+                  "unparseable generation file, or a `.model.npz` "
+                  "missing its `applied` array / failing checksum "
+                  "(serve.py _load_model_tensor, predict.py "
+                  "load_model_generation): carries the failure class "
+                  "and which piece tore; readers degrade to the "
+                  "`.bak` generation or refuse, writers route to the "
+                  "refit repair path — never a silent consume "
+                  "(docs/predict.md)",
+    "model_generation_advanced": "a model-store commit atomically "
+                                 "advanced the model's generation "
+                                 "stamp (predict.py "
+                                 "advance_generation from serve.py's "
+                                 "update/fit commits): carries model, "
+                                 "the new gen ordinal and the factor "
+                                 "content sha — the fence every "
+                                 "predict pins against "
+                                 "(docs/predict.md)",
+    "predict_served": "a predict job answered from an intact, "
+                      "generation-fenced model (serve.py "
+                      "_run_predict): carries model, the served "
+                      "generation, the pinned-at-admission "
+                      "generation and the cache outcome — the "
+                      "journal-auditable staleness evidence "
+                      "(docs/predict.md)",
+    "predict_degraded": "a predict's preferred path failed "
+                        "classified: a poisoned cache fell back to "
+                        "the direct read, or no intact generation "
+                        "survived the fence and the predict was "
+                        "REFUSED (reason records which) — a refusal, "
+                        "never garbage (docs/predict.md)",
 }
 
 
@@ -981,6 +1020,15 @@ class RunReport:
             lines.append(f"  model {e.get('base')}: full refit "
                          f"scheduled at update #{e.get('update_n')} "
                          f"({e.get('reason')})")
+        for e in self.events("model_torn"):
+            lines.append(f"  MODEL TORN: {e.get('piece')} of "
+                         f"{e.get('path')} "
+                         f"({e.get('failure_class')}: "
+                         f"{str(e.get('error', ''))[:80]})")
+        for e in self.events("predict_degraded"):
+            lines.append(f"  predict on model {e.get('model')} "
+                         f"degraded ({e.get('reason')}: "
+                         f"{str(e.get('error', ''))[:80]})")
         return lines
 
 

@@ -144,8 +144,11 @@ BATCH_MAX = 32
 #: job kinds a spec may declare (docs/batched.md): "cpd" decomposes a
 #: workload from scratch (the default), "update" appends a delta COO
 #: to an existing checkpointed model and runs a few warm-started
-#: sweeps — the journal/checkpoint store acting as a model store
-JOB_KINDS = ("cpd", "update")
+#: sweeps — the journal/checkpoint store acting as a model store —
+#: and "predict" reads a committed model generation (docs/predict.md)
+#: on a dedicated low-latency lane: leased/journaled like every job,
+#: but never coalesced, never affinity-deferred
+JOB_KINDS = ("cpd", "update", "predict")
 
 
 def _job_id(spec: dict) -> str:
@@ -330,6 +333,20 @@ class Server:
         #: pending job ids; _next() picks by (priority, arrival seq)
         self._queue: List[str] = lockcheck.guard(
             [], self._lock, "serve.Server._queue")
+        #: pending predict ids — the dedicated low-latency lane
+        #: (docs/predict.md): FIFO, bounded separately, dispatched
+        #: before any fit/update, never coalesced or deferred
+        self._pqueue: List[str] = lockcheck.guard(
+            [], self._lock, "serve.Server._pqueue")
+        self.predict_queue_max = int(
+            read_env_int("SPLATT_PREDICT_QUEUE_MAX"))
+        # in-replica hot factors keyed by (model, generation): an
+        # update commit invalidates by generation ADVANCE, never
+        # deletion, so a pinned in-flight predict finishes bit-exactly
+        from splatt_tpu.predict import HotFactorCache
+
+        self._hot_cache = HotFactorCache(
+            int(read_env_int("SPLATT_PREDICT_CACHE_MAX")))
         self._seq = 0
         #: job ids currently claimed/running on THIS replica's workers
         self._running: set = lockcheck.guard(
@@ -389,7 +406,7 @@ class Server:
              "resumed": False, "tenant": "default", "priority": "normal",
              "seq": self._seq, "owner": None, "adopt_from": None,
              "adopted_from": None, "deferred": 0, "regime": None,
-             "t_accepted": None}
+             "t_accepted": None, "gen_pinned": None}
         self._seq += 1
         if spec is not None:
             self._fill_admission(j, spec)
@@ -425,6 +442,11 @@ class Server:
             # the journaled accept time feeds the queue-wait histogram
             # for replayed/peer-accepted jobs too (docs/observability.md)
             j["t_accepted"] = rec.get("ts")
+            if rec.get("gen_pinned") is not None:
+                # the generation a predict pinned at admission — the
+                # journal-auditable staleness floor (docs/predict.md);
+                # folded here so a peer/adopter serves the same pin
+                j["gen_pinned"] = int(rec["gen_pinned"])
         else:
             j["state"] = kind
             if kind in (DONE, FAILED):
@@ -486,9 +508,16 @@ class Server:
                         # it over is an adoption, audited as one
                         j["adopt_from"] = j["owner"]
                 j["resumed"] = True
-                self._queue.append(jid)
+                # lane routing inlined (not _enqueue_locked): the
+                # mutation stays visible to SPL014's lock-set proof
+                # over _replay, which a *_locked helper would exempt
+                if str(j["spec"].get("kind") or "cpd") == "predict":
+                    self._pqueue.append(jid)
+                else:
+                    self._queue.append(jid)
                 resumed.append((jid, j["state"]))
             depth = len(self._queue)
+            pdepth = len(self._pqueue)
         for jid, was in resumed:
             resilience.run_report().add("job_resumed", job=jid,
                                         from_state=was)
@@ -501,6 +530,8 @@ class Server:
                 self._warn_journal("resume", jid, e)
         if depth:
             self._queue_metric(depth)
+        if pdepth:
+            self._pqueue_metric(pdepth)
 
     # -- submission / job API ----------------------------------------------
 
@@ -551,6 +582,14 @@ class Server:
                                         or spec.get("tensor")):
                 reason = ("invalid: no workload (give 'synthetic' or "
                           "'tensor')")
+            elif kind == "predict" and not spec.get("model"):
+                reason = ("invalid: predict job needs 'model': "
+                          "<job id of a committed model>")
+            elif kind == "predict" and spec.get("coords") is None \
+                    and not isinstance(spec.get("top_k"), dict):
+                reason = ("invalid: predict job needs 'coords': "
+                          "[[i0, i1, ...], ...] and/or 'top_k': "
+                          "{fixed, mode, k}")
             elif prio is not None and str(prio) not in PRIORITIES:
                 reason = (f"invalid: unknown priority {prio!r} (want "
                           f"one of {sorted(PRIORITIES)})")
@@ -573,7 +612,17 @@ class Server:
                         "quota_rejected", job=jid, tenant=tenant,
                         quota=self.tenant_quota, live=live)
                     reason = f"quota:{tenant}"
-            if reason is None and self.queue_max > 0 \
+            if reason is None and kind == "predict":
+                # the predict lane's own bound (docs/predict.md): a
+                # flood of reads load-sheds explicitly without
+                # starving — or being starved by — the fit queue
+                if self.predict_queue_max > 0 \
+                        and len(self._pqueue) >= self.predict_queue_max:
+                    resilience.run_report().add(
+                        "queue_full", job=jid, lane="predict",
+                        queue_max=self.predict_queue_max)
+                    reason = "queue_full"
+            elif reason is None and self.queue_max > 0 \
                     and len(self._queue) >= self.queue_max:
                 resilience.run_report().add("queue_full", job=jid,
                                             queue_max=self.queue_max)
@@ -584,10 +633,25 @@ class Server:
                 self._jobs[jid] = self._new_job_locked(spec, ACCEPTING)
         if reason is not None:
             return self._reject(jid, spec, reason)
+        # pin the staleness floor at admission (docs/predict.md): the
+        # newest COMMITTED generation right now — stamped into the
+        # durable ACCEPTED record so the invariant "served gen >= the
+        # newest generation committed before acceptance" is auditable
+        # from the journal alone, on any replica.  File IO, so outside
+        # the lock like every other submit-path read.
+        gen_pinned = None
+        if kind == "predict":
+            from splatt_tpu.predict import current_generation
+
+            gen_pinned = int(current_generation(
+                self.ckpt_dir, str(spec.get("model"))))
         # durability-first: the submitter hears "accepted" only once
         # this append has fsynced
         try:
-            self.journal.append(self._rec(ACCEPTED, jid, spec=spec))
+            acc = self._rec(ACCEPTED, jid, spec=spec)
+            if gen_pinned is not None:
+                acc["gen_pinned"] = gen_pinned
+            self.journal.append(acc)
         except Exception as e:
             cls = resilience.classify_failure(e)
             return self._reject(
@@ -597,14 +661,17 @@ class Server:
         with self._lock:
             self._jobs[jid]["state"] = ACCEPTED
             self._jobs[jid]["t_accepted"] = time.time()
+            self._jobs[jid]["gen_pinned"] = gen_pinned
             # a fleet peer's journal sync may have surfaced the id
             # while our accept append fsynced — never queue it twice
-            if jid not in self._queue and jid not in self._running:
-                self._queue.append(jid)
+            if jid not in self._queue and jid not in self._pqueue \
+                    and jid not in self._running:
+                self._enqueue_locked(jid)
             # gauge published under the lock: concurrent workers'
             # pop/publish pairs stay ordered, so the depth is
             # monotone-consistent with the queue
             self._queue_metric(len(self._queue))
+            self._pqueue_metric(len(self._pqueue))
         self._log(f"job {jid}: accepted")
         return {"job": jid, "state": ACCEPTED}
 
@@ -653,10 +720,12 @@ class Server:
         with self._lock:
             jobs = {jid: j["state"] for jid, j in self._jobs.items()}
             pending = len(self._queue)
+            pending_predict = len(self._pqueue)
         counts: Dict[str, int] = {}
         for s in jobs.values():
             counts[s] = counts.get(s, 0) + 1
         out = {"jobs": jobs, "counts": counts, "pending": pending,
+               "pending_predict": pending_predict,
                "draining": self._draining.is_set()}
         if self.fleet is not None:
             out["replica"] = self.fleet.replica
@@ -779,8 +848,17 @@ class Server:
             routed = None  # (reason, jid, regime, peer) emitted below
             with self._lock:
                 pick = None
-                order = self._order_locked()
-                if self.affinity and self.fleet is not None:
+                if self._pqueue:
+                    # predict lane first (docs/predict.md): FIFO, no
+                    # affinity pass, no deferral — the low-latency
+                    # read path never waits behind a fit, and the
+                    # lease claim below still applies like any job
+                    pick = self._pqueue.pop(0)
+                    self._running.add(pick)
+                    self._pqueue_metric(len(self._pqueue))
+                order = self._order_locked() if pick is None else []
+                if pick is None and self.affinity \
+                        and self.fleet is not None:
                     # affinity pass: ANY job warm on this replica
                     # beats queue position (within a scan the
                     # priority/arrival order still breaks warm ties)
@@ -808,7 +886,9 @@ class Server:
                         routed = ("load_tiebreak", jid, reg, peer)
                     pick = jid
                     break
-                if pick is not None:
+                if pick is not None and pick in self._queue:
+                    # predict-lane picks were popped above; only a
+                    # priority-queue pick still needs dequeueing
                     self._queue.remove(pick)
                     self._running.add(pick)
                     self._queue_metric(len(self._queue))
@@ -846,9 +926,8 @@ class Server:
                 self.journal.replay_new(self._journal_offset)
             for rec in recs:
                 done = self._apply_rec_locked(rec)
-                if done and self._jobs[done]["state"] in TERMINAL \
-                        and done in self._queue:
-                    self._queue.remove(done)
+                if done and self._jobs[done]["state"] in TERMINAL:
+                    self._unqueue_locked(done)
             return self._jobs[jid]["state"] in TERMINAL
 
     # -- auto coalescing (docs/batched.md) -----------------------------------
@@ -1006,6 +1085,30 @@ class Server:
 
         trace.metric_set("splatt_serve_queue_depth", float(depth))
 
+    @staticmethod
+    def _pqueue_metric(depth: int) -> None:
+        from splatt_tpu import trace
+
+        trace.metric_set("splatt_predict_queue_depth", float(depth))
+
+    def _enqueue_locked(self, jid: str) -> None:
+        """Route one pending job to its lane (callers hold the server
+        lock): predicts ride the dedicated low-latency queue
+        (docs/predict.md), everything else the priority queue."""
+        j = self._jobs[jid]
+        if str((j.get("spec") or {}).get("kind") or "cpd") == "predict":
+            self._pqueue.append(jid)
+        else:
+            self._queue.append(jid)
+
+    def _unqueue_locked(self, jid: str) -> None:
+        """Drop one id from whichever lane holds it (callers hold the
+        server lock)."""
+        if jid in self._queue:
+            self._queue.remove(jid)
+        if jid in self._pqueue:
+            self._pqueue.remove(jid)
+
     def run_once(self) -> dict:
         """Ingest the spool (and in fleet mode, sync the shared
         journal + adopt dead peers' jobs), then run every queued job
@@ -1024,7 +1127,7 @@ class Server:
             self._fleet_scan()
         while not self._draining.is_set():
             with self._lock:
-                idle = not self._queue
+                idle = not self._queue and not self._pqueue
             if idle:
                 # nothing queued (the serve_forever steady state): skip
                 # worker-thread construction entirely — an idle daemon
@@ -1085,7 +1188,7 @@ class Server:
             for t in threads:
                 t.join()
             with self._lock:
-                again = bool(self._queue)
+                again = bool(self._queue) or bool(self._pqueue)
             if not again or self.fleet is None:
                 break
         return self.summary()
@@ -1159,15 +1262,15 @@ class Server:
                 self.journal.replay_new(self._journal_offset)
             for rec in recs:
                 jid = self._apply_rec_locked(rec)
-                if jid and self._jobs[jid]["state"] in TERMINAL \
-                        and jid in self._queue:
+                if jid and self._jobs[jid]["state"] in TERMINAL:
                     # a peer finished a job we still had queued
-                    self._queue.remove(jid)
+                    self._unqueue_locked(jid)
             candidates = [
                 jid for jid, j in self._jobs.items()
                 if j["state"] not in (*TERMINAL, ACCEPTING)
                 and j["spec"] is not None
-                and jid not in self._queue and jid not in self._running]
+                and jid not in self._queue and jid not in self._pqueue
+                and jid not in self._running]
         for jid in candidates:
             lease = self.fleet.lease_of(jid)
             if lease is not None and not lease.expired():
@@ -1175,7 +1278,8 @@ class Server:
             with self._lock:
                 j = self._jobs.get(jid)
                 if (j is None or j["state"] in (*TERMINAL, ACCEPTING)
-                        or jid in self._queue or jid in self._running):
+                        or jid in self._queue or jid in self._pqueue
+                        or jid in self._running):
                     continue
                 owner = (lease.replica if lease is not None
                          else j.get("owner"))
@@ -1198,8 +1302,9 @@ class Server:
                 # _execute just finds no checkpoint and starts fresh
                 j["resumed"] = not steal or j["state"] != ACCEPTED
                 j["deferred"] = 0
-                self._queue.append(jid)
+                self._enqueue_locked(jid)
                 self._queue_metric(len(self._queue))
+                self._pqueue_metric(len(self._pqueue))
             if j["adopt_from"]:
                 self._log(f"job {jid}: dead-peer candidate "
                           f"(owner {j['adopt_from']}); queued for "
@@ -1385,9 +1490,17 @@ class Server:
             if record is not None:
                 self._write_result(jid, record)
                 kind = FAILED if record["status"] == "failed" else DONE
+                # generation evidence rides the terminal record
+                # (docs/predict.md): a commit's advanced model_gen and
+                # a predict's served/pinned gens make the staleness
+                # invariant auditable from the journal alone
+                fence = {k: record[k]
+                         for k in ("model", "model_gen", "gen",
+                                   "gen_pinned")
+                         if record.get(k) is not None}
                 try:
                     self.journal.append(self._rec(
-                        kind, jid, status=record["status"]))
+                        kind, jid, status=record["status"], **fence))
                     # the span carries the terminal verdict only once
                     # it is durably journaled — the merged-trace
                     # lineage audit counts COMMITTED verdicts (exactly
@@ -1688,12 +1801,18 @@ class Server:
                                              if deadline_s > 0 else 0):
                         faults.maybe_fail("serve.job_run")
                         update_info = None
-                        if str(spec.get("kind") or "cpd") == "update":
+                        predict_rec = None
+                        model_gen = None
+                        job_kind = str(spec.get("kind") or "cpd")
+                        if job_kind == "update":
                             out, update_info = self._run_update(
                                 jid, spec, _stop_or_deadline)
                             tune_info = None
+                        elif job_kind == "predict":
+                            predict_rec = self._run_predict(jid, spec)
+                            out, tune_info = None, None
                         else:
-                            out, tune_info = self._run_cpd(
+                            out, tune_info, model_gen = self._run_cpd(
                                 jid, spec, _stop_or_deadline)
                         if stopped["deadline"]:
                             # the cooperative stop beat the post-hoc
@@ -1709,20 +1828,37 @@ class Server:
                                 f"(cooperative job-deadline stop)")
                 if stopped["lease"] or stopped["drain"]:
                     return None, stopped
-                degraded = bool(sc.report.events("health_degraded"))
-                if degraded:
-                    # run_report() here IS the job scope's report
-                    resilience.run_report().add(
-                        "job_degraded", job=jid,
-                        failure_class="numerical",
-                        error="health-retry budget exhausted")
-                record.update(status="degraded" if degraded
-                              else "converged",
-                              fit=float(out.fit))
+                if predict_rec is not None:
+                    # the predict verdict ("served"/"refused") is its
+                    # own status class — never "converged", and a
+                    # refusal is a degrade, not a failure
+                    record.update(predict_rec)
+                else:
+                    degraded = bool(
+                        sc.report.events("health_degraded"))
+                    if degraded:
+                        # run_report() here IS the job scope's report
+                        resilience.run_report().add(
+                            "job_degraded", job=jid,
+                            failure_class="numerical",
+                            error="health-retry budget exhausted")
+                    record.update(status="degraded" if degraded
+                                  else "converged",
+                                  fit=float(out.fit))
                 if tune_info is not None:
                     record["tune"] = tune_info
                 if update_info is not None:
                     record["update"] = update_info
+                    if update_info.get("model_gen") is not None:
+                        # surface the commit's generation at record
+                        # top level: _run_job copies it into the
+                        # terminal journal record, which is what the
+                        # journal-only staleness audit keys on
+                        record["model"] = update_info["base"]
+                        record["model_gen"] = update_info["model_gen"]
+                if model_gen is not None:
+                    record["model"] = jid
+                    record["model_gen"] = model_gen
             except Exception as e:
                 cls = resilience.classify_failure(e)
                 msg = resilience.failure_message(e)[:200]
@@ -1737,7 +1873,8 @@ class Server:
                      if s.fired}
             record.update(
                 resumed=resumed, seconds=round(time.time() - t0, 3),
-                degraded=record["status"] != "converged",
+                degraded=record["status"] not in ("converged",
+                                                  "served"),
                 events=[{k: v for k, v in e.items() if k != "ts"}
                         for e in sc.report.events()],
                 demotions=[dict(engine=d.engine,
@@ -1802,7 +1939,22 @@ class Server:
         out = cpd_als(bs, rank=rank, opts=opts, checkpoint_path=ckpt,
                       checkpoint_every=int(spec.get("checkpoint_every", 5)),
                       stop=stop)
-        return out, tune_info
+        gen = None
+        if not (stop is not None and stop()):
+            # fit commit (docs/predict.md): publish the FINAL factors
+            # as the model checkpoint and advance the generation stamp
+            # — this is what makes a completed fit servable by the
+            # predict lane.  A failed stamp advance (the
+            # model.generation fault site) raises: the commit aborts
+            # classified and readers keep the previous generation.
+            from splatt_tpu.cpd import _save_checkpoint
+            from splatt_tpu.predict import advance_generation
+
+            _save_checkpoint(ckpt, out.factors, out.lam, 0,
+                             float(out.fit))
+            gen = advance_generation(self.ckpt_dir, jid, out.factors,
+                                     out.lam)
+        return out, tune_info, gen
 
     # -- one incremental model update (docs/batched.md) ----------------------
 
@@ -1962,7 +2114,116 @@ class Server:
             if jid not in applied:
                 applied = list(applied) + [jid]
             _save_model_tensor(tpath, merged, applied)
+            # the generation fence seals the commit LAST
+            # (docs/predict.md): a failed stamp advance (the
+            # model.generation fault site) raises — this update fails
+            # classified, the stamp never moved, and readers verify
+            # the OLD stamp against the .bak checkpoint, so the old
+            # generation keeps serving.  A bit-identical re-commit
+            # (crash idempotency above) returns the current ordinal
+            # without advancing.
+            from splatt_tpu.predict import advance_generation
+
+            info["model_gen"] = int(advance_generation(
+                self.ckpt_dir, base, out.factors, out.lam))
         return out, info
+
+    # -- one generation-fenced predict (docs/predict.md) ---------------------
+
+    def _run_predict(self, jid: str, spec: dict) -> dict:
+        """The ``predict`` job body: answer from an intact model
+        generation or REFUSE — never garbage.
+
+        The read path prefers the hot-factor cache at the generation
+        PINNED at admission (an update commit advances the generation
+        rather than deleting entries, so the pinned entry — when
+        cached — replays bit-exactly); a cache miss or poisoned
+        lookup (``predict.cache``) degrades classified to the direct
+        fenced read (``predict.read`` inside), which serves the
+        newest generation that verifies against a stamp.  No intact
+        generation -> status "refused" with a classified
+        ``predict_degraded`` event.  Latency is observed
+        accepted-to-served into the predict p99 SLO histogram."""
+        from splatt_tpu import predict as _predict
+        from splatt_tpu import resilience, trace
+
+        model = str(spec.get("model") or "")
+        with self._lock:
+            j = self._jobs.get(jid) or {}
+            pinned = j.get("gen_pinned")
+            t_accepted = j.get("t_accepted")
+        rec: dict = {"model": model}
+        if pinned is not None:
+            rec["gen_pinned"] = int(pinned)
+        with trace.span("serve.predict", job=jid, model=model) as sp:
+            entry = None
+            cache_outcome = "miss"
+            if pinned:
+                try:
+                    entry = self._hot_cache.get(model, int(pinned))
+                    if entry is not None:
+                        cache_outcome = "hit"
+                except Exception as e:
+                    cls = resilience.classify_failure(e)
+                    resilience.run_report().add(
+                        "predict_degraded", job=jid, model=model,
+                        reason="cache_poisoned",
+                        failure_class=cls.value,
+                        error=resilience.failure_message(e)[:120])
+                    entry = None
+            if entry is None:
+                try:
+                    entry = _predict.load_model_generation(
+                        self.ckpt_dir, model)
+                except Exception as e:
+                    cls = resilience.classify_failure(e)
+                    resilience.run_report().add(
+                        "predict_degraded", job=jid, model=model,
+                        reason="read_failed",
+                        failure_class=cls.value,
+                        error=resilience.failure_message(e)[:120])
+                    entry = None
+                if entry is not None:
+                    self._hot_cache.put(model, entry["gen"], entry)
+            if entry is None:
+                resilience.run_report().add(
+                    "predict_degraded", job=jid, model=model,
+                    reason="no_intact_generation")
+                trace.metric_inc("splatt_predict_requests_total",
+                                 outcome="refused")
+                sp.set(status="refused")
+                rec.update(status="refused",
+                           reason="no_intact_generation")
+                return rec
+            gen = int(entry["gen"])
+            if spec.get("coords") is not None:
+                vals = _predict.reconstruct_entries(
+                    entry["factors"], entry["lam"], spec["coords"])
+                rec["values"] = [float(v) for v in vals]
+            tk = spec.get("top_k")
+            if isinstance(tk, dict):
+                fixed = {int(m): int(i) for m, i in
+                         (tk.get("fixed") or {}).items()}
+                idx, scores = _predict.top_k_slice(
+                    entry["factors"], entry["lam"], fixed,
+                    int(tk.get("mode", 0)), int(tk.get("k", 10)))
+                rec["top_k"] = {"indices": [int(i) for i in idx],
+                                "scores": [float(s) for s in scores]}
+            rec.update(status="served", gen=gen, sha=entry["sha"],
+                       cache=cache_outcome)
+            resilience.run_report().add(
+                "predict_served", job=jid, model=model, gen=gen,
+                gen_pinned=(int(pinned) if pinned is not None
+                            else None),
+                cache=cache_outcome)
+            trace.metric_inc("splatt_predict_requests_total",
+                             outcome="served")
+            if t_accepted is not None:
+                trace.metric_observe(
+                    "splatt_predict_latency_seconds",
+                    max(time.time() - float(t_accepted), 0.0))
+            sp.set(status="served", gen=gen, cache=cache_outcome)
+        return rec
 
     # -- plumbing ------------------------------------------------------------
 
@@ -2079,24 +2340,44 @@ def _save_model_tensor(path: str, tt, applied) -> None:
 
     from splatt_tpu.utils.durable import publish_bytes
 
+    from splatt_tpu.cpd import _checkpoint_digest
+
+    payload = {"inds": np.asarray(tt.inds), "vals": np.asarray(tt.vals),
+               "dims": np.asarray(tt.dims),
+               "applied": np.asarray(list(applied), dtype="U64")}
     buf = _io.BytesIO()
-    np.savez(buf, inds=np.asarray(tt.inds), vals=np.asarray(tt.vals),
-             dims=np.asarray(tt.dims),
-             applied=np.asarray(list(applied), dtype="U64"))
+    np.savez(buf, checksum=np.asarray(_checkpoint_digest(payload)),
+             **payload)
     publish_bytes(path, buf.getvalue())
 
 
 def _load_model_tensor(path: str):
     """Load a persisted model tensor → (SparseTensor, applied ids), or
-    ``(None, [])`` when absent or unreadable — a corrupt model tensor
-    degrades CLASSIFIED to rebuilding from the base workload (the
-    refit repair path), never a failed update."""
+    ``(None, [])`` when absent or unreadable — a corrupt or torn model
+    tensor (unparseable, missing its ``applied`` idempotency stamp, or
+    failing its content checksum) emits a classified ``model_torn``
+    event and degrades to rebuilding from the base workload (the refit
+    repair path), never a failed update."""
     import numpy as np
 
     from splatt_tpu.coo import SparseTensor
 
     try:
         with np.load(path) as z:
+            if "applied" not in z.files:
+                raise ValueError(
+                    "model tensor has no 'applied' idempotency stamp")
+            if "checksum" in z.files:
+                from splatt_tpu.cpd import _checkpoint_digest
+
+                payload = {k: np.asarray(z[k])
+                           for k in ("inds", "vals", "dims", "applied")}
+                want = str(z["checksum"])
+                got = _checkpoint_digest(payload)
+                if got != want:
+                    raise ValueError(
+                        f"model tensor checksum mismatch: stored "
+                        f"{want[:12]} != computed {got[:12]}")
             tt = SparseTensor(inds=np.asarray(z["inds"]),
                               vals=np.asarray(z["vals"]),
                               dims=tuple(int(d) for d in z["dims"]))
@@ -2108,11 +2389,9 @@ def _load_model_tensor(path: str):
         from splatt_tpu import resilience
 
         resilience.run_report().add(
-            "checkpoint_recovery", path=path,
-            error=(f"{resilience.classify_failure(e).value}: "
-                   f"{resilience.failure_message(e)[:120]}"),
-            action="model tensor unreadable; rebuilding from the "
-                   "base workload")
+            "model_torn", path=path, piece="model-tensor",
+            failure_class=resilience.classify_failure(e).value,
+            error=resilience.failure_message(e)[:200])
         return None, []
 
 

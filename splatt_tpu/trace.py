@@ -149,6 +149,10 @@ SPANS = {
     "cpd.update": "one incremental model update's warm path: the "
                   "touched-row refresh + warm-started sweeps (attrs: "
                   "job, base, delta_nnz; docs/batched.md)",
+    "serve.predict": "one generation-fenced predict job: hot-cache "
+                     "lookup (or direct read) + the λ·Π reconstruct "
+                     "or top-k scan (attrs: job, model, gen, cache; "
+                     "docs/predict.md)",
     "trace.export": "writing one Chrome-trace JSON file",
     "timer.*": "legacy utils/timers.py brackets routed through the "
                "span layer (timer.cpd, timer.mttkrp, ...)",
@@ -229,10 +233,31 @@ METRICS = {
                    "(applied = warm sweeps committed, refit = the "
                    "full-refit repair path ran — no_model/periodic/"
                    "health/failure; docs/batched.md)"),
+    "splatt_predict_latency_seconds": (
+        "histogram", "serve: predict-lane wall seconds accepted-to-"
+                     "served — the predict p99 latency SLO's "
+                     "histogram (docs/predict.md); the ms-scale "
+                     "buckets exist for this metric"),
+    "splatt_predict_requests_total": (
+        "counter", "serve: predict jobs by outcome (served = answered "
+                   "from a fenced generation, refused = no intact "
+                   "generation — classified, never garbage; "
+                   "docs/predict.md)"),
+    "splatt_predict_cache_total": (
+        "counter", "serve: hot-factor cache consults by outcome "
+                   "(hit/miss) keyed on (model, generation) — an "
+                   "update commit invalidates by generation advance, "
+                   "never deletion (docs/predict.md)"),
+    "splatt_predict_queue_depth": (
+        "gauge", "serve: pending predicts in the bounded low-latency "
+                 "lane (docs/predict.md)"),
 }
 
-#: histogram bucket upper bounds (seconds); +Inf is implicit
-HIST_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0)
+#: histogram bucket upper bounds (seconds); +Inf is implicit.  The
+#: ms-scale low end exists for the predict-lane latency histogram —
+#: every consumer is generic over this tuple's length.
+HIST_BUCKETS = (0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+                900.0)
 
 _TRACE_ENV = "SPLATT_TRACE"
 

@@ -88,6 +88,28 @@ ENV_VARS = {
                                  "persistent capability-probe cache "
                                  "(default: tools/probe_cache.json in "
                                  "a repo checkout)"),
+    "SPLATT_COMPILE_CACHE": EnvVar(None, "directory for JAX's "
+                                   "persistent compilation cache, "
+                                   "applied by every splatt entry "
+                                   "point (CLI verbs, serve replicas, "
+                                   "bench.py) before backends "
+                                   "initialize: processes sharing the "
+                                   "path reuse each other's serialized "
+                                   "XLA executables, so a cold "
+                                   "replica's first same-shape job "
+                                   "skips compilation (the first rung "
+                                   "of the warm-fleet artifact, "
+                                   "ROADMAP item 4).  Unset = no "
+                                   "persistent cache; enable failures "
+                                   "degrade classified "
+                                   "(compile_cache_error) and the run "
+                                   "just compiles.  CAUTION: on "
+                                   "current jaxlib, executing a "
+                                   "DESERIALIZED multi-device sharded "
+                                   "CPU executable corrupts the heap "
+                                   "— scope the knob to single-device "
+                                   "processes (fleet replicas) on CPU "
+                                   "hosts"),
     "SPLATT_PROBE_CACHE_TTL_S": EnvVar(14 * 24 * 3600.0, "seconds a "
                                        "cached probe verdict stays "
                                        "fresh; <= 0 disables expiry "
@@ -258,6 +280,32 @@ ENV_VARS = {
                               "BOTH windows (multi-window gating "
                               "suppresses blips and stale burns "
                               "alike)"),
+    "SPLATT_SLO_PREDICT_P99_S": EnvVar(0.25, "SLO objective: 99% of "
+                                       "served predicts complete "
+                                       "within this many wall seconds "
+                                       "accepted-to-served (the "
+                                       "splatt_predict_latency_seconds "
+                                       "histogram; threshold rounds "
+                                       "up to a histogram bucket "
+                                       "bound; docs/predict.md)"),
+    # predict lane (splatt_tpu/predict.py + serve.py, docs/predict.md)
+    "SPLATT_PREDICT_QUEUE_MAX": EnvVar(64, "serve predict lane: "
+                                       "bounded pending-predict "
+                                       "depth, separate from the "
+                                       "fit/update queue; a predict "
+                                       "past it is load-shed with an "
+                                       "explicit queue_full rejection "
+                                       "(<= 0 disables the bound)"),
+    "SPLATT_PREDICT_CACHE_MAX": EnvVar(8, "predict hot-factor cache: "
+                                      "(model, generation) entries "
+                                      "kept per replica, LRU-evicted "
+                                      "past the bound — an update "
+                                      "commit invalidates by "
+                                      "generation advance, never "
+                                      "deletion, so a pinned "
+                                      "in-flight predict still "
+                                      "finishes on its generation; "
+                                      "<= 0 disables the cache"),
     # fleet status / top (splatt_tpu/fleetobs.py, docs/fleet.md)
     "SPLATT_STATUS_JOBS": EnvVar(8, "splatt status/top: how many "
                                  "recent terminal jobs the dashboard "
@@ -549,6 +597,53 @@ def host_fence(x):
             continue
         jax.device_get(leaf.ravel()[0])
     return x
+
+
+def apply_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at SPLATT_COMPILE_CACHE.
+
+    Call before any backend initializes (next to
+    :func:`apply_env_platform`).  When the knob names a directory,
+    every process applying it shares one on-disk store of serialized
+    XLA executables keyed by HLO + topology — a fleet replica (or a
+    restarted one) whose first job matches a shape some peer already
+    compiled loads the executable instead of recompiling.  The floors
+    are pinned to zero because a serve fleet's steady state is many
+    small same-regime compiles: exactly the entries the default
+    min-compile-time floor would refuse to persist.
+
+    Unset = no-op.  Enable failures (read-only path, an older jax
+    without the config) degrade classified: the run just compiles.
+
+    CAUTION (current jaxlib, CPU): executing a deserialized
+    MULTI-DEVICE sharded CPU executable corrupts the process heap
+    (malloc abort inside pxla) — measured, not theoretical.
+    Single-device executables round-trip fine.  On CPU hosts, set the
+    knob only for processes that run single-device programs (the serve
+    fleet's replica daemons — the production shape); leave it unset
+    for anything driving the 8-virtual-device sharded paths.
+    """
+    path = read_env("SPLATT_COMPILE_CACHE")
+    if not path:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:
+        from splatt_tpu import resilience
+
+        cls = resilience.classify_failure(e)
+        resilience.run_report().add(
+            "compile_cache_error", path=str(path),
+            failure_class=cls.value,
+            error=resilience.failure_message(e)[:200])
+        print(f"splatt-tpu: WARNING: could not enable the persistent "
+              f"compile cache at {path} ({cls.value}: {e}); compiles "
+              f"will not be cached", file=sys.stderr)
 
 
 def apply_env_platform() -> None:

@@ -243,6 +243,24 @@ SITES = {
                        "isolation drill: slot 0 must roll back ALONE "
                        "while every batch neighbor stays bit-clean "
                        "(docs/batched.md)",
+    "predict.read": "the direct generation-fenced model read of one "
+                    "predict (predict.py load_model_generation, "
+                    "docs/predict.md); a raised fault must REFUSE "
+                    "that predict classified (predict_degraded "
+                    "event) — a refusal, never garbage",
+    "predict.cache": "one hot-factor cache lookup on the predict "
+                     "lane (predict.py HotFactorCache.get); a raised "
+                     "fault must degrade that predict classified to "
+                     "the direct generation-fenced read "
+                     "(predict_degraded event with a served answer) "
+                     "— slower bytes, never a wrong generation",
+    "model.generation": "the generation-stamp advance of one model "
+                        "commit (predict.py advance_generation, "
+                        "called from serve.py's update/fit commits); "
+                        "a raised fault must ABORT that commit "
+                        "classified — the stamp never advances, so "
+                        "readers keep serving the previous "
+                        "generation (docs/predict.md)",
 }
 
 

@@ -14,6 +14,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# NOTE on SPLATT_COMPILE_CACHE (utils/env.py): do NOT enable the
+# persistent executable cache suite-wide here.  On this jaxlib, a
+# DESERIALIZED multi-device (8-virtual-device sharded) CPU executable
+# corrupts the heap on execution — malloc() abort inside pxla — so the
+# main pytest process, which runs the sharded paths constantly, must
+# never read cache entries.  Single-device executables round-trip
+# fine; the fleet chaos soak scopes the knob to its replica daemons
+# (single-device jobs only), which is also the production shape.
 import jax
 
 # The env var alone is not enough where a site plugin (e.g. the axon TPU

@@ -209,7 +209,8 @@ def test_slo_state_roundtrip(tmp_path):
     states = fleetobs.read_slo_states(str(tmp_path))
     assert states["r0"]["replica"] == "r0"
     assert states["latest"]["slos"].keys() == \
-        {"queue_wait_p95", "job_wall_p95", "availability"}
+        {"queue_wait_p95", "job_wall_p95", "availability",
+         "predict_latency_p99"}
 
 
 # -- flight recorder ---------------------------------------------------------

@@ -355,7 +355,9 @@ def test_tuner_measures_format_candidates():
     finally:
         tune._measure_candidate = orig
     assert {"i32", "auto"} <= {iw for iw, _ in seen}
-    assert res.plans and all(p.idx_width in ("i32", "auto", "u8")
+    # the winner is whichever measured candidate timed fastest — any
+    # member of the matrix is legitimate, the plan just has to carry it
+    assert res.plans and all(p.idx_width in tune.IDX_CANDIDATES
                              for p in res.plans.values())
 
 

@@ -1,0 +1,480 @@
+"""The generation-fenced prediction plane (docs/predict.md).
+
+The contracts under test:
+
+- the math: batched entry reconstruction and the top-k slice scan
+  agree with the dense reconstruction, validate their inputs loudly,
+  and ride KruskalTensor as `.reconstruct()` / `.top_k()`;
+- model generations: every commit advances a monotonic stamp, a
+  bit-identical re-commit is IDEMPOTENT (no advance), a failed stamp
+  write (the ``model.generation`` fault site) aborts the commit with
+  the old generation still serving, and the previous generation
+  survives as the ``.bak`` rollback;
+- fenced reads: a torn (checkpoint, stamp) pair degrades classified
+  (``model_torn``) down the candidate chain to the ``.bak``
+  generation, an unstamped checkpoint REFUSES, and a fully shredded
+  store refuses — never garbage;
+- the hot-factor cache: keyed by (model, generation), LRU-bounded,
+  invalidated by generation ADVANCE; a poisoned lookup (the
+  ``predict.cache`` fault site) degrades to the direct fenced read
+  and a failed direct read (``predict.read``) refuses classified;
+- the serve lane: predicts are journaled/leased like any job but
+  dispatch on a dedicated bounded low-latency lane, pin their
+  staleness floor at admission (the ACCEPTED record's ``gen_pinned``)
+  and replay bit-exactly on the pinned generation even when a
+  concurrent commit advances the model mid-flight.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from splatt_tpu import predict, resilience, serve
+from splatt_tpu.cpd import _save_checkpoint, factor_content_sha
+from splatt_tpu.kruskal import KruskalTensor
+from splatt_tpu.utils import faults
+
+DIMS = (12, 10, 8)
+SYN = {"dims": list(DIMS), "nnz": 400, "seed": 0}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    def clean():
+        faults.reset()
+        resilience.reset_demotions()
+        resilience.run_report().clear()
+
+    clean()
+    yield
+    clean()
+
+
+def _kt(seed=0, dims=DIMS, rank=3):
+    rng = np.random.default_rng(seed)
+    factors = [rng.standard_normal((d, rank)) for d in dims]
+    lam = rng.uniform(0.5, 2.0, rank)
+    return factors, lam
+
+
+def _fit_spec(jid="base", **kw):
+    spec = {"id": jid, "rank": 3, "iters": 5, "seed": 0,
+            "synthetic": dict(SYN)}
+    spec.update(kw)
+    return spec
+
+
+def _run(srv, *specs):
+    for spec in specs:
+        r = srv.submit(spec)
+        assert r["state"] == serve.ACCEPTED, r
+    srv.run_once()
+    return [serve.read_result(srv.root, s["id"]) for s in specs]
+
+
+# -- the math ----------------------------------------------------------------
+
+def test_reconstruct_matches_dense():
+    factors, lam = _kt()
+    import jax.numpy as jnp
+
+    kt = KruskalTensor([jnp.asarray(U) for U in factors],
+                       jnp.asarray(lam), jnp.asarray(1.0))
+    dense = kt.to_dense()
+    coords = [[0, 0, 0], [3, 4, 5], [11, 9, 7], [5, 0, 2]]
+    got = predict.reconstruct_entries(factors, lam, coords)
+    want = np.array([dense[tuple(c)] for c in coords])
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+    # 1-D coords promote to a single-row batch
+    one = predict.reconstruct_entries(factors, lam, [3, 4, 5])
+    assert one.shape == (1,) and one[0] == pytest.approx(dense[3, 4, 5])
+    # ...and the KruskalTensor method delegates
+    np.testing.assert_allclose(kt.reconstruct(coords), want, rtol=1e-6)
+
+
+def test_top_k_matches_dense():
+    factors, lam = _kt(seed=1)
+    import jax.numpy as jnp
+
+    kt = KruskalTensor([jnp.asarray(U) for U in factors],
+                       jnp.asarray(lam), jnp.asarray(1.0))
+    dense = kt.to_dense()
+    idx, scores = predict.top_k_slice(factors, lam, {1: 2, 2: 1},
+                                      mode=0, k=4)
+    col = dense[:, 2, 1]
+    want = np.argsort(-col)[:4]
+    np.testing.assert_array_equal(idx, want)
+    np.testing.assert_allclose(scores, col[want], rtol=1e-10)
+    assert list(scores) == sorted(scores, reverse=True)
+    # k clamps to the mode's dim; method delegation agrees
+    all_idx, _ = kt.top_k({0: 3, 2: 0}, mode=1, k=999)
+    assert len(all_idx) == DIMS[1]
+
+
+def test_predict_math_validates_inputs():
+    factors, lam = _kt()
+    with pytest.raises(ValueError, match="modes"):
+        predict.reconstruct_entries(factors, lam, [[0, 0]])
+    with pytest.raises(ValueError, match="out of range"):
+        predict.reconstruct_entries(factors, lam, [[0, 0, 99]])
+    with pytest.raises(ValueError, match="out of range"):
+        predict.reconstruct_entries(factors, lam, [[-1, 0, 0]])
+    with pytest.raises(ValueError, match="pin exactly"):
+        predict.top_k_slice(factors, lam, {1: 0}, mode=0, k=2)
+    with pytest.raises(ValueError, match="pin exactly"):
+        predict.top_k_slice(factors, lam, {0: 0, 1: 0, 2: 0},
+                            mode=0, k=2)
+    with pytest.raises(ValueError, match="out of range"):
+        predict.top_k_slice(factors, lam, {1: 99, 2: 0}, mode=0, k=2)
+    with pytest.raises(ValueError, match="mode"):
+        predict.top_k_slice(factors, lam, {}, mode=7, k=2)
+
+
+# -- generation stamps -------------------------------------------------------
+
+def test_generation_advance_monotonic_and_idempotent(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    f2, l2 = _kt(seed=3)
+    assert predict.current_generation(d, "m") == 0
+    assert predict.advance_generation(d, "m", f1, l1) == 1
+    # bit-identical re-commit (a replayed/adopted commit): NO advance
+    assert predict.advance_generation(d, "m", f1, l1) == 1
+    assert predict.current_generation(d, "m") == 1
+    assert predict.advance_generation(d, "m", f2, l2) == 2
+    assert predict.current_generation(d, "m") == 2
+    # the outgoing generation survives as the rollback stamp
+    bak = predict.read_stamp(predict.stamp_path(d, "m") + ".bak")
+    assert bak["gen"] == 1 and bak["sha"] == factor_content_sha(f1, l1)
+    evs = resilience.run_report().events("model_generation_advanced")
+    assert [e["gen"] for e in evs] == [1, 2]
+
+
+def test_generation_stamp_fault_aborts_advance(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    f2, l2 = _kt(seed=3)
+    assert predict.advance_generation(d, "m", f1, l1) == 1
+    with faults.inject("model.generation", "runtime"):
+        with pytest.raises(RuntimeError):
+            predict.advance_generation(d, "m", f2, l2)
+    # the stamp never moved: the old generation keeps serving
+    assert predict.current_generation(d, "m") == 1
+    stamp = predict.read_stamp(predict.stamp_path(d, "m"))
+    assert stamp["sha"] == factor_content_sha(f1, l1)
+
+
+def test_garbage_stamp_is_torn_not_trusted(tmp_path):
+    spath = str(tmp_path / "m.gen.json")
+    with open(spath, "w") as f:
+        f.write("{not json")
+    assert predict.read_stamp(spath) is None
+    evs = resilience.run_report().events("model_torn")
+    assert evs and evs[0]["piece"] == "generation-stamp"
+
+
+# -- fenced reads ------------------------------------------------------------
+
+def test_fenced_read_serves_newest_intact_generation(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    ckpt = os.path.join(d, "m.npz")
+    _save_checkpoint(ckpt, f1, l1, 0, 0.9)
+    predict.advance_generation(d, "m", f1, l1)
+    out = predict.load_model_generation(d, "m")
+    assert out["gen"] == 1 and out["sha"] == factor_content_sha(f1, l1)
+    for U, W in zip(out["factors"], f1):
+        np.testing.assert_array_equal(U, W)
+
+
+def test_fenced_read_degrades_to_bak_on_torn_commit(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    f2, l2 = _kt(seed=3)
+    ckpt = os.path.join(d, "m.npz")
+    _save_checkpoint(ckpt, f1, l1, 0, 0.9)
+    predict.advance_generation(d, "m", f1, l1)
+    # a commit that died between checkpoint publish and stamp advance:
+    # the new factors landed but the stamp still names generation 1 —
+    # the .bak checkpoint is what the stamp verifies
+    _save_checkpoint(ckpt, f2, l2, 0, 0.9)
+    out = predict.load_model_generation(d, "m")
+    assert out is not None and out["gen"] == 1
+    for U, W in zip(out["factors"], f1):
+        np.testing.assert_array_equal(U, W)
+    assert resilience.run_report().events("model_torn")
+
+
+def test_fenced_read_falls_back_to_bak_generation(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    f2, l2 = _kt(seed=3)
+    ckpt = os.path.join(d, "m.npz")
+    _save_checkpoint(ckpt, f1, l1, 0, 0.9)
+    predict.advance_generation(d, "m", f1, l1)
+    _save_checkpoint(ckpt, f2, l2, 0, 0.95)
+    predict.advance_generation(d, "m", f2, l2)
+    # generation 2's checkpoint shredded on disk: the fence walks back
+    # to (ckpt.bak, stamp.bak) and serves generation 1
+    with open(ckpt, "wb") as f:
+        f.write(b"shredded")
+    out = predict.load_model_generation(d, "m")
+    assert out is not None and out["gen"] == 1
+    for U, W in zip(out["factors"], f1):
+        np.testing.assert_array_equal(U, W)
+    # ...and with the rollback generation gone too, REFUSE
+    os.remove(ckpt + ".bak")
+    assert predict.load_model_generation(d, "m") is None
+
+
+def test_unstamped_checkpoint_refuses(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    _save_checkpoint(os.path.join(d, "m.npz"), f1, l1, 0, 0.9)
+    assert predict.load_model_generation(d, "m") is None
+    evs = resilience.run_report().events("model_torn")
+    assert evs and evs[-1]["piece"] == "no-generation-stamp"
+
+
+def test_predict_read_fault_site(tmp_path):
+    d = str(tmp_path)
+    f1, l1 = _kt(seed=2)
+    _save_checkpoint(os.path.join(d, "m.npz"), f1, l1, 0, 0.9)
+    predict.advance_generation(d, "m", f1, l1)
+    with faults.inject("predict.read", "runtime"):
+        with pytest.raises(RuntimeError):
+            predict.load_model_generation(d, "m")
+    # disarmed, the same read serves
+    assert predict.load_model_generation(d, "m")["gen"] == 1
+
+
+# -- the hot-factor cache ----------------------------------------------------
+
+def test_hot_cache_lru_and_generation_keying():
+    cache = predict.HotFactorCache(max_entries=2)
+    cache.put("m", 1, {"gen": 1})
+    cache.put("m", 2, {"gen": 2})
+    # generation keying: both generations coexist — an advance
+    # invalidates by NEW KEY, never by deleting the pinned entry
+    assert cache.get("m", 1)["gen"] == 1
+    assert cache.get("m", 2)["gen"] == 2
+    cache.put("other", 1, {"gen": 1})       # evicts LRU ("m", 1)
+    assert len(cache) == 2
+    assert cache.get("m", 1) is None
+    assert cache.get("m", 2) is not None
+    # disabled storage: every put is dropped
+    off = predict.HotFactorCache(max_entries=0)
+    off.put("m", 1, {"gen": 1})
+    assert len(off) == 0 and off.get("m", 1) is None
+
+
+def test_predict_cache_fault_site():
+    cache = predict.HotFactorCache(max_entries=2)
+    cache.put("m", 1, {"gen": 1})
+    with faults.inject("predict.cache", "runtime"):
+        with pytest.raises(RuntimeError):
+            cache.get("m", 1)
+    assert cache.get("m", 1)["gen"] == 1
+
+
+# -- the serve lane ----------------------------------------------------------
+
+def test_serve_predict_end_to_end(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    (base,) = _run(srv, _fit_spec())
+    assert base["status"] == "converged"
+    # the fit COMMITTED: generation 1 stamped, journal carries it
+    assert base["model"] == "base" and base["model_gen"] == 1
+    assert predict.current_generation(srv.ckpt_dir, "base") == 1
+    spec = {"id": "p1", "kind": "predict", "model": "base",
+            "coords": [[0, 0, 0], [1, 2, 3]],
+            "top_k": {"fixed": {"1": 0, "2": 0}, "mode": 0, "k": 3}}
+    (res,) = _run(srv, spec)
+    assert res["status"] == "served"
+    assert res["gen"] == 1 and res["gen_pinned"] == 1
+    assert res["cache"] == "miss" and len(res["values"]) == 2
+    assert len(res["top_k"]["indices"]) == 3
+    # the answer verifies against the fenced read
+    loaded = predict.load_model_generation(srv.ckpt_dir, "base")
+    want = predict.reconstruct_entries(loaded["factors"],
+                                       loaded["lam"],
+                                       [[0, 0, 0], [1, 2, 3]])
+    np.testing.assert_allclose(res["values"], want, rtol=1e-12)
+    # a second predict hits the warmed cache, bit-exactly
+    (res2,) = _run(srv, {"id": "p2", "kind": "predict",
+                         "model": "base",
+                         "coords": [[0, 0, 0], [1, 2, 3]]})
+    assert res2["status"] == "served" and res2["cache"] == "hit"
+    assert res2["values"] == res["values"]
+    # journal audit: predict ACCEPTED pins the floor, DONE carries the
+    # served generation — the staleness invariant is journal-checkable
+    recs, _ = serve.Journal(os.path.join(
+        srv.root, "journal.jsonl")).replay()
+    acc = next(r for r in recs if r["job"] == "p1"
+               and r["rec"] == serve.ACCEPTED)
+    done = next(r for r in recs if r["job"] == "p1"
+                and r["rec"] == serve.DONE)
+    assert acc["gen_pinned"] == 1
+    assert done["gen"] == 1 and done["gen_pinned"] == 1
+    base_done = next(r for r in recs if r["job"] == "base"
+                     and r["rec"] == serve.DONE)
+    assert base_done["model_gen"] == 1
+
+
+def test_update_commit_advances_generation_and_serving(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec(iters=8, checkpoint_every=2))
+    (p1,) = _run(srv, {"id": "p1", "kind": "predict", "model": "base",
+                       "coords": [[0, 0, 0]]})
+    assert p1["gen"] == 1
+    (up,) = _run(srv, {"id": "up1", "kind": "update", "base": "base",
+                       "delta": {"dims": list(DIMS), "nnz": 20,
+                                 "seed": 9}})
+    assert up["status"] == "converged"
+    assert up["model"] == "base" and up["model_gen"] == 2
+    # a predict admitted after the commit serves the new generation
+    (p2,) = _run(srv, {"id": "p2", "kind": "predict", "model": "base",
+                       "coords": [[0, 0, 0]]})
+    assert p2["status"] == "served"
+    assert p2["gen"] == 2 and p2["gen_pinned"] == 2
+
+
+def test_predict_pinned_race_replays_bit_exactly(tmp_path):
+    """The update-commit vs predict race: a predict ACCEPTED before a
+    commit but EXECUTED after it finishes on its pinned generation
+    bit-exactly (the hot cache holds the pinned entry; the advance
+    never deletes it)."""
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec())
+    coords = [[2, 3, 4], [0, 1, 0]]
+    (warm,) = _run(srv, {"id": "pw", "kind": "predict",
+                         "model": "base", "coords": coords})
+    assert warm["gen"] == 1
+    # accept the racing predict (pins generation 1)...
+    r = srv.submit({"id": "pr", "kind": "predict", "model": "base",
+                    "coords": coords})
+    assert r["state"] == serve.ACCEPTED
+    # ...then a concurrent committer advances the model before the
+    # predict runs (new factors, new checkpoint, generation 2)
+    f2, l2 = _kt(seed=44)
+    _save_checkpoint(os.path.join(srv.ckpt_dir, "base.npz"),
+                     f2, l2, 0, 0.9)
+    assert predict.advance_generation(srv.ckpt_dir, "base",
+                                      f2, l2) == 2
+    srv.run_once()
+    res = serve.read_result(srv.root, "pr")
+    assert res["status"] == "served"
+    assert res["gen_pinned"] == 1 and res["gen"] == 1
+    assert res["cache"] == "hit"
+    # bit-exact replay of the pinned generation's answer
+    assert res["values"] == warm["values"]
+    # a fresh predict (pinned at 2) serves the NEW generation
+    (after,) = _run(srv, {"id": "pa", "kind": "predict",
+                          "model": "base", "coords": coords})
+    assert after["gen"] == 2 and after["values"] != warm["values"]
+
+
+def test_predict_cache_poison_degrades_to_direct_read(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec())
+    _run(srv, {"id": "pw", "kind": "predict", "model": "base",
+               "coords": [[0, 0, 0]]})     # warm the cache
+    (res,) = _run(srv, {"id": "pp", "kind": "predict", "model": "base",
+                        "coords": [[0, 0, 0]],
+                        "faults": "predict.cache:runtime"})
+    # the poisoned lookup degraded classified to the direct fenced
+    # read — the answer still SERVED
+    assert res["status"] == "served" and res["cache"] == "miss"
+    evs = [e for e in res["events"] if e["kind"] == "predict_degraded"]
+    assert evs and evs[0]["reason"] == "cache_poisoned"
+
+
+def test_predict_read_fault_refuses_classified(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec())
+    (res,) = _run(srv, {"id": "pf", "kind": "predict", "model": "base",
+                        "coords": [[0, 0, 0]],
+                        "faults": "predict.read:runtime"})
+    assert res["status"] == "refused"
+    reasons = {e.get("reason") for e in res["events"]
+               if e["kind"] == "predict_degraded"}
+    assert {"read_failed", "no_intact_generation"} <= reasons
+
+
+def test_predict_refuses_on_shredded_model(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec())
+    ckpt = os.path.join(srv.ckpt_dir, "base.npz")
+    for p in (ckpt, ckpt + ".bak"):
+        if os.path.exists(p):
+            with open(p, "wb") as f:
+                f.write(b"garbage")
+    for p in (predict.stamp_path(srv.ckpt_dir, "base"),
+              predict.stamp_path(srv.ckpt_dir, "base") + ".bak"):
+        if os.path.exists(p):
+            os.remove(p)
+    (res,) = _run(srv, {"id": "px", "kind": "predict", "model": "base",
+                        "coords": [[0, 0, 0]]})
+    assert res["status"] == "refused"
+    assert res["reason"] == "no_intact_generation"
+    assert "values" not in res
+
+
+def test_generation_fault_aborts_update_old_gen_serves(tmp_path):
+    """A failed stamp advance (the ``model.generation`` site) fails
+    the update commit CLASSIFIED — and readers keep serving the old
+    generation, whose stamp never moved."""
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec(iters=8, checkpoint_every=2))
+    (up,) = _run(srv, {"id": "upf", "kind": "update", "base": "base",
+                       "delta": {"dims": list(DIMS), "nnz": 20,
+                                 "seed": 9},
+                       "faults": "model.generation:runtime"})
+    assert up["status"] == "failed"
+    assert predict.current_generation(srv.ckpt_dir, "base") == 1
+    (res,) = _run(srv, {"id": "p1", "kind": "predict", "model": "base",
+                        "coords": [[0, 0, 0]]})
+    assert res["status"] == "served" and res["gen"] == 1
+
+
+def test_predict_lane_bounded_and_validated(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPLATT_PREDICT_QUEUE_MAX", "1")
+    srv = serve.Server(str(tmp_path), workers=1)
+    # validation: no model / no question → rejected loudly
+    r = srv.submit({"id": "bad1", "kind": "predict",
+                    "coords": [[0, 0, 0]]})
+    assert r["state"] == serve.REJECTED and "model" in r["reason"]
+    r = srv.submit({"id": "bad2", "kind": "predict", "model": "base"})
+    assert r["state"] == serve.REJECTED and "coords" in r["reason"]
+    # the predict lane's own bound load-sheds without touching the
+    # fit queue
+    a = srv.submit({"id": "p1", "kind": "predict", "model": "base",
+                    "coords": [[0, 0, 0]]})
+    assert a["state"] == serve.ACCEPTED
+    b = srv.submit({"id": "p2", "kind": "predict", "model": "base",
+                    "coords": [[0, 0, 0]]})
+    assert b["state"] == serve.REJECTED and b["reason"] == "queue_full"
+    evs = resilience.run_report().events("queue_full")
+    assert evs and evs[-1]["lane"] == "predict"
+    assert srv.submit(_fit_spec("f1"))["state"] == serve.ACCEPTED
+    assert srv.summary()["pending_predict"] == 1
+
+
+def test_predict_survives_restart_replay(tmp_path):
+    """A predict accepted but not yet run when the daemon dies is
+    re-enqueued on the predict lane by journal replay — zero lost
+    predictions."""
+    srv = serve.Server(str(tmp_path), workers=1)
+    _run(srv, _fit_spec())
+    r = srv.submit({"id": "p1", "kind": "predict", "model": "base",
+                    "coords": [[0, 0, 0]]})
+    assert r["state"] == serve.ACCEPTED
+    # "crash": a fresh Server over the same root replays the journal
+    srv2 = serve.Server(str(tmp_path), workers=1)
+    assert srv2.summary()["pending_predict"] == 1
+    srv2.run_once()
+    res = serve.read_result(srv2.root, "p1")
+    assert res["status"] == "served"
+    assert res["gen"] == 1 and res["gen_pinned"] == 1

@@ -190,6 +190,37 @@ def test_faults_env_var(monkeypatch):
     faults.maybe_fail("unarmed_site")
 
 
+def test_apply_compile_cache_knob(monkeypatch):
+    """SPLATT_COMPILE_CACHE points jax's persistent executable cache
+    at the named directory with the caching floors zeroed (fleet
+    replicas share many small same-regime compiles); unset leaves the
+    config untouched.  Config only — executing deserialized entries is
+    the chaos soaks' job (and is CPU-unsafe for sharded programs, see
+    utils/env.py)."""
+    import jax
+
+    from splatt_tpu.utils.env import apply_compile_cache
+
+    prior = jax.config.jax_compilation_cache_dir
+    prior_t = jax.config.jax_persistent_cache_min_compile_time_secs
+    prior_b = jax.config.jax_persistent_cache_min_entry_size_bytes
+    try:
+        monkeypatch.delenv("SPLATT_COMPILE_CACHE", raising=False)
+        apply_compile_cache()   # unset: a no-op
+        assert jax.config.jax_compilation_cache_dir == prior
+        monkeypatch.setenv("SPLATT_COMPILE_CACHE", "/tmp/xc-test")
+        apply_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == "/tmp/xc-test"
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prior_t)
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", prior_b)
+
+
 def test_faults_kinds_map_to_taxonomy():
     for kind, cls in [("http500", FailureClass.TRANSIENT),
                       ("internal", FailureClass.TRANSIENT),

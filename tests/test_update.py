@@ -163,8 +163,12 @@ def test_update_lineage_auditable_via_status(tmp_path):
 
 def test_update_without_model_refits(tmp_path):
     srv = serve.Server(str(tmp_path), workers=1)
-    # base too short to ever checkpoint -> no model in the store
     _run(srv, _base_spec(iters=1, checkpoint_every=10))
+    # store retention loss: checkpoint + generation stamp gone (every
+    # completed fit commits one now, so absence must be manufactured)
+    for name in os.listdir(srv.ckpt_dir):
+        if name.startswith("base."):
+            os.remove(os.path.join(srv.ckpt_dir, name))
     (up,) = _run(srv, _up_spec())
     assert up["status"] == "converged"
     assert up["update"]["refit"] == "no_model"
@@ -306,4 +310,36 @@ def test_corrupt_model_tensor_degrades(tmp_path):
         f.write(b"not an npz")
     tt, applied = serve._load_model_tensor(path)
     assert tt is None and applied == []
-    assert resilience.run_report().events("checkpoint_recovery")
+    evs = resilience.run_report().events("model_torn")
+    assert evs and evs[0]["piece"] == "model-tensor"
+
+
+def test_model_tensor_missing_applied_or_bad_checksum(tmp_path):
+    """A model tensor without its idempotency stamp, or whose content
+    checksum no longer matches, is TORN — classified degrade to the
+    refit path, never silently trusted."""
+    tt = synthetic_tensor(DIMS, 50, seed=0)
+    path = str(tmp_path / "m.model.npz")
+    serve._save_model_tensor(path, tt, ["u1"])
+    got, applied = serve._load_model_tensor(path)
+    assert got is not None and applied == ["u1"]
+
+    # strip the applied stamp
+    with np.load(path) as z:
+        slim = {k: z[k] for k in ("inds", "vals", "dims")}
+    np.savez(path, **slim)
+    got, applied = serve._load_model_tensor(path)
+    assert got is None and applied == []
+    evs = resilience.run_report().events("model_torn")
+    assert evs and "applied" in evs[-1]["error"]
+
+    # flip a value under an otherwise-valid file: checksum catches it
+    serve._save_model_tensor(path, tt, ["u1"])
+    with np.load(path) as z:
+        bad = {k: np.asarray(z[k]) for k in z.files}
+    bad["vals"] = bad["vals"] + 1.0
+    np.savez(path, **bad)
+    got, applied = serve._load_model_tensor(path)
+    assert got is None and applied == []
+    assert any("checksum" in e["error"]
+               for e in resilience.run_report().events("model_torn"))

@@ -129,6 +129,19 @@ class Ignores:
 
 # -- file / project model ---------------------------------------------------
 
+def walk_nodes(tree) -> list:
+    """``list(ast.walk(tree))``, memoized on the tree object.  Every
+    structural rule re-walks each module tree, and the generator
+    machinery (deque extends + iter_child_nodes) dominates the
+    perf-gated full-tree run — one materialized walk per file serves
+    them all.  Use ONLY on whole-file trees (FileCtx.tree): subtree
+    walks are cheap and memoizing them would pin every node twice."""
+    cached = getattr(tree, "_splint_walk", None)
+    if cached is None:
+        cached = tree._splint_walk = list(ast.walk(tree))
+    return cached
+
+
 class FileCtx:
     """One analyzed source file: path, AST, alias map, pragmas."""
 
@@ -149,7 +162,7 @@ class FileCtx:
         numpy as jnp`` -> {'jnp': 'jax.numpy'})."""
         if self._aliases is None:
             amap: Dict[str, str] = {}
-            for node in ast.walk(self.tree):
+            for node in walk_nodes(self.tree):
                 if isinstance(node, ast.Import):
                     for a in node.names:
                         amap[a.asname or a.name.split(".")[0]] = (
@@ -167,7 +180,7 @@ class FileCtx:
         lets rules resolve ``read_env(_CACHE_ENV)`` to its value."""
         if self._consts is None:
             consts: Dict[str, str] = {}
-            for node in ast.walk(self.tree):
+            for node in walk_nodes(self.tree):
                 if (isinstance(node, ast.Assign)
                         and len(node.targets) == 1
                         and isinstance(node.targets[0], ast.Name)
@@ -760,17 +773,30 @@ def scope_functions(tree) -> List[ast.FunctionDef]:
     the entry points for per-function analyses: module-level functions
     AND class methods (at any class-nesting depth).  Function-nested
     defs are reached by each analysis' own recursion, which threads
-    the enclosing scope's environment down to them."""
-    nested: Set[int] = set()
-    for fn in ast.walk(tree):
-        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for sub in ast.walk(fn):
-                if sub is not fn and isinstance(
-                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    nested.add(id(sub))
-    return [fn for fn in ast.walk(tree)
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and id(fn) not in nested]
+    the enclosing scope's environment down to them.
+
+    Memoized on the tree (several rules ask per file, and a marker
+    walk from every function is quadratic on deeply-methoded files —
+    measurable against the perf-gated full-tree run): one descent
+    that simply stops at function boundaries is both linear and the
+    definition itself."""
+    cached = getattr(tree, "_splint_scope_fns", None)
+    if cached is not None:
+        return cached
+
+    out: List[ast.FunctionDef] = []
+
+    def descend(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                out.append(child)  # and do NOT descend: nested defs
+                continue           # belong to the per-analysis walks
+            descend(child)
+
+    descend(tree)
+    tree._splint_scope_fns = out
+    return out
 
 
 def free_reads(fn) -> Set[str]:
@@ -909,7 +935,7 @@ class JitBoundary:
             for fn in local_defs.values():
                 visit(fns, fn.body)
 
-        for fn in ast.walk(ctx.tree):
+        for fn in walk_nodes(ctx.tree):
             if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 spec = jit_decorator_spec(ctx, fn)
                 if spec is not None:

@@ -344,6 +344,10 @@ class ProjectLocks:
         self._calls: Dict[str, List[str]] = {}
         #: function key -> (ctx, fn, cls)
         self.functions: Dict[str, Tuple[object, object, Optional[str]]] = {}
+        #: function key -> LockWalkResult (the walks are the dominant
+        #: cost of a full-tree run, and every consumer — summaries,
+        #: order_edges, SPL017 — needs the same on_nested-free walk)
+        self._walks: Dict[str, LockWalkResult] = {}
         for ctx in project.files:
             self.files[ctx.relpath] = FileLocks(ctx)
         for ctx in project.files:
@@ -362,7 +366,7 @@ class ProjectLocks:
         acq: Set[str] = set()
         blocks: Set[str] = set()
         callees: List[str] = []
-        walk = lock_walk(ctx, fn, cls, fl)
+        walk = self._walks[key] = lock_walk(ctx, fn, cls, fl)
         for lid, _line, _held in walk.acquisitions:
             acq.add(lid)
         for node in ast.walk(fn):
@@ -463,6 +467,15 @@ class ProjectLocks:
                         self._blocks[key] |= extra_b
                         changed = True
 
+    def walk_of(self, key: str) -> "LockWalkResult":
+        """The memoized on_nested-free walk for one known function."""
+        walk = self._walks.get(key)
+        if walk is None:
+            ctx, fn, cls = self.functions[key]
+            walk = self._walks[key] = lock_walk(
+                ctx, fn, cls, self.files[ctx.relpath])
+        return walk
+
     def acquires(self, key: str) -> Set[str]:
         return self._acquires.get(key, set())
 
@@ -500,7 +513,7 @@ class ProjectLocks:
 
         for key, (ctx, fn, cls) in self.functions.items():
             fl = self.files[ctx.relpath]
-            walk = lock_walk(ctx, fn, cls, fl)
+            walk = self.walk_of(key)
             for lid, line, held in walk.acquisitions:
                 for h in held:
                     add(h, lid, ctx.relpath, line)

@@ -12,6 +12,7 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from tools.splint.core import (FileCtx, Finding, FunctionCFG, JitSpec,
+                               walk_nodes,
                                Project, _body_stmts, _expr_loads,
                                callable_jit_spec, free_reads,
                                jit_boundary, jit_call_spec, nested_defs,
@@ -70,7 +71,7 @@ class RawEnvironAccess(Rule):
         if ctx.relpath == project.config.env_module:
             return []
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in walk_nodes(ctx.tree):
             dotted = None
             if isinstance(node, ast.Attribute):
                 dotted = ctx.resolve(node)
@@ -102,7 +103,7 @@ class BroadExceptSwallows(Rule):
         routers = RESILIENCE_ROUTERS | set(
             project.config.resilience_routers)
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in walk_nodes(ctx.tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not self._is_broad(node.type):
@@ -201,7 +202,7 @@ class HostSyncInJit(Rule):
     def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
         hot = set(project.config.hot_functions)
         out = []
-        for fn in ast.walk(ctx.tree):
+        for fn in walk_nodes(ctx.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             jitted = _jit_static_names(ctx, fn) is not None
@@ -247,7 +248,7 @@ class RecompilationHazard(Rule):
 
     def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
         out = []
-        for fn in ast.walk(ctx.tree):
+        for fn in walk_nodes(ctx.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             static = _jit_static_names(ctx, fn)
@@ -308,7 +309,7 @@ class DtypeLiteral(Rule):
         if ctx.relpath == project.config.config_module:
             return []
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in walk_nodes(ctx.tree):
             if (isinstance(node, ast.Attribute)
                     and node.attr in _DTYPE_LITERALS
                     and (ctx.resolve(node.value) or "") in _DTYPE_MODULES):
@@ -326,7 +327,7 @@ def _call_sites(ctx: FileCtx) -> List[Tuple[Optional[str], int]]:
     literal string, 'prefix.*' for an f-string with a literal prefix,
     or None when not statically resolvable."""
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         dotted = ctx.resolve(node.func) or ""
@@ -349,7 +350,7 @@ def _call_sites(ctx: FileCtx) -> List[Tuple[Optional[str], int]]:
 
 
 def _declared_sites(ctx: FileCtx) -> Dict[str, int]:
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id == "SITES"
@@ -423,7 +424,7 @@ class FaultSiteDrift(Rule):
 # -- SPL007 -----------------------------------------------------------------
 
 def _declared_env_vars(ctx: FileCtx) -> Dict[str, int]:
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id == "ENV_VARS"
@@ -468,7 +469,7 @@ class UndocumentedEnvVar(Rule):
                 return ctx.str_consts.get(arg.id)
             return None
 
-        for node in ast.walk(ctx.tree):
+        for node in walk_nodes(ctx.tree):
             if isinstance(node, ast.Call):
                 dotted = ctx.resolve(node.func) or ""
                 if (dotted in ("os.environ.get", "os.getenv")
@@ -1083,7 +1084,7 @@ class CacheLockDiscipline(Rule):
 
 def _declared_registry(ctx: FileCtx, registry: str) -> Dict[str, int]:
     """String keys (-> line) of a module-level ``REGISTRY = {...}``."""
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id == registry
@@ -1155,13 +1156,13 @@ class RunReportEventDrift(Rule):
 
         # names bound to the report object: rr = run_report()
         report_names: Set[str] = set()
-        for node in ast.walk(ctx.tree):
+        for node in walk_nodes(ctx.tree):
             if (isinstance(node, ast.Assign) and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Name)
                     and is_run_report_call(node.value)):
                 report_names.add(node.targets[0].id)
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in walk_nodes(ctx.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr == "add"):
@@ -1195,7 +1196,7 @@ def _span_opens(ctx: FileCtx, is_trace_module: bool
     bare ``span(...)``/``begin(...)`` spellings count too (the module
     opens its own ``trace.export`` span)."""
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         dotted = ctx.resolve(node.func) or ""
@@ -1292,7 +1293,7 @@ _METRIC_FNS = {"metric_inc": "counter", "metric_set": "gauge",
 def _declared_metric_types(ctx: FileCtx) -> Dict[str, Tuple[Optional[str], int]]:
     """name -> (declared type, line) of the trace module's
     ``METRICS = {"name": ("type", "doc"), ...}`` registry."""
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and node.targets[0].id == "METRICS"
@@ -1317,7 +1318,7 @@ def _metric_emissions(ctx: FileCtx, is_trace_module: bool
     metric_observe`` call in `ctx` (bare spellings inside the trace
     module itself count too — _event_metrics records there)."""
     out = []
-    for node in ast.walk(ctx.tree):
+    for node in walk_nodes(ctx.tree):
         if not isinstance(node, ast.Call):
             continue
         dotted = ctx.resolve(node.func) or ""
@@ -1815,7 +1816,7 @@ class BlockingCallUnderLock(Rule):
 
     def finalize(self, project: Project) -> List[Finding]:
         from tools.splint.locks import (_blocking_verb, is_flock_id,
-                                        lock_walk, project_locks)
+                                        project_locks)
 
         hot = set(project.config.hot_lock_paths)
         if not hot:
@@ -1825,8 +1826,7 @@ class BlockingCallUnderLock(Rule):
         for key, (ctx, fn, cls) in pl.functions.items():
             if f"{ctx.relpath}::{fn.name}" not in hot:
                 continue
-            fl = pl.files[ctx.relpath]
-            walk = lock_walk(ctx, fn, cls, fl)
+            walk = pl.walk_of(key)
             for stmt in ast.walk(fn):
                 if not isinstance(stmt, ast.stmt):
                     continue
