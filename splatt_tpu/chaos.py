@@ -33,6 +33,7 @@ jax/device combinations before trusting them.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -372,6 +373,11 @@ class ServeChaosResult:
     resumed: List[str]            # jobs the restart re-enqueued
     violations: List[str]         # invariant breaches (empty = pass)
     error: Optional[str] = None
+    #: which durable-op crash windows the SIGKILL actually landed in
+    #: (ids from the crash-point checker's vocabulary,
+    #: tools/splint/crashpoint.py) — the dynamic half of the
+    #: static-vs-dynamic coverage comparison in docs/static-analysis.md
+    crash_windows: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -379,6 +385,73 @@ class ServeChaosResult:
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+
+def _crash_windows_exercised(root: str) -> List[str]:
+    """Classify the spool's post-kill state into the durable-op crash
+    windows the kill evidently landed in.
+
+    The ids come from the crash-point checker's window vocabulary
+    (``tools/splint/crashpoint.py``), which enumerates EVERY window
+    exhaustively; a soak's SIGKILL samples a handful per run.  Emitting
+    the sampled set (the ``crash_windows_exercised`` run-report event)
+    makes that gap measurable instead of anecdotal — the comparison
+    lives in docs/static-analysis.md.  Classification is conservative:
+    only states that are unambiguous evidence of a window are counted.
+    """
+    from splatt_tpu import serve
+
+    windows = set()
+    jpath = os.path.join(root, "journal.jsonl")
+    try:
+        with open(jpath, "rb") as f:
+            data = f.read()
+    except OSError:
+        data = b""
+    if data:
+        windows.add("journal.append")
+        if not data.endswith(b"\n"):
+            windows.add("journal.append.torn")
+    # publish-window debris: a crash between the tmp write and the
+    # atomic rename leaves the pid-stamped tmp beside the destination
+    for dirpath, _dirs, names in os.walk(root):
+        for name in names:
+            if ".tmp" not in name:
+                continue
+            if "gen.json.bak" in name:
+                windows.add("stamp.bak.publish")
+            elif "gen.json" in name:
+                windows.add("stamp.publish")
+            elif ".npz" in name:
+                windows.add("ckpt.publish")
+            elif os.path.basename(dirpath) == "results":
+                windows.add("result.publish")
+            elif os.path.basename(dirpath) == "leases":
+                windows.add("lease.publish")
+    try:
+        recs, torn = serve.Journal(jpath).replay()
+    # splint: ignore[SPL002] post-mortem classification is best-effort
+    # evidence gathering — an unreadable journal yields no windows,
+    # and the soak's own invariant audit reports the breakage
+    except Exception:
+        recs, torn = [], 0
+    if torn:
+        windows.add("journal.append.torn")
+    by_job: Dict[str, List[str]] = {}
+    for r in recs:
+        if r.get("job"):
+            by_job.setdefault(r["job"], []).append(r.get("rec"))
+    for jid, kinds in by_job.items():
+        terminal = any(k in serve.TERMINAL for k in kinds)
+        res = serve.read_result(root, jid)
+        if res is not None and not terminal:
+            # the terminal-commit protocol is result publish THEN the
+            # terminal append: a result with no terminal record means
+            # the crash landed before the final journal append
+            windows.add("journal.append[done]")
+        elif terminal and res is None:
+            windows.add("result.publish")
+    return sorted(windows)
 
 
 def run_serve_chaos(seed: int = 0, smoke: bool = True,
@@ -414,6 +487,7 @@ def run_serve_chaos(seed: int = 0, smoke: bool = True,
     violations: List[str] = []
     jobs: Dict[str, str] = {}
     resumed: List[str] = []
+    crash_windows: List[str] = []
     killed_mid_queue = False
     error = None
     tmp = tempfile.mkdtemp(prefix="splatt-serve-chaos-")
@@ -467,6 +541,13 @@ def run_serve_chaos(seed: int = 0, smoke: bool = True,
                 "daemon finished (or died) before the kill — the soak "
                 "did not exercise a mid-queue restart")
         proc.wait(timeout=60)
+
+        # post-mortem, BEFORE the restart heals anything: which crash
+        # windows did this kill actually land in?
+        crash_windows = _crash_windows_exercised(tmp)
+        resilience.run_report().add(
+            "crash_windows_exercised", soak="serve",
+            windows=",".join(crash_windows))
 
         restart = subprocess.run(cmd + ["--json"], env=env,
                                  capture_output=True, text=True,
@@ -531,7 +612,7 @@ def run_serve_chaos(seed: int = 0, smoke: bool = True,
     return ServeChaosResult(verdict=verdict, jobs=jobs,
                             killed_mid_queue=killed_mid_queue,
                             resumed=resumed, violations=violations,
-                            error=error)
+                            error=error, crash_windows=crash_windows)
 
 
 # -- fleet soak (docs/fleet.md) ---------------------------------------------
@@ -565,6 +646,9 @@ class FleetChaosResult:
     #: event count
     observability: Dict[str, float] = dataclasses.field(
         default_factory=dict)
+    #: which durable-op crash windows the victim's SIGKILL landed in
+    #: (crash-point checker vocabulary, tools/splint/crashpoint.py)
+    crash_windows: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -709,6 +793,7 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
     jobs: Dict[str, str] = {}
     adopted: List[str] = []
     affinity: Dict[str, dict] = {}
+    crash_windows: List[str] = []
     rids = [f"r{i}" for i in range(nrep)]
     victim = None
     error = None
@@ -835,6 +920,12 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
         time.sleep(0.5)  # well inside the 5 s slow-fault window
         procs[victim].kill()  # SIGKILL: no drain, no lease release
         procs[victim].wait(timeout=60)
+        # post-mortem before the survivors heal the spool: which crash
+        # windows did this kill actually land in?
+        crash_windows = _crash_windows_exercised(tmp)
+        resilience.run_report().add(
+            "crash_windows_exercised", soak="fleet",
+            windows=",".join(crash_windows))
         # batched tenant mix (docs/batched.md): filed in one burst
         # while the victim is dead, so one survivor ingests the set
         # together and its >= SPLATT_SERVE_BATCH_MIN same-key queue
@@ -1202,7 +1293,8 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = True,
     return FleetChaosResult(verdict=verdict, jobs=jobs, replicas=rids,
                             victim=victim, adopted=adopted,
                             affinity=affinity, violations=violations,
-                            error=error, observability=observability)
+                            error=error, observability=observability,
+                            crash_windows=crash_windows)
 
 
 def format_fleet_report(res: FleetChaosResult) -> List[str]:

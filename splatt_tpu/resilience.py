@@ -618,6 +618,20 @@ RUN_REPORT_EVENTS = {
                     "leave; classified and skipped, never fatal, and "
                     "the next append heals a torn tail before writing "
                     "(serve.py Journal, docs/fleet.md)",
+    "journal_unknown_kind": "journal replay skipped a record whose "
+                            "kind this version does not know "
+                            "(serve.KNOWN_KINDS) — a newer writer's "
+                            "journal or hand-edited debris; skipped "
+                            "classified instead of wedging the job "
+                            "table (the SPL022 forward-compat gate, "
+                            "docs/static-analysis.md)",
+    "crash_windows_exercised": "which durable-op crash windows a "
+                               "chaos soak's kills actually landed in "
+                               "(window ids from the crash-point "
+                               "checker's vocabulary, tools/splint/"
+                               "crashpoint.py) — the dynamic-coverage "
+                               "half of the static-vs-dynamic "
+                               "comparison in docs/static-analysis.md",
     "job_adopted": "a fleet replica took over a dead peer's "
                    "non-terminal job after its lease expired (the "
                    "fleet.adopt takeover path); the job resumes from "

@@ -124,6 +124,15 @@ REJECTED = "rejected"  # terminal: load-shed or invalid
 #: records after which a job needs no further work
 TERMINAL = (DONE, FAILED, REJECTED)
 
+#: every record kind this version journals — the replay forward-compat
+#: vocabulary.  A replayed record whose kind is NOT here (a newer
+#: version's journal, or hand-edited debris) is skipped with a
+#: classified ``journal_unknown_kind`` event instead of corrupting the
+#: job table.  splint SPL022 checks this registry against every
+#: ``_rec`` emission and every test, in both directions.
+KNOWN_KINDS = (ACCEPTED, STARTED, RESUMED, ADOPTED, INTERRUPTED,
+               DONE, FAILED, REJECTED)
+
 #: admission priority classes, class -> rank (lower runs first); the
 #: scheduler orders by (priority rank, arrival) so within a class the
 #: queue stays FIFO (docs/fleet.md)
@@ -433,6 +442,20 @@ class Server:
         kind = rec.get("rec")
         if not jid or not kind:
             return None
+        if kind not in KNOWN_KINDS:
+            # forward-compat: a newer version's record kind (or hand-
+            # edited debris) is skipped classified, never folded — an
+            # unknown kind blindly applied would wedge the job in a
+            # state no scheduler transition leaves (SPL022)
+            from splatt_tpu import resilience
+
+            resilience.run_report().add(
+                "journal_unknown_kind", path=self.journal.path,
+                job=str(jid), record_kind=str(kind)[:60],
+                failure_class="permanent",
+                error="journal record kind unknown to this version; "
+                      "skipped (newer writer?)")
+            return None
         j = self._jobs.setdefault(jid, self._new_job_locked())
         if kind == ACCEPTED:
             if rec.get("spec") is not None:
@@ -687,6 +710,8 @@ class Server:
             j["status"] = "rejected"
             self._jobs[jid] = j
         try:
+            # splint: ignore[SPL020] admission-time load shed: the job
+            # never ran, so no lease exists to fence this terminal
             self.journal.append(self._rec(REJECTED, jid, reason=reason))
         except Exception as e:
             # the rejection itself needs no durability: an un-journaled
@@ -1193,6 +1218,25 @@ class Server:
                 break
         return self.summary()
 
+    def _renew_fence(self, jid: str) -> bool:
+        """The live-lease commit fence as one dominating call: True
+        when this replica may journal a terminal record for `jid`
+        right now.  Single-replica mode has no lease plane — the fence
+        is vacuously live.  In fleet mode a renew refusal (or an
+        unverifiable lease: the conservative answer) means a peer owns
+        the job — the caller must abandon uncommitted.  splint SPL020
+        requires every terminal append to be DOMINATED by this call
+        (or an inline ``fleet.renew``), which is only checkable when
+        the fence is a single statement on every path."""
+        if self.fleet is None:
+            return True
+        try:
+            return bool(self.fleet.renew(jid))
+        # splint: ignore[SPL002] an unverifiable lease is an
+        # unowned lease: the conservative answer is abandon
+        except Exception:
+            return False
+
     def _backstop_fail(self, jid: str, cls, msg: str) -> None:
         """Commit a supervisor-error FAILED verdict — with the same
         fences the normal commit path has.  A job already terminal
@@ -1207,20 +1251,13 @@ class Server:
             self._log(f"job {jid}: already terminal; the supervisor "
                       f"error was post-commit cleanup", error=True)
             return
-        if self.fleet is not None:
-            try:
-                owned = self.fleet.renew(jid)
-            # splint: ignore[SPL002] an unverifiable lease is an
-            # unowned lease: the conservative answer is abandon
-            except Exception:
-                owned = False
-            if not owned:
-                with self._lock:
-                    self._jobs[jid]["state"] = ACCEPTED
-                self._log(f"job {jid}: supervisor error without a "
-                          f"live lease; abandoned uncommitted",
-                          error=True)
-                return
+        if not self._renew_fence(jid):
+            with self._lock:
+                self._jobs[jid]["state"] = ACCEPTED
+            self._log(f"job {jid}: supervisor error without a "
+                      f"live lease; abandoned uncommitted",
+                      error=True)
+            return
         self._write_result(jid, {"job": jid, "status": "failed",
                                  "failure_class": cls.value,
                                  "error": msg})

@@ -72,7 +72,7 @@ Span names are a registry (:data:`SPANS`), statically checked by
 splint rule SPL013 exactly like fault sites (SPL006) and run-report
 events (SPL012): an undeclared ``trace.span("...")`` literal — or a
 declared name no production code opens — is a finding.  Metric names
-(:data:`METRICS`) get the same treatment from SPL019.
+(:data:`METRICS`) get the same treatment from SPL024.
 
 This module imports nothing heavy at import time (no jax, no numpy);
 jax is touched lazily only for the optional TPU trace annotation.
@@ -739,7 +739,7 @@ def metrics_text(job: Optional[str] = None) -> str:
 def render_samples(samples: Dict, job: Optional[str] = None) -> str:
     """Render a raw sample map (:func:`samples`-shaped) as Prometheus
     text exposition.  Only :data:`METRICS`-declared names are emitted —
-    the registry is the exposition contract (splint SPL019), for the
+    the registry is the exposition contract (splint SPL024), for the
     fleet aggregator's merged samples exactly as for this process's
     own (splatt_tpu/fleetobs.py)."""
     lines: List[str] = []

@@ -45,11 +45,35 @@ except ImportError:  # non-POSIX: appends degrade to in-process safety
     _fcntl = None
 
 
+def _fsync_dir(path: str) -> None:
+    """Fsync the parent directory of `path`, making a just-completed
+    ``os.replace`` (a directory-entry update) itself durable.  Without
+    this the RENAME can be lost on power failure even though the
+    file's content was fsynced — the crash-point checker's
+    ``rename-lost`` states (tools/splint/crashpoint.py).  Best-effort
+    on filesystems/platforms where directories cannot be opened or
+    fsynced (the rename then has the platform's weaker durability,
+    which is the best available)."""
+    dirpath = os.path.dirname(os.path.abspath(str(path))) or "."
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(dirpath, flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def publish_file(tmp: str, path: str, fsync: bool = True) -> None:
     """Atomically publish an already-written temp file onto `path`:
-    fsync the temp's content, then ``os.replace``.  For callers whose
-    content is produced by a writer that needs the filename itself
-    (``np.savez`` in cpd.py's checkpoint path)."""
+    fsync the temp's content, ``os.replace``, then fsync the parent
+    directory so the rename itself survives power loss.  For callers
+    whose content is produced by a writer that needs the filename
+    itself (``np.savez`` in cpd.py's checkpoint path)."""
     if fsync:
         fd = os.open(tmp, os.O_RDONLY)
         try:
@@ -57,13 +81,15 @@ def publish_file(tmp: str, path: str, fsync: bool = True) -> None:
         finally:
             os.close(fd)
     os.replace(tmp, path)
+    if fsync:
+        _fsync_dir(path)
 
 
 def publish_bytes(path: str, data: bytes, fsync: bool = True) -> None:
     """Atomically publish `data` as the full new content of `path`
-    (same-directory temp write + fsync + ``os.replace``).  The temp
-    name carries the pid so concurrent publishers in different
-    processes never collide on debris."""
+    (same-directory temp write + fsync + ``os.replace`` + parent-dir
+    fsync).  The temp name carries the pid so concurrent publishers in
+    different processes never collide on debris."""
     path = str(path)
     tmp = f"{path}.~{os.getpid()}.tmp"
     try:
@@ -73,6 +99,8 @@ def publish_bytes(path: str, data: bytes, fsync: bool = True) -> None:
             if fsync:
                 os.fsync(f.fileno())
         os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(path)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -107,6 +135,7 @@ def append_line(path: str, data: bytes, heal_tail: bool = True,
     with open(path, "ab") as f:
         if _fcntl is not None and use_flock:
             _fcntl.flock(f.fileno(), _fcntl.LOCK_EX)
+        fresh = f.tell() == 0
         try:
             if heal_tail and f.tell() > 0:
                 with open(path, "rb") as r:
@@ -120,6 +149,10 @@ def append_line(path: str, data: bytes, heal_tail: bool = True,
         finally:
             if _fcntl is not None and use_flock:
                 _fcntl.flock(f.fileno(), _fcntl.LOCK_UN)
+    if fsync and fresh:
+        # first append CREATED the file: fsync the directory entry too,
+        # or a crash can lose the whole journal, records and all
+        _fsync_dir(path)
 
 
 def ring_append(path: str, lines: list, max_bytes: int) -> int:

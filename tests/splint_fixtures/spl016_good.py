@@ -7,15 +7,28 @@ import json
 import os
 
 
+def _fsync_dir(path):
+    # makes the rename itself durable (a directory-entry update); also
+    # a configured durable-write helper, so SPL016/SPL019 exempt it
+    fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                 os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def publish_bytes(path, data):
     # the sanctioned chokepoint ([tool.splint] durable-write-helpers):
-    # tmp write + fsync + atomic rename, exempted by name
+    # tmp write + fsync + atomic rename + parent-dir fsync, exempted
+    # by name
     tmp = f"{path}.~{os.getpid()}.tmp"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    _fsync_dir(path)
 
 
 def publish_record(path, record):

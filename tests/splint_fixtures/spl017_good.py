@@ -5,10 +5,10 @@ happens with the lock released (serve.submit's ACCEPTING pattern)."""
 import threading
 
 
-def publish_bytes(path, data):
-    # stand-in for splatt_tpu.utils.durable.publish_bytes (the
+def append_line(path, data):
+    # stand-in for splatt_tpu.utils.durable.append_line (the
     # configured durable-write helper; its body owns the fsync)
-    with open(path, "wb") as f:
+    with open(path, "ab") as f:
         f.write(data)
 
 
@@ -23,5 +23,5 @@ class Server:
             # reserve the id so a concurrent same-id submission dedups
             # while the durable append runs lock-free below
             self._jobs[jid] = spec
-        publish_bytes(self._journal_path, b"accepted\n")
+        append_line(self._journal_path, b"accepted\n")
         return jid

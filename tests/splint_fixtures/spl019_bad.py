@@ -1,13 +1,26 @@
-"""SPL019 bad: recording a metric the METRICS registry never declared,
-and recording a declared counter through the gauge verb (which would
-raise at runtime)."""
+"""SPL019 bad: torn-publish protocol violations — a sanctioned
+publish helper missing the fsync steps, and an inline tmp-write →
+rename publish outside the helpers."""
 
-from splatt_tpu import trace
-
-
-def rogue_counter():
-    trace.metric_inc("spl019_fixture_undeclared_total")
+import json
+import os
 
 
-def mistyped_verb():
-    trace.metric_set("splatt_retries_total", 1.0)
+def publish_bytes(path, data):
+    # configured atomic-publish helper, but the protocol is gutted: no
+    # content fsync before the rename, no parent-dir fsync after it —
+    # a crash can publish torn bytes, or lose the publish entirely
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def commit_inline(path, record):
+    # inline re-implementation of the publish protocol: this function
+    # writes the tmp file AND renames it into place itself, bypassing
+    # the audited chokepoint
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(record))
+    os.replace(tmp, path)

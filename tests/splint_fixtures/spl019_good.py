@@ -1,12 +1,33 @@
-"""SPL019 good: metric emissions name declared METRICS entries through
-the verb matching each declared type (docs/observability.md)."""
+"""SPL019 good: the full atomic-publish protocol in order inside the
+sanctioned helper, and only pure renames (no self-written source)
+outside it."""
 
-from splatt_tpu import trace
-
-
-def counted_retry():
-    trace.metric_inc("splatt_retries_total")
+import os
 
 
-def observed_wall(seconds):
-    trace.metric_observe("splatt_job_seconds", float(seconds))
+def _fsync_dir(path):
+    # configured durable-write helper: the rename-durability barrier
+    fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                 os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def publish_bytes(path, data):
+    # tmp write -> content fsync -> atomic rename -> parent-dir fsync:
+    # every step present, in order, on the normal path only
+    tmp = f"{path}.~{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(path)
+
+
+def rotate(path):
+    # renaming an EXISTING file this function never wrote is not a
+    # publish — rotation/claim verbs stay clean
+    os.replace(path, path + ".1")

@@ -1,0 +1,20 @@
+"""SPL020 bad: a terminal journal append with no dominating live-lease
+fence, and a journal append in a function the registry never heard
+of."""
+
+
+class MiniServer:
+    def __init__(self, journal, fleet):
+        self.journal = journal
+        self.fleet = fleet
+
+    def commit_unfenced(self, jid, status):
+        # registered + lease-fenced in [tool.splint], but NO dominating
+        # renew on the path to this terminal append: a deposed replica
+        # can double-commit a job its adopter already owns
+        self.journal.append({"rec": "done", "job": jid,
+                             "status": status})
+
+    def rogue_append(self, jid):
+        # not in journal-append-functions at all — an unaudited writer
+        self.journal.append({"rec": "started", "job": jid})

@@ -1,0 +1,22 @@
+"""SPL020 good: the terminal append is dominated by the live-lease
+fence — every path to the commit proves the lease is still held."""
+
+
+class MiniServer:
+    def __init__(self, journal, fleet):
+        self.journal = journal
+        self.fleet = fleet
+
+    def _renew_fence(self, jid):
+        if self.fleet is None:
+            return True
+        return bool(self.fleet.renew(jid))
+
+    def commit_fenced(self, jid, status):
+        # the fence call sits on EVERY path to the append (it dominates
+        # the commit) — a renew refusal abandons uncommitted
+        if not self._renew_fence(jid):
+            return None
+        self.journal.append({"rec": "done", "job": jid,
+                             "status": status})
+        return jid

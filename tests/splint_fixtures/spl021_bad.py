@@ -1,0 +1,33 @@
+"""SPL021 bad: generation-stamp advance and factor persist travelling
+separately — a stamp with no dominating persist, and a commit persist
+with a normal-flow path to exit that skips the advance."""
+
+
+def advance_generation(ckpt_dir, model, factors, lam):
+    return 1  # stand-in for splatt_tpu.predict.advance_generation
+
+
+def _save_checkpoint(path, factors, lam, it, fit):
+    pass  # stand-in for splatt_tpu.cpd._save_checkpoint
+
+
+def _save_model_tensor(path, tt, applied):
+    pass  # stand-in for splatt_tpu.serve._save_model_tensor
+
+
+def commit_stamp_only(ckpt_dir, model, factors, lam):
+    # advances the stamp without persisting the factors it fences:
+    # readers verify the sha against stale content and REFUSE — a
+    # committed generation becomes unservable
+    return advance_generation(ckpt_dir, model, factors, lam)
+
+
+def commit_tensor_only(path, ckpt_dir, model, tt, factors, lam,
+                       applied, dry_run):
+    _save_checkpoint(path, factors, lam, 0, 0.0)
+    _save_model_tensor(path + ".model", tt, applied)
+    if dry_run:
+        # normal-flow exit that skips the advance: the tensor just
+        # published has no stamp and never will
+        return None
+    return advance_generation(ckpt_dir, model, factors, lam)

@@ -1,0 +1,22 @@
+"""SPL021 good: persist first, then advance, straight-line — the
+stamp covers exactly the content just written, on every path."""
+
+
+def advance_generation(ckpt_dir, model, factors, lam):
+    return 1  # stand-in for splatt_tpu.predict.advance_generation
+
+
+def _save_checkpoint(path, factors, lam, it, fit):
+    pass  # stand-in for splatt_tpu.cpd._save_checkpoint
+
+
+def _save_model_tensor(path, tt, applied):
+    pass  # stand-in for splatt_tpu.serve._save_model_tensor
+
+
+def commit_update(path, ckpt_dir, model, tt, factors, lam, applied):
+    # the commit protocol in order: checkpoint, model tensor, THEN the
+    # generation advance — no early return between persist and stamp
+    _save_checkpoint(path, factors, lam, 0, 0.0)
+    _save_model_tensor(path + ".model", tt, applied)
+    return advance_generation(ckpt_dir, model, factors, lam)

@@ -1,0 +1,18 @@
+"""SPL022 bad: a journal record kind emitted nowhere in serve's
+KNOWN_KINDS vocabulary — replay will skip it as unknown — plus an
+emission splint cannot resolve statically."""
+
+
+class MiniServer:
+    def _rec(self, kind, jid, **kw):
+        return {"rec": kind, "job": jid, **kw}
+
+    def emit_undeclared(self, sink, jid):
+        # not in serve.KNOWN_KINDS: the replay forward-compat gate
+        # will drop this record on the floor
+        sink.append(self._rec("spl022_fixture_unknown_kind", jid))
+
+    def emit_unresolvable(self, sink, jid, kind_from_caller):
+        # the kind is a bare parameter — replay totality cannot be
+        # audited for an emission splint cannot resolve
+        sink.append(self._rec(kind_from_caller, jid))

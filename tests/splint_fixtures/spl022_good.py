@@ -1,0 +1,14 @@
+"""SPL022 good: every emitted journal record kind resolves statically
+to a kind serve's KNOWN_KINDS declares."""
+
+STARTED = "started"
+
+
+class MiniServer:
+    def _rec(self, kind, jid, **kw):
+        return {"rec": kind, "job": jid, **kw}
+
+    def emit_started(self, sink, jid):
+        # a literal declared kind, resolved through this module's
+        # constant — replay folds it
+        sink.append(self._rec(STARTED, jid))

@@ -1,0 +1,15 @@
+"""SPL023 bad: a durable-root write with no fsync barrier — the
+writer reports success, the process dies, the post-crash reader sees
+nothing (or a torn prefix)."""
+
+import os
+
+
+def append_journal_raw(root, line):
+    # hand-rolled journal append: write + flush reaches the page
+    # cache, not the platter — a crash can lose the record a replay
+    # depends on
+    journal_path = os.path.join(root, "journal.jsonl")
+    with open(journal_path, "a") as f:
+        f.write(line + "\n")
+        f.flush()

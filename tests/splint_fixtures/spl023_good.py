@@ -1,0 +1,21 @@
+"""SPL023 good: durable appends routed through the sanctioned helper
+(which owns the fsync), scratch writes left alone."""
+
+import os
+import tempfile
+
+from splatt_tpu.utils.durable import append_line
+
+
+def append_journal(root, line):
+    # the sanctioned durable-append chokepoint fsyncs for us
+    journal_path = os.path.join(root, "journal.jsonl")
+    append_line(journal_path, (line + "\n").encode())
+
+
+def write_scratch(payload):
+    # not under any durable root: scratch files need no barrier
+    fd, scratch = tempfile.mkstemp(suffix=".scratch")
+    with os.fdopen(fd, "w") as f:
+        f.write(payload)
+    return scratch

@@ -225,6 +225,48 @@ def test_serve_chaos_smoke_kill_and_restart():
                for s in res.jobs.values())
     rec = res.to_json()
     assert rec["verdict"] == "survived" and not rec["violations"]
+    # the kill's crash windows were classified and recorded — the
+    # static-vs-dynamic coverage comparison (docs/static-analysis.md)
+    # reads this event against the crash-point checker's enumeration
+    assert isinstance(res.crash_windows, list)
+    evs = resilience.run_report().events("crash_windows_exercised")
+    assert evs and evs[0]["soak"] == "serve"
+    assert evs[0]["windows"] == ",".join(res.crash_windows)
+
+
+def test_crash_window_classifier(tmp_path):
+    """The post-mortem window classifier reads a fabricated post-kill
+    spool back into the crash-point checker's window vocabulary: torn
+    journal tails, publish tmp debris on every plane, and the two
+    result/journal divergence directions."""
+    import os
+
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "results"))
+    os.makedirs(os.path.join(root, "leases"))
+    with open(os.path.join(root, "journal.jsonl"), "w") as f:
+        f.write('{"rec": "accepted", "job": "j1", "ts": 1}\n')
+        f.write('{"rec": "done", "job": "j1", "ts": 2}\n')
+        f.write('{"rec": "accepted", "job": "j3", "ts": 3}\n')
+        f.write('{"rec": "acce')  # torn mid-append
+    # j3: result published but no terminal record (died before DONE);
+    # j1: DONE journaled but its result is gone
+    with open(os.path.join(root, "results", "j3.json"), "w") as f:
+        json.dump({"job": "j3", "status": "converged"}, f)
+    for debris in ("m1.gen.json.~7.tmp", "m1.gen.json.bak.~7.tmp",
+                   "m1.npz.~7.tmp"):
+        open(os.path.join(root, debris), "w").close()
+    open(os.path.join(root, "leases", "j1.json.~7.tmp"), "w").close()
+    got = chaos._crash_windows_exercised(root)
+    assert got == sorted({
+        "journal.append", "journal.append.torn", "journal.append[done]",
+        "result.publish", "stamp.publish", "stamp.bak.publish",
+        "ckpt.publish", "lease.publish",
+    })
+    # every id the classifier can emit is in the checker's vocabulary
+    from tools.splint.crashpoint import _windows
+
+    assert set(got) <= _windows()
 
 
 def test_serve_chaos_cli_flag_parses():

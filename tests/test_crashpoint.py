@@ -1,0 +1,177 @@
+"""The crash-point replay checker (tools/splint/crashpoint.py).
+
+The chaos soaks sample one crash per run; this checker enumerates a
+crash before (and torn, mid-way through) EVERY durable operation of
+the modeled commit/lease/journal protocols, with the REAL production
+writers and readers on both sides.  Tier-1 pins four things: the
+enumeration is exhaustive over the modeled protocols (state and op
+counts are asserted, so a silently-skipped window fails loudly), the
+unmutated protocols uphold all four soak invariants, each wired-in
+regression mutant IS caught (the invariants have teeth), and the
+window vocabulary stays in lockstep with chaos.py's post-mortem
+classifier so static and dynamic coverage stay comparable.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.splint.crashpoint import (MUTANTS, _protocols,  # noqa: E402
+                                     _windows, run_crash_check)
+
+
+def test_protocols_pass_unmutated():
+    """The acceptance invariant: every crash state of every modeled
+    protocol — plus torn-tail and rename-lost variants — replays to a
+    spool the real readers either serve consistently or REFUSE."""
+    res = run_crash_check()
+    assert res.ok, "\n".join(
+        f"{v.protocol}/{v.init} {v.state}: [{v.invariant}] {v.detail}"
+        for v in res.violations[:8])
+
+
+def test_enumeration_is_exhaustive_and_bounded():
+    """Every durable op in every modeled protocol gets a crash state
+    (appends also a torn one; volatile windows a rename-lost sibling).
+    The counts are pinned EXACTLY: a new durable op in a production
+    path shows up here (and in the protocol-drift assertion) rather
+    than silently widening the unchecked surface — and the bound keeps
+    the checker cheap enough for tier-1."""
+    res = run_crash_check()
+    # one discovery/complete state per (protocol, init), one crash
+    # state per durable op, one torn variant per append op, one
+    # rename-lost sibling per state with un-fsynced renames
+    expected_ops = sum(len(ops) for p in _protocols()
+                      for ops in p.expected.values())
+    assert res.ops_enumerated == expected_ops == 21
+    assert res.states == 36
+    assert res.per_protocol == {
+        # complete + per-op crash states + torn append variants +
+        # rename-lost siblings (the ckpt.rotate window)
+        "fit_commit": 9, "update_commit": 7, "torn_ckpt_read": 2,
+        "lease": 5, "journal": 9, "terminal_commit": 4,
+    }
+
+
+def test_window_coverage_spans_every_plane():
+    """The observed crash windows cover the checkpoint, stamp, model
+    tensor, result, lease, and journal planes — and every observed
+    window is in the declared vocabulary (asserted inside the run as
+    a protocol-drift violation otherwise)."""
+    res = run_crash_check()
+    assert set(res.windows) == {
+        "ckpt.rotate", "ckpt.publish", "stamp.publish",
+        "stamp.bak.publish", "tensor.publish", "result.publish",
+        "lease.publish", "lease.release", "journal.append[accepted]",
+        "journal.append[started]", "journal.append[done]",
+    }
+    assert set(res.windows) <= _windows()
+
+
+@pytest.mark.parametrize("mutant", MUTANTS)
+def test_each_mutant_is_caught(mutant):
+    """Each wired-in protocol regression — stamp-before-factors, lost
+    tail healing, a gen-bump-free adoption, dropped directory fsyncs —
+    must produce at least one violation, or the checker is decorative."""
+    res = run_crash_check(mutant=mutant)
+    assert res.violations, f"mutant {mutant!r} not caught"
+
+
+def test_mutant_violations_name_the_right_invariant():
+    """The mutants land on the invariant they were designed to break
+    (not some incidental one), so a future refactor can't silently
+    swap a real check for a coincidental failure."""
+    assert {v.invariant for v in
+            run_crash_check("stamp_first").violations} == {"availability"}
+    assert {v.invariant for v in
+            run_crash_check("no_heal").violations} == {"lost-job"}
+    assert {v.invariant for v in
+            run_crash_check("adopt_same_gen").violations} == {"double-owner"}
+    kinds = {v.invariant for v in
+             run_crash_check("no_dir_fsync").violations}
+    assert "lost-job" in kinds
+
+
+def test_unknown_mutant_rejected():
+    with pytest.raises(ValueError):
+        run_crash_check(mutant="definitely_not_a_mutant")
+
+
+def test_instrumentation_is_restored_after_a_run():
+    """The os/durable patches must never leak past the context — a
+    leaked patch would corrupt every later test in the process."""
+    import os
+
+    from splatt_tpu.utils import durable
+
+    before = (os.replace, os.unlink, durable._fsync_dir,
+              durable.append_line)
+    run_crash_check(mutant="no_dir_fsync")
+    assert (os.replace, os.unlink, durable._fsync_dir,
+            durable.append_line) == before
+
+
+def test_cli_exit_codes():
+    """`python -m tools.splint.crashpoint` is the CI entry: 0 clean;
+    with --mutant, 0 iff the mutant is CAUGHT (a self-test of the
+    checker's teeth, gateable either way)."""
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.splint.crashpoint"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "0 violation(s)" in ok.stdout
+    caught = subprocess.run(
+        [sys.executable, "-m", "tools.splint.crashpoint",
+         "--mutant", "no_heal"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert caught.returncode == 0, caught.stdout + caught.stderr
+    assert "caught" in caught.stdout
+
+
+def test_cli_json_report():
+    import json
+
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.splint.crashpoint", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["ok"] is True
+    assert rep["states"] == 36
+    assert rep["violations"] == []
+
+
+def test_chaos_window_ids_stay_in_vocabulary():
+    """chaos.py's post-mortem classifier tags each soak's kills with
+    the crash windows they landed in; those ids must come from the
+    checker's vocabulary or the static-vs-dynamic coverage comparison
+    (docs/static-analysis.md) silently diverges."""
+    import ast
+
+    vocab = _windows()
+    src = (REPO / "splatt_tpu" / "chaos.py").read_text()
+    tree = ast.parse(src)
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef)
+              and n.name == "_crash_windows_exercised")
+    used = {n.value for n in ast.walk(fn)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            and (n.value in vocab or "." in n.value and
+                 n.value.split("[")[0] in {w.split("[")[0]
+                                           for w in vocab})}
+    window_literals = {n.value for n in ast.walk(fn)
+                       if isinstance(n, ast.Constant)
+                       and isinstance(n.value, str)
+                       and n.value.endswith((".publish", ".rotate",
+                                             ".torn", ".release"))
+                       or isinstance(n, ast.Constant)
+                       and isinstance(n.value, str)
+                       and n.value.startswith("journal.append")}
+    assert window_literals, "classifier lost its window literals"
+    assert window_literals <= vocab, window_literals - vocab
+    assert used  # the classifier really names vocabulary windows

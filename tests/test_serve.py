@@ -197,6 +197,36 @@ def test_torn_journal_line_is_skipped(tmp_path):
     assert torn == 1 and len(recs) == 3
 
 
+def test_unknown_journal_kind_is_skipped_and_classified(tmp_path):
+    """FORWARD-COMPAT INVARIANT: a record kind this version does not
+    know (a newer writer's journal, or corruption that still parses)
+    is SKIPPED with a classified ``journal_unknown_kind`` event — it
+    must neither wedge replay nor invent job-table state."""
+    root = str(tmp_path)
+    s1 = serve.Server(root, workers=1)
+    s1.submit(_spec("u1"))
+    s1.run_once()
+    with open(os.path.join(root, "journal.jsonl"), "a") as f:
+        f.write('{"rec": "paused_v99", "job": "u1", "ts": 1.0}\n')
+        f.write('{"rec": "paused_v99", "job": "u2", "ts": 2.0}\n')
+    s2 = serve.Server(root, workers=1)
+    # the known lineage replays untouched; the unknown kinds are
+    # dropped on the floor rather than mutating (or creating) jobs
+    assert s2.status("u1")["status"] == "converged"
+    # no job-table entry was invented for u2 (absent jobs report a
+    # bare state-None shell with no status field)
+    assert s2.status("u2") == {"job": "u2", "state": None}
+    evs = resilience.run_report().events("journal_unknown_kind")
+    assert {e["job"] for e in evs} == {"u1", "u2"}
+    assert all(e["record_kind"] == "paused_v99" for e in evs)
+    # the declared vocabulary is what replay checks against
+    assert "paused_v99" not in serve.KNOWN_KINDS
+    assert serve.DONE in serve.KNOWN_KINDS
+    # and the scheduler is not wedged: u1 stays terminal, started once
+    assert s2.run_once()["counts"] == {serve.DONE: 1}
+    assert _journal_kinds(root, "u1").count(serve.STARTED) == 1
+
+
 def test_terminal_jobs_are_not_rerun(tmp_path):
     root = str(tmp_path)
     s1 = serve.Server(root, workers=1)

@@ -107,7 +107,8 @@ def test_baseline_has_no_stale_or_overcounted_entries():
 RULE_IDS = ["SPL000", "SPL001", "SPL002", "SPL003", "SPL004", "SPL005",
             "SPL006", "SPL007", "SPL008", "SPL009", "SPL010", "SPL011",
             "SPL012", "SPL013", "SPL014", "SPL015", "SPL016", "SPL017",
-            "SPL018", "SPL019"]
+            "SPL018", "SPL019", "SPL020", "SPL021", "SPL022",
+            "SPL023", "SPL024"]
 
 
 @pytest.mark.parametrize("rule", RULE_IDS)
@@ -262,7 +263,7 @@ def test_spl013_span_registry_matches_runtime():
         assert isinstance(doc, str) and len(doc) > 10, name
 
 
-def _spl019_project(tmp_path, docs: str = None):
+def _spl024_project(tmp_path, docs: str = None):
     (tmp_path / "pkg").mkdir(exist_ok=True)
     (tmp_path / "pkg" / "trace.py").write_text(
         "METRICS = {'splatt_used_total': ('counter', 'doc'),\n"
@@ -287,15 +288,15 @@ def _spl019_project(tmp_path, docs: str = None):
                   trace_module="pkg/trace.py", **kw)
 
 
-def test_spl019_metric_drift(tmp_path):
+def test_spl024_metric_drift(tmp_path):
     """Both registry directions plus the type check, on a
     mini-project: an undeclared recorded name fires at the call site,
     a declared-but-never-recorded name fires at the registry, and a
     counter recorded through the gauge verb (a runtime raise) is a
     finding before anything runs."""
-    cfg = _spl019_project(tmp_path)
+    cfg = _spl024_project(tmp_path)
     msgs = [f.message for f in run(cfg, baseline={}).findings
-            if f.rule == "SPL019"]
+            if f.rule == "SPL024"]
     assert any("splatt_rogue_total" in m and "not declared" in m
                for m in msgs)
     assert any("splatt_dead_total" in m and "never recorded" in m
@@ -305,7 +306,7 @@ def test_spl019_metric_drift(tmp_path):
     assert not any("splatt_used_total" in m for m in msgs)
 
 
-def test_spl019_docs_table_both_directions(tmp_path):
+def test_spl024_docs_table_both_directions(tmp_path):
     """The docs legs: a declared metric missing from the configured
     metrics doc fires at the registry, and a doc-table metric the
     registry never declares is a dead promise."""
@@ -314,9 +315,9 @@ def test_spl019_docs_table_both_directions(tmp_path):
             "| `splatt_used_total` | counter |\n"
             "| `splatt_ghost_total{x=y}` | counter |\n"
             "| `splatt_depth` | gauge |\n")
-    cfg = _spl019_project(tmp_path, docs=docs)
+    cfg = _spl024_project(tmp_path, docs=docs)
     msgs = [f.message for f in run(cfg, baseline={}).findings
-            if f.rule == "SPL019"]
+            if f.rule == "SPL024"]
     assert any("splatt_dead_total" in m and "no row" in m
                for m in msgs)
     assert any("splatt_ghost_total" in m and "never declares" in m
@@ -329,11 +330,11 @@ def test_spl019_docs_table_both_directions(tmp_path):
         docs.replace("| `splatt_ghost_total{x=y}` | counter |\n", "")
         + "| `splatt_dead_total` | counter |\n")
     msgs2 = [f.message for f in run(cfg, baseline={}).findings
-             if f.rule == "SPL019"]
+             if f.rule == "SPL024"]
     assert not any("row" in m or "never declares" in m for m in msgs2)
 
 
-def test_spl019_registry_matches_runtime_and_docs():
+def test_spl024_registry_matches_runtime_and_docs():
     """The real registry is importable and the real docs table is in
     sync (the full-tree zero gate enforces this too; this pins the
     wiring: metrics-doc configured, every metric typed + documented)."""
@@ -951,6 +952,66 @@ def test_spl014_fires_when_replay_drops_the_lock(tmp_path):
                         for f in hits)
 
 
+def test_spl020_fires_when_backstop_fence_reverted(tmp_path):
+    """Reverting the PR 17 fix — _backstop_fail's lease fence before
+    its terminal FAILED append — must trip SPL020: the append is then
+    reachable without a dominating renew, the exact zombie-commit
+    shape the fence exists to kill."""
+    anchor = "        if not self._renew_fence(jid):"
+
+    def mutate(src):
+        assert anchor in src, "serve.py _backstop_fail anchor drifted"
+        return src.replace(anchor, "        if jid is None:", 1)
+
+    cfg = _copy_serve_tree(tmp_path, mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL020"]
+    assert hits and any("_backstop_fail" in f.message for f in hits)
+
+
+def test_spl022_fires_when_replay_gate_reverted(tmp_path):
+    """Reverting the PR 17 forward-compat gate — _apply_rec_locked's
+    KNOWN_KINDS membership check — must trip SPL022's never-consulted
+    leg: a declared vocabulary replay no longer reads is exactly the
+    drift the rule polices."""
+    anchor = "if kind not in KNOWN_KINDS:"
+
+    def mutate(src):
+        assert anchor in src, "serve.py replay-gate anchor drifted"
+        return src.replace(anchor, "if not isinstance(kind, str):", 1)
+
+    cfg = _copy_serve_tree(tmp_path, mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL022"]
+    assert hits and any("KNOWN_KINDS" in f.message for f in hits)
+
+
+def test_spl019_fires_when_publish_dir_fsync_reverted(tmp_path):
+    """Reverting the PR 17 durability fix — publish_bytes' post-rename
+    directory fsync — must trip SPL019 on the helper itself: without
+    the barrier the rename can be lost on power failure after the
+    caller was acknowledged (the crash-point checker's rename-lost
+    states show the resulting data loss dynamically)."""
+    pkg = tmp_path / "splatt_tpu"
+    (pkg / "utils").mkdir(parents=True)
+    (pkg / "serve.py").write_text(
+        (REPO / "splatt_tpu" / "serve.py").read_text())
+    src = (REPO / "splatt_tpu" / "utils" / "durable.py").read_text()
+    anchor = ("        os.replace(tmp, path)\n"
+              "        if fsync:\n"
+              "            _fsync_dir(path)")
+    assert anchor in src, "durable.py publish_bytes anchor drifted"
+    (pkg / "utils" / "durable.py").write_text(
+        src.replace(anchor, "        os.replace(tmp, path)", 1))
+    cfg = _cfg()
+    cfg.root = tmp_path
+    cfg.paths = ["splatt_tpu"]
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL019"
+            and f.path.endswith("durable.py")]
+    assert hits and any("publish_bytes" in f.message for f in hits)
+
+
 def test_shared_state_config_is_well_formed():
     """Every [tool.splint] shared-state / hot-lock-paths entry parses
     and points at a real file (a typo'd map silently unguards)."""
@@ -1117,13 +1178,25 @@ def test_cli_explain_prints_doc_and_fixtures():
 
 def test_full_tree_run_stays_fast():
     """The splint pass rides in tier-1 on every pytest run: a full-tree
-    analysis (all rules, dataflow included) must stay well under 10 s
-    or the gate starts costing more than it protects."""
+    analysis (all rules, the dataflow passes, AND the v4 durability
+    rules) plus one full crash-point enumeration must stay well under
+    12 s or the gate starts costing more than it protects.  The
+    crash-state count is bounded here too: the checker's cost is
+    linear in enumerated states, so an accidental combinatorial
+    blow-up (a new init x op product) fails this gate before it
+    swamps CI."""
+    from tools.splint.crashpoint import run_crash_check
+
     baseline = load_baseline(REPO / "tools" / "splint" / "baseline.json")
     t0 = time.perf_counter()
     run(_cfg(), baseline=baseline)
+    crash = run_crash_check()
     elapsed = time.perf_counter() - t0
-    assert elapsed < 10.0, f"full-tree splint run took {elapsed:.1f}s"
+    assert crash.states <= 64, (
+        f"crash-point enumeration grew to {crash.states} states — "
+        f"bound it or move the new protocol to the slow tier")
+    assert elapsed < 12.0, (
+        f"full-tree splint + crash-point run took {elapsed:.1f}s")
 
 
 def test_env_docs_render():

@@ -47,9 +47,9 @@ class Config:
     #: the resilience module declaring RUN_REPORT_EVENTS (SPL012)
     resilience_module: str = "splatt_tpu/resilience.py"
     #: the trace module declaring the SPANS name registry (SPL013)
-    #: and the METRICS registry (SPL019)
+    #: and the METRICS registry (SPL024)
     trace_module: str = "splatt_tpu/trace.py"
-    #: the markdown file whose metrics table SPL019 checks against
+    #: the markdown file whose metrics table SPL024 checks against
     #: trace.METRICS in both directions ("" disables the docs legs)
     metrics_doc: str = "docs/observability.md"
     #: functions returning shared-cache file paths; values derived
@@ -73,6 +73,39 @@ class Config:
     #: blocking call (fsync/flock/sleep/join/wait/subprocess, directly
     #: or transitively) made while an in-process lock is held
     hot_lock_paths: List[str] = dataclasses.field(default_factory=list)
+    #: path fragments naming the durable roots (journal, ckpt, stamp,
+    #: lease, result, metrics ...) — a write-mode open whose path
+    #: expression carries one of these is a durable write (SPL023)
+    durable_roots: List[str] = dataclasses.field(default_factory=list)
+    #: the atomic-publish subset of the durable helpers whose bodies
+    #: SPL019 audits for the full tmp-write → fsync → os.replace →
+    #: parent-dir-fsync protocol, in order
+    atomic_publish_helpers: List[str] = dataclasses.field(
+        default_factory=list)
+    #: every function ("relpath::name") allowed to append to the job
+    #: journal — SPL020 flags appends anywhere else
+    journal_append_functions: List[str] = dataclasses.field(
+        default_factory=list)
+    #: the subset of journal-append functions whose terminal appends
+    #: must be dominated by a live-lease fence (SPL020)
+    lease_fenced_functions: List[str] = dataclasses.field(
+        default_factory=list)
+    #: call names that constitute a live-lease fence (SPL020)
+    lease_fence_calls: List[str] = dataclasses.field(default_factory=list)
+    #: call names that advance the generation stamp (SPL021 leg A)
+    stamp_advance_calls: List[str] = dataclasses.field(
+        default_factory=list)
+    #: call names that persist factor content a stamp covers (SPL021
+    #: leg A: one must dominate every stamp advance)
+    factor_persist_calls: List[str] = dataclasses.field(
+        default_factory=list)
+    #: commit-scope persists after which the stamp advance is
+    #: mandatory on every normal-flow path (SPL021 leg B)
+    commit_persist_calls: List[str] = dataclasses.field(
+        default_factory=list)
+    #: the serve module declaring TERMINAL and KNOWN_KINDS (SPL020,
+    #: SPL022)
+    serve_module: str = "splatt_tpu/serve.py"
     #: rules whose finding budget is ZERO — never baselined, never
     #: grandfathered; the pytest gate enforces each at 0 findings
     zero_rules: List[str] = dataclasses.field(default_factory=list)

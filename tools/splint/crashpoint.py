@@ -1,0 +1,856 @@
+"""Crash-point replay checker: exhaustive crash enumeration over the
+REAL durable-write protocols.
+
+The static rules (SPL019-SPL023, tools/splint/durability.py) prove the
+publish/fence/barrier SHAPE of the code.  This module proves the
+BEHAVIOR: it runs the actual production commit paths — cpd's
+checkpoint save, predict's generation-stamp advance, serve's journal
+append and result publish, fleet's lease state machine — under an
+instrumented os layer that can crash the process before (or torn,
+mid-way through) EVERY durable operation, then runs the real recovery
+readers (``Journal.replay``, ``load_model_generation``,
+``_load_model_tensor``, ``lease_of``, ``read_result``) against the
+surviving spool and asserts the soak invariants:
+
+  1. no accepted job is ever lost (a durably-appended journal record
+     survives every later crash);
+  2. no double-owner lineage (lease adoption strictly increases the
+     fencing generation; a deposed owner can never renew);
+  3. fenced reads never serve a factor/stamp mismatch — and a model
+     that was ever committed stays servable through any crash of a
+     LATER commit (availability of the last good generation);
+  4. REFUSED beats garbage: a reader faced with torn or corrupt state
+     refuses (or falls back to an intact generation) rather than
+     serving bytes that fail their own checksum.
+
+Crash model.  Durable state changes funnel through three chokepoints:
+``os.replace`` (every atomic publish and the checkpoint rotate),
+``durable.append_line`` (the journal), and ``os.unlink`` (lease
+release).  The instrument wraps all three plus ``durable._fsync_dir``.
+A run with ``crash_at=N`` raises before the N-th chokepoint executes;
+append chokepoints additionally get a TORN variant that writes the
+first half of the record with no newline — a dead writer's partial
+final line — before crashing.  Completed renames are tracked as
+VOLATILE until a directory fsync covers their parent; every crash
+state whose volatile set is non-empty spawns a ``rename-lost`` sibling
+where those renames are rolled back, modeling a power failure that
+discarded the un-fsynced directory-entry updates.  (A crash between a
+content fsync and its rename is reader-equivalent to crashing before
+the rename; the write ORDER itself is SPL019's job, enforced
+statically.)
+
+Mutants.  ``--mutant NAME`` re-runs the enumeration with one known
+protocol regression wired in; the checker must catch each with at
+least one violation (the test suite asserts this — it is the proof
+that the invariants have teeth):
+
+  stamp_first    fit commit advances the generation stamp BEFORE
+                 persisting factors (the SPL021 hazard);
+  no_heal        journal appends skip tail-healing, so an append
+                 after a torn tail merges into one garbage line;
+  adopt_same_gen lease adoption forgets the generation bump, so a
+                 takeover shares lineage with the deposed owner;
+  no_dir_fsync   directory fsyncs are dropped (the SPL019/SPL023
+                 hazard), so acknowledged renames can be lost.
+
+Exit status: with no mutant, 0 iff zero violations.  With a mutant,
+0 iff the mutant WAS caught (>=1 violation) — so both modes can gate
+CI.  Runs entirely under temp directories; stdlib + the production
+package only, imported at runtime (never by splint's static passes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+from typing import Callable, Dict, List, Optional, Tuple
+
+MODEL = "m1"
+JOB = "j1"
+MUTANTS = ("stamp_first", "no_heal", "adopt_same_gen", "no_dir_fsync")
+
+
+def _known_kinds() -> Tuple[str, ...]:
+    from splatt_tpu import serve
+
+    return tuple(serve.KNOWN_KINDS)
+
+
+def _windows() -> frozenset:
+    """The full crash-window vocabulary.  chaos.py's post-mortem
+    classifier (``_crash_windows_exercised``) emits ids from this set
+    — a test asserts the containment, keeping the static and dynamic
+    coverage planes comparable in one vocabulary."""
+    base = {
+        "stamp.publish", "stamp.bak.publish", "ckpt.rotate",
+        "ckpt.publish", "tensor.publish", "result.publish",
+        "lease.publish", "lease.release", "journal.append",
+        "journal.append.torn",
+    }
+    base.update(f"journal.append[{k}]" for k in _known_kinds())
+    return frozenset(base)
+
+
+# -- the instrumented os layer ----------------------------------------------
+
+
+class _Crash(BaseException):
+    """Raised at the chosen crash point.  BaseException so no
+    production ``except Exception`` recovery path can swallow the
+    simulated power failure."""
+
+
+def _classify_replace(dst: str) -> str:
+    b = os.path.basename(str(dst))
+    parent = os.path.basename(os.path.dirname(str(dst)))
+    if b.endswith(".gen.json.bak"):
+        return "stamp.bak.publish"
+    if b.endswith(".gen.json"):
+        return "stamp.publish"
+    if b.endswith(".model.npz"):
+        return "tensor.publish"
+    if b.endswith(".npz.bak"):
+        return "ckpt.rotate"
+    if b.endswith(".npz"):
+        return "ckpt.publish"
+    if parent == "results":
+        return "result.publish"
+    if parent == "leases":
+        return "lease.publish"
+    return f"publish[{b}]"
+
+
+def _read_or_none(path: str) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+class Instrument:
+    """Records every durable chokepoint the body reaches, crashes at
+    the requested one, and tracks renames whose directory entries have
+    not yet been fsynced (the volatile set a power failure may lose)."""
+
+    def __init__(self, crash_at: Optional[int] = None, torn: bool = False,
+                 no_dir_fsync: bool = False, heal_tail: bool = True):
+        self.crash_at = crash_at
+        self.torn = torn
+        self.no_dir_fsync = no_dir_fsync
+        self.heal_tail = heal_tail
+        self.ops: List[str] = []          # labels, in reach order
+        self.is_append: List[bool] = []   # parallel to ops
+        self.completed: List[Tuple[str, object]] = []
+        # (label, src, src_bytes, dst, dst_prev_bytes); dst_prev None
+        # means dst did not exist.  src None models a file CREATION
+        # (first journal append) rather than a rename.
+        self.volatile: List[Tuple] = []
+
+    def _reach(self, label: str, is_append: bool = False,
+               torn_fn: Optional[Callable[[], None]] = None) -> None:
+        self.ops.append(label)
+        self.is_append.append(is_append)
+        if self.crash_at is not None and len(self.ops) == self.crash_at:
+            if self.torn and torn_fn is not None:
+                torn_fn()
+            raise _Crash(label)
+
+
+def _revert_volatile(volatile: List[Tuple]) -> None:
+    """Roll back un-fsynced directory-entry updates, newest first —
+    the maximum-loss outcome of a power failure (the strongest
+    adversary; partial persistence is a subset of these states)."""
+    for label, src, src_bytes, dst, dst_prev in reversed(volatile):
+        if dst_prev is None:
+            with contextlib.suppress(OSError):
+                os.unlink(dst)
+        else:
+            with open(dst, "wb") as f:
+                f.write(dst_prev)
+        if src is not None and src_bytes is not None:
+            with open(src, "wb") as f:
+                f.write(src_bytes)
+
+
+@contextlib.contextmanager
+def _instrumented(ins: Instrument):
+    from splatt_tpu.utils import durable
+
+    real_replace = os.replace
+    real_unlink = os.unlink
+    real_fsync_dir = durable._fsync_dir
+    real_append = durable.append_line
+
+    def replace(src, dst, *a, **k):
+        label = _classify_replace(dst)
+        ins._reach(label)
+        src_bytes = _read_or_none(str(src))
+        dst_prev = _read_or_none(str(dst))
+        real_replace(src, dst, *a, **k)
+        ins.volatile.append((label, str(src), src_bytes, str(dst), dst_prev))
+        ins.completed.append((label, str(dst)))
+
+    def fsync_dir(path):
+        if ins.no_dir_fsync:
+            return  # mutant: the barrier is a no-op, renames stay volatile
+        d = os.path.dirname(os.path.abspath(str(path)))
+        ins.volatile = [
+            v for v in ins.volatile
+            if os.path.dirname(os.path.abspath(v[3])) != d
+        ]
+        real_fsync_dir(path)
+
+    def append(path, data, heal_tail=True, fsync=True, use_flock=True):
+        if not data.endswith(b"\n"):
+            data = data + b"\n"
+        try:
+            kind = str(json.loads(data.decode()).get("rec", ""))
+        except ValueError:
+            kind = ""
+        label = f"journal.append[{kind}]" if kind else "journal.append"
+
+        def torn():
+            # a dead writer's partial final line: half the record, no
+            # terminating newline
+            with open(path, "ab") as f:
+                f.write(data[: max(1, len(data) // 2)].rstrip(b"\n"))
+                f.flush()
+
+        fresh = not os.path.exists(path)
+        ins._reach(label, is_append=True, torn_fn=torn)
+        if fresh:
+            # first append CREATES the file: until the directory entry
+            # is fsynced the whole journal is volatile.  Registered
+            # BEFORE the real append so the helper's own internal
+            # directory fsync (patched above) clears it.
+            ins.volatile.append((label, None, None, str(path), None))
+        real_append(path, data, heal_tail=ins.heal_tail and heal_tail,
+                    fsync=fsync, use_flock=use_flock)
+        try:
+            rec = json.loads(data.decode())
+        except ValueError:
+            rec = None
+        ins.completed.append((label, rec))
+
+    def unlink(path, *a, **k):
+        p = str(path)
+        if (os.path.basename(os.path.dirname(p)) == "leases"
+                and p.endswith(".json")):
+            ins._reach("lease.release")
+            real_unlink(path, *a, **k)
+            ins.completed.append(("lease.release", p))
+            return
+        real_unlink(path, *a, **k)
+
+    os.replace = replace
+    os.unlink = unlink
+    durable._fsync_dir = fsync_dir
+    durable.append_line = append
+    try:
+        yield
+    finally:
+        os.replace = real_replace
+        os.unlink = real_unlink
+        durable._fsync_dir = real_fsync_dir
+        durable.append_line = real_append
+
+
+# -- protocol bodies ---------------------------------------------------------
+
+
+class VirtualClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def _factors(g: int):
+    import numpy as np
+
+    # float32 end-to-end, like production factors: the content sha is
+    # dtype-sensitive and load_checkpoint yields float32
+    U = [np.full((4, 2), float(g + m + 1), dtype=np.float32)
+         for m in range(2)]
+    lam = np.ones(2, dtype=np.float32)
+    return U, lam
+
+
+def _sha_of(g: int) -> str:
+    from splatt_tpu.cpd import factor_content_sha
+
+    U, lam = _factors(g)
+    return factor_content_sha(U, lam)
+
+
+def _commit(env: dict, g: int) -> None:
+    """One UNinstrumented, fully durable fit commit at generation g."""
+    from splatt_tpu.cpd import _save_checkpoint
+    from splatt_tpu.predict import advance_generation
+
+    U, lam = _factors(g)
+    _save_checkpoint(env["ckpt"], U, lam, it=g, fit=0.5)
+    advance_generation(env["root"], MODEL, U, lam)
+
+
+def _init_empty(env: dict) -> None:
+    env["committed_gen"] = 0
+    # the body's commit is the FIRST: advance_generation numbers it 1
+    env["sha_by_gen"] = {1: _sha_of(2)}
+    env["final_gen"] = 1
+
+
+def _init_committed(env: dict) -> None:
+    _commit(env, 1)
+    env["committed_gen"] = 1
+    env["sha_by_gen"] = {1: _sha_of(1), 2: _sha_of(2)}
+    env["final_gen"] = 2
+
+
+def _body_fit(env: dict) -> None:
+    from splatt_tpu.cpd import _save_checkpoint
+    from splatt_tpu.predict import advance_generation
+
+    U, lam = _factors(2)
+    if env["mutant"] == "stamp_first":
+        advance_generation(env["root"], MODEL, U, lam)
+        _save_checkpoint(env["ckpt"], U, lam, it=2, fit=0.9)
+    else:
+        _save_checkpoint(env["ckpt"], U, lam, it=2, fit=0.9)
+        advance_generation(env["root"], MODEL, U, lam)
+
+
+def _verify_model_plane(env: dict, state: str) -> List[Tuple[str, str]]:
+    from splatt_tpu.cpd import factor_content_sha
+    from splatt_tpu.predict import load_model_generation
+
+    v: List[Tuple[str, str]] = []
+    try:
+        out = load_model_generation(env["root"], MODEL)
+    except Exception as e:  # the fenced read must never raise
+        return [("refused-beats-garbage",
+                 f"fenced read raised {type(e).__name__}: {e}")]
+    if out is None:
+        if env.get("committed_gen", 0) >= 1:
+            v.append(("availability",
+                      "read REFUSED despite an intact committed "
+                      "generation existing before the crashed commit"))
+        elif state.startswith("complete") and "+rename-lost" not in state:
+            v.append(("availability",
+                      "commit completed (would be acknowledged) but "
+                      "the read refuses"))
+        return v
+    gen, sha = int(out["gen"]), str(out["sha"])
+    want = env["sha_by_gen"].get(gen)
+    if want is None or sha != want:
+        v.append(("stamp-factor-match",
+                  f"served gen {gen} under an unexpected stamp sha"))
+        return v
+    got = factor_content_sha(out["factors"], out["lam"])
+    if got != want:
+        v.append(("stamp-factor-match",
+                  f"served factors do not hash to their gen-{gen} "
+                  f"stamp sha"))
+    if state == "complete" and gen != env["final_gen"]:
+        v.append(("availability",
+                  f"commit completed but the read still serves gen "
+                  f"{gen}, not gen {env['final_gen']}"))
+    return v
+
+
+def _verify_fit(env: dict, ins: Instrument, state: str):
+    return _verify_model_plane(env, state)
+
+
+def _body_update(env: dict) -> None:
+    from splatt_tpu import serve
+    from splatt_tpu.coo import SparseTensor
+    from splatt_tpu.cpd import _save_checkpoint
+    from splatt_tpu.predict import advance_generation
+    import numpy as np
+
+    U, lam = _factors(2)
+    tt = SparseTensor(inds=np.zeros((3, 3), dtype=np.int64),
+                      vals=np.ones(3, dtype=np.float64),
+                      dims=(4, 4, 4))
+    # production order (serve._run_update): persist factors and the
+    # merged model tensor, THEN advance the stamp (SPL021's leg A)
+    _save_checkpoint(env["ckpt"], U, lam, it=2, fit=0.9)
+    serve._save_model_tensor(env["tpath"], tt, ["job-u1"])
+    advance_generation(env["root"], MODEL, U, lam)
+
+
+def _verify_update(env: dict, ins: Instrument, state: str):
+    from splatt_tpu import serve
+
+    v = _verify_model_plane(env, state)
+    try:
+        tt, applied = serve._load_model_tensor(env["tpath"])
+    except Exception as e:
+        return v + [("refused-beats-garbage",
+                     f"model-tensor read raised {type(e).__name__}: {e}")]
+    if tt is None:
+        if applied:
+            v.append(("refused-beats-garbage",
+                      "absent tensor returned non-empty applied ids"))
+    else:
+        if list(applied) != ["job-u1"]:
+            v.append(("stamp-factor-match",
+                      f"tensor served with wrong applied ids {applied!r}"))
+    return v
+
+
+def _init_corrupt_no_bak(env: dict) -> None:
+    _init_committed(env)
+    _shred(env["ckpt"])
+    # the only checkpoint is garbage: REFUSING is the correct outcome
+    env["committed_gen"] = 0
+
+
+def _init_corrupt_with_bak(env: dict) -> None:
+    _commit(env, 1)
+    _commit(env, 2)
+    _shred(env["ckpt"])
+    env["committed_gen"] = 1  # gen-1 .bak chain must still serve
+
+
+def _shred(path: str) -> None:
+    data = _read_or_none(path) or b"\x00" * 64
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])
+
+
+def _body_noop(env: dict) -> None:
+    pass
+
+
+def _verify_corrupt(env: dict, ins: Instrument, state: str):
+    from splatt_tpu.cpd import factor_content_sha
+    from splatt_tpu.predict import load_model_generation
+
+    v: List[Tuple[str, str]] = []
+    try:
+        out = load_model_generation(env["root"], MODEL)
+    except Exception as e:
+        return [("refused-beats-garbage",
+                 f"read over shredded checkpoint raised "
+                 f"{type(e).__name__}: {e}")]
+    if out is None:
+        if env["committed_gen"] >= 1:
+            v.append(("availability",
+                      "gen-1 .bak fallback chain exists but the read "
+                      "refused"))
+        return v
+    if env["committed_gen"] == 0:
+        v.append(("refused-beats-garbage",
+                  "served a model whose only checkpoint was shredded"))
+        return v
+    if int(out["gen"]) != 1:
+        v.append(("stamp-factor-match",
+                  f"expected the gen-1 fallback, served gen "
+                  f"{out['gen']}"))
+    elif factor_content_sha(out["factors"], out["lam"]) != _sha_of(1):
+        v.append(("stamp-factor-match",
+                  "fallback factors do not hash to their stamp sha"))
+    return v
+
+
+def _init_lease(env: dict) -> None:
+    from splatt_tpu.fleet import FleetMember
+
+    clk = VirtualClock()
+    env["clk"] = clk
+    env["A"] = FleetMember(env["root"], replica="A", lease_s=10.0,
+                           clock=clk)
+    env["B"] = FleetMember(env["root"], replica="B", lease_s=10.0,
+                           clock=clk)
+
+
+def _body_lease(env: dict) -> None:
+    import dataclasses as _dc
+
+    A, B, clk = env["A"], env["B"], env["clk"]
+    assert A.acquire(JOB)
+    clk.advance(1.0)
+    assert A.renew(JOB)
+    clk.advance(100.0)  # A's lease expires without a release
+    before = B.lease_of(JOB)
+    env["gen_before_adopt"] = before.gen if before is not None else 0
+    assert B.adopt(JOB)
+    if env["mutant"] == "adopt_same_gen":
+        # the modeled regression: a takeover that forgot the fencing
+        # generation bump, sharing lineage with the deposed owner
+        cur = B.lease_of(JOB)
+        demoted = _dc.replace(cur, gen=env["gen_before_adopt"])
+        B._write_lease(demoted)
+        with B._lock:
+            B._held[JOB] = demoted
+    env["adopt_returned"] = True
+    B.release(JOB)
+
+
+def _verify_lease(env: dict, ins: Instrument, state: str):
+    from splatt_tpu.fleet import FleetMember
+
+    v: List[Tuple[str, str]] = []
+    viewer = FleetMember(env["root"], replica="observer",
+                         clock=env["clk"])
+    lease = viewer.lease_of(JOB)
+    released = any(lbl == "lease.release" for lbl, _ in ins.completed)
+    if released and lease is not None:
+        v.append(("double-owner",
+                  "released lease still published"))
+    if env.get("adopt_returned") and lease is not None:
+        if lease.gen <= env["gen_before_adopt"]:
+            v.append(("double-owner",
+                      f"adoption did not advance the fencing "
+                      f"generation (gen {lease.gen} after adopting "
+                      f"gen {env['gen_before_adopt']})"))
+        elif lease.replica != "B":
+            v.append(("double-owner",
+                      f"adopted lease published for {lease.replica!r}"))
+    if env.get("adopt_returned") and env["A"].renew(JOB):
+        v.append(("double-owner",
+                  "deposed owner successfully renewed after adoption"))
+    return v
+
+
+def _journal_path(env: dict) -> str:
+    return os.path.join(env["root"], "journal.jsonl")
+
+
+def _init_journal_empty(env: dict) -> None:
+    pass
+
+
+def _body_journal(env: dict) -> None:
+    from splatt_tpu import serve
+
+    j = serve.Journal(_journal_path(env))
+    j.append({"rec": "accepted", "job": "j1", "spec": {"rank": 2}})
+    j.append({"rec": "started", "job": "j1"})
+    j.append({"rec": "done", "job": "j1", "status": "converged"})
+    j.append({"rec": "accepted", "job": "j2", "spec": {"rank": 2}})
+
+
+def _verify_journal(env: dict, ins: Instrument, state: str):
+    from splatt_tpu import serve
+    from splatt_tpu.utils import durable
+
+    v: List[Tuple[str, str]] = []
+    j = serve.Journal(_journal_path(env))
+    try:
+        recs, torn = j.replay()
+    except Exception as e:
+        return [("lost-job", f"replay raised {type(e).__name__}: {e}")]
+    seen = {(r.get("rec"), r.get("job")) for r in recs}
+    for lbl, rec in ins.completed:
+        if not lbl.startswith("journal.append") or rec is None:
+            continue
+        if (rec.get("rec"), rec.get("job")) not in seen:
+            v.append(("lost-job",
+                      f"durably appended {rec.get('rec')}/{rec.get('job')} "
+                      f"record missing after replay"))
+    # recovery leg: the NEXT append (post-restart) must survive a torn
+    # tail — under the no_heal mutant the heal is disabled here too
+    heal = env["mutant"] != "no_heal"
+    durable.append_line(
+        _journal_path(env),
+        json.dumps({"rec": "accepted", "job": "j3", "ts": 0}).encode(),
+        heal_tail=heal)
+    recs2, _ = j.replay()
+    if "j3" not in {r.get("job") for r in recs2}:
+        v.append(("lost-job",
+                  "append after the crash's torn tail was swallowed "
+                  "(tail healing broken)"))
+    return v
+
+
+def _init_terminal(env: dict) -> None:
+    from splatt_tpu import serve
+
+    os.makedirs(os.path.join(env["root"], "results"), exist_ok=True)
+    j = serve.Journal(_journal_path(env))
+    j.append({"rec": "accepted", "job": JOB, "spec": {"rank": 2}})
+    j.append({"rec": "started", "job": JOB})
+
+
+def _body_terminal(env: dict) -> None:
+    from splatt_tpu import serve
+    from splatt_tpu.utils.durable import publish_json
+
+    # serve's terminal commit order: publish the result payload, THEN
+    # journal DONE — a DONE record must always find its result
+    publish_json(os.path.join(env["root"], "results", f"{JOB}.json"),
+                 {"job": JOB, "status": "converged"})
+    j = serve.Journal(_journal_path(env))
+    j.append({"rec": "done", "job": JOB, "status": "converged"})
+
+
+def _verify_terminal(env: dict, ins: Instrument, state: str):
+    from splatt_tpu import serve
+
+    v: List[Tuple[str, str]] = []
+    recs, _ = serve.Journal(_journal_path(env)).replay()
+    kinds = {r.get("rec") for r in recs if r.get("job") == JOB}
+    if "accepted" not in kinds:
+        v.append(("lost-job",
+                  "the pre-crash accepted record vanished"))
+    res = serve.read_result(env["root"], JOB)
+    if "done" in kinds and res is None:
+        v.append(("lost-job",
+                  "terminal DONE journaled but its published result "
+                  "is gone — the job's outcome is lost"))
+    return v
+
+
+@dataclasses.dataclass
+class Protocol:
+    name: str
+    inits: Dict[str, Callable[[dict], None]]
+    body: Callable[[dict], None]
+    verify: Callable[[dict, Instrument, str], List[Tuple[str, str]]]
+    # expected op-label sequence per init (the explicit protocol
+    # model); discovery asserts the real code still matches it
+    expected: Dict[str, List[str]]
+
+
+def _protocols() -> List[Protocol]:
+    return [
+        Protocol(
+            name="fit_commit",
+            inits={"empty": _init_empty, "committed_gen1": _init_committed},
+            body=_body_fit,
+            verify=_verify_fit,
+            expected={
+                "empty": ["ckpt.publish", "stamp.publish"],
+                "committed_gen1": ["ckpt.rotate", "ckpt.publish",
+                                   "stamp.bak.publish", "stamp.publish"],
+            },
+        ),
+        Protocol(
+            name="update_commit",
+            inits={"committed_gen1": _init_committed},
+            body=_body_update,
+            verify=_verify_update,
+            expected={
+                "committed_gen1": ["ckpt.rotate", "ckpt.publish",
+                                   "tensor.publish", "stamp.bak.publish",
+                                   "stamp.publish"],
+            },
+        ),
+        Protocol(
+            name="torn_ckpt_read",
+            inits={"no_bak": _init_corrupt_no_bak,
+                   "with_bak": _init_corrupt_with_bak},
+            body=_body_noop,
+            verify=_verify_corrupt,
+            expected={"no_bak": [], "with_bak": []},
+        ),
+        Protocol(
+            name="lease",
+            inits={"fresh": _init_lease},
+            body=_body_lease,
+            verify=_verify_lease,
+            expected={
+                "fresh": ["lease.publish", "lease.publish",
+                          "lease.publish", "lease.release"],
+            },
+        ),
+        Protocol(
+            name="journal",
+            inits={"empty": _init_journal_empty},
+            body=_body_journal,
+            verify=_verify_journal,
+            expected={
+                "empty": ["journal.append[accepted]",
+                          "journal.append[started]",
+                          "journal.append[done]",
+                          "journal.append[accepted]"],
+            },
+        ),
+        Protocol(
+            name="terminal_commit",
+            inits={"accepted_started": _init_terminal},
+            body=_body_terminal,
+            verify=_verify_terminal,
+            expected={
+                "accepted_started": ["result.publish",
+                                     "journal.append[done]"],
+            },
+        ),
+    ]
+
+
+# -- the enumeration driver --------------------------------------------------
+
+
+@dataclasses.dataclass
+class Violation:
+    protocol: str
+    init: str
+    state: str
+    invariant: str
+    detail: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CrashCheckResult:
+    states: int = 0
+    ops_enumerated: int = 0
+    windows: List[str] = dataclasses.field(default_factory=list)
+    per_protocol: Dict[str, int] = dataclasses.field(default_factory=dict)
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return {
+            "states": self.states,
+            "ops_enumerated": self.ops_enumerated,
+            "windows": list(self.windows),
+            "per_protocol": dict(self.per_protocol),
+            "violations": [v.to_json() for v in self.violations],
+            "ok": self.ok,
+        }
+
+
+def _fresh_env(mutant: Optional[str]) -> dict:
+    root = tempfile.mkdtemp(prefix="crashpt-")
+    return {
+        "root": root,
+        "mutant": mutant,
+        "ckpt": os.path.join(root, f"{MODEL}.npz"),
+        "tpath": os.path.join(root, f"{MODEL}.model.npz"),
+    }
+
+
+def _run_state(proto: Protocol, init_name: str, mutant: Optional[str],
+               crash_at: Optional[int], torn: bool,
+               result: CrashCheckResult, seen_windows: set) -> Instrument:
+    """Run one crash state (and, if renames stayed volatile, its
+    rename-lost sibling) through init → instrumented body → verify."""
+    env = _fresh_env(mutant)
+    try:
+        proto.inits[init_name](env)
+        ins = Instrument(crash_at=crash_at, torn=torn,
+                         no_dir_fsync=(mutant == "no_dir_fsync"),
+                         heal_tail=(mutant != "no_heal"))
+        with _instrumented(ins):
+            try:
+                proto.body(env)
+            except _Crash:
+                pass
+        if crash_at is None:
+            state = "complete"
+        else:
+            state = f"crash@{crash_at}[{ins.ops[crash_at - 1]}]"
+            if torn:
+                state += "+torn"
+        seen_windows.update(ins.ops)
+        result.states += 1
+        result.per_protocol[proto.name] = (
+            result.per_protocol.get(proto.name, 0) + 1)
+        for invariant, detail in proto.verify(env, ins, state):
+            result.violations.append(Violation(
+                proto.name, init_name, state, invariant, detail))
+        if ins.volatile:
+            _revert_volatile(ins.volatile)
+            state += "+rename-lost"
+            result.states += 1
+            result.per_protocol[proto.name] += 1
+            for invariant, detail in proto.verify(env, ins, state):
+                result.violations.append(Violation(
+                    proto.name, init_name, state, invariant, detail))
+        return ins
+    finally:
+        shutil.rmtree(env["root"], ignore_errors=True)
+
+
+def run_crash_check(mutant: Optional[str] = None) -> CrashCheckResult:
+    if mutant is not None and mutant not in MUTANTS:
+        raise ValueError(f"unknown mutant {mutant!r}; one of {MUTANTS}")
+    result = CrashCheckResult()
+    seen_windows: set = set()
+    for proto in _protocols():
+        for init_name in proto.inits:
+            # discovery / complete run: the op trace IS the protocol
+            # model — drift from the expected sequence is a violation
+            # (a new durable op entered the path unreviewed, or one
+            # disappeared), asserted only unmutated since mutants
+            # drift by construction
+            ins = _run_state(proto, init_name, mutant, None, False,
+                             result, seen_windows)
+            if mutant is None and ins.ops != proto.expected[init_name]:
+                result.violations.append(Violation(
+                    proto.name, init_name, "discovery", "protocol-drift",
+                    f"durable-op trace {ins.ops} != modeled "
+                    f"{proto.expected[init_name]}"))
+            total = len(ins.ops)
+            result.ops_enumerated += total
+            for k in range(1, total + 1):
+                _run_state(proto, init_name, mutant, k, False,
+                           result, seen_windows)
+                if ins.is_append[k - 1]:
+                    _run_state(proto, init_name, mutant, k, True,
+                               result, seen_windows)
+    unknown = seen_windows - _windows()
+    if unknown:
+        result.violations.append(Violation(
+            "*", "*", "discovery", "protocol-drift",
+            f"ops outside the window vocabulary: {sorted(unknown)}"))
+    result.windows = sorted(seen_windows)
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.splint.crashpoint",
+        description="exhaustive crash-point replay check of the "
+                    "journal/lease/generation durable-write protocols")
+    p.add_argument("--mutant", choices=MUTANTS, default=None,
+                   help="wire in a known protocol regression; exit 0 "
+                        "iff the checker CATCHES it")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the machine-readable report")
+    args = p.parse_args(argv)
+    result = run_crash_check(mutant=args.mutant)
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        print(f"crashpoint: {result.states} states over "
+              f"{result.ops_enumerated} durable ops; "
+              f"{len(result.windows)} windows; "
+              f"{len(result.violations)} violation(s)")
+        for v in result.violations:
+            print(f"  {v.protocol}/{v.init} {v.state}: "
+                  f"[{v.invariant}] {v.detail}")
+    if args.mutant is not None:
+        if result.violations:
+            print(f"mutant {args.mutant!r} caught "
+                  f"({len(result.violations)} violation(s))")
+            return 0
+        print(f"mutant {args.mutant!r} NOT caught — the invariants "
+              f"have lost their teeth", file=sys.stderr)
+        return 1
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

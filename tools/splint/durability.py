@@ -1,0 +1,843 @@
+"""splint v4: crash-consistency protocol rules (SPL019–SPL023).
+
+The serve/fleet/predict planes rest on hand-maintained durability
+protocols — tmp-write + fsync + ``os.replace`` publishes, flock-
+serialized journal appends, lease-fenced terminal commits, generation
+stamps that advance atomically with factor checksums.  Until now the
+only enforcement was two SIGKILL chaos soaks, which SAMPLE a handful
+of crash windows per run.  These rules make the protocols structural:
+they run over the splint v2 CFG/def-use engine and fail the build on
+any ordering the protocol forbids, whether or not a soak ever lands a
+kill inside that window.
+
+Rules (all hard zero-rules — never baselined):
+
+SPL019 torn-publish
+    The sanctioned atomic-publish helpers (``[tool.splint]``
+    ``atomic-publish-helpers``) must contain the full protocol in
+    order — content fsync BEFORE the ``os.replace``, a parent-
+    directory fsync AFTER it, and no publish step on an exception
+    path.  Outside the helpers, any ``os.replace``/``os.rename``/
+    ``shutil.move`` whose source this same function wrote is an inline
+    publish bypassing the chokepoint (a torn-publish window splint
+    cannot audit).  Pure renames of pre-existing files are fine.
+
+SPL020 unfenced terminal commit
+    A terminal journal append (``done``/``failed``/``rejected``)
+    reachable without a DOMINATING live-lease renew is the PR 11
+    zombie window made static: a deposed replica can journal a
+    terminal record for a job a peer already adopted.  Every journal
+    append site must live in a function registered in
+    ``journal-append-functions``; terminal appends must additionally
+    be in ``lease-fenced-functions`` and be dominated (over normal AND
+    exception edges) by a call from ``lease-fence-calls``.
+
+SPL021 stamp-factor atomicity
+    A generation-stamp advance (``stamp-advance-calls``) not dominated
+    by a factor persist (``factor-persist-calls``) can stamp content
+    that was never written; a commit persist (``commit-persist-calls``)
+    with a normal-edge path to exit that skips the advance publishes
+    factors no stamp will ever fence.  Exception edges are exempt from
+    the second leg: a raise IS the crash the replay/refit repair paths
+    cover — the stamp correctly never moves.
+
+SPL022 replay totality
+    Every journal record kind emitted anywhere (``_rec(...)`` first
+    argument, resolved through constants and local assignments) must
+    be declared in serve's ``KNOWN_KINDS`` registry; every declared
+    kind must be emitted somewhere and exercised by at least one test
+    (the SPL006 shape, for the journal plane).  A kind splint cannot
+    resolve statically is itself a finding — replay totality that
+    cannot be audited is not totality.
+
+SPL023 fsync-barrier
+    A write-mode ``open`` whose path lands under a durable root
+    (``durable-roots`` fragments: journal, ckpt, stamp, lease, result,
+    metrics …) inside a function with no fsync and no sanctioned
+    helper call publishes bytes a post-crash reader may never see —
+    or worse, see torn.  Lock-sidecar files are exempt (their content
+    is meaningless; only their existence matters).
+
+The module deliberately imports ONLY from ``tools.splint.core`` so it
+can be loaded standalone (and by ``rules.py``) without import cycles.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.splint.core import (FileCtx, Finding, FunctionCFG, Project,
+                               walk_nodes)
+
+__all__ = [
+    "TornPublish", "UnfencedTerminalCommit", "StampFactorAtomicity",
+    "ReplayTotality", "FsyncBarrier", "DURABILITY_RULES",
+]
+
+
+# -- shared machinery --------------------------------------------------------
+
+class _DurabilityRule:
+    """Duck-typed splint rule (same interface as ``rules.Rule``)."""
+
+    id = "SPL?"
+    title = ""
+    hint = ""
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+    def finding(self, ctx_or_path, line: int, message: str) -> Finding:
+        path = (ctx_or_path.relpath if isinstance(ctx_or_path, FileCtx)
+                else ctx_or_path)
+        return Finding(self.id, path, line, message, hint=self.hint)
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen = set()
+    out = []
+    for f in findings:
+        k = (f.rule, f.path, f.line, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def _functions(tree: ast.AST):
+    for node in walk_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _last_seg(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _fn_calls(ctx: FileCtx, fn: ast.AST) -> List[Tuple[ast.Call, str]]:
+    """Every call in `fn` (nested defs included — conservative) with
+    its alias-resolved dotted name."""
+    out = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call):
+            out.append((n, ctx.resolve(n.func) or ""))
+    return out
+
+
+def _node_exprs(node) -> List[ast.AST]:
+    """The expressions a CFG node actually EVALUATES.  Branch-owning
+    nodes (``test``/``for``/``with``/``except``) hold the whole
+    compound statement in ``.stmt``; only the controlling expression
+    belongs to the node — the bodies are separate nodes."""
+    s = node.stmt
+    if s is None:
+        return []
+    if node.kind == "test":
+        return [s.test]
+    if node.kind == "for":
+        return [s.iter]
+    if node.kind == "with":
+        return [i.context_expr for i in s.items]
+    if node.kind == "except":
+        return [s.type] if getattr(s, "type", None) is not None else []
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return []  # nested scopes are opaque to this function's CFG
+    return [s]
+
+
+def _node_calls(ctx: FileCtx, node) -> List[Tuple[ast.Call, str]]:
+    out = []
+    for e in _node_exprs(node):
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call):
+                out.append((n, ctx.resolve(n.func) or ""))
+    return out
+
+
+def _dominators(cfg: FunctionCFG) -> List[Set[int]]:
+    """Iterative dominator sets: ``dom(n) = {n} ∪ ⋂ dom(pred)`` over
+    BOTH normal and exception edges.  A fence only counts if it sits
+    on EVERY path to the commit, including the path through a handler
+    — which is exactly what "dominates over all edges" means."""
+    n = len(cfg.nodes)
+    preds = cfg.preds()
+    every = set(range(n))
+    entry = cfg.nodes[0].idx
+    dom = [set(every) for _ in range(n)]
+    dom[entry] = {entry}
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n):
+            if i == entry:
+                continue
+            ps = [p for p, _exc in preds.get(i, [])]
+            if not ps:
+                continue
+            new = set.intersection(*(dom[p] for p in ps))
+            new.add(i)
+            if new != dom[i]:
+                dom[i] = new
+                changed = True
+    return dom
+
+
+def _node_of(cfg: FunctionCFG, call: ast.Call) -> Optional[int]:
+    """The CFG node whose evaluated expressions contain `call` (by
+    object identity), or None (call inside a nested def)."""
+    for node in cfg.nodes:
+        for e in _node_exprs(node):
+            for n in ast.walk(e):
+                if n is call:
+                    return node.idx
+    return None
+
+
+def _local_assigns(fn: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name → assigned value expressions, this function's body only."""
+    out: Dict[str, List[ast.AST]] = {}
+    for s in ast.walk(fn):
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(s.value)
+        elif isinstance(s, ast.AnnAssign) and s.value is not None \
+                and isinstance(s.target, ast.Name):
+            out.setdefault(s.target.id, []).append(s.value)
+    return out
+
+
+def _path_tokens(expr: ast.AST, assigns: Dict[str, List[ast.AST]],
+                 depth: int = 1) -> Set[str]:
+    """The identifier/attribute/string-literal tokens a path expression
+    is built from, chasing function-local Name assignments one level
+    (``fp = os.path.join(ckdir, name)`` → tokens of BOTH args)."""
+    toks: Set[str] = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            toks.add(n.id)
+            if depth > 0:
+                for v in assigns.get(n.id, []):
+                    toks |= _path_tokens(v, assigns, depth - 1)
+        elif isinstance(n, ast.Attribute):
+            toks.add(n.attr)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            toks.add(n.value)
+        elif isinstance(n, ast.arg):
+            toks.add(n.arg)
+    return toks
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open()`` call, or None (default 'r' or
+    non-literal)."""
+    if len(call.args) > 1 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _is_write_mode(mode: Optional[str]) -> bool:
+    return bool(mode) and any(c in mode for c in "wax+")
+
+
+# -- journal record-kind resolution (SPL020/SPL022) --------------------------
+
+def _kind_values(ctx: FileCtx, fn: ast.AST, expr: ast.AST,
+                 depth: int = 2) -> Set[str]:
+    """Every string a kind-valued expression can evaluate to: literals,
+    module/function-level string constants, and (one chase) function-
+    local assignments (handles ``kind = FAILED if ... else DONE``)."""
+    kinds: Set[str] = set()
+    assigns = _local_assigns(fn)
+
+    def visit(e: ast.AST, d: int, seen: Set[str]) -> None:
+        if isinstance(e, ast.Constant):
+            if isinstance(e.value, str):
+                kinds.add(e.value)
+            return
+        if isinstance(e, ast.Name):
+            v = ctx.str_consts.get(e.id)
+            if v is not None:
+                kinds.add(v)
+                return
+            if d > 0 and e.id not in seen:
+                seen = seen | {e.id}
+                for val in assigns.get(e.id, []):
+                    visit(val, d - 1, seen)
+            return
+        if isinstance(e, ast.IfExp):
+            visit(e.body, d, seen)
+            visit(e.orelse, d, seen)
+            return
+        # compound fallback: any Name/const inside may carry the kind
+        for n in ast.walk(e):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                kinds.add(n.value)
+            elif isinstance(n, ast.Name):
+                v = ctx.str_consts.get(n.id)
+                if v is not None:
+                    kinds.add(v)
+
+    visit(expr, depth, set())
+    return kinds
+
+
+def _record_kinds(ctx: FileCtx, fn: ast.AST, expr: ast.AST,
+                  depth: int = 2) -> Set[str]:
+    """The journal record kinds a record-valued expression can carry:
+    ``self._rec(KIND, ...)`` first args, dict literals / ``dict()``
+    calls with a ``rec`` key, chased through local assignments
+    (handles ``acc = self._rec(ACCEPTED, ...)`` … ``append(acc)``)."""
+    kinds: Set[str] = set()
+    assigns = _local_assigns(fn)
+
+    def visit(e: ast.AST, d: int, seen: Set[str]) -> None:
+        found = False
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call):
+                name = _last_seg(ctx.resolve(n.func) or "")
+                if name == "_rec" and n.args:
+                    kinds.update(_kind_values(ctx, fn, n.args[0]))
+                    found = True
+                elif name == "dict":
+                    for kw in n.keywords:
+                        if kw.arg == "rec":
+                            kinds.update(_kind_values(ctx, fn, kw.value))
+                            found = True
+            elif isinstance(n, ast.Dict):
+                for k, v in zip(n.keys, n.values):
+                    if isinstance(k, ast.Constant) and k.value == "rec" \
+                            and v is not None:
+                        kinds.update(_kind_values(ctx, fn, v))
+                        found = True
+        if found or d <= 0:
+            return
+        for n in ast.walk(e):
+            if isinstance(n, ast.Name) and n.id not in seen:
+                for val in assigns.get(n.id, []):
+                    visit(val, d - 1, seen | {n.id})
+
+    visit(expr, depth, set())
+    return kinds
+
+
+def _is_journal_append(ctx: FileCtx, call: ast.Call) -> bool:
+    """``<something>.journal.append(...)`` or ``journal.append(...)``
+    — the Journal chokepoint, matched structurally."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "append"):
+        return False
+    v = f.value
+    if isinstance(v, ast.Attribute) and v.attr == "journal":
+        return True
+    if isinstance(v, ast.Name) and v.id == "journal":
+        return True
+    return False
+
+
+def _terminal_kinds(project: Project) -> Set[str]:
+    """Serve's ``TERMINAL`` tuple, names resolved through the module's
+    string constants.  Empty when the serve module is absent (fixture
+    mini-projects without a serve plane)."""
+    ctx = project.ctx_for(project.config.serve_module)
+    if ctx is None:
+        return set()
+    for node in walk_nodes(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "TERMINAL"
+                and isinstance(node.value, (ast.Tuple, ast.List))):
+            out = set()
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+                elif isinstance(e, ast.Name):
+                    v = ctx.str_consts.get(e.id)
+                    if v is not None:
+                        out.add(v)
+            return out
+    return set()
+
+
+def _declared_kinds(ctx: FileCtx) -> Dict[str, int]:
+    """Serve's ``KNOWN_KINDS`` registry → {kind: lineno}, names
+    resolved through string constants.  Empty when undeclared."""
+    for node in walk_nodes(ctx.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "KNOWN_KINDS"
+                and isinstance(node.value, (ast.Tuple, ast.List, ast.Set))):
+            out: Dict[str, int] = {}
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out[e.value] = e.lineno
+                elif isinstance(e, ast.Name):
+                    v = ctx.str_consts.get(e.id)
+                    if v is not None:
+                        out[v] = e.lineno
+            return out
+    return {}
+
+
+# -- SPL019 ------------------------------------------------------------------
+
+class TornPublish(_DurabilityRule):
+    """Durable publish outside — or violating — the sanctioned
+    tmp-write + fsync + ``os.replace`` + dir-fsync protocol.
+
+    Inside an ``atomic-publish-helpers`` function the steps must all
+    be present and ORDERED: content fsync strictly before the rename
+    (else a crash publishes unsynced bytes), a parent-directory fsync
+    after it (else the rename itself is volatile — the published file
+    can vanish on power loss), and no rename on an exception path
+    (exception handlers must clean up, never publish).  Outside the
+    helpers, renaming a file this same function wrote is an inline
+    re-implementation of the protocol that splint cannot audit — route
+    it through the helper."""
+
+    id = "SPL019"
+    title = "torn-publish: durable publish outside/violating the " \
+            "sanctioned atomic-publish protocol"
+    hint = ("publish through splatt_tpu.utils.durable.publish_file/"
+            "publish_bytes (tmp write → fsync → os.replace → parent-dir "
+            "fsync); never rename self-written files inline")
+
+    _RENAMES = {"os.replace", "os.rename", "shutil.move"}
+    _WRITER_CALLS = {"savez", "savez_compressed", "save"}
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        cfg = project.config
+        atomic = set(cfg.atomic_publish_helpers)
+        helpers = set(cfg.durable_write_helpers) | atomic
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            if fn.name in atomic:
+                out.extend(self._protocol(ctx, fn))
+            elif fn.name not in helpers:
+                out.extend(self._inline(ctx, fn))
+        return _dedupe(out)
+
+    def _protocol(self, ctx: FileCtx, fn: ast.AST) -> List[Finding]:
+        calls = _fn_calls(ctx, fn)
+        renames = [c for c, d in calls if d in self._RENAMES]
+        fsyncs = [c for c, d in calls if d == "os.fsync"]
+        dirsyncs = [c for c, d in calls if _last_seg(d) == "_fsync_dir"]
+        out: List[Finding] = []
+        if not renames:
+            out.append(self.finding(
+                ctx, fn.lineno,
+                f"sanctioned publish helper '{fn.name}' contains no "
+                f"atomic rename (os.replace) — its publishes are torn-"
+                f"writable in place"))
+            return out
+        first_r = min(c.lineno for c in renames)
+        last_r = max(c.lineno for c in renames)
+        if not any(c.lineno < first_r for c in fsyncs):
+            out.append(self.finding(
+                ctx, first_r,
+                f"'{fn.name}': no content fsync before the publish "
+                f"rename — a crash can publish unsynced (torn) bytes"))
+        if not any(c.lineno > last_r for c in dirsyncs) \
+                and not any(c.lineno > last_r for c in fsyncs):
+            out.append(self.finding(
+                ctx, last_r,
+                f"'{fn.name}': no parent-directory fsync after the "
+                f"rename — the publish itself is volatile and can be "
+                f"lost on power failure"))
+        # exception-path leg: a rename inside a handler/finally runs
+        # when the protocol already failed — steps reorder under crash
+        exc_calls: Set[int] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Try):
+                for region in list(n.handlers) + list(n.finalbody):
+                    for sub in ast.walk(region):
+                        if isinstance(sub, ast.Call):
+                            exc_calls.add(id(sub))
+        for c in renames:
+            if id(c) in exc_calls:
+                out.append(self.finding(
+                    ctx, c.lineno,
+                    f"'{fn.name}': publish rename on an exception path "
+                    f"— the protocol steps reorder or partially apply "
+                    f"under failure"))
+        return out
+
+    def _inline(self, ctx: FileCtx, fn: ast.AST) -> List[Finding]:
+        written: Set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                dotted = ctx.resolve(n.func) or ""
+                last = _last_seg(dotted)
+                if dotted == "open" and n.args \
+                        and _is_write_mode(_open_mode(n)):
+                    written |= {t for t in _path_tokens(n.args[0], {}, 0)
+                                if t.isidentifier()}
+                elif last in self._WRITER_CALLS and n.args:
+                    written |= {t for t in _path_tokens(n.args[0], {}, 0)
+                                if t.isidentifier()}
+                elif last == "dump" and len(n.args) > 1:
+                    written |= {t for t in _path_tokens(n.args[1], {}, 0)
+                                if t.isidentifier()}
+                elif isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in ("write_text", "write_bytes") \
+                        and isinstance(n.func.value, ast.Name):
+                    written.add(n.func.value.id)
+        if not written:
+            return []
+        out: List[Finding] = []
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) \
+                    and (ctx.resolve(n.func) or "") in self._RENAMES \
+                    and n.args:
+                src_names = {t for t in _path_tokens(n.args[0], {}, 0)
+                             if t.isidentifier()}
+                if src_names & written:
+                    out.append(self.finding(
+                        ctx, n.lineno,
+                        f"'{fn.name}' writes a file and renames it into "
+                        f"place inline — an unaudited publish protocol; "
+                        f"route it through the sanctioned durable helper"))
+        return out
+
+
+# -- SPL020 ------------------------------------------------------------------
+
+class UnfencedTerminalCommit(_DurabilityRule):
+    """Terminal journal append without a dominating live-lease fence.
+
+    A replica that lost its lease (GC pause, preemption stall) but
+    still runs can journal ``done``/``failed`` for a job a peer
+    already adopted — the zombie double-commit PR 11's dynamic fence
+    closes at runtime.  This rule makes the fence STRUCTURAL: every
+    journal append site must be registered; terminal appends must sit
+    in a lease-fenced function and be dominated, over normal and
+    exception edges alike, by a fence call (``renew``/
+    ``_renew_fence``) that proves the lease was live on this very
+    path."""
+
+    id = "SPL020"
+    title = "terminal journal append not dominated by a live-lease fence"
+    hint = ("guard the append with `if not self._renew_fence(jid): "
+            "return` (or an equivalent dominating fleet.renew) and "
+            "register the function in [tool.splint] "
+            "journal-append-functions / lease-fenced-functions")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        cfg = project.config
+        registered = set(cfg.journal_append_functions)
+        fenced = set(cfg.lease_fenced_functions)
+        fence_calls = set(cfg.lease_fence_calls)
+        if not registered and not fenced:
+            return []
+        terminal = _terminal_kinds(project)
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            sites = [c for c, _d in _fn_calls(ctx, fn)
+                     if _is_journal_append(ctx, c)]
+            if not sites:
+                continue
+            key = f"{ctx.relpath}::{fn.name}"
+            if key not in registered:
+                for c in sites:
+                    out.append(self.finding(
+                        ctx, c.lineno,
+                        f"journal append in unregistered function "
+                        f"'{fn.name}' — declare it in [tool.splint] "
+                        f"journal-append-functions so the fence audit "
+                        f"covers it"))
+                continue
+            if not terminal:
+                continue
+            term_sites = [c for c in sites if c.args
+                          and _record_kinds(ctx, fn, c.args[0]) & terminal]
+            if not term_sites:
+                continue
+            if key not in fenced:
+                for c in term_sites:
+                    out.append(self.finding(
+                        ctx, c.lineno,
+                        f"terminal journal append in '{fn.name}', which "
+                        f"is not a lease-fenced function — a deposed "
+                        f"replica can double-commit here"))
+                continue
+            g = FunctionCFG(fn)
+            dom = _dominators(g)
+            fence_nodes = {
+                node.idx for node in g.nodes
+                if any(_last_seg(d) in fence_calls
+                       for _c, d in _node_calls(ctx, node))}
+            for c in term_sites:
+                idx = _node_of(g, c)
+                dominated = idx is not None and any(
+                    d in fence_nodes for d in dom[idx] if d != idx)
+                if not dominated:
+                    out.append(self.finding(
+                        ctx, c.lineno,
+                        f"terminal journal append in '{fn.name}' is not "
+                        f"dominated by a live-lease fence "
+                        f"({'/'.join(sorted(fence_calls))}) — some path "
+                        f"reaches the commit without proving the lease "
+                        f"is still held"))
+        return _dedupe(out)
+
+
+# -- SPL021 ------------------------------------------------------------------
+
+class StampFactorAtomicity(_DurabilityRule):
+    """Generation-stamp advance and factor persist must travel
+    together on every path.
+
+    Leg A: an ``advance_generation`` call not dominated by a factor
+    persist can stamp (and thereby fence-approve) content that was
+    never written — readers verify the sha against STALE factors and
+    refuse, losing availability for a committed generation.  Leg B: a
+    commit persist (``commit-persist-calls``) from which exit is
+    reachable via normal edges without passing an advance publishes
+    factors no stamp will ever cover — permanently unservable.
+    Exception edges are exempt from leg B: a raise is the crash the
+    replay/refit paths already repair (the stamp correctly stays
+    put)."""
+
+    id = "SPL021"
+    title = "generation-stamp advance and factor persist not atomic " \
+            "on every path"
+    hint = ("persist the factors/model tensor first, then advance the "
+            "generation stamp, on the SAME straight-line path; never "
+            "return between them")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        cfg = project.config
+        adv = set(cfg.stamp_advance_calls)
+        persist = set(cfg.factor_persist_calls)
+        commit = set(cfg.commit_persist_calls)
+        if not adv or not persist:
+            return []
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            if fn.name in adv | persist | commit:
+                continue  # the helpers' own definitions
+            calls = _fn_calls(ctx, fn)
+            has_adv = any(_last_seg(d) in adv for _c, d in calls)
+            has_commit = any(_last_seg(d) in commit for _c, d in calls)
+            if not (has_adv or has_commit):
+                continue
+            g = FunctionCFG(fn)
+            dom = _dominators(g)
+            adv_nodes, persist_nodes, commit_nodes = set(), set(), set()
+            for node in g.nodes:
+                for _c, d in _node_calls(ctx, node):
+                    seg = _last_seg(d)
+                    if seg in adv:
+                        adv_nodes.add(node.idx)
+                    if seg in persist:
+                        persist_nodes.add(node.idx)
+                    if seg in commit:
+                        commit_nodes.add(node.idx)
+            for i in sorted(adv_nodes):
+                if not any(d in persist_nodes for d in dom[i] if d != i):
+                    out.append(self.finding(
+                        ctx, g.nodes[i].line,
+                        f"'{fn.name}': generation-stamp advance without "
+                        f"a dominating factor persist — some path stamps "
+                        f"content that was never written"))
+            for i in sorted(commit_nodes):
+                if self._exit_reachable_avoiding(g, i, adv_nodes):
+                    out.append(self.finding(
+                        ctx, g.nodes[i].line,
+                        f"'{fn.name}': commit persist with a normal-"
+                        f"flow path to exit that skips the generation-"
+                        f"stamp advance — the published factors are "
+                        f"unservable (no stamp will fence them)"))
+        return _dedupe(out)
+
+    @staticmethod
+    def _exit_reachable_avoiding(g: FunctionCFG, start: int,
+                                 avoid: Set[int]) -> bool:
+        exit_idx = g.nodes[1].idx
+        seen = set()
+        stack = [s for s in g.nodes[start].succs]
+        while stack:
+            i = stack.pop()
+            if i in seen or i in avoid:
+                continue
+            if i == exit_idx:
+                return True
+            seen.add(i)
+            stack.extend(g.nodes[i].succs)
+        return False
+
+
+# -- SPL022 ------------------------------------------------------------------
+
+class ReplayTotality(_DurabilityRule):
+    """Journal record kinds: emitted ↔ declared ↔ tested, both ways.
+
+    Serve declares its record vocabulary in ``KNOWN_KINDS`` (replay's
+    unknown-kind forward-compat gate keys off it).  Every ``_rec``
+    emission anywhere must resolve to declared kinds; a kind splint
+    cannot resolve statically is a finding in its own right.  In the
+    other direction, a declared kind nobody emits is dead vocabulary,
+    a registry nobody reads is decorative, and a kind no test
+    mentions has an untested replay path — the SPL006 shape applied
+    to the journal plane."""
+
+    id = "SPL022"
+    title = "journal record kind not declared/emitted/tested " \
+            "(replay totality)"
+    hint = ("declare every journal record kind in serve.KNOWN_KINDS, "
+            "emit kinds only through _rec with statically resolvable "
+            "names, and exercise each kind in at least one test")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        serve_ctx = project.ctx_for(project.config.serve_module)
+        if serve_ctx is None:
+            return []
+        declared = _declared_kinds(serve_ctx)
+        if not declared:
+            return []
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            if fn.name == "_rec":
+                continue  # the constructor itself takes the kind param
+            for call, dotted in _fn_calls(ctx, fn):
+                if _last_seg(dotted) != "_rec" or not call.args:
+                    continue
+                kinds = _kind_values(ctx, fn, call.args[0])
+                if not kinds:
+                    out.append(self.finding(
+                        ctx, call.lineno,
+                        f"journal record kind in '{fn.name}' is not "
+                        f"statically resolvable — replay totality "
+                        f"cannot be audited for this emission"))
+                    continue
+                for k in sorted(kinds):
+                    if k not in declared:
+                        out.append(self.finding(
+                            ctx, call.lineno,
+                            f"journal record kind '{k}' emitted in "
+                            f"'{fn.name}' is not declared in "
+                            f"serve.KNOWN_KINDS — replay will skip it "
+                            f"as unknown"))
+        return _dedupe(out)
+
+    def finalize(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        serve_ctx = project.ctx_for(cfg.serve_module)
+        if serve_ctx is None or serve_ctx not in project.files:
+            return []
+        declared = _declared_kinds(serve_ctx)
+        if not declared:
+            return []
+        out: List[Finding] = []
+        # registry consulted at all?
+        consulted = any(
+            isinstance(n, ast.Name) and n.id == "KNOWN_KINDS"
+            and isinstance(n.ctx, ast.Load)
+            for n in walk_nodes(serve_ctx.tree))
+        if not consulted:
+            out.append(self.finding(
+                serve_ctx, min(declared.values()),
+                "KNOWN_KINDS is declared but never consulted — the "
+                "replay unknown-kind gate does not exist"))
+        # emitted set across the whole project
+        emitted: Set[str] = set()
+        for ctx in project.files:
+            for fn in _functions(ctx.tree):
+                if fn.name == "_rec":
+                    continue
+                for call, dotted in _fn_calls(ctx, fn):
+                    if _last_seg(dotted) == "_rec" and call.args:
+                        emitted |= _kind_values(ctx, fn, call.args[0])
+        for k, line in sorted(declared.items()):
+            if k not in emitted:
+                out.append(self.finding(
+                    serve_ctx, line,
+                    f"journal record kind '{k}' is declared in "
+                    f"KNOWN_KINDS but never emitted anywhere"))
+        # tested leg: each kind quoted (or its constant NAME used) in
+        # at least one test file
+        tests = project.test_ctxs()
+        if tests:
+            const_names: Dict[str, List[str]] = {}
+            for name, val in serve_ctx.str_consts.items():
+                const_names.setdefault(val, []).append(name)
+            for k, line in sorted(declared.items()):
+                needles = [f'"{k}"', f"'{k}'"]
+                needles += const_names.get(k, [])
+                if not any(any(nd in t.source for nd in needles)
+                           for t in tests):
+                    out.append(self.finding(
+                        serve_ctx, line,
+                        f"journal record kind '{k}' is exercised by no "
+                        f"test — its replay path is unverified"))
+        return _dedupe(out)
+
+
+# -- SPL023 ------------------------------------------------------------------
+
+class FsyncBarrier(_DurabilityRule):
+    """Durable write with no fsync barrier before a cross-process read.
+
+    A write-mode ``open`` on a path under a durable root, in a
+    function that neither fsyncs nor delegates to a sanctioned durable
+    helper, leaves bytes the page cache may never flush: the writer
+    reports success, the process dies, and the post-crash reader —
+    replay, a fleet peer, the fenced predict path — sees nothing, or
+    a torn prefix.  Lock sidecars are exempt (only their existence
+    matters, and flock state dies with the process anyway)."""
+
+    id = "SPL023"
+    title = "durable write without an fsync barrier on the write side"
+    hint = ("route the write through splatt_tpu.utils.durable "
+            "(publish_* / append_line), or fsync before any cross-"
+            "process reader can depend on the bytes")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        cfg = project.config
+        helpers = set(cfg.durable_write_helpers) \
+            | set(cfg.atomic_publish_helpers)
+        roots = [r.lower() for r in cfg.durable_roots]
+        if not roots:
+            return []
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            if fn.name in helpers:
+                continue
+            calls = _fn_calls(ctx, fn)
+            if any(d == "os.fsync" or _last_seg(d) == "_fsync_dir"
+                   for _c, d in calls):
+                continue  # the function carries its own barrier
+            assigns = _local_assigns(fn)
+            for call, dotted in calls:
+                if dotted != "open" or not call.args:
+                    continue
+                if not _is_write_mode(_open_mode(call)):
+                    continue
+                toks = {t.lower()
+                        for t in _path_tokens(call.args[0], assigns, 1)}
+                if any("lock" in t for t in toks):
+                    continue
+                hit = sorted({r for r in roots
+                              for t in toks if r in t})
+                if hit:
+                    out.append(self.finding(
+                        ctx, call.lineno,
+                        f"'{fn.name}' writes a durable path "
+                        f"({'/'.join(hit)}) with no fsync barrier — a "
+                        f"crash can lose or tear bytes a cross-process "
+                        f"reader depends on"))
+        return _dedupe(out)
+
+
+DURABILITY_RULES = [TornPublish(), UnfencedTerminalCommit(),
+                    StampFactorAtomicity(), ReplayTotality(),
+                    FsyncBarrier()]

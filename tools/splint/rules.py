@@ -1281,10 +1281,10 @@ class SpanNameDrift(Rule):
         return out
 
 
-# -- SPL019 -----------------------------------------------------------------
+# -- SPL024 -----------------------------------------------------------------
 
 #: the metric-recording verbs, each bound to the one sample type it
-#: may record (trace.py raises on the mismatch at runtime; SPL019
+#: may record (trace.py raises on the mismatch at runtime; SPL024
 #: catches it before anything runs)
 _METRIC_FNS = {"metric_inc": "counter", "metric_set": "gauge",
                "metric_observe": "histogram"}
@@ -1352,7 +1352,7 @@ class MetricNameDrift(Rule):
     Prometheus surface that dashboards and the fleet aggregator are
     built on (docs/observability.md)."""
 
-    id = "SPL019"
+    id = "SPL024"
     title = "metric-name drift against trace.py:METRICS / the docs table"
     hint = ("declare the metric (name -> (type, doc)) in "
             "splatt_tpu/trace.py:METRICS and add its row to the docs "
@@ -1980,6 +1980,12 @@ def _dedupe(findings: List[Finding]) -> List[Finding]:
     return out
 
 
+# the crash-consistency protocol rules (SPL019-SPL023) live in their
+# own module; it imports only from core, so this import is cycle-free
+from tools.splint.durability import (ReplayTotality,  # noqa: E402
+                                     FsyncBarrier, StampFactorAtomicity,
+                                     TornPublish, UnfencedTerminalCommit)
+
 RULES: List[Rule] = [
     RawEnvironAccess(),
     BroadExceptSwallows(),
@@ -2000,4 +2006,9 @@ RULES: List[Rule] = [
     DurabilityProtocolDrift(),
     BlockingCallUnderLock(),
     ContextvarLeak(),
+    TornPublish(),
+    UnfencedTerminalCommit(),
+    StampFactorAtomicity(),
+    ReplayTotality(),
+    FsyncBarrier(),
 ]
