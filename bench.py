@@ -110,6 +110,11 @@ SHAPES = {
 SCENARIO_SHAPES = {
     "powerlaw": (131072, 4096, 128),
     "amazon-like": (48212, 17742, 18051),
+    # one near-dense mode (docs/dense.md): at the default densemode
+    # nnz the mode-0 unfolding fills past the dense threshold while
+    # the tensor stays sparse by COO standards — the workload class
+    # the dense tile layout + MXU engine exist for
+    "densemode": (24, 256, 512),
 }
 
 #: per-mode zipf exponents of the amazon-like scenario: reviews/user
@@ -173,9 +178,12 @@ def scenario_tensor(scenario: str, shape: str, nnz: int, seed: int):
         return (synthetic_zipf(SCENARIO_SHAPES["amazon-like"], nnz,
                                seed=seed, exponents=_AMAZON_EXPONENTS),
                 "Amazon-like review-tensor", "amazon-like")
+    if scenario == "densemode":
+        return (synthetic_tensor(SCENARIO_SHAPES["densemode"], nnz, seed),
+                "dense-mode", "densemode")
     raise ValueError(
         f"unknown SPLATT_BENCH_SCENARIO {scenario!r}; want uniform, "
-        f"zipf:<a>, powerlaw, amazon-like or batched")
+        f"zipf:<a>, powerlaw, amazon-like, densemode or batched")
 
 
 def _timing_cv(times) -> float:
@@ -562,6 +570,15 @@ def _bench_regressions(rec: dict, prior: dict,
     theirs_gb = prior.get("model_gb_per_path") or {}
     for path in sorted(set(mine_gb) & set(theirs_gb)):
         pairs.append((f"bytes:{path}", mine_gb[path], theirs_gb[path],
+                      None, None))
+    # modeled flops per path (docs/dense.md): work amplification the
+    # bytes model cannot see — a dispatch change that silently
+    # re-inflates padded MACs is a regression; deterministic, never
+    # noisy (the bytes-legs contract)
+    mine_f = rec.get("model_gflops_per_path") or {}
+    theirs_f = prior.get("model_gflops_per_path") or {}
+    for path in sorted(set(mine_f) & set(theirs_f)):
+        pairs.append((f"flops:{path}", mine_f[path], theirs_f[path],
                       None, None))
     # achieved balance per path (docs/layout-balance.md): the one-hot
     # work amplification of the built layouts — a packing/reorder
@@ -1163,12 +1180,16 @@ def main(gate: bool = False) -> None:
 
     results = {}
     default_paths = "blocked,balanced,compact,tuned,stream"
+    if scen_label == "densemode":
+        # the densemode scenario exists to A/B the hybrid dense-tile
+        # dispatch against the sparse rows (docs/dense.md)
+        default_paths = "blocked,compact,dense,tuned,stream"
     raw_paths = [p.strip() for p in
                  os.environ.get("SPLATT_BENCH_PATHS",
                                 default_paths).split(",") if p.strip()]
     paths = [p for p in raw_paths
              if p in ("blocked", "balanced", "compact", "stream",
-                      "tuned")]
+                      "tuned", "dense")]
     if paths != raw_paths:
         # keep the valid subset rather than silently re-enabling the
         # slow paths the caller asked to skip — inside a hard-timeout
@@ -1213,6 +1234,12 @@ def main(gate: bool = False) -> None:
     path_gb = {}
     path_decode = {}
     path_fmt = {}
+    # per-path modeled FLOPs (bench_algs.mttkrp_flops): the compute
+    # half of the roofline — beside the bytes-only model, it is what
+    # separates the dense MXU path (high intensity) from the
+    # bandwidth-bound sparse rows (docs/dense.md).  flops:<path> gate
+    # legs, like bytes:<path>.
+    path_flops = {}
     # per-path achieved balance (docs/layout-balance.md): max/mean nnz
     # and row span per block (worst layout) + the summed one-hot work
     # amplification — the quantities the balanced packing improves,
@@ -1224,7 +1251,8 @@ def main(gate: bool = False) -> None:
 
     def note_format(label, X, pallas=None):
         from splatt_tpu.bench_algs import (mttkrp_bytes_encoded,
-                                           mttkrp_decode_bytes)
+                                           mttkrp_decode_bytes,
+                                           mttkrp_flops)
         from splatt_tpu.ops.mttkrp import plan_mttkrp
 
         # `pallas` overrides the run-wide engine family for paths that
@@ -1250,21 +1278,29 @@ def main(gate: bool = False) -> None:
         path_decode[label] = (round(gb / enc_gb, 3) if enc_gb > 0
                               else 1.0)
         path_fmt[label] = X.format_summary()
+        path_flops[label] = round(
+            sum(mttkrp_flops(alg, X, rank, m)
+                for m in range(X.nmodes)) / 1e9, 4)
+        # dense tile layouts have no nnz stream to balance — a
+        # fully-dense hybrid has no imbalance row (and no balance leg)
         per_mode = X.imbalance()
-        path_imb[label] = dict(
-            block_nnz_max_mean=max(d["block_nnz_max_mean"]
-                                   for d in per_mode.values()),
-            span_max_mean=max(d["span_max_mean"]
-                              for d in per_mode.values()),
-            work_amp=round(sum(d["work_amp"]
-                               for d in per_mode.values()), 2),
-            packing=sorted({d["packing"] for d in per_mode.values()}))
+        if per_mode:
+            path_imb[label] = dict(
+                block_nnz_max_mean=max(d["block_nnz_max_mean"]
+                                       for d in per_mode.values()),
+                span_max_mean=max(d["span_max_mean"]
+                                  for d in per_mode.values()),
+                work_amp=round(sum(d["work_amp"]
+                                   for d in per_mode.values()), 2),
+                packing=sorted({d["packing"] for d in per_mode.values()}))
+        bal = (f"; balance: block nnz max/mean "
+               f"{path_imb[label]['block_nnz_max_mean']}, one-hot work "
+               f"x{path_imb[label]['work_amp']}/nnz"
+               if label in path_imb else "")
         note(f"format[{label}]: {path_fmt[label]} -> "
              f"{path_gb[label]} GB/iter (achieved bytes; decode "
-             f"overhead x{path_decode[label]}); balance: "
-             f"block nnz max/mean "
-             f"{path_imb[label]['block_nnz_max_mean']}, one-hot work "
-             f"x{path_imb[label]['work_amp']}/nnz")
+             f"overhead x{path_decode[label]}), "
+             f"{path_flops[label]} GFLOP/iter{bal}")
 
     def record_failure(label, e):
         from splatt_tpu import resilience
@@ -1334,6 +1370,24 @@ def main(gate: bool = False) -> None:
         except Exception as e:
             record_failure("compact", e)
         release()
+    if "dense" in paths:
+        # the hybrid dense-mode row (docs/dense.md): same sweep, modes
+        # whose padded density crosses the threshold ride the dense
+        # tile layout + MXU matmul engines, the rest keep the sparse
+        # blocked path — zero index bytes on the dense modes is the
+        # whole bet, and the bytes:dense gate leg holds it
+        try:
+            note("building hybrid dense-mode layouts")
+            opts_d = Options(random_seed=7, verbosity=Verbosity.NONE,
+                             val_dtype=bench_dtype, use_pallas=use_pallas,
+                             block_alloc=alloc, autotune=False,
+                             dense="auto")
+            X = BlockedSparse.from_coo(tt, opts_d)
+            note_format("dense", X)
+            results["dense"] = run(X)
+        except Exception as e:
+            record_failure("dense", e)
+        release()
     tuned_plan_info = None
     if "tuned" in paths:
         # the autotuned row: measure candidate plans (or hit the warm
@@ -1345,9 +1399,14 @@ def main(gate: bool = False) -> None:
 
             from splatt_tpu import tune as _tune
 
+            # on the densemode scenario the dense tile candidates join
+            # the tuner's matrix (docs/dense.md) — the hybrid verdict
+            # is measured, not assumed
             topts = Options(random_seed=7, verbosity=Verbosity.NONE,
                             val_dtype=bench_dtype, use_pallas=use_pallas,
-                            block_alloc=alloc, autotune=True)
+                            block_alloc=alloc, autotune=True,
+                            dense=("auto" if scen_label == "densemode"
+                                   else None))
             note(f"autotuning (plan cache: {_tune.cache_path()})")
             tres = _tune.tune(tt, rank=rank, opts=topts)
             if tres.measured == 0 and tres.plans:
@@ -1507,6 +1566,18 @@ def main(gate: bool = False) -> None:
                 k: round(path_gb[k] / results[k]["median"], 1)
                 for k in path_gb if k in results}
             rec["format"] = dict(path_fmt)
+        if path_flops:
+            # the compute half of the roofline (docs/dense.md): modeled
+            # GFLOP/iteration per path and the intensity-vs-ridge
+            # verdict — the flops:<path> gate legs read the former, a
+            # reader takes the bound classification from the latter
+            from splatt_tpu.bench_algs import roofline_verdict
+
+            rec["model_gflops_per_path"] = dict(path_flops)
+            rec["roofline_verdict"] = {
+                k: roofline_verdict(path_gb[k] * 1e9,
+                                    path_flops[k] * 1e9)
+                for k in path_flops if k in path_gb}
         peak = hbm_peak_gbs()
         if peak:
             rec["hbm_peak_pct"] = round(100 * gb / sec_per_iter / peak, 1)

@@ -261,6 +261,16 @@ def mttkrp_bytes_encoded(alg: str, X: BlockedSparse, rank: int, mode: int,
     acc = 4  # f32 accumulator width
     out = X.dims[mode] * rank * acc
     streams = lay.storage_bytes()     # encoded idx + bases + vals + starts
+    if getattr(lay, "encoding", "v1") == "dense":
+        # dense tile layout (docs/dense.md): value tiles + pad mask
+        # stream once (storage_bytes — ZERO index bytes, the point of
+        # the format), the non-mode factor tables stream once into the
+        # Khatri-Rao operand, and the KR matrix (span x R) is
+        # materialized (write + read)
+        tables = sum(d * rank * factor_itemsize
+                     for k, d in enumerate(X.dims) if k != mode)
+        kr = 2 * lay.span * rank * factor_itemsize
+        return streams + tables + kr + out
     rows = (nmodes - 1) * nnz * rank * factor_itemsize
     if alg in ("blocked", "blocked_pallas"):
         partials = 2 * lay.nblocks * lay.seg_width * rank * acc
@@ -292,7 +302,7 @@ def mttkrp_decode_bytes(X: BlockedSparse, rank: int, mode: int,
     from splatt_tpu.utils.env import ceil_to
 
     lay = X.layout_for(mode)
-    if (getattr(lay, "encoding", "v1") == "v1"
+    if (getattr(lay, "encoding", "v1") in ("v1", "dense")
             or engine in STREAM_NATIVE_ENGINES or engine == "native"):
         return 0.0
     decoded = 2.0 * lay.nmodes * lay.nnz_pad * 4   # i32 write + read
@@ -304,6 +314,77 @@ def mttkrp_decode_bytes(X: BlockedSparse, rank: int, mode: int,
                 ck = -(-b_pad // d_pad)
                 decoded += 2.0 * lay.nblocks * ck * 8 * d_pad * 4
     return decoded
+
+
+#: MXU peak compute by device-kind prefix (bf16 GFLOP/s per chip).
+#: Sources: public TPU spec sheets (v4 275 TFLOPS, v5e 197, v5p 459,
+#: v6e "Trillium" 918).  The same prefix-match contract as
+#: :data:`HBM_PEAK_GBS`.
+MXU_PEAK_GFLOPS = (("TPU v6", 918000.0), ("TPU v5p", 459000.0),
+                   ("TPU v5", 197000.0), ("TPU v4", 275000.0),
+                   ("TPU v3", 123000.0), ("TPU v2", 45000.0))
+
+#: nominal CPU peaks for the roofline VERDICT off-TPU (docs/dense.md):
+#: the bound classification (memory- vs compute-bound) only needs the
+#: ridge's order of magnitude, and CI runs the densemode bench on CPU —
+#: a missing peak would silence the verdict legs exactly where they
+#: gate.
+NOMINAL_CPU_GBS = 50.0
+NOMINAL_CPU_GFLOPS = 100.0
+
+
+def mxu_peak_gflops() -> Optional[float]:
+    """Peak MXU compute of device 0 (bf16 GFLOP/s), or None off-TPU."""
+    try:
+        kind = jax.devices()[0].device_kind
+    # splint: ignore[SPL002] device discovery off-accelerator: absence
+    # of a backend is the signal (no roofline), not a failure to route
+    except Exception:
+        return None
+    for prefix, gflops in MXU_PEAK_GFLOPS:
+        if kind.startswith(prefix):
+            return gflops
+    return None
+
+
+def mttkrp_flops(alg: str, X: BlockedSparse, rank: int,
+                 mode: int) -> float:
+    """First-order flop count of one MTTKRP over a compiled
+    :class:`BlockedSparse` — the compute half of the roofline model
+    (docs/dense.md) beside the bytes-only :func:`mttkrp_bytes_encoded`.
+
+    - dense tile layout: the batched matmul's MACs over the PADDED
+      cell space (2 * cells * R — pad rows are real MXU work, which is
+      exactly why the verdict thresholds padded density) plus the
+      Khatri-Rao build (span * R multiplies);
+    - sparse paths: one Hadamard chain + accumulate per nonzero per
+      rank column (2 * nnz * R * (nmodes-1)), plus the one-hot
+      expansion's dense MACs (2 * nblocks * S * block * R) for the
+      one-hot algorithms — work amplification the bytes model cannot
+      see.
+    """
+    lay = X.layout_for(mode)
+    if getattr(lay, "encoding", "v1") == "dense":
+        geo = lay.geometry
+        return 2.0 * geo.cells * rank + geo.span * rank
+    flops = 2.0 * lay.nnz * rank * (lay.nmodes - 1)
+    if alg in ("blocked", "blocked_pallas"):
+        flops += 2.0 * lay.nblocks * lay.seg_width * lay.block * rank
+    return flops
+
+
+def roofline_verdict(bytes_moved: float, flops: float) -> dict:
+    """Classify one path against the device roofline: arithmetic
+    intensity (flops/byte), the device ridge point (peak flops / peak
+    bandwidth), and which side of it the path sits on.  Off-TPU the
+    NOMINAL CPU peaks stand in — the bound verdict is an order-of-
+    magnitude classification, not a measurement (docs/dense.md)."""
+    peak_bw = hbm_peak_gbs() or NOMINAL_CPU_GBS
+    peak_fl = mxu_peak_gflops() or NOMINAL_CPU_GFLOPS
+    intensity = flops / max(bytes_moved, 1.0)
+    ridge = peak_fl / peak_bw
+    return dict(intensity=round(intensity, 3), ridge=round(ridge, 3),
+                bound=("compute" if intensity >= ridge else "memory"))
 
 
 def roofline_report(tt: SparseTensor, results: Dict[str, List[float]],

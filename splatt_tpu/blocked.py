@@ -238,6 +238,12 @@ class ModeLayout:
     #: never steer power-law ones ("" = unclassified legacy layout)
     skew: str = dataclasses.field(default="",
                                   metadata=dict(static=True))
+    #: mode-density bucket (mode_density_bucket, docs/dense.md) — the
+    #: dense-mode analog of `skew` in the autotuner's regime key, so a
+    #: plan tuned where dense tiling was a candidate never steers a
+    #: genuinely sparse regime ("" = sparse/legacy: keys unchanged)
+    density_bucket: str = dataclasses.field(default="",
+                                            metadata=dict(static=True))
 
     @property
     def nnz_pad(self) -> int:
@@ -758,7 +764,8 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
                  fmt: Optional[LayoutFormat] = None,
                  packing: str = "fixed",
                  reorder_label: str = "identity",
-                 record_stats: bool = True) -> ModeLayout:
+                 record_stats: bool = True,
+                 dense: Optional[bool] = None):
     """Sort, block and pad the tensor for output mode `mode`.
 
     ≙ csf_alloc's sort + fiber build (src/csf.c:613-726); the secondary
@@ -782,6 +789,15 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     (``packing_fallback`` event) — never a failed build.
     `reorder_label` stamps the relabeling recipe the caller applied
     before this build (plan matching and demotion scoping carry it).
+
+    `dense` picks the dense tile layout (docs/dense.md): True forces
+    it, False forbids it, None consults the SPLATT_DENSE policy and
+    the per-mode density verdict.  A dense build that fails (the
+    ``format.dense`` fault site, infeasible geometry, a blowup past
+    the cap) degrades CLASSIFIED to this sparse build — recorded as a
+    ``format_fallback`` event with ``site="dense"``, never a failed
+    build — so the return type is ModeLayout unless the dense tiling
+    actually lands (then :class:`DenseModeLayout`).
     """
     nmodes, nnz = tt.nmodes, tt.nnz
     from splatt_tpu.utils.env import check_int32_dims
@@ -790,6 +806,36 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
     fmt = (fmt or LayoutFormat()).validate()
     if packing not in ("fixed", "balanced"):
         raise ValueError(f"unknown packing {packing!r}")
+
+    if dense is None:
+        from splatt_tpu.config import (Options, resolve_dense,
+                                       resolve_dense_threshold)
+        pol = resolve_dense(Options())
+        dense = (pol != "off" and dense_mode_verdict(
+            tt.dims, mode, nnz, resolve_dense_threshold(Options()),
+            force=(pol == "on")))
+    if dense:
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        try:
+            faults.maybe_fail("format.dense")
+            return build_dense_layout(tt, mode, val_dtype=val_dtype,
+                                      reorder_label=reorder_label,
+                                      verbose=verbose)
+        except Exception as e:
+            # a failed dense tiling must degrade the BUILD, not kill
+            # it: classify, report, fall through to the sparse build
+            # every engine can always consume
+            cls = resilience.classify_failure(e)
+            resilience.run_report().add(
+                "format_fallback", mode=mode, site="dense",
+                idx_width="dense", failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
+            if verbose:
+                print(f"  layout mode{mode}: dense tiling failed "
+                      f"({cls.value}); falling back to the sparse "
+                      f"encoding")
     others = secondary_order(tt.dims, mode, mode_order, mode_order_custom)
     order = [mode] + others
     perm = tt.sort_order(order)
@@ -882,7 +928,8 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
 
     statics = dict(mode=mode, dim=dim, block=block, seg_width=seg_width,
                    nnz=nnz, packing=packing, reorder=reorder_label,
-                   skew=skew)
+                   skew=skew,
+                   density_bucket=mode_density_bucket(tt.dims, mode, nnz))
     bnz = None if block_nnz is None else jnp.asarray(block_nnz)
     if fmt.v2:
         from splatt_tpu import resilience
@@ -928,17 +975,39 @@ def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
 
 
 def reencode_layout(layout: ModeLayout, fmt: LayoutFormat,
-                    val_dtype=None) -> ModeLayout:
+                    val_dtype=None, dense: bool = False,
+                    dims: Optional[Sequence[int]] = None):
     """Re-encode an existing v1 layout under `fmt` (and optionally a
     new stored value dtype) WITHOUT re-sorting — the autotuner derives
     its format candidates from one sorted build per (mode, block)
     instead of paying the host sort per candidate.  Same degradation
     contract as :func:`build_layout`: a failed v2 encode (the
     ``format.encode`` fault site) returns the v1 layout, classified
-    into the run report."""
+    into the run report.
+
+    `dense` re-encodes to the dense tile layout instead (docs/dense.md;
+    requires `dims`, the full tensor extents a single-mode layout does
+    not store) — a failed dense tiling (the ``format.dense`` fault
+    site) degrades to the `fmt` re-encode under the same classified
+    ``format_fallback`` contract, with ``site="dense"``."""
     fmt = fmt.validate()
     if layout.encoding != "v1":
         raise ValueError("reencode_layout expects a v1 source layout")
+    if dense:
+        from splatt_tpu import resilience
+        from splatt_tpu.utils import faults
+
+        if dims is None:
+            raise ValueError("dense re-encode needs the tensor dims")
+        try:
+            faults.maybe_fail("format.dense")
+            return densify_layout(layout, dims, val_dtype=val_dtype)
+        except Exception as e:
+            cls = resilience.classify_failure(e)
+            resilience.run_report().add(
+                "format_fallback", mode=layout.mode, site="dense",
+                idx_width="dense", failure_class=cls.value,
+                error=resilience.failure_message(e)[:200])
     vals = (layout.vals if val_dtype is None
             else layout.vals.astype(val_dtype))
     if not fmt.v2:
@@ -982,6 +1051,307 @@ def decode_to_v1(layout: ModeLayout) -> ModeLayout:
     inds = jnp.stack([layout.mode_ids(k) for k in range(layout.nmodes)])
     return dataclasses.replace(layout, inds=inds, base=None,
                                idx_width="i32")
+
+
+# -- dense-mode tile layout (docs/dense.md) ----------------------------------
+#
+# A mode whose fiber density crosses the threshold stops paying index
+# traffic entirely: its unfolding X_(m) is stored as dense (tile, span)
+# value tiles — NO index streams at all — and MTTKRP becomes the matmul
+# X_(m) @ KR(other factors), the one shape the MXU is built for
+# (GenTen's dense-MTTKRP line, PAPERS.md).  Column c of the unfolding
+# linearizes the non-output modes row-major in ascending mode order
+# with the LAST one fastest; the inner mode's extent is padded to the
+# 128-lane boundary (pad columns hold zero values and the KR operand
+# is zero there by construction — see dense_operands in ops/mttkrp.py),
+# so the tiles feed the MXU without any re-layout.
+
+#: the feasibility floor: a dense tiling whose PADDED cells exceed this
+#: multiple of nnz is refused even under dense="on" — materializing a
+#: 64x blowup through a skinny inner mode is never a win
+DENSE_BLOWUP_CAP = 64
+
+
+class DenseGeometry(NamedTuple):
+    """Tile geometry of one mode's dense unfolding — derived
+    deterministically from (dims, mode), never stored, so the layout's
+    static metadata stays minimal and build/dispatch cannot disagree.
+    """
+
+    others: Tuple[int, ...]   # non-output modes, ascending
+    inner: int                # fastest-varying (last) other mode
+    n_outer: int              # prod of the remaining other dims (>= 1)
+    inner_pad: int            # dims[inner] padded to the 128-lane tile
+    tile: int                 # output rows per tile (8-sublane multiple)
+    ntiles: int               # row tiles (ntiles * tile >= dim)
+    span: int                 # columns per tile = n_outer * inner_pad
+    cells: int                # padded cells = ntiles * tile * span
+
+
+def dense_tile_geometry(dims: Sequence[int],
+                        mode: int) -> Optional[DenseGeometry]:
+    """The (tile, span) geometry of mode `mode`'s dense unfolding, or
+    None when the mode cannot be tiled (fewer than two modes, or an
+    empty dim)."""
+    dims = tuple(int(d) for d in dims)
+    others = tuple(k for k in range(len(dims)) if k != mode)
+    if not others or min(dims, default=0) < 1:
+        return None
+    inner = others[-1]
+    n_outer = 1
+    for k in others[:-1]:
+        n_outer *= dims[k]
+    inner_pad = _ceil_to(dims[inner], 128)
+    dim = dims[mode]
+    tile = min(_ceil_to(dim, 8), 256)
+    ntiles = -(-dim // tile)
+    span = n_outer * inner_pad
+    return DenseGeometry(others=others, inner=inner, n_outer=n_outer,
+                         inner_pad=inner_pad, tile=tile, ntiles=ntiles,
+                         span=span, cells=ntiles * tile * span)
+
+
+def mode_density(dims: Sequence[int], mode: int, nnz: int) -> float:
+    """True per-mode density: nnz / (prod of other dims x dim) — the
+    fill fraction of the mode's unfolding (docs/dense.md)."""
+    total = 1
+    for d in dims:
+        total *= max(int(d), 1)
+    return float(nnz) / float(max(total, 1))
+
+
+def padded_mode_density(dims: Sequence[int], mode: int,
+                        nnz: int) -> float:
+    """Density over the PADDED tile space — what the dense verdict is
+    judged on: a mode whose inner dim pads 3 -> 128 looks 42x sparser
+    here than :func:`mode_density` says, which is exactly the blowup
+    the tiling would pay."""
+    geo = dense_tile_geometry(dims, mode)
+    if geo is None:
+        return 0.0
+    return float(nnz) / float(max(geo.cells, 1))
+
+
+def mode_density_bucket(dims: Sequence[int], mode: int, nnz: int) -> str:
+    """Power-of-two bucket of a mode's padded density: ``dn<n>`` where
+    n = bit_length of 1/density — dn1 means more than half full, dn5 ≈
+    the 5% regime.  "" below ~3% (or infeasible geometry): sparse modes
+    keep their legacy plan keys byte-identical, the nnz_skew_bucket
+    convention (tune.plan_key carries this next to the skew bucket)."""
+    pd = padded_mode_density(dims, mode, nnz)
+    if pd <= 1.0 / 32.0:
+        return ""
+    return f"dn{int(1.0 / pd).bit_length()}"
+
+
+def dense_mode_verdict(dims: Sequence[int], mode: int, nnz: int,
+                       threshold: float, force: bool = False) -> bool:
+    """Whether mode `mode` should be stored as dense tiles: the padded
+    density meets `threshold`, and the geometry is feasible (two+
+    modes, padded cells within :data:`DENSE_BLOWUP_CAP` x nnz).
+    `force` (the dense="on" policy) skips the threshold but keeps the
+    feasibility floor."""
+    geo = dense_tile_geometry(dims, mode)
+    if geo is None or nnz < 1:
+        return False
+    if geo.cells > DENSE_BLOWUP_CAP * nnz:
+        return False
+    return force or padded_mode_density(dims, mode, nnz) >= threshold
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DenseModeLayout:
+    """The dense tile layout of one mode (docs/dense.md): the mode's
+    unfolding as (ntiles, tile, span) value tiles plus a (span,) pad
+    mask — no index streams at all, so the encoded-bytes model carries
+    ZERO index bytes for this mode.
+
+    tiles: (ntiles, tile, span) values at the resolved storage dtype
+      (bf16-capable, f32 accumulation in the engines); pad rows/columns
+      hold zero.
+    mask: (span,) bool — True at REAL unfolding columns (False at the
+      inner mode's 128-lane pad columns).  The engines never read it
+      on the hot path (the KR operand is zero at pad columns because
+      the inner factor is zero-padded); stats/tests recover real
+      entries through it.
+
+    The static metadata mirrors :class:`ModeLayout`'s plan-matching
+    surface (block/idx_width/val_storage/packing/reorder properties)
+    so the autotuner's strict match and the per-shape demotion keys
+    treat dense plans uniformly — idx_width reads "dense", block is
+    the row tile.
+    """
+
+    tiles: jax.Array
+    mask: jax.Array
+    mode: int = dataclasses.field(metadata=dict(static=True))
+    dims: Tuple[int, ...] = dataclasses.field(metadata=dict(static=True))
+    nnz: int = dataclasses.field(metadata=dict(static=True))
+    val_storage: str = dataclasses.field(default="auto",
+                                         metadata=dict(static=True))
+    reorder: str = dataclasses.field(default="identity",
+                                     metadata=dict(static=True))
+    density_bucket: str = dataclasses.field(default="",
+                                            metadata=dict(static=True))
+
+    @property
+    def dim(self) -> int:
+        return int(self.dims[self.mode])
+
+    @property
+    def nmodes(self) -> int:
+        return len(self.dims)
+
+    @property
+    def geometry(self) -> DenseGeometry:
+        return dense_tile_geometry(self.dims, self.mode)
+
+    @property
+    def tile(self) -> int:
+        return int(self.tiles.shape[1])
+
+    @property
+    def ntiles(self) -> int:
+        return int(self.tiles.shape[0])
+
+    @property
+    def span(self) -> int:
+        return int(self.tiles.shape[2])
+
+    # -- the plan-matching surface shared with ModeLayout ------------------
+
+    @property
+    def encoding(self) -> str:
+        return "dense"
+
+    @property
+    def block(self) -> int:
+        """The row tile plays nnz_block's role in plan matching and
+        the per-shape demotion keys."""
+        return self.tile
+
+    @property
+    def idx_width(self) -> str:
+        return "dense"
+
+    @property
+    def packing(self) -> str:
+        return "fixed"
+
+    @property
+    def skew(self) -> str:
+        return ""
+
+    def density(self) -> float:
+        return mode_density(self.dims, self.mode, self.nnz)
+
+    def index_bytes(self) -> int:
+        """ZERO by construction — the point of the format."""
+        return 0
+
+    def value_bytes(self) -> int:
+        return self.tiles.size * self.tiles.dtype.itemsize
+
+    def storage_bytes(self) -> int:
+        return self.value_bytes() + self.mask.size * self.mask.dtype.itemsize
+
+    def format_desc(self) -> str:
+        val = _DTYPE_SHORT.get(jnp.dtype(self.tiles.dtype).name,
+                               jnp.dtype(self.tiles.dtype).name)
+        return f"dense/t{self.tile}/{val}"
+
+    def __repr__(self) -> str:
+        extra = ("" if self.reorder == "identity"
+                 else f", reorder={self.reorder}")
+        return (f"DenseModeLayout(mode={self.mode}, dim={self.dim}, "
+                f"tile={self.tile}x{self.span}, ntiles={self.ntiles}, "
+                f"nnz={self.nnz}, density={self.density():.3g}{extra})")
+
+
+def build_dense_layout(tt: SparseTensor, mode: int, val_dtype=None,
+                       reorder_label: str = "identity",
+                       verbose: bool = False) -> DenseModeLayout:
+    """Materialize mode `mode`'s unfolding as dense value tiles.
+
+    Raises on infeasible geometry or a blowup past
+    :data:`DENSE_BLOWUP_CAP` — callers own the classified degrade to
+    the sparse encoding (the ``format.dense`` fault site contract:
+    :func:`build_layout` / :meth:`BlockedSparse.from_coo`).  Duplicate
+    coordinates accumulate (np.add.at), matching the scatter-add
+    semantics of every sparse engine."""
+    from splatt_tpu.config import (Options, host_staging_dtype,
+                                   resolve_dtype, resolve_storage_dtype)
+    from splatt_tpu.utils.env import check_int32_dims
+
+    check_int32_dims(tt.dims)
+    if val_dtype is None:
+        val_dtype = resolve_dtype(Options())
+    geo = dense_tile_geometry(tt.dims, mode)
+    if geo is None:
+        raise ValueError(
+            f"mode {mode} of dims {tuple(tt.dims)} cannot be dense-tiled "
+            f"(need two+ nonempty modes)")
+    if geo.cells > DENSE_BLOWUP_CAP * max(tt.nnz, 1):
+        raise ValueError(
+            f"dense tiling of mode {mode} would materialize {geo.cells} "
+            f"padded cells for {tt.nnz} nonzeros (> {DENSE_BLOWUP_CAP}x "
+            f"blowup); keeping the sparse encoding")
+    stage = host_staging_dtype(val_dtype)
+    arr = np.zeros((geo.ntiles * geo.tile, geo.n_outer, geo.inner_pad),
+                   dtype=stage)
+    if tt.nnz:
+        inds = np.asarray(tt.inds, dtype=np.int64)
+        if len(geo.others) > 1:
+            outer_lin = np.ravel_multi_index(
+                [inds[k] for k in geo.others[:-1]],
+                [tt.dims[k] for k in geo.others[:-1]])
+        else:
+            outer_lin = np.zeros(tt.nnz, dtype=np.int64)
+        np.add.at(arr, (inds[mode], outer_lin, inds[geo.inner]),
+                  np.asarray(tt.vals, dtype=stage))
+    mask = np.zeros((geo.n_outer, geo.inner_pad), dtype=bool)
+    mask[:, :tt.dims[geo.inner]] = True
+    lay = DenseModeLayout(
+        tiles=jnp.asarray(arr.reshape(geo.ntiles, geo.tile, geo.span)
+                          ).astype(jnp.dtype(val_dtype)),
+        mask=jnp.asarray(mask.reshape(-1)),
+        mode=mode, dims=tuple(int(d) for d in tt.dims), nnz=tt.nnz,
+        val_storage=("bf16" if jnp.dtype(val_dtype)
+                     == resolve_storage_dtype("bf16", val_dtype)
+                     else "auto"),
+        reorder=reorder_label,
+        density_bucket=mode_density_bucket(tt.dims, mode, tt.nnz))
+    if verbose:
+        print(f"  layout mode{mode}: dense tiles {geo.ntiles}x{geo.tile}"
+              f"x{geo.span} (density {lay.density():.3g}, zero index "
+              f"bytes)")
+    return lay
+
+
+def densify_layout(layout: ModeLayout, dims: Sequence[int],
+                   val_dtype=None) -> DenseModeLayout:
+    """Dense re-encoding of an existing sorted layout WITHOUT re-sorting
+    the COO — the :func:`reencode_layout` dense hook: real coordinates
+    are recovered through the stream-consumer decode (mode_ids +
+    real_mask), so the result is identical to a fresh
+    :func:`build_dense_layout` of the same tensor.  `dims` supplies the
+    other modes' extents (a ModeLayout only stores its own)."""
+    from splatt_tpu.config import host_acc_dtype, host_staging_dtype
+
+    real = layout.real_mask().reshape(-1)
+    inds = np.stack([np.asarray(layout.mode_ids(k))
+                     for k in range(layout.nmodes)])[:, real]
+    stage = host_staging_dtype(layout.vals.dtype)
+    vals = np.asarray(jnp.asarray(layout.vals, stage))[real]
+    tt = SparseTensor(inds=inds.astype(np.int64),
+                      vals=vals.astype(host_acc_dtype()),
+                      dims=tuple(int(d) for d in dims))
+    return build_dense_layout(
+        tt, layout.mode,
+        val_dtype=(val_dtype if val_dtype is not None
+                   else layout.vals.dtype),
+        reorder_label=layout.reorder)
 
 
 @dataclasses.dataclass
@@ -1031,6 +1401,9 @@ class BlockedSparse:
         events record at build time (docs/layout-balance.md)."""
         out = {}
         for lay in self.layouts:
+            if getattr(lay, "encoding", "v1") == "dense":
+                # dense tile layouts have no nnz stream to balance
+                continue
             real = lay.real_mask()
             counts = real.sum(axis=1)
             # mode_ids is the stream-consumer decode shared with the
@@ -1059,7 +1432,8 @@ class BlockedSparse:
                  tuned_blocks: Optional[Dict[int, int]] = None,
                  tuned_formats: Optional[Dict[int, LayoutFormat]] = None,
                  tuned_packings: Optional[Dict[int, str]] = None,
-                 reorder_label: str = "identity"
+                 reorder_label: str = "identity",
+                 tuned_dense: Optional[Dict[int, bool]] = None
                  ) -> "BlockedSparse":
         """Compile a COO tensor into blocked layouts per the alloc policy.
 
@@ -1083,13 +1457,16 @@ class BlockedSparse:
         factor dtype from it): the explicit/env policy wins, else a
         unanimous tuned-format verdict.
         """
-        from splatt_tpu.config import resolve_packing
+        from splatt_tpu.config import (resolve_dense,
+                                       resolve_dense_threshold,
+                                       resolve_packing)
 
         opts = (opts or default_opts()).validate()
         nmodes = tt.nmodes
         tuned_blocks = dict(tuned_blocks or {})
         tuned_formats = dict(tuned_formats or {})
         tuned_packings = dict(tuned_packings or {})
+        tuned_dense = dict(tuned_dense or {})
         fmt_default = layout_format(opts)
         packing_default = resolve_packing(opts)
         # one storage dtype across layouts: pinned policy > unanimous
@@ -1139,15 +1516,58 @@ class BlockedSparse:
                            else fmt_default.idx,
                            val=val_pol),
                        packing=tuned_packings.get(m, packing_default),
-                       reorder_label=reorder_label)
+                       reorder_label=reorder_label,
+                       dense=False)
                    for m in build_modes]
         mode_map = {}
         for m in range(nmodes):
             mode_map[m] = build_modes.index(m) if m in build_modes else 0
+        # hybrid per-mode dispatch (docs/dense.md): a mode whose tuned
+        # plan says path=="dense", or whose fiber density crosses the
+        # policy threshold, gets a dense tile layout APPENDED and its
+        # mode_map entry remapped — the sparse layouts above stay
+        # intact, so a dense build failure degrades to an
+        # already-built sparse path, never a failed compile.  A tuned
+        # dense verdict wins regardless of the env policy (tuned wins,
+        # the tuned_blocks precedent).
+        pol = resolve_dense(opts)
+        thr = resolve_dense_threshold(opts)
+        for m in range(nmodes):
+            want = tuned_dense.get(m)
+            if want is None:
+                want = (pol != "off"
+                        and dense_mode_verdict(tt.dims, m, tt.nnz,
+                                               threshold=thr,
+                                               force=(pol == "on")))
+            if not want:
+                continue
+            from splatt_tpu import resilience
+            from splatt_tpu.utils import faults
+
+            try:
+                faults.maybe_fail("format.dense")
+                dl = build_dense_layout(
+                    tt, m, val_dtype=storage,
+                    reorder_label=reorder_label,
+                    verbose=opts.verbosity >= Verbosity.LOW)
+            except Exception as e:
+                cls = resilience.classify_failure(e)
+                resilience.run_report().add(
+                    "format_fallback", mode=m, site="dense",
+                    idx_width="dense", failure_class=cls.value,
+                    error=resilience.failure_message(e)[:200])
+                if opts.verbosity >= Verbosity.LOW:
+                    print(f"  layout mode{m}: dense tiling failed "
+                          f"({cls.value}); mode keeps the sparse "
+                          f"encoding")
+                continue
+            mode_map[m] = len(layouts)
+            layouts.append(dl)
         bs = BlockedSparse(layouts=layouts, mode_map=mode_map,
                            dims=tt.dims, nnz=tt.nnz, opts=opts,
                            reorder=reorder_label)
-        if any(l.encoding == "v2" for l in layouts) or val_pol != "auto":
+        if (any(l.encoding in ("v2", "dense") for l in layouts)
+                or val_pol != "auto"):
             # the chosen encoding is part of the executed plan: record
             # it (docs/format.md) like tuned_plan records dispatch
             from splatt_tpu import resilience
@@ -1261,15 +1681,24 @@ class BlockedSparse:
                                    f"reorder {failed!r}, which degraded "
                                    f"to identity; mode keeps the "
                                    f"default layout policy")
-        tuned_blocks = {m: p.nnz_block for m, p in plans.items()}
+        # dense-path plans (docs/dense.md) leave the sparse build
+        # matrix entirely: their "idx_width" is the sentinel "dense"
+        # (not a LayoutFormat), their block is the dense row tile —
+        # from_coo appends a dense tile layout for those modes instead
+        tuned_dense = {m: True for m, p in plans.items()
+                       if p.path == "dense"}
+        sparse_plans = {m: p for m, p in plans.items()
+                        if p.path != "dense"}
+        tuned_blocks = {m: p.nnz_block for m, p in sparse_plans.items()}
         tuned_formats = {m: LayoutFormat(idx=p.idx_width,
                                          val=p.val_storage)
-                         for m, p in plans.items()}
-        tuned_packings = {m: p.packing for m, p in plans.items()}
+                         for m, p in sparse_plans.items()}
+        tuned_packings = {m: p.packing for m, p in sparse_plans.items()}
         bs = BlockedSparse.from_coo(tt, opts, tuned_blocks=tuned_blocks,
                                     tuned_formats=tuned_formats,
                                     tuned_packings=tuned_packings,
-                                    reorder_label=how)
+                                    reorder_label=how,
+                                    tuned_dense=tuned_dense)
         bs.perm = perm
         return bs
 
@@ -1435,7 +1864,8 @@ def batch_compile(tensors: Sequence[SparseTensor],
                            mode_order=opts.mode_order,
                            mode_order_custom=opts.mode_order_custom,
                            fmt=LayoutFormat(idx="i32", val=fmt.val),
-                           packing="fixed", record_stats=False)
+                           packing="fixed", record_stats=False,
+                           dense=False)
         n = lay.nnz_pad
         for m in range(nmodes):
             inds[i, m, :n] = np.asarray(lay.mode_ids(m))

@@ -671,7 +671,8 @@ def cmd_stats(args) -> int:
     """≙ splatt_stats_cmd (src/cmds/cmd_stats.c; -p gives the hypergraph
     partition-quality stats, src/stats.c:53-170)."""
     from splatt_tpu.io import load, read_permutation
-    from splatt_tpu.stats import (partition_quality_text, skew_stats_text,
+    from splatt_tpu.stats import (density_stats_text,
+                                  partition_quality_text, skew_stats_text,
                                   tensor_stats)
 
     tt = load(args.tensor)
@@ -688,6 +689,9 @@ def cmd_stats(args) -> int:
     # slice/fiber skew (docs/layout-balance.md): uniform vs power-law
     # is the first question the layout/tuner answer depends on
     print(skew_stats_text(tt))
+    # per-mode density (docs/dense.md): dense-tile vs sparse-blocked is
+    # the other axis the layout/tuner answer depends on
+    print(density_stats_text(tt))
     return 0
 
 

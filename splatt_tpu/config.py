@@ -243,6 +243,52 @@ def resolve_reorder(opts: "Options") -> Optional[str]:
     return how
 
 
+#: legal dense-mode policies (SPLATT_DENSE / Options.dense,
+#: docs/dense.md): "off" keeps every mode on the sparse blocked
+#: encodings (the conservative default — existing workloads see no
+#: change); "auto" lets build/dispatch switch a mode to the dense tile
+#: layout when its padded fiber density crosses the threshold; "on" is
+#: "auto" with the verdict forced for every mode that is FEASIBLE to
+#: tile (the padding-blowup guard still applies — forcing a 42x
+#: materialization through a 3-wide inner mode is never useful).
+DENSE_POLICIES = ("off", "auto", "on")
+
+#: default padded-density threshold for the dense-mode verdict
+#: (SPLATT_DENSE_THRESHOLD / Options.dense_threshold, docs/dense.md):
+#: a mode whose nnz fill of the PADDED tile space meets this fraction
+#: stops paying index traffic and is stored as dense value tiles.
+DENSE_THRESHOLD_DEFAULT = 0.05
+
+
+def resolve_dense(opts: "Options") -> str:
+    """Resolve the dense-mode policy (docs/dense.md): the explicit
+    Options field wins, else the SPLATT_DENSE env default ("off" — the
+    conservative choice: dense tiling is opt-in, like every format
+    knob whose wrong guess costs memory)."""
+    from splatt_tpu.utils.env import read_env
+
+    pol = (opts.dense if opts.dense is not None
+           else str(read_env("SPLATT_DENSE")))
+    if pol not in DENSE_POLICIES:
+        raise ValueError(
+            f"dense must be one of {DENSE_POLICIES}, got {pol!r}")
+    return pol
+
+
+def resolve_dense_threshold(opts: "Options") -> float:
+    """Resolve the dense-mode padded-density threshold: the explicit
+    Options field wins, else SPLATT_DENSE_THRESHOLD (default
+    :data:`DENSE_THRESHOLD_DEFAULT`)."""
+    from splatt_tpu.utils.env import read_env_float
+
+    thr = (opts.dense_threshold if opts.dense_threshold is not None
+           else float(read_env_float("SPLATT_DENSE_THRESHOLD")))
+    if not 0.0 < thr <= 1.0:
+        raise ValueError(
+            f"dense_threshold must lie in (0, 1], got {thr!r}")
+    return thr
+
+
 @dataclasses.dataclass(frozen=True)
 class LayoutFormat:
     """One blocked-layout encoding request: index width x value
@@ -415,6 +461,16 @@ class Options:
     reorder: Optional[str] = None        # "identity" | "random" |
                                          # "graph" | "hgraph" | "fibsched"
 
+    # Dense-mode tile layouts (docs/dense.md): a mode whose padded
+    # fiber density crosses the threshold stores dense (tile, span)
+    # value tiles with NO index streams and dispatches through the
+    # dense matmul engines instead of the sparse blocked chain.
+    # None = env defaults (SPLATT_DENSE "off" / SPLATT_DENSE_THRESHOLD
+    # 0.05); any dense build failure degrades classified to the sparse
+    # encoding (format_fallback site=dense), never fails the run.
+    dense: Optional[str] = None           # "off" | "auto" | "on"
+    dense_threshold: Optional[float] = None
+
     # Distributed
     decomposition: Decomposition = Decomposition.MEDIUM
     # Row-exchange strategy for the FINE decomposition.  None = env
@@ -473,6 +529,14 @@ class Options:
         if self.reorder is not None and self.reorder not in REORDERS:
             raise ValueError(
                 f"reorder must be one of {REORDERS}, got {self.reorder!r}")
+        if self.dense is not None and self.dense not in DENSE_POLICIES:
+            raise ValueError(
+                f"dense must be one of {DENSE_POLICIES}, got {self.dense!r}")
+        if (self.dense_threshold is not None
+                and not 0.0 < self.dense_threshold <= 1.0):
+            raise ValueError(
+                f"dense_threshold must lie in (0, 1], "
+                f"got {self.dense_threshold!r}")
         import jax.numpy as jnp
 
         if (self.val_dtype is not None

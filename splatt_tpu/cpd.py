@@ -674,11 +674,14 @@ def _cpd_als_traced(X: Union[SparseTensor, BlockedSparse], rank: int,
 
         tuned_plans = {}
         for m in range(nmodes):
-            plan = _tuned_plan_for(X.layout_for(m), factors, m,
+            lay = X.layout_for(m)
+            plan = _tuned_plan_for(lay, factors, m,
                                    _choose_path_bs(X, m),
                                    autotune=opts.autotune)
             if plan is not None:
-                tuned_plans[m] = dataclasses.asdict(plan)
+                tuned_plans[m] = dict(
+                    dataclasses.asdict(plan),
+                    mode_density=getattr(lay, "density_bucket", ""))
         if tuned_plans:
             _resilience.run_report().add("tuned_plan", plans=tuned_plans)
             if opts.verbosity >= Verbosity.LOW:

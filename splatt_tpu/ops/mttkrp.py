@@ -38,7 +38,8 @@ from splatt_tpu.config import Options
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.utils.env import read_env, read_env_int
 
-PATHS = ("stream", "sorted_onehot", "privatized", "scatter", "sorted_scatter")
+PATHS = ("stream", "sorted_onehot", "privatized", "scatter",
+         "sorted_scatter", "dense")
 
 #: engines that consume a compact layout's encoded streams NATIVELY —
 #: decode runs in registers (fused_v2 in the Pallas kernel, xla_scan
@@ -180,6 +181,59 @@ def mttkrp_ttbox(inds: jax.Array, vals: jax.Array,
     rank = factors[0].shape[1]
     cols = jax.lax.map(col, jnp.arange(rank))
     return cols.T
+
+
+# -- dense path (docs/dense.md) --------------------------------------------
+
+def dense_operands(layout, factors: Sequence[jax.Array], mode: int):
+    """The two Khatri-Rao operands of the dense-mode matmul: ``w``, the
+    chained Khatri-Rao product of the OUTER non-target factors
+    ((n_outer, R), all-ones when the mode has only one other), and
+    ``u``, the INNER factor zero-padded to the tile span's 128-lane
+    boundary ((inner_pad, R)) — so the pad columns of the value tiles
+    meet exact-zero KR entries and contribute nothing, with no mask
+    read on the hot path.
+
+    Column c of the unfolding is ``outer_lin * inner_pad + inner_idx``
+    (build_dense_layout's scatter), which is exactly the row order of
+    ``(w[:, None, :] * u[None, :, :]).reshape(span, R)`` — the KR tile
+    is a regular grid, so no gather is ever needed to build it.  ONE
+    definition shared by the Pallas kernel and the XLA reference: bit
+    parity between the engines starts with identical operands."""
+    geo = layout.geometry
+    dtype = factors[0].dtype
+    R = int(factors[0].shape[1])
+    w = jnp.ones((1, R), dtype=dtype)
+    for k in geo.others[:-1]:
+        w = (w[:, None, :] * factors[k][None, :, :]).reshape(-1, R)
+    u = factors[geo.inner]
+    pad = geo.inner_pad - int(u.shape[0])
+    if pad:
+        u = jnp.pad(u, ((0, pad), (0, 0)))
+    return w, u
+
+
+def dense_mttkrp(layout, factors: Sequence[jax.Array],
+                 mode: int) -> jax.Array:
+    """Dense-mode MTTKRP, XLA reference engine (``dense_xla``): the
+    mode's unfolding tiles contracted against the Khatri-Rao'd factors
+    in one batched dot_general — no index streams, no gathers, no
+    scatter.  The always-works terminal of the dense engine chain
+    (plain dot_general: no kernel or VMEM preconditions); the Pallas
+    ``fused_dense`` engine computes the identical reduction per row
+    tile (same operands, same precision, same accumulator dtype)."""
+    if mode != layout.mode:
+        raise ValueError("dense_mttkrp requires the layout's own mode")
+    dtype = factors[0].dtype
+    R = int(factors[0].shape[1])
+    w, u = dense_operands(layout, factors, mode)
+    kr = (w[:, None, :] * u[None, :, :]).reshape(-1, R)   # (span, R)
+    out = jax.lax.dot_general(
+        layout.tiles.astype(dtype), kr,
+        dimension_numbers=(((2,), (0,)), ((), ())),
+        preferred_element_type=_acc_dtype(dtype),
+        precision=mxu_precision(dtype))                   # (ntiles, tile, R)
+    return out.reshape(-1, R)[:layout.dim]
 
 
 # -- blocked paths ---------------------------------------------------------
@@ -337,7 +391,9 @@ def _tuned_plan_for(layout: ModeLayout, factors: Sequence[jax.Array],
     plan = tune.cached_plan([int(f.shape[0]) for f in factors],
                             nnz, mode, int(factors[0].shape[1]),
                             factors[0].dtype,
-                            skew=getattr(layout, "skew", ""))
+                            skew=getattr(layout, "skew", ""),
+                            mode_density=getattr(layout,
+                                                 "density_bucket", ""))
     if (plan is None or plan.path != path
             or plan.nnz_block != layout.block
             or plan.idx_width != getattr(layout, "idx_width", "i32")
@@ -412,7 +468,16 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
 
     if fallback is None:
         fallback = resilience.fallback_enabled()
-    if getattr(layout, "encoding", "v1") != "v1":
+    # dense tile layouts have no streams to decode — they skip the
+    # format-decode machinery entirely and dispatch on their own
+    # engine chain (fused_dense -> dense_xla, docs/dense.md).  The
+    # layout's encoding is authoritative over the `path` default, so a
+    # caller handing us a dense layout without asking choose_path first
+    # still lands on the dense matmul, never a sparse body that would
+    # choke on the missing index streams.
+    if getattr(layout, "encoding", "v1") == "dense":
+        path = "dense"
+    if getattr(layout, "encoding", "v1") not in ("v1", "dense"):
         from splatt_tpu.blocked import decode_to_v1
         from splatt_tpu.config import resolve_decode
 
@@ -478,7 +543,17 @@ def mttkrp_blocked(layout: ModeLayout, factors: List[jax.Array], mode: int,
             first = (engine, shape_key) not in _DEADLINE_ARMED
             if first:
                 _DEADLINE_ARMED.add((engine, shape_key))
-                if getattr(layout, "encoding", "v1") != "v1":
+                if getattr(layout, "encoding", "v1") == "dense":
+                    # first (compile-bearing) dispatch over a dense
+                    # tile layout: record the hybrid dispatcher's
+                    # verdict as evidence (docs/dense.md) — once per
+                    # (engine, shape), like the deadline arming
+                    resilience.run_report().add(
+                        "dense_dispatch", engine=engine, mode=int(mode),
+                        tile=int(layout.block), span=int(layout.span),
+                        density_bucket=getattr(layout,
+                                               "density_bucket", ""))
+                elif getattr(layout, "encoding", "v1") != "v1":
                     # first (compile-bearing) dispatch over an encoded
                     # layout: record WHERE its decode runs — natively
                     # in-kernel/per-chunk, or at operand prep — next
@@ -545,6 +620,18 @@ def _mttkrp_blocked_jit(layout: ModeLayout, factors: List[jax.Array],
     dim = int(factors[mode].shape[0])
     R = factors[mode].shape[1]
     interpret = impl == "pallas_interpret"
+
+    if path == "dense":
+        # the dense tile layout's batched matmul (docs/dense.md): the
+        # MXU kernel when probed/VMEM-fit, else the dot_general
+        # reference — bit-identical engines, so demotion costs speed,
+        # never numerics
+        if engine == "fused_dense":
+            from splatt_tpu.ops.pallas_kernels import fused_dense
+
+            return fused_dense(layout, factors, mode,
+                               interpret=interpret)
+        return dense_mttkrp(layout, factors, mode)
 
     if path in ("scatter", "sorted_scatter") or engine == "xla":
         if path == "sorted_scatter" and mode != layout.mode:
@@ -679,7 +766,12 @@ def _engine_shape_key(layout: ModeLayout, factors: Sequence[jax.Array],
     key = f"{regime}:b{layout.block}"
     # getattr: gate-probing tests pass partial layout stand-ins
     enc = getattr(layout, "encoding", "v1")
-    if enc != "v1":
+    if enc == "dense":
+        # the dense tile scope (docs/dense.md): a dense-engine OOM
+        # demotes the engine for dense dispatches only — the sparse
+        # path's standing is untouched, and vice versa
+        key += ":dn"
+    elif enc != "v1":
         key += f":{enc}"
     # layout-balance axes scope their own demotions exactly like :v2
     # (docs/layout-balance.md): an OOM under a balanced/reordered
@@ -709,8 +801,13 @@ def _engine_probed_ok(engine: str, regime: str, block: int,
 
     from splatt_tpu.ops.pallas_kernels import fused_v2_supported
 
-    if interpret or engine in ("unfused_pallas", "xla_scan", "xla"):
+    if interpret or engine in ("unfused_pallas", "xla_scan", "xla",
+                               "dense_xla"):
         return True
+    if engine == "fused_dense":
+        from splatt_tpu.ops.pallas_kernels import fused_dense_supported
+
+        return fused_dense_supported(regime, block)
     if engine == "fused_v2":
         return fused_v2_supported(regime, block, idx_width)
     if engine == "fused_t":
@@ -745,6 +842,23 @@ def engine_chain(layout: ModeLayout, factors: List[jax.Array], mode: int,
 
     if path in ("scatter", "sorted_scatter", "stream"):
         return ["xla"]
+    if (path == "dense"
+            or getattr(layout, "encoding", "v1") == "dense"):
+        # the dense tile layout's own chain (docs/dense.md): the MXU
+        # kernel when the tile working set fits VMEM, then the
+        # dot_general reference — which has no kernel or VMEM
+        # preconditions, so the dense chain cannot run dry either
+        from splatt_tpu.ops.pallas_kernels import dense_vmem_ok
+
+        if shape_key is None:
+            shape_key = _engine_shape_key(layout, factors, mode)
+        chain = []
+        if (impl in ("pallas", "pallas_interpret")
+                and not resilience.is_demoted("fused_dense", shape_key)
+                and dense_vmem_ok(layout, factors, mode)):
+            chain.append("fused_dense")
+        chain.append("dense_xla")
+        return chain
     dim = int(factors[mode].shape[0])
     R = int(factors[0].shape[1])
     B = layout.block
@@ -953,6 +1067,8 @@ def _onehot_pays(opts: Options) -> bool:
 
 def choose_path(layout: ModeLayout, mode: int, opts: Options) -> str:
     """Static path selection (≙ mttkrp_csf dispatch + p_is_privatized)."""
+    if getattr(layout, "encoding", "v1") == "dense":
+        return "dense"
     if mode == layout.mode:
         if layout.seg_width <= opts.onehot_cap and _onehot_pays(opts):
             return "sorted_onehot"
@@ -962,6 +1078,11 @@ def choose_path(layout: ModeLayout, mode: int, opts: Options) -> str:
 
 def _choose_path_bs(bs: BlockedSparse, mode: int) -> str:
     layout = bs.layout_for(mode)
+    if getattr(layout, "encoding", "v1") == "dense":
+        # the hybrid per-mode dispatcher (docs/dense.md): a mode whose
+        # compiled layout is dense tiles runs the dense matmul path;
+        # every other mode keeps its sparse-blocked path
+        return "dense"
     dim = bs.dims[mode]
     if mode != layout.mode:
         if (_onehot_pays(bs.opts)

@@ -1061,7 +1061,8 @@ def _probe_case(kernel_fn, regime: str, block: int, fmt=None) -> bool:
                             for d in dims[1:]])
     tt = SparseTensor(inds=inds.astype(np.int64),
                       vals=np.ones(nnz), dims=dims)
-    lay = build_layout(tt, 0, block=block, val_dtype=np.float32, fmt=fmt)
+    lay = build_layout(tt, 0, block=block, val_dtype=np.float32, fmt=fmt,
+                       dense=False)
     fac = [jnp.zeros((d, rank), jnp.float32) for d in dims]
     kernel_fn.lower(lay, fac, mode=0, width=lay.seg_width,
                     accumulate=False, interpret=False).compile()
@@ -1069,7 +1070,7 @@ def _probe_case(kernel_fn, regime: str, block: int, fmt=None) -> bool:
 
 
 def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
-                    block: int = 4096, fmt=None) -> bool:
+                    block: int = 4096, fmt=None, case=None) -> bool:
     """Whether `kernel_fn(layout, factors, mode, width, accumulate,
     interpret)` COMPILES for this backend at a shape representative of
     `regime` at the CALLER's block size.  Lowering alone is not
@@ -1124,9 +1125,14 @@ def _probe_compiles(kernel_fn, name: str, regime: str = "ck1",
 
     def attempt():
         faults.maybe_fail("probe_compile")
-        # fmt is only threaded through when a probe needs an encoded
-        # layout (fused_v2): the default call keeps the documented
-        # 3-arg substitution contract tests stub _probe_case with
+        # a kernel whose call signature differs from the shared probe
+        # case (fused_dense: no width/accumulate) supplies its own
+        # `case` callable; fmt is only threaded through when a probe
+        # needs an encoded layout (fused_v2) — the default call keeps
+        # the documented 3-arg substitution contract tests stub
+        # _probe_case with
+        if case is not None:
+            return case(kernel_fn, regime, block)
         if fmt is None:
             return _probe_case(kernel_fn, regime, block)
         return _probe_case(kernel_fn, regime, block, fmt=fmt)
@@ -1410,3 +1416,124 @@ def onehot_reduce_full(local: jax.Array, prod: jax.Array, width: int,
         compiler_params=_compiler_params(),
     )(local, prod)
     return out
+
+
+# -- dense-mode MXU engine (docs/dense.md) ----------------------------------
+#
+# A DenseModeLayout's MTTKRP is X_(m) @ KR(other factors): a batched
+# (tile, span) @ (span, R) matmul — the one shape the MXU is literally
+# built for, with NO index streams, gathers or one-hots anywhere.  The
+# kernel stages the two Khatri-Rao operands (the chained outer-factor
+# product w and the lane-padded inner factor u, built ONCE by
+# ops.mttkrp.dense_operands and shared with the XLA reference for bit
+# parity) whole in VMEM, builds the (span, R) KR tile in registers via
+# a broadcast multiply — the column space is a regular grid, so no
+# arbitrary gather is ever needed (the construct Mosaic cannot lower) —
+# and drives one dot_general per row tile.
+
+def _dense_kernel(tiles_ref, w_ref, u_ref, out_ref, *, rank: int,
+                  precision):
+    w = w_ref[...]                           # (n_outer, R)
+    u = u_ref[...]                           # (inner_pad, R)
+    tiles = tiles_ref[0].astype(w.dtype)     # (tile, span)
+    kr = (w[:, None, :] * u[None, :, :]).reshape(-1, rank)   # (span, R)
+    out_ref[0] = jax.lax.dot_general(
+        tiles, kr,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=out_ref.dtype,
+        precision=precision)
+
+
+def dense_vmem_ok(layout, factors, mode: int,
+                  budget_bytes: int = None) -> bool:
+    """VMEM plan of the dense MXU kernel: one (tile, span) value tile
+    resident per step, both KR operands whole, the (span, R) Khatri-Rao
+    product built in registers, and the (tile, R) output block at the
+    accumulator width."""
+    if budget_bytes is None:
+        budget_bytes = _vmem_budget()
+    geo = layout.geometry
+    R = int(factors[0].shape[1])
+    itemsize = jnp.dtype(factors[0].dtype).itemsize
+    tile_bytes = layout.tile * layout.span * layout.tiles.dtype.itemsize
+    work = ((geo.n_outer + geo.inner_pad) * R * itemsize      # w + u
+            + layout.span * R * itemsize                      # kr
+            + layout.tile * R * max(itemsize, 4))             # out block
+    return tile_bytes + work <= budget_bytes
+
+
+@functools.partial(jax.jit, static_argnames=("mode", "interpret"))
+def fused_dense(layout, factors, mode: int,
+                interpret: bool = False) -> jax.Array:
+    """Dense-mode MTTKRP on the MXU over a
+    :class:`splatt_tpu.blocked.DenseModeLayout`.
+
+    Interpret mode is bit-identical to :func:`ops.mttkrp.dense_mttkrp`
+    by construction: both build (w, u) through the same
+    ``dense_operands``, form the same (span, R) KR product, and reduce
+    each output element with ONE dot_general over span at the same
+    precision and accumulator dtype.  Output: (dim, R) at the
+    accumulator dtype — pad rows trimmed, pad columns contributing
+    exact zeros (the inner factor is zero-padded)."""
+    from splatt_tpu.ops.mttkrp import dense_operands, mxu_precision
+
+    if mode != layout.mode:
+        raise ValueError("fused_dense requires the layout's own mode")
+    R = int(factors[0].shape[1])
+    dtype = factors[0].dtype
+    w, u = dense_operands(layout, factors, mode)
+    ntiles, tile, span = (int(s) for s in layout.tiles.shape)
+    acc = _acc_dtype(dtype)
+    out = pl.pallas_call(
+        functools.partial(_dense_kernel, rank=R,
+                          precision=mxu_precision(dtype)),
+        grid=(ntiles,),
+        in_specs=[
+            pl.BlockSpec((1, tile, span), lambda i: (i, 0, 0)),
+            pl.BlockSpec((int(w.shape[0]), R), lambda i: (0, 0)),
+            pl.BlockSpec((int(u.shape[0]), R), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tile, R), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((ntiles, tile, R), acc),
+        interpret=interpret,
+        compiler_params=_compiler_params(),
+    )(layout.tiles, w, u)
+    return out.reshape(-1, R)[:layout.dim]
+
+
+def _probe_case_dense(kernel_fn, regime: str, block: int) -> bool:
+    """The dense-engine probe compile — its own case because the
+    kernel's call signature has no width/accumulate (the shared
+    :func:`_probe_case` lowers the sparse-layout signature).  A
+    synthetic near-dense mode at a production-like (tile, span); the
+    Mosaic-sensitive step the probe exercises is the in-kernel
+    (n_outer, inner_pad, R) -> (span, R) Khatri-Rao reshape."""
+    import numpy as np
+
+    from splatt_tpu.blocked import build_dense_layout
+    from splatt_tpu.coo import SparseTensor
+
+    rng = np.random.default_rng(0)
+    dims = (64, 32, 256)
+    nnz = 65536
+    rank = 48 if _vmem_limit() >= (32 << 20) else 16
+    inds = np.stack([rng.integers(0, d, nnz) for d in dims])
+    tt = SparseTensor(inds=inds.astype(np.int64), vals=np.ones(nnz),
+                      dims=dims)
+    from splatt_tpu.config import fit_dtype
+
+    lay = build_dense_layout(tt, 0)
+    fac = [jnp.zeros((d, rank), fit_dtype()) for d in dims]
+    kernel_fn.lower(lay, fac, mode=0, interpret=False).compile()
+    return True
+
+
+@functools.cache
+def fused_dense_supported(regime: str = "ck1", block: int = 4096) -> bool:
+    """Whether the dense-mode MXU kernel compiles here (the in-kernel
+    broadcast-multiply + (n_outer·inner_pad, R) reshape that builds the
+    Khatri-Rao tile is the Mosaic-sensitive step), probed per
+    (lane-chunk regime, tile) like every engine — an unlowerable form
+    demotes cleanly to the ``dense_xla`` reference path."""
+    return _probe_compiles(fused_dense, "fused_dense", regime, block,
+                           case=_probe_case_dense)

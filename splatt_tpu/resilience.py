@@ -527,12 +527,20 @@ RUN_REPORT_EVENTS = {
                  "value storage, docs/format.md); carries the achieved "
                  "per-mode format descriptions",
     "format_fallback": "a compact-format encode failed (blocked.py, "
-                       "the format.encode fault site) or its native "
+                       "the format.encode fault site), its native "
                        "stream consumption failed at dispatch "
                        "(ops/mttkrp.py, the format.decode site — "
-                       "site=decode) and the run degraded CLASSIFIED "
-                       "to the v1 i32 path — slower bytes, never a "
-                       "failed build or run",
+                       "site=decode), or a dense tile-layout build "
+                       "failed (blocked.py, the format.dense site — "
+                       "site=dense, docs/dense.md) and the run "
+                       "degraded CLASSIFIED to the v1 i32 / sparse "
+                       "path — slower bytes, never a failed build or "
+                       "run",
+    "dense_dispatch": "first dispatch of a dense-tile MTTKRP engine "
+                      "over a dense-mode layout (ops/mttkrp.py, "
+                      "docs/dense.md): records the engine, mode, row "
+                      "tile, span and density bucket — the "
+                      "zero-index-bytes contract made observable",
     "format_decode": "first dispatch of an engine over a compact "
                      "layout: records the consumed encoding and "
                      "whether decode runs natively in-kernel/per-"
@@ -892,12 +900,24 @@ class RunReport:
                              f"({e['failure_class']}: "
                              f"{e['error'][:80]}); degraded to the "
                              f"materialized v1 i32 path")
+            elif e.get("site") == "dense":
+                lines.append(f"  dense tile-layout build failed for "
+                             f"mode {e.get('mode')} "
+                             f"({e['failure_class']}: "
+                             f"{e['error'][:80]}); mode keeps the "
+                             f"sparse blocked encoding")
             else:
                 lines.append(f"  compact-format encode failed for mode "
                              f"{e.get('mode')} "
                              f"(requested {e.get('idx_width')}; "
                              f"{e['failure_class']}: {e['error'][:80]}); "
                              f"degraded to the v1 i32 encoding")
+        for e in self.events("dense_dispatch"):
+            lines.append(f"  dense-mode dispatch [{e.get('engine')}]: "
+                         f"mode {e.get('mode')} as "
+                         f"{e.get('tile')}x{e.get('span')} value tiles "
+                         f"({e.get('density_bucket') or 'dense'}; zero "
+                         f"index bytes)")
         for e in self.events("packing_fallback"):
             lines.append(f"  balanced fiber pack failed for mode "
                          f"{e.get('mode')} ({e['failure_class']}: "

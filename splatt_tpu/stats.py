@@ -104,6 +104,48 @@ def skew_stats_text(tt: SparseTensor) -> str:
     return "\n".join(lines)
 
 
+def density_stats(tt: SparseTensor, threshold: float = None) -> dict:
+    """Per-mode density metrics (docs/dense.md): the raw mode density
+    (nnz over the full dense cell count), the PADDED density against
+    the dense tile layout's cells (what the verdict thresholds), the
+    autotuner's density regime bucket, and the dense/sparse verdict at
+    `threshold` (default: the resolved SPLATT_DENSE_THRESHOLD)."""
+    from splatt_tpu.blocked import (dense_mode_verdict, mode_density,
+                                    mode_density_bucket,
+                                    padded_mode_density)
+    from splatt_tpu.config import Options, resolve_dense_threshold
+
+    if threshold is None:
+        threshold = resolve_dense_threshold(Options())
+    out = {"threshold": threshold, "modes": {}}
+    for m in range(tt.nmodes):
+        out["modes"][str(m)] = dict(
+            density=float(mode_density(tt.dims, m, tt.nnz)),
+            padded_density=float(padded_mode_density(tt.dims, m, tt.nnz)),
+            bucket=mode_density_bucket(tt.dims, m, tt.nnz),
+            verdict=("dense" if dense_mode_verdict(tt.dims, m, tt.nnz,
+                                                   threshold)
+                     else "sparse"))
+    return out
+
+
+def density_stats_text(tt: SparseTensor) -> str:
+    """Human-readable per-mode density report (the `splatt stats` view
+    of :func:`density_stats`) — tells a dense-mode workload from a
+    sparse one before picking layouts (docs/dense.md)."""
+    st = density_stats(tt)
+    lines = ["Mode density ---------------------------------------"]
+    for m, d in st["modes"].items():
+        bucket = f" [{d['bucket']}]" if d["bucket"] else ""
+        lines.append(
+            f"  mode {m}: density={d['density']:.3e} "
+            f"padded={d['padded_density']:.3e}{bucket} -> "
+            f"{d['verdict']}")
+    lines.append(f"  (dense verdict at padded density >= "
+                 f"{st['threshold']:g}; SPLATT_DENSE governs dispatch)")
+    return "\n".join(lines)
+
+
 def grid_stats_text(decomp) -> str:
     """Distributed decomposition stats (≙ mpi_global_stats /
     mpi_rank_stats / mpi_cpd_stats, src/stats.c:298-457)."""
@@ -173,6 +215,14 @@ def cpd_stats_text(bs_or_tt, rank: int, opts: Options) -> str:
             f"LAYOUTS={nlay}")
         lines.append(f"BLOCKED-STORAGE={_human_bytes(bs.storage_bytes())}")
         for i, lay in enumerate(bs.layouts):
+            if getattr(lay, "encoding", "v1") == "dense":
+                # dense tile layouts have no blocks/segments/pad — the
+                # tile geometry is the whole story (docs/dense.md)
+                lines.append(
+                    f"  layout[{i}]: mode={lay.mode} dense "
+                    f"tiles={lay.ntiles}x{lay.block}x{lay.span} "
+                    f"index_bytes=0")
+                continue
             lines.append(
                 f"  layout[{i}]: mode={lay.mode} nblocks={lay.nblocks} "
                 f"seg_width={lay.seg_width} pad={lay.nnz_pad - lay.nnz}")

@@ -65,7 +65,11 @@ import numpy as np
 #: engine heads compact chains, docs/format.md) — plans tuned when
 #: every engine paid operand-prep decode are re-earned, not
 #: reinterpreted.
-PLAN_CACHE_VERSION = 4
+#: v5: the plan key gains a mode-density regime component and the
+#: dense tile-layout candidates join the matrix (docs/dense.md) —
+#: plans tuned when every mode was sparse-only are re-earned on
+#: dense-eligible regimes, not reinterpreted.
+PLAN_CACHE_VERSION = 5
 
 #: candidate nnz blocks (build_layout clamps small tensors; duplicate
 #: effective blocks are measured once)
@@ -187,22 +191,28 @@ def skew_of(tt, mode: int) -> str:
 
 
 def plan_key(dims: Sequence[int], nnz: int, mode: int, rank: int,
-             dtype, skew: str = "", batch: int = 1) -> str:
+             dtype, skew: str = "", batch: int = 1,
+             mode_density: str = "") -> str:
     """The cache key of one tuned dispatch site.  Device kind and
     kernel-source hash live in the environment key (shared with the
     probe cache), so this only carries the workload shape — plus the
     mode's slice-skew regime (:func:`skew_regime`; "" for
-    near-uniform, keeping legacy keys byte-identical) and, for the
-    batched fleet engine (docs/batched.md), a power-of-two batch-size
-    bucket: a plan measured under one vmapped batch never steers
-    single-tensor dispatch (or the reverse) — ``batch=1`` (every
-    pre-batch caller) keeps legacy keys byte-identical."""
+    near-uniform, keeping legacy keys byte-identical), the mode's
+    density regime (blocked.mode_density_bucket, docs/dense.md; "" for
+    genuinely sparse modes, keeping legacy keys byte-identical — a
+    plan tuned on a near-dense mode never steers a sparse one) and,
+    for the batched fleet engine (docs/batched.md), a power-of-two
+    batch-size bucket: a plan measured under one vmapped batch never
+    steers single-tensor dispatch (or the reverse) — ``batch=1``
+    (every pre-batch caller) keeps legacy keys byte-identical."""
     import jax.numpy as jnp
 
     sk = skew_regime(skew)
+    md = str(mode_density or "")
     bt = f":bk{int(batch).bit_length()}" if int(batch) > 1 else ""
     return (f"{shape_regime(dims, nnz)}:mode{mode}:r{int(rank)}"
-            f":{jnp.dtype(dtype).name}" + (f":{sk}" if sk else "") + bt)
+            f":{jnp.dtype(dtype).name}" + (f":{sk}" if sk else "")
+            + (f":{md}" if md else "") + bt)
 
 
 def _negative_key(key: str, engine: str, block: int, scan_target: int,
@@ -367,10 +377,12 @@ def _entry_store(key: str, value: dict) -> None:
 
 
 def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,
-                dtype, skew: str = "") -> Optional[TunedPlan]:
+                dtype, skew: str = "",
+                mode_density: str = "") -> Optional[TunedPlan]:
     """The persisted winning plan for this dispatch site, or None
     (never tuned, expired, negative-only, or unreadable cache)."""
-    entry = _entry_get(plan_key(dims, nnz, mode, rank, dtype, skew=skew))
+    entry = _entry_get(plan_key(dims, nnz, mode, rank, dtype, skew=skew,
+                                mode_density=mode_density))
     if not entry or "plan" not in entry:
         return None
     p = entry["plan"]
@@ -397,10 +409,14 @@ def tuned_build_for(tt, rank: int, dtype) -> Dict[int, TunedPlan]:
     disagrees with the default.  Takes the COO tensor (not just
     dims/nnz): the plan key's skew component needs the mode
     histograms."""
+    from splatt_tpu.blocked import mode_density_bucket
+
     out = {}
     for m in range(tt.nmodes):
         plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype,
-                           skew=skew_of(tt, m))
+                           skew=skew_of(tt, m),
+                           mode_density=mode_density_bucket(
+                               tt.dims, m, tt.nnz))
         if plan is not None:
             out[m] = plan
     return out
@@ -675,12 +691,17 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
             facs_by_dtype[sd] = [f.astype(sd) for f in factors]
         return facs_by_dtype[sd]
 
+    from splatt_tpu.blocked import mode_density_bucket
+
     result = TuneResult(plans={})
     for m in modes:
         skew = skew_of(tt, m)
-        key = plan_key(tt.dims, tt.nnz, m, rank, dtype, skew=skew)
+        md = mode_density_bucket(tt.dims, m, tt.nnz)
+        key = plan_key(tt.dims, tt.nnz, m, rank, dtype, skew=skew,
+                       mode_density=md)
         if not force:
-            plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype, skew=skew)
+            plan = cached_plan(tt.dims, tt.nnz, m, rank, dtype, skew=skew,
+                               mode_density=md)
             # always-on metrics (docs/observability.md): the serve
             # fleet's warm-cache payoff as a Prometheus series
             trace.metric_inc("splatt_tune_cache_total",
@@ -708,7 +729,7 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
                         mode_order=opts.mode_order,
                         mode_order_custom=opts.mode_order_custom,
                         packing=pack, reorder_label=how,
-                        record_stats=False)
+                        record_stats=False, dense=False)
                     path = choose_path(base_layout, m, opts)
                     for iw, vs in formats:
                         storage = resolve_storage_dtype(vs, dtype)
@@ -790,6 +811,87 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
                                     val_storage=layout.val_storage,
                                     packing=layout.packing,
                                     reorder=how)
+        # dense tile-layout candidates (docs/dense.md): measured
+        # beside the sparse matrix whenever the policy allows and the
+        # mode's geometry passes the density verdict.  One dense build
+        # per value storage — the tile layout has no index-width or
+        # packing axis, and a relabeling permutes cells without
+        # changing density, so dense is measured under identity
+        # reorder only.  A failed build (the format.dense fault site)
+        # degrades classified to "no dense candidates", never a
+        # failed tune.
+        from splatt_tpu.blocked import build_dense_layout, \
+            dense_mode_verdict
+        from splatt_tpu.config import (resolve_dense,
+                                       resolve_dense_threshold)
+        from splatt_tpu.utils import faults
+
+        pol = resolve_dense(opts)
+        if pol != "off" and dense_mode_verdict(
+                tt.dims, m, tt.nnz,
+                threshold=resolve_dense_threshold(opts),
+                force=(pol == "on")):
+            for vs in dict.fromkeys(v for _, v in formats):
+                storage = resolve_storage_dtype(vs, dtype)
+                try:
+                    faults.maybe_fail("format.dense")
+                    dlay = build_dense_layout(
+                        tt, m, val_dtype=np.dtype(storage))
+                except Exception as e:
+                    cls = resilience.classify_failure(e)
+                    resilience.run_report().add(
+                        "format_fallback", mode=m, site="dense",
+                        idx_width="dense", failure_class=cls.value,
+                        error=resilience.failure_message(e)[:200])
+                    continue
+                fac = factors_for(storage)
+                fmt_tag = f"dense-{dlay.val_storage}-fixed-identity"
+                for engine, st in _candidates(dlay, fac, m, "dense",
+                                              impl, scan_targets,
+                                              default_scan):
+                    neg = _entry_get(_negative_key(
+                        key, engine, dlay.block, st, fmt_tag))
+                    if neg is not None:
+                        result.skipped += 1
+                        continue
+
+                    def attempt_dense(dlay=dlay, fac=fac, engine=engine,
+                                      st=st):
+                        return _measure_candidate(
+                            dlay, fac, m, "dense", impl, engine, st,
+                            warm=warm, reps=reps)
+
+                    try:
+                        sec = resilience.retry_transient(
+                            attempt_dense, label=f"tuner.{engine}")
+                    except Exception as e:
+                        cls = resilience.classify_failure(e)
+                        if cls in (resilience.FailureClass.DETERMINISTIC,
+                                   resilience.FailureClass.RESOURCE):
+                            _entry_store(
+                                _negative_key(key, engine, dlay.block,
+                                              st, fmt_tag),
+                                {"state": cls.value,
+                                 "error":
+                                 resilience.failure_message(e)[:200]})
+                        resilience.run_report().add(
+                            "tuner_negative", key=key, engine=engine,
+                            block=dlay.block, scan_target=st,
+                            fmt=fmt_tag, failure_class=cls.value,
+                            error=resilience.failure_message(e)[:200])
+                        result.skipped += 1
+                        continue
+                    result.measured += 1
+                    if loud:
+                        print(f"  tune mode {m}: dense/{engine} "
+                              f"t{dlay.tile} {fmt_tag}: {sec:.4f}s")
+                    if best is None or sec < best.sec:
+                        best = TunedPlan(
+                            path="dense", engine=engine,
+                            nnz_block=dlay.tile, scan_target=st,
+                            sec=sec, idx_width="dense",
+                            val_storage=dlay.val_storage,
+                            packing="fixed", reorder="identity")
         if best is None:
             # every candidate failed or was skipped: no plan — dispatch
             # keeps the heuristic chain (observable, not silent)
@@ -799,13 +901,21 @@ def tune(tt, rank: int, opts=None, modes: Optional[Sequence[int]] = None,
                       f"dispatch keeps the heuristic chain")
             continue
         _entry_store(key, {"plan": dataclasses.asdict(best)})
+        if best.path == "dense" and skew_regime(skew):
+            # a dense layout has no nnz stream, so dispatch keys its
+            # lookup with an empty skew bucket — alias the winner
+            # there so a skewed-regime dense plan still steers
+            # (the storage-dtype alias idiom below)
+            _entry_store(plan_key(tt.dims, tt.nnz, m, rank, dtype,
+                                  mode_density=md),
+                         {"plan": dataclasses.asdict(best)})
         storage = resolve_storage_dtype(best.val_storage, dtype)
         if jnp.dtype(storage) != jnp.dtype(dtype):
             # a storage-narrowing winner also steers dispatch, where
             # the factors already carry the narrow dtype — alias the
             # plan under that key so the steering is not lost
             _entry_store(plan_key(tt.dims, tt.nnz, m, rank, storage,
-                                  skew=skew),
+                                  skew=skew, mode_density=md),
                          {"plan": dataclasses.asdict(best)})
         result.plans[m] = best
         if loud:

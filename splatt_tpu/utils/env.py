@@ -169,6 +169,24 @@ ENV_VARS = {
                                    "explicit Options.fiber_packing "
                                    "wins; unset, both are autotuner "
                                    "candidates"),
+    "SPLATT_DENSE": EnvVar("off", "dense-mode tile layout policy "
+                           "(docs/dense.md): off = every mode keeps "
+                           "the sparse blocked encoding; auto = a "
+                           "mode whose padded fiber density crosses "
+                           "SPLATT_DENSE_THRESHOLD (and whose dense "
+                           "cells stay within the blowup cap) gets a "
+                           "dense tile layout and the MXU matmul "
+                           "engines; on = force the dense tiling for "
+                           "every geometrically feasible mode.  A "
+                           "tuned dense plan wins over this policy; "
+                           "any dense build failure degrades "
+                           "classified to the sparse encoding "
+                           "(format_fallback, site=dense)"),
+    "SPLATT_DENSE_THRESHOLD": EnvVar("0.05", "padded per-mode density "
+                                     "(nnz / dense tile cells) at or "
+                                     "above which SPLATT_DENSE=auto "
+                                     "elects the dense tile layout "
+                                     "(docs/dense.md)"),
     "SPLATT_REORDER": EnvVar(None, "index-relabeling reorder applied "
                              "before blocked layouts are built (docs/"
                              "layout-balance.md): identity | random | "
@@ -444,7 +462,11 @@ ENV_VARS = {
                                     "popularity at exponent a, e.g. "
                                     "zipf:1.5), powerlaw (power-law "
                                     "mode sizes), amazon-like (scaled "
-                                    "review-tensor shape preset).  "
+                                    "review-tensor shape preset), "
+                                    "densemode (one near-dense mode, "
+                                    "docs/dense.md — adds the hybrid "
+                                    "dense-tile path row and the "
+                                    "flops/roofline-verdict fields).  "
                                     "Non-uniform scenarios tag the "
                                     "metric string so the regression "
                                     "gate only compares like "

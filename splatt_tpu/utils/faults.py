@@ -147,6 +147,12 @@ SITES = {
                      "a raised fault must degrade the build classified "
                      "to the v1 i32 encoding (format_fallback event), "
                      "never fail it",
+    "format.dense": "the dense tile-layout build of one mode "
+                    "(blocked.py build_layout/from_coo/reencode_layout, "
+                    "docs/dense.md); a raised fault must degrade the "
+                    "build classified to the sparse blocked encoding "
+                    "(format_fallback event with site=dense), never "
+                    "fail it",
     "format.decode": "native stream consumption of a compact layout "
                      "at MTTKRP dispatch (ops/mttkrp.py "
                      "mttkrp_blocked, docs/format.md); a raised fault "

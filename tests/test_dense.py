@@ -1,0 +1,623 @@
+"""Dense-mode MTTKRP (docs/dense.md).
+
+Contract under test:
+
+- **bit parity**: the dense tile layout is a re-encoding, not a
+  different computation — ``dense_mttkrp`` (XLA reference) matches the
+  sparse engines within f32 accumulation tolerance on every mode, the
+  interpret-mode ``fused_dense`` Pallas kernel is BIT-IDENTICAL to the
+  XLA reference, and a full CPD over a hybrid (dense + sparse) build
+  matches the all-sparse run, donated sweep on or off;
+- **verdict**: the dense/sparse decision thresholds the PADDED density
+  (the blowup the tiling actually pays), keeps a feasibility floor
+  even when forced, and SPLATT_DENSE defaults off;
+- **resilient build**: a failed dense tiling (the ``format.dense``
+  fault site, an infeasible geometry, a blowup past the cap) degrades
+  CLASSIFIED to the sparse encoding — a ``format_fallback`` event with
+  ``site="dense"``, never a failed build;
+- **tuner integration**: dense layouts are measured candidates, a
+  path="dense" winner is persisted under the mode-density regime key
+  and retrieved at dispatch, the strict match means a dense plan never
+  steers a sparse layout (and vice versa), and demotions are scoped to
+  the ``:dn`` shape keys — a dense-engine OOM never demotes the sparse
+  path;
+- **zero index bytes**: the encoded-bytes model charges a dense mode
+  value tiles + pad mask ONLY (``index_bytes() == 0``), and the flop
+  model + roofline verdict classify the dense path on CPU;
+- **registries**: the env vars / fault site / run-report events are
+  declared (splint SPL006/SPL007/SPL012 stay at zero).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import splatt_tpu.tune as tune
+from splatt_tpu import resilience
+from splatt_tpu.bench_algs import (mttkrp_bytes_encoded, mttkrp_decode_bytes,
+                                   mttkrp_flops, roofline_verdict)
+from splatt_tpu.blocked import (DENSE_BLOWUP_CAP, BlockedSparse,
+                                DenseModeLayout, build_dense_layout,
+                                build_layout, dense_mode_verdict,
+                                dense_tile_geometry, densify_layout,
+                                mode_density, mode_density_bucket,
+                                padded_mode_density)
+from splatt_tpu.config import BlockAlloc, Options, Verbosity
+from splatt_tpu.coo import SparseTensor
+from splatt_tpu.cpd import cpd_als, init_factors
+from splatt_tpu.ops.mttkrp import (_DEADLINE_ARMED, _engine_shape_key,
+                                   _tuned_plan_for, choose_path,
+                                   dense_mttkrp, engine_chain,
+                                   mttkrp_blocked)
+from splatt_tpu.ops.pallas_kernels import dense_vmem_ok, fused_dense
+from splatt_tpu.stats import density_stats, density_stats_text
+from splatt_tpu.utils import faults
+from tests import gen
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    monkeypatch.setenv(tune._CACHE_ENV, str(tmp_path / "tune_cache.json"))
+    monkeypatch.delenv("SPLATT_DENSE", raising=False)
+    monkeypatch.delenv("SPLATT_DENSE_THRESHOLD", raising=False)
+    tune.reset_memo()
+    resilience.reset_demotions()
+    resilience.run_report().clear()
+    _DEADLINE_ARMED.clear()
+    yield
+    tune.reset_memo()
+    resilience.reset_demotions()
+    resilience.run_report().clear()
+    _DEADLINE_ARMED.clear()
+    faults.reset()
+
+
+def _dense_tensor(seed=3, nnz=4000, dims=(16, 32, 32)):
+    """A genuinely dense-ish tensor: ~24% raw fill, ~6% PADDED fill —
+    above the default 5% dense verdict threshold on every mode, unique
+    coordinates (so dense placement vs sparse scatter-add agree to the
+    last accumulation)."""
+    rng = np.random.default_rng(seed)
+    total = int(np.prod(dims))
+    lin = rng.choice(total, size=nnz, replace=False)
+    inds = np.stack(np.unravel_index(lin, dims)).astype(np.int64)
+    vals = rng.random(nnz) + 0.1
+    return SparseTensor(inds, vals, dims)
+
+
+def _sparse_tensor():
+    return gen.fixture_tensor("med")
+
+
+def _opts(**kw):
+    kw.setdefault("random_seed", 42)
+    kw.setdefault("verbosity", Verbosity.NONE)
+    kw.setdefault("use_pallas", False)
+    kw.setdefault("autotune", False)
+    return Options(**kw)
+
+
+# -- geometry / metrics ------------------------------------------------------
+
+def test_geometry_and_storage_accounting():
+    """The tile geometry is derived (never stored), pads the inner dim
+    to the 128-lane tile, and the layout's storage model carries ZERO
+    index bytes — the point of the format."""
+    tt = _dense_tensor()
+    geo = dense_tile_geometry(tt.dims, 0)
+    assert geo.others == (1, 2) and geo.inner == 2
+    assert geo.inner_pad == 128 and geo.n_outer == 32
+    assert geo.tile == 16 and geo.ntiles == 1
+    assert geo.span == 32 * 128 and geo.cells == 16 * geo.span
+    lay = build_dense_layout(tt, 0)
+    assert isinstance(lay, DenseModeLayout)
+    assert lay.tiles.shape == (geo.ntiles, geo.tile, geo.span)
+    assert lay.mask.shape == (geo.span,)
+    assert lay.index_bytes() == 0
+    assert lay.storage_bytes() == lay.value_bytes() + geo.span
+    assert lay.encoding == "dense" and lay.idx_width == "dense"
+    assert lay.block == geo.tile and lay.skew == ""
+    # every nonzero landed exactly once (unique coords): total mass
+    np.testing.assert_allclose(float(jnp.sum(lay.tiles)),
+                               float(np.sum(tt.vals)), rtol=1e-6)
+    # pad columns really are masked out
+    assert not bool(np.asarray(lay.mask).all())
+    assert int(np.asarray(lay.mask).sum()) == 32 * 32
+    assert "dense" in lay.format_desc() and "tile=16x4096" in repr(lay)
+
+
+def test_density_metrics_and_bucket():
+    tt = _dense_tensor()
+    d = mode_density(tt.dims, 0, tt.nnz)
+    pd = padded_mode_density(tt.dims, 0, tt.nnz)
+    assert d == pytest.approx(4000 / 16384)
+    assert pd == pytest.approx(4000 / 65536)
+    assert pd < d  # padding makes the unfolding look sparser
+    assert mode_density_bucket(tt.dims, 0, tt.nnz) == "dn5"
+    # below ~3% the bucket is empty: legacy plan keys stay byte-identical
+    assert mode_density_bucket(tt.dims, 0, 1000) == ""
+    assert mode_density_bucket((2,), 0, 10) == ""  # infeasible geometry
+
+
+def test_verdict_threshold_boundaries_and_caps():
+    """The verdict thresholds PADDED density (>=), the blowup cap is a
+    feasibility floor even under force, and degenerate tensors never
+    qualify."""
+    tt = _dense_tensor()
+    pd = padded_mode_density(tt.dims, 0, tt.nnz)
+    assert dense_mode_verdict(tt.dims, 0, tt.nnz, threshold=pd)
+    assert not dense_mode_verdict(tt.dims, 0, tt.nnz, threshold=pd * 1.01)
+    # blowup cap: 10 nonzeros in 65536 padded cells is past 64x even
+    # when the policy forces dense
+    assert not dense_mode_verdict(tt.dims, 0, 10, threshold=1e-9)
+    assert not dense_mode_verdict(tt.dims, 0, 10, threshold=1e-9,
+                                  force=True)
+    # force skips the threshold but keeps the feasibility floor
+    nnz_floor = (16 * 32 * 128) // DENSE_BLOWUP_CAP
+    assert dense_mode_verdict(tt.dims, 0, nnz_floor, threshold=0.99,
+                              force=True)
+    assert not dense_mode_verdict(tt.dims, 0, nnz_floor, threshold=0.99)
+    assert not dense_mode_verdict(tt.dims, 0, 0, threshold=1e-9, force=True)
+    assert not dense_mode_verdict((7,), 0, 5, threshold=1e-9, force=True)
+
+
+def test_build_dense_layout_raises_past_cap():
+    tt = _dense_tensor()
+    tiny = SparseTensor(tt.inds[:, :10], np.asarray(tt.vals)[:10], tt.dims)
+    with pytest.raises(ValueError, match="blowup"):
+        build_dense_layout(tiny, 0)
+
+
+# -- bit parity --------------------------------------------------------------
+
+def test_dense_vs_sparse_parity_all_modes():
+    """dense_mttkrp equals the sparse engines on every mode within f32
+    accumulation tolerance (same scatter-add semantics, different
+    summation order)."""
+    tt = _dense_tensor()
+    facs = init_factors(tt.dims, 5, 7, dtype=jnp.float32)
+    for mode in range(tt.nmodes):
+        dl = build_dense_layout(tt, mode)
+        sl = build_layout(tt, mode, block=1024, val_dtype=np.float32,
+                          dense=False)
+        ref = np.asarray(mttkrp_blocked(sl, facs, mode,
+                                        path="sorted_onehot", impl="xla",
+                                        autotune=False))
+        out = np.asarray(dense_mttkrp(dl, facs, mode))
+        assert out.shape == ref.shape == (tt.dims[mode], 5)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"mode {mode}")
+
+
+def test_fused_dense_interpret_bit_identical_to_xla():
+    """The Pallas kernel in interpret mode is BIT-IDENTICAL to the XLA
+    reference: same operands, same (span, R) KR product, one
+    dot_general over span per row tile at the same precision."""
+    tt = _dense_tensor()
+    for dtype in (jnp.float32, jnp.float64):
+        facs = init_factors(tt.dims, 4, 2, dtype=dtype)
+        for mode in range(tt.nmodes):
+            dl = build_dense_layout(tt, mode)
+            a = np.asarray(dense_mttkrp(dl, facs, mode))
+            b = np.asarray(fused_dense(dl, facs, mode, interpret=True))
+            np.testing.assert_array_equal(a, b,
+                                          err_msg=f"{dtype}/{mode}")
+
+
+def test_dispatched_dense_path_and_evidence():
+    """mttkrp_blocked routes a dense layout through the dense chain
+    (both impls), matches the reference exactly, and records the
+    dense_dispatch evidence event at first (compile-bearing) dispatch."""
+    tt = _dense_tensor()
+    facs = init_factors(tt.dims, 4, 5, dtype=jnp.float32)
+    dl = build_dense_layout(tt, 0)
+    ref = np.asarray(dense_mttkrp(dl, facs, 0))
+    for impl in ("xla", "pallas_interpret"):
+        out = np.asarray(mttkrp_blocked(dl, facs, 0, path="dense",
+                                        impl=impl, autotune=False))
+        np.testing.assert_array_equal(out, ref, err_msg=impl)
+    evs = resilience.run_report().events("dense_dispatch")
+    assert evs, "first dense dispatch must leave evidence"
+    engines = {e["engine"] for e in evs}
+    assert "dense_xla" in engines
+    for e in evs:
+        assert e["mode"] == 0 and e["tile"] == dl.tile
+        assert e["span"] == dl.span and e["density_bucket"] == "dn5"
+    # once per (engine, shape): a warm dispatch adds nothing
+    mttkrp_blocked(dl, facs, 0, path="dense", impl="xla", autotune=False)
+    assert len(resilience.run_report().events("dense_dispatch")) == len(evs)
+
+
+def test_chain_and_path_choice():
+    tt = _dense_tensor()
+    facs = init_factors(tt.dims, 4, 5, dtype=jnp.float32)
+    dl = build_dense_layout(tt, 0)
+    assert choose_path(dl, 0, _opts()) == "dense"
+    assert engine_chain(dl, facs, 0, impl="xla") == ["dense_xla"]
+    assert dense_vmem_ok(dl, facs, 0)
+    chain = engine_chain(dl, facs, 0, impl="pallas_interpret")
+    assert chain == ["fused_dense", "dense_xla"]
+    # the layout's encoding overrides the sparse path default: a caller
+    # that skips choose_path still lands on the dense matmul
+    got = np.asarray(mttkrp_blocked(dl, facs, 0, autotune=False))
+    ref = np.asarray(dense_mttkrp(dl, facs, 0))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_bf16_dense_storage():
+    tt = _dense_tensor()
+    l32 = build_dense_layout(tt, 0)
+    l16 = build_dense_layout(tt, 0, val_dtype=jnp.bfloat16)
+    assert l16.tiles.dtype == jnp.bfloat16 and l16.val_storage == "bf16"
+    assert l16.value_bytes() == l32.value_bytes() // 2
+    assert "bf16" in l16.format_desc()
+    facs = init_factors(tt.dims, 3, 1, dtype=jnp.bfloat16)
+    a = np.asarray(dense_mttkrp(l16, facs, 0), dtype=np.float32)
+    b = np.asarray(dense_mttkrp(l32, facs, 0), dtype=np.float32)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-1)
+
+
+def test_densify_matches_direct_build():
+    """densify_layout (the tuner's re-encoding of an existing sorted
+    build) produces the same tiles as building dense directly — unique
+    coordinates make placement exact."""
+    tt = _dense_tensor()
+    sl = build_layout(tt, 0, block=1024, val_dtype=np.float32, dense=False)
+    a = densify_layout(sl, tt.dims)
+    b = build_dense_layout(tt, 0)
+    np.testing.assert_array_equal(np.asarray(a.tiles), np.asarray(b.tiles))
+    np.testing.assert_array_equal(np.asarray(a.mask), np.asarray(b.mask))
+    assert a.density_bucket == b.density_bucket == "dn5"
+
+
+# -- policy / resilient build ------------------------------------------------
+
+def test_policy_default_off_and_env(monkeypatch):
+    """SPLATT_DENSE defaults off (dense tiling is opt-in, like every
+    format change); auto consults the verdict; on forces feasible
+    modes."""
+    tt = _dense_tensor()
+    assert build_layout(tt, 0).encoding == "v1"
+    monkeypatch.setenv("SPLATT_DENSE", "auto")
+    assert build_layout(tt, 0).encoding == "dense"
+    monkeypatch.setenv("SPLATT_DENSE_THRESHOLD", "0.5")
+    assert build_layout(tt, 0).encoding == "v1"  # 6% < 50%
+    monkeypatch.setenv("SPLATT_DENSE", "on")
+    assert build_layout(tt, 0).encoding == "dense"  # forced past threshold
+    monkeypatch.setenv("SPLATT_DENSE", "off")
+    assert build_layout(tt, 0).encoding == "v1"
+
+
+def test_degrade_drill_build_layout():
+    """Chaos drill: a raised fault at format.dense degrades the build
+    CLASSIFIED to the sparse encoding — a format_fallback event with
+    site="dense", never a failed build."""
+    tt = _dense_tensor()
+    with faults.inject("format.dense", "runtime", times=1):
+        lay = build_layout(tt, 0, dense=True)
+    assert lay.encoding == "v1"  # the sparse build every engine consumes
+    evs = resilience.run_report().events("format_fallback")
+    assert len(evs) == 1
+    assert evs[0]["site"] == "dense" and evs[0]["idx_width"] == "dense"
+    assert evs[0]["failure_class"] and evs[0]["error"]
+    # the degraded layout still dispatches
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float32)
+    out = np.asarray(mttkrp_blocked(lay, facs, 0, autotune=False))
+    assert np.isfinite(out).all()
+    # summary renders the dense degrade line
+    text = "\n".join(resilience.run_report().summary())
+    assert "dense" in text
+
+
+def test_degrade_drill_from_coo():
+    """A forced-dense compile whose every dense build fails still
+    produces a fully sparse, dispatchable BlockedSparse."""
+    tt = _dense_tensor()
+    opts = _opts(dense="on", block_alloc=BlockAlloc.ALLMODE)
+    with faults.inject("format.dense", "runtime", times=99):
+        X = BlockedSparse.from_coo(tt, opts)
+    assert all(l.encoding == "v1" for l in X.layouts)
+    evs = resilience.run_report().events("format_fallback")
+    assert len(evs) == tt.nmodes
+    assert all(e["site"] == "dense" for e in evs)
+    facs = init_factors(tt.dims, 3, 0, dtype=jnp.float32)
+    for m in range(tt.nmodes):
+        out = np.asarray(mttkrp_blocked(X.layout_for(m), facs, m,
+                                        autotune=False))
+        assert np.isfinite(out).all()
+
+
+# -- hybrid per-mode builds --------------------------------------------------
+
+def test_from_coo_hybrid_mix_parity():
+    """Mode 0 dense, modes 1-2 sparse in ONE BlockedSparse: the
+    per-mode mode_map routes each mode to its encoding and the MTTKRP
+    outputs match the all-sparse build."""
+    tt = _dense_tensor()
+    opts = _opts(block_alloc=BlockAlloc.ALLMODE)
+    hyb = BlockedSparse.from_coo(tt, opts, tuned_dense={0: True})
+    ref = BlockedSparse.from_coo(tt, opts)
+    assert hyb.layout_for(0).encoding == "dense"
+    assert hyb.layout_for(1).encoding == "v1"
+    assert hyb.layout_for(2).encoding == "v1"
+    facs = init_factors(tt.dims, 4, 9, dtype=jnp.float32)
+    for m in range(tt.nmodes):
+        lay = hyb.layout_for(m)
+        path = "dense" if lay.encoding == "dense" else "sorted_onehot"
+        a = np.asarray(mttkrp_blocked(lay, facs, m, path=path,
+                                      autotune=False))
+        b = np.asarray(mttkrp_blocked(ref.layout_for(m), facs, m,
+                                      path="sorted_onehot",
+                                      autotune=False))
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"mode {m}")
+
+
+def test_from_coo_auto_policy_densifies_eligible_modes():
+    tt = _dense_tensor()
+    opts = _opts(dense="auto", block_alloc=BlockAlloc.ALLMODE)
+    X = BlockedSparse.from_coo(tt, opts)
+    assert all(X.layout_for(m).encoding == "dense"
+               for m in range(tt.nmodes))
+    # imbalance reporting skips the dense layouts instead of crashing
+    # (the sparse builds every dense mode degrades to are still there)
+    imb = X.imbalance()
+    assert isinstance(imb, dict)
+    assert all("dense" not in str(v.get("packing", "")) for v in
+               imb.values())
+    # a sparse tensor under the same policy stays sparse
+    st = _sparse_tensor()
+    Y = BlockedSparse.from_coo(st, _opts(dense="auto"))
+    assert all(l.encoding == "v1" for l in Y.layouts)
+
+
+# -- CPD: donation parity + guarded round-trip -------------------------------
+
+def test_cpd_hybrid_parity_and_donation():
+    """A full CPD over the hybrid build reaches the all-sparse fit
+    within f32 tolerance, and the donated sweep changes NOTHING bit
+    for bit relative to the undonated hybrid run."""
+    tt = _dense_tensor()
+    init = init_factors(tt.dims, 3, 11, dtype=jnp.float32)
+    outs = {}
+    for name, kw in (("sparse", dict()),
+                     ("dense", dict(dense="auto")),
+                     ("dense_nodonate", dict(dense="auto",
+                                             donate_sweep=False))):
+        opts = _opts(max_iterations=5, nnz_block=1024,
+                     block_alloc=BlockAlloc.ALLMODE, **kw)
+        outs[name] = cpd_als(BlockedSparse.from_coo(tt, opts), 3,
+                             opts=opts, init=init)
+    assert float(outs["dense"].fit) == pytest.approx(
+        float(outs["sparse"].fit), abs=1e-4)
+    assert float(outs["dense"].fit) == float(outs["dense_nodonate"].fit)
+    for ua, ub in zip(outs["dense"].factors, outs["dense_nodonate"].factors):
+        np.testing.assert_array_equal(np.asarray(ua), np.asarray(ub))
+    # the caller's init survives the donated dense run
+    assert not any(u.is_deleted() for u in init)
+
+
+def test_cpd_dense_guarded_checkpoint_resume(tmp_path):
+    """The guarded-ALS surround (checkpoint/resume, health sentinel)
+    works unchanged over a dense-mode tensor, and the run leaves
+    dense_dispatch evidence."""
+    tt = _dense_tensor()
+    ck = str(tmp_path / "ck.npz")
+    opts = _opts(max_iterations=4, dense="auto",
+                 block_alloc=BlockAlloc.ALLMODE)
+    X = BlockedSparse.from_coo(tt, opts)
+    assert any(l.encoding == "dense" for l in X.layouts)
+    a = cpd_als(X, rank=3, opts=opts, checkpoint_path=ck,
+                checkpoint_every=2)
+    assert np.isfinite(float(a.fit))
+    assert resilience.run_report().events("dense_dispatch")
+    # resume from the checkpoint: same terminal model
+    b = cpd_als(X, rank=3, opts=opts, checkpoint_path=ck,
+                checkpoint_every=2)
+    assert float(b.fit) == pytest.approx(float(a.fit), abs=1e-6)
+
+
+# -- tuner integration -------------------------------------------------------
+
+def _dense_plan(dl, rank=4):
+    return tune.TunedPlan(path="dense", engine="dense_xla",
+                          nnz_block=dl.tile, scan_target=1 << 21,
+                          sec=0.001, idx_width="dense", val_storage="auto",
+                          packing="fixed", reorder="identity")
+
+
+def test_strict_match_dense_vs_sparse():
+    """A dense plan never steers a sparse layout and vice versa: the
+    plan key carries the mode-density regime, and the field match pins
+    idx_width/nnz_block to the layout that was measured."""
+    tt = _dense_tensor()
+    dl = build_dense_layout(tt, 0)
+    sl = build_layout(tt, 0, block=1024, val_dtype=np.float32, dense=False)
+    facs = init_factors(tt.dims, 4, 0, dtype=jnp.float32)
+    # dense layouts dispatch with skew="" and their density bucket
+    tune._entry_store(
+        tune.plan_key(tt.dims, tt.nnz, 0, 4, jnp.float32, skew="",
+                      mode_density=dl.density_bucket),
+        {"plan": dataclasses.asdict(_dense_plan(dl))})
+    got = _tuned_plan_for(dl, facs, 0, "dense", autotune=True)
+    assert got is not None and got.path == "dense"
+    assert got.engine == "dense_xla" and got.nnz_block == dl.tile
+    # the same plan must never steer the sparse layout
+    assert _tuned_plan_for(sl, facs, 0, "sorted_onehot",
+                           autotune=True) is None
+    assert _tuned_plan_for(sl, facs, 0, "dense", autotune=True) is None
+    # ... and a sparse plan stored under the sparse key never steers
+    # the dense layout
+    sparse_plan = tune.TunedPlan(path="sorted_onehot", engine="xla",
+                                 nnz_block=1024, scan_target=1 << 21,
+                                 sec=0.001)
+    tune._entry_store(
+        tune.plan_key(tt.dims, tt.nnz, 0, 4, jnp.float32,
+                      skew=tune.skew_of(tt, 0),
+                      mode_density=sl.density_bucket),
+        {"plan": dataclasses.asdict(sparse_plan)})
+    assert _tuned_plan_for(sl, facs, 0, "sorted_onehot",
+                           autotune=True) is not None
+    # (a skew-free regime shares the key: the sparse winner then
+    # REPLACES the dense entry, and the strict field match refuses to
+    # apply it — dense dispatch falls back to the heuristic chain
+    # instead of running the wrong plan)
+    got2 = _tuned_plan_for(dl, facs, 0, "dense", autotune=True)
+    assert got2 is None or got2.path == "dense"
+
+
+def test_demotion_scoped_to_dense_keys():
+    """An OOM under the dense engine demotes the :dn shape key only —
+    the sparse path's standing is untouched, and vice versa."""
+    tt = _dense_tensor()
+    dl = build_dense_layout(tt, 0)
+    sl = build_layout(tt, 0, block=1024, val_dtype=np.float32, dense=False)
+    facs = init_factors(tt.dims, 4, 0, dtype=jnp.float32)
+    kd = _engine_shape_key(dl, facs, 0)
+    ks = _engine_shape_key(sl, facs, 0)
+    assert ":dn" in kd and ":dn" not in ks and kd != ks
+    resilience.demote_engine("fused_dense",
+                             MemoryError("injected dense OOM"),
+                             shape_key=kd)
+    assert resilience.is_demoted("fused_dense", kd)
+    assert not resilience.is_demoted("fused_dense", ks)
+    # the dense chain drops the kernel and keeps the terminal engine
+    assert engine_chain(dl, facs, 0, impl="pallas_interpret") == [
+        "dense_xla"]
+    # a sparse-side demotion never reaches the dense keys
+    resilience.reset_demotions()
+    resilience.demote_engine("xla_scan", MemoryError("sparse OOM"),
+                             shape_key=ks)
+    assert not resilience.is_demoted("xla_scan", kd)
+
+
+def test_tune_measures_dense_candidates_and_persists_winner(monkeypatch):
+    """tune() measures dense candidates when the policy allows them,
+    and a dense winner is persisted under the mode-density regime key
+    and retrieved at dispatch."""
+    tt = _dense_tensor()
+    kw = dict(modes=[0], blocks=(4096,), reorders=("identity",),
+              formats=[("i32", "auto")], warm=0, reps=1, force=True)
+    monkeypatch.setenv("SPLATT_DENSE", "off")
+    off = tune.tune(tt, 4, **kw)
+    assert off.measured >= 1
+    assert off.plans[0].path != "dense"  # no dense candidates under off
+    tune.reset_memo()
+    monkeypatch.setenv("SPLATT_DENSE", "auto")
+    # substitute the timing body (the module-level seam
+    # _measure_candidate exists for) so the dense candidate wins
+    # deterministically: the real dispatch still runs — a broken
+    # candidate still classifies — but the clock is synthetic
+    real = tune._measure_candidate
+
+    def rigged(layout, factors, mode, path, impl, engine, scan_target,
+               warm=1, reps=2):
+        real(layout, factors, mode, path, impl, engine, scan_target,
+             warm=warm, reps=reps)
+        return 1e-6 if path == "dense" else 1.0
+
+    monkeypatch.setattr(tune, "_measure_candidate", rigged)
+    auto = tune.tune(tt, 4, **kw)
+    assert auto.measured > off.measured  # the dense candidates ran too
+    plan = auto.plans.get(0)
+    assert plan is not None and plan.path == "dense"
+    assert plan.engine in ("dense_xla", "fused_dense")
+    assert plan.idx_width == "dense" and plan.reorder == "identity"
+    # retrieval at the dispatch site: the dense layout's own regime key
+    # (tune measured at the tensor's f64 dtype — look up at the same)
+    dl = build_dense_layout(tt, 0, val_dtype=np.float64)
+    facs = init_factors(tt.dims, 4, 0, dtype=jnp.float64)
+    got = _tuned_plan_for(dl, facs, 0, "dense", autotune=True)
+    assert got is not None and got.path == "dense"
+    # the dispatched result still matches the reference
+    ref = np.asarray(dense_mttkrp(dl, facs, 0))
+    out = np.asarray(mttkrp_blocked(dl, facs, 0, path="dense",
+                                    autotune=True))
+    np.testing.assert_array_equal(out, ref)
+
+
+# -- stats / bytes / flops / roofline ----------------------------------------
+
+def test_density_stats_and_text():
+    tt = _dense_tensor()
+    st = density_stats(tt)
+    assert st["threshold"] == pytest.approx(0.05)
+    for m in range(tt.nmodes):
+        d = st["modes"][str(m)]
+        assert d["verdict"] == "dense" and d["bucket"] == "dn5"
+        assert 0 < d["padded_density"] < d["density"] < 1
+    assert density_stats(tt, threshold=0.5)["modes"]["0"][
+        "verdict"] == "sparse"
+    text = density_stats_text(tt)
+    assert "Mode density" in text and "-> dense" in text
+    st2 = density_stats(_sparse_tensor())
+    assert all(d["verdict"] == "sparse" for d in st2["modes"].values())
+    assert "-> sparse" in density_stats_text(_sparse_tensor())
+    # the factoring preamble renders hybrid builds (dense layouts have
+    # no nblocks/seg_width — the CLI cpd verb hits this line)
+    from splatt_tpu.stats import cpd_stats_text
+    hyb = BlockedSparse.from_coo(tt, _opts(block_alloc=BlockAlloc.ALLMODE),
+                                 tuned_dense={0: True})
+    txt = cpd_stats_text(hyb, 4, _opts())
+    assert "dense tiles=" in txt and "index_bytes=0" in txt
+
+
+def test_encoded_bytes_model_zero_index_bytes():
+    """Acceptance: the dense mode carries ZERO index bytes in the
+    encoded-bytes model — its traffic is value tiles + pad mask +
+    factor tables + the KR operand + the output, nothing indexed."""
+    tt = _dense_tensor()
+    rank = 4
+    hyb = BlockedSparse.from_coo(tt, _opts(block_alloc=BlockAlloc.ALLMODE),
+                                 tuned_dense={0: True})
+    dl = hyb.layout_for(0)
+    assert dl.encoding == "dense" and dl.index_bytes() == 0
+    got = mttkrp_bytes_encoded("blocked", hyb, rank, 0, 4)
+    tables = sum(d * rank * 4 for k, d in enumerate(tt.dims) if k != 0)
+    want = (dl.storage_bytes() + tables + 2 * dl.span * rank * 4
+            + tt.dims[0] * rank * 4)
+    assert got == pytest.approx(want)
+    # no decode traffic either: the dense engines read the tiles as-is
+    assert mttkrp_decode_bytes(hyb, rank, 0, "dense_xla") == 0
+    assert mttkrp_decode_bytes(hyb, rank, 0, "fused_dense") == 0
+    # the sparse build pays real index traffic the dense mode deleted
+    ref = BlockedSparse.from_coo(tt, _opts(block_alloc=BlockAlloc.ALLMODE))
+    sref = ref.layout_for(0)
+    assert sref.storage_bytes() > sref.nnz * 4  # idx streams beyond vals
+    assert dl.storage_bytes() == dl.value_bytes() + dl.mask.size
+
+
+def test_flops_model_and_roofline_verdict():
+    tt = _dense_tensor()
+    rank = 4
+    hyb = BlockedSparse.from_coo(tt, _opts(block_alloc=BlockAlloc.ALLMODE),
+                                 tuned_dense={0: True})
+    dl = hyb.layout_for(0)
+    geo = dl.geometry
+    assert mttkrp_flops("blocked", hyb, rank, 0) == pytest.approx(
+        2.0 * geo.cells * rank + geo.span * rank)
+    # sparse modes keep the per-nonzero Hadamard-chain count
+    sparse_flops = mttkrp_flops("stream", hyb, rank, 1)
+    assert sparse_flops >= 2.0 * tt.nnz * rank * (tt.nmodes - 1)
+    # the roofline verdict classifies on CPU through the nominal peaks
+    v = roofline_verdict(1e9, 1e9)
+    assert set(v) == {"intensity", "ridge", "bound"}
+    assert v["bound"] in ("compute", "memory") and v["ridge"] > 0
+    assert roofline_verdict(1.0, 1e12)["bound"] == "compute"
+    assert roofline_verdict(1e12, 1.0)["bound"] == "memory"
+
+
+# -- registries (splint stays at zero) ---------------------------------------
+
+def test_registries_declare_dense_surface():
+    from splatt_tpu.resilience import RUN_REPORT_EVENTS
+    from splatt_tpu.utils.env import ENV_VARS
+    from splatt_tpu.utils.faults import SITES
+
+    assert "format.dense" in SITES
+    assert "SPLATT_DENSE" in ENV_VARS
+    assert "SPLATT_DENSE_THRESHOLD" in ENV_VARS
+    assert "dense_dispatch" in RUN_REPORT_EVENTS
+    assert "dense" in RUN_REPORT_EVENTS["format_fallback"].lower()
