@@ -421,10 +421,11 @@ def nnz_skew_bucket(hist: np.ndarray) -> str:
     autotuner's shape regime (tune.shape_regime) so a plan measured on
     a uniform tensor never steers a zipf one, without fragmenting the
     cache per tensor."""
-    hist = np.asarray(hist)
+    hist = np.asarray(hist, dtype=np.int64)
     hist = hist[hist > 0]
     if hist.size == 0:
         return "k0"
+    # integer counts: numpy's mean over int64 accumulates at f64
     ratio = float(hist.max()) / float(hist.mean())
     return f"k{int(max(ratio, 1.0)).bit_length()}"
 
@@ -759,7 +760,7 @@ def _record_imbalance(mode: int, packing: str, block: int, seg_width: int,
 
 
 def build_layout(tt: SparseTensor, mode: int, block: int = 4096,
-                 val_dtype=np.float32, mode_order=None,
+                 val_dtype=np.float32, mode_order=None,  # splint: ignore[SPL005] signature default mirroring the reference's val_t; callers override via Options.val_dtype
                  mode_order_custom=None, verbose: bool = False,
                  fmt: Optional[LayoutFormat] = None,
                  packing: str = "fixed",
@@ -1405,7 +1406,7 @@ class BlockedSparse:
                 # dense tile layouts have no nnz stream to balance
                 continue
             real = lay.real_mask()
-            counts = real.sum(axis=1)
+            counts = np.count_nonzero(real, axis=1)
             # mode_ids is the stream-consumer decode shared with the
             # engines (identity for v1, local+base / RLE expansion for
             # the compact encodings) — only the sorted mode's decoded
@@ -1711,7 +1712,7 @@ class BlockedSparse:
         fit denominator.  (bf16-stored values upcast first: numpy's dot
         has no bfloat16 kernel.)
         """
-        v = np.asarray(self.layouts[0].vals).astype(np.float64)
+        v = np.asarray(self.layouts[0].vals).astype(np.float64)  # splint: ignore[SPL005] host-side frobsq upcasts to f64 BEFORE the reduce by design
         return float(np.dot(v, v))
 
 

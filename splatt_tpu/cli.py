@@ -62,7 +62,7 @@ def _build_opts(args) -> "Options":
     if getattr(args, "block", None):
         opts.nnz_block = args.block
     if getattr(args, "f64", False):
-        opts.val_dtype = np.dtype(np.float64)
+        opts.val_dtype = np.dtype(np.float64)  # splint: ignore[SPL005] the --f64 flag IS the user-facing dtype contract
     if getattr(args, "mode_order", None):
         from splatt_tpu.config import ModeOrder
         opts.mode_order = ModeOrder(args.mode_order)
@@ -459,7 +459,7 @@ def cmd_bench(args) -> int:
         print(f"cross-check max relative |alg - stream| = {dev:.3e}")
         # tolerance follows the dtype actually computed in (a float64
         # request degrades to float32 when x64 is off)
-        tol = (1e-10 if resolve_dtype(opts, tt.vals.dtype) == np.float64
+        tol = (1e-10 if resolve_dtype(opts, tt.vals.dtype) == np.float64  # splint: ignore[SPL005] crosscheck tolerance selection names the dtype on purpose
                else 9e-3)
         if dev > tol:
             print(f"error: algorithms disagree beyond tolerance {tol}")

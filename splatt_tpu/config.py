@@ -372,6 +372,49 @@ def host_staging_dtype(dtype):
     return np.dtype(d)
 
 
+#: the narrow storage floats: stored low-precision, accumulated wide
+#: (docs/format.md bf16 value storage; :func:`acc_dtype`)
+NARROW_DTYPES = ("bfloat16", "float16")
+
+
+def is_narrow(dtype) -> bool:
+    """True when `dtype` is a narrow storage float (bf16/f16) whose
+    reductions must accumulate wide (:func:`acc_dtype`)."""
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).name in NARROW_DTYPES
+
+
+def acc_dtype(dtype):
+    """THE accumulation-dtype policy: reductions over narrow storage
+    floats (bf16/f16) accumulate in f32 — the MXU-native mixed pattern
+    (low-precision reads, full-precision accumulation) — and every
+    other dtype accumulates in itself.  The engine-side ``_acc_dtype``
+    helpers delegate here so storage narrowing stays ONE decision;
+    splint SPL024 recognizes reductions routed through this helper
+    (or pinned via ``preferred_element_type``) as carrying the
+    discipline."""
+    import jax.numpy as jnp
+
+    if is_narrow(dtype):
+        return jnp.dtype(jnp.float32)
+    return jnp.dtype(dtype)
+
+
+def tile_packing(dtype):
+    """Native TPU ``(sublane, lane)`` tile packing for `dtype`:
+    (8, 128) for 4-byte types, (16, 128) for the 2-byte floats
+    (bf16/f16), (32, 128) for 1-byte.  The minor dim is always 128
+    lanes; the sublane count scales inversely with itemsize, so one
+    packed register tile always spans the same bytes.  Kernel rank/row
+    padding must align to THIS (splint SPL025): a dtype-blind pad to 8
+    sublanes under-packs bf16 tiles 2x."""
+    import jax.numpy as jnp
+
+    itemsize = max(1, jnp.dtype(dtype).itemsize)
+    return (8 * max(1, 4 // itemsize), 128)
+
+
 @dataclasses.dataclass
 class Options:
     """Run-time options (≙ splatt_default_opts, src/opts.c:10-47).

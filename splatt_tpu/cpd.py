@@ -30,7 +30,8 @@ import numpy as np
 
 from splatt_tpu import trace
 from splatt_tpu.blocked import BlockedSparse
-from splatt_tpu.config import Options, Verbosity, default_opts, resolve_dtype
+from splatt_tpu.config import (Options, Verbosity, acc_dtype, default_opts,
+                               resolve_dtype)
 from splatt_tpu.coo import SparseTensor
 from splatt_tpu.kruskal import KruskalTensor, post_process
 from splatt_tpu.ops.linalg import (form_normal_lhs, gram, normalize_columns,
@@ -40,7 +41,7 @@ from splatt_tpu.utils.timers import timers
 
 
 def init_factors(dims: Tuple[int, ...], rank: int, seed: int,
-                 dtype=jnp.float32) -> List[jax.Array]:
+                 dtype=jnp.float32) -> List[jax.Array]:  # splint: ignore[SPL005] init_factors signature default; cpd_als resolves through config.resolve_dtype
     """Seed-stable random factor init (≙ mat_rand; per-mode fold_in keeps
     initialization independent of device layout, ≙ mpi_mat_rand's
     rank-count invariance, src/splatt_mpi.h:368-386)."""
@@ -71,10 +72,17 @@ def _zz_inner(lam, grams, M, U_last):
     """⟨Z,Z⟩ = λᵀ(⊛ Grams)λ and ⟨X,Z⟩ from the last-mode MTTKRP result
     (p_kruskal_norm / p_tt_kruskal_inner, src/cpd.c:116-218) — shared by
     both sweep builders."""
+    acc = acc_dtype(M.dtype)
     had = jnp.outer(lam, lam)
     for g in grams:
         had = had * g
-    return jnp.sum(had), jnp.sum(M * U_last * lam[None, :])
+    # <X,Z> as ONE pinned contraction: under bf16 factors, M (the wide
+    # MTTKRP accumulator) times U_last (narrow) would materialize a
+    # wide (dim, R) product ahead of the reduce — doubled hot-loop
+    # bytes (SPL028) and an unpinned accumulation (SPL024)
+    inner = jnp.einsum("dr,dr,r->", M, U_last, lam,
+                       preferred_element_type=acc)
+    return jnp.sum(had, dtype=acc), inner
 
 
 def _make_sweep(X: Union[SparseTensor, BlockedSparse], nmodes: int,
@@ -1094,9 +1102,11 @@ def _make_batched_sweep(bb, rank: int, donate: bool, xnormsq,
         # max-norm pick is a select, not a retrace (zero-padded bucket
         # rows change neither: they add 0 to the 2-norm sum and the
         # max-norm clamps at 1.0 either way)
-        lam2 = jnp.sqrt(jnp.sum(U * U, axis=0))
+        lam2 = jnp.sqrt(jnp.einsum(
+            "dr,dr->r", U, U,
+            preferred_element_type=acc_dtype(U.dtype)))
         lamm = jnp.maximum(jnp.max(U, axis=0), 1.0)
-        lam = jnp.where(first, lam2, lamm)
+        lam = jnp.where(first, lam2.astype(U.dtype), lamm)
         safe = jnp.where(lam > 0, lam, 1.0)
         return U / safe, lam
 

@@ -29,12 +29,12 @@ def from_torch(t) -> SparseTensor:
     if t.is_sparse:
         t = t.coalesce()
         inds = t.indices().cpu().numpy().astype(np.int64)
-        vals = t.values().cpu().numpy().astype(np.float64)
+        vals = t.values().cpu().numpy().astype(np.float64)  # splint: ignore[SPL005] host COO values are f64 by convention (reference val_t ingest)
         return SparseTensor(inds, vals, tuple(t.shape))
     dense = t.cpu().numpy()
     idx = np.nonzero(dense)
     return SparseTensor(np.stack([i.astype(np.int64) for i in idx]),
-                        dense[idx].astype(np.float64), dense.shape)
+                        dense[idx].astype(np.float64), dense.shape)  # splint: ignore[SPL005] host COO values are f64 by convention (reference val_t ingest)
 
 
 def to_torch(tt: SparseTensor):
@@ -88,7 +88,7 @@ def from_scipy(mat) -> SparseTensor:
     """scipy.sparse matrix → 2-mode SparseTensor."""
     coo = mat.tocoo()
     inds = np.stack([coo.row.astype(np.int64), coo.col.astype(np.int64)])
-    return SparseTensor(inds, coo.data.astype(np.float64), coo.shape)
+    return SparseTensor(inds, coo.data.astype(np.float64), coo.shape)  # splint: ignore[SPL005] host COO values are f64 by convention (reference val_t ingest)
 
 
 def unfold_to_scipy(tt: SparseTensor, mode: int):

@@ -49,7 +49,7 @@ def _parse_text(path: str) -> Tuple[np.ndarray, np.ndarray]:
     if not body:
         raise ValueError(f"{path}: empty tensor file")
     ncols = len(body[0].split())
-    toks = np.array(b" ".join(body).split(), dtype=np.float64)
+    toks = np.array(b" ".join(body).split(), dtype=np.float64)  # splint: ignore[SPL005] text ingest parses at full precision; storage dtype resolves later
     if toks.size % ncols != 0:
         raise ValueError(f"{path}: ragged rows in tensor file")
     table = toks.reshape(-1, ncols)
@@ -146,7 +146,7 @@ def load_memmap(path: str) -> SparseTensor:
     """
     nmodes, idx_width, val_width, dims, nnz, off = _bin_header(path)
     idt = np.int32 if idx_width == 4 else np.int64
-    vdt = np.float32 if val_width == 4 else np.float64
+    vdt = np.float32 if val_width == 4 else np.float64  # splint: ignore[SPL005] binary format width decoding (val_width 4/8) — the literal IS the format spec
     inds = np.memmap(path, dtype=idt, mode="r", offset=off,
                      shape=(nmodes, nnz))
     vals = np.memmap(path, dtype=vdt, mode="r",
@@ -157,7 +157,7 @@ def load_memmap(path: str) -> SparseTensor:
 def _load_binary(path: str) -> SparseTensor:
     nmodes, idx_width, val_width, dims, nnz, off = _bin_header(path)
     idt = np.int32 if idx_width == 4 else np.int64
-    vdt = np.float32 if val_width == 4 else np.float64
+    vdt = np.float32 if val_width == 4 else np.float64  # splint: ignore[SPL005] binary format width decoding (val_width 4/8) — the literal IS the format spec
     with open(path, "rb") as f:
         f.seek(off)
         inds = np.empty((nmodes, nnz), dtype=np.int64)

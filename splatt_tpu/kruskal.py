@@ -101,11 +101,15 @@ class KruskalTensor:
 
     def normsq(self) -> jax.Array:
         """⟨Z,Z⟩ = λᵀ (⊛_m UᵐᵀUᵐ) λ (≙ p_kruskal_norm, src/cpd.c:116-152)."""
-        rank = self.factors[0].shape[1]
+        # gram() pins the accumulation dtype — a raw `f.T @ f` over
+        # bf16 factors would accumulate the Gram at 8 mantissa bits
+        from splatt_tpu.config import acc_dtype
+        from splatt_tpu.ops.linalg import gram
+
         had = jnp.outer(self.lam, self.lam)
         for f in self.factors:
-            had = had * (f.T @ f)
-        return jnp.sum(had)
+            had = had * gram(f)
+        return jnp.sum(had, dtype=acc_dtype(had.dtype))
 
 
 def unstack_batched(factors, lam, fits, dims_list) -> List["KruskalTensor"]:

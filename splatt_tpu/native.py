@@ -103,7 +103,7 @@ def parse_tns(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         ncols = lib.tns_cols(h)
         nmodes = ncols - 1
         inds = np.empty((nmodes, nrows), dtype=np.int64)
-        vals = np.empty(nrows, dtype=np.float64)
+        vals = np.empty(nrows, dtype=np.float64)  # splint: ignore[SPL005] C++ ABI: the shared library exports an f64 ingest buffer
         rc = lib.tns_fill(h, inds.ctypes.data_as(ctypes.c_void_p),
                           vals.ctypes.data_as(ctypes.c_void_p))
         if rc != 0:
@@ -187,9 +187,9 @@ def mttkrp(inds: np.ndarray, vals: np.ndarray, factors, mode: int,
         return None
     vals = np.ascontiguousarray(vals)
     dtype = vals.dtype
-    if dtype == np.float32:
+    if dtype == np.float32:  # splint: ignore[SPL005] C++ ABI gate: the library exports exactly f32/f64 kernels
         fn = lib.mttkrp_f32
-    elif dtype == np.float64:
+    elif dtype == np.float64:  # splint: ignore[SPL005] C++ ABI gate: the library exports exactly f32/f64 kernels
         fn = lib.mttkrp_f64
     else:
         return None

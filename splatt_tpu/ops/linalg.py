@@ -30,11 +30,10 @@ def gram(U: jax.Array) -> jax.Array:
     (bf16/f16) accumulate in f32 — Gram matrices feed the normal
     equations and cannot afford bf16 accumulation error.
     """
+    from splatt_tpu.config import acc_dtype
     from splatt_tpu.ops.mttkrp import mxu_precision
 
-    acc = (jnp.float32 if U.dtype in (jnp.bfloat16, jnp.float16)
-           else U.dtype)
-    return jnp.matmul(U.T, U, preferred_element_type=acc,
+    return jnp.matmul(U.T, U, preferred_element_type=acc_dtype(U.dtype),
                       precision=mxu_precision(U.dtype))
 
 
@@ -72,12 +71,16 @@ def solve_normals(lhs: jax.Array, rhs: jax.Array) -> jax.Array:
     # keep eigh noise and blow the solve up.
     from splatt_tpu.ops.mttkrp import mxu_precision
 
+    from splatt_tpu.config import acc_dtype
+
     prec = mxu_precision(lhs.dtype)
+    acc = acc_dtype(rhs.dtype)
     w, v = jnp.linalg.eigh(lhs)
     tol = jnp.sqrt(jnp.finfo(lhs.dtype).eps) * jnp.max(jnp.abs(w))
     w_inv = jnp.where(jnp.abs(w) > tol, 1.0 / w, 0.0)
-    x_pinv = jnp.matmul(jnp.matmul(rhs, v * w_inv, precision=prec), v.T,
-                        precision=prec)
+    x_pinv = jnp.matmul(jnp.matmul(rhs, v * w_inv, precision=prec,
+                                   preferred_element_type=acc), v.T,
+                        precision=prec, preferred_element_type=acc)
 
     spd = (jnp.min(w) > tol) & jnp.all(jnp.isfinite(x_chol))
     return jnp.where(spd, x_chol, x_pinv)
@@ -95,11 +98,17 @@ def normalize_columns(U: jax.Array, which: str = "2") -> tuple[jax.Array, jax.Ar
     are all negative gets λ=1, keeping iteration trajectories comparable
     bit-for-bit with reference runs.
     """
+    from splatt_tpu.config import acc_dtype
+
     if which == "2":
-        lam = jnp.sqrt(jnp.sum(U * U, axis=0))
+        # upcast-before-reduce: a bf16 column's squared norm loses
+        # mass accumulated at 8 mantissa bits — one pinned contraction
+        # accumulates wide without materializing U*U (SPL024)
+        lam = jnp.sqrt(jnp.einsum("dr,dr->r", U, U,
+                                  preferred_element_type=acc_dtype(U.dtype)))
     elif which == "max":
         lam = jnp.maximum(jnp.max(U, axis=0), 1.0)
     else:
         raise ValueError(f"unknown norm {which!r}")
     safe = jnp.where(lam > 0, lam, 1.0)
-    return U / safe, lam
+    return U / safe.astype(U.dtype), lam

@@ -85,10 +85,14 @@ def _gather_prod_layout(layout: ModeLayout, factors: Sequence[jax.Array],
 
 def _acc_dtype(dtype):
     """Accumulate bf16/f16 operands in f32 (the MXU-native mixed
-    pattern: low-precision reads, full-precision accumulation)."""
-    if dtype in (jnp.bfloat16, jnp.float16):
-        return jnp.float32
-    return dtype
+    pattern: low-precision reads, full-precision accumulation).
+    Delegates to :func:`splatt_tpu.config.acc_dtype` — the config
+    module owns dtype policy; this name survives as the engines'
+    local spelling (and the probe cache hashes config.py so policy
+    edits invalidate cached verdicts)."""
+    from splatt_tpu.config import acc_dtype
+
+    return acc_dtype(dtype)
 
 
 acc_dtype = _acc_dtype  # public name for the sharded sweeps
@@ -104,7 +108,7 @@ def mxu_precision(dtype):
     into bf16 pieces for f32-faithful products; bf16 operands are native
     single-pass and keep DEFAULT.
     """
-    if dtype == jnp.float32:
+    if dtype == jnp.float32:  # splint: ignore[SPL005] mxu_precision IS dtype-policy code, colocated with the kernels it guards
         return jax.lax.Precision.HIGHEST
     return jax.lax.Precision.DEFAULT
 
@@ -118,7 +122,7 @@ def onehot_precision(dtype, onehot_side: str = "lhs"):
     keeps exactness while dropping the pass count versus HIGHEST on
     both sides.  `onehot_side` names which dot operand is the one-hot.
     """
-    if dtype != jnp.float32:
+    if dtype != jnp.float32:  # splint: ignore[SPL005] onehot_precision IS dtype-policy code, colocated with the kernels it guards
         p = jax.lax.Precision.DEFAULT
         return (p, p)
     if onehot_side == "lhs":
@@ -176,7 +180,10 @@ def mttkrp_ttbox(inds: jax.Array, vals: jax.Array,
         for k, U in enumerate(factors):
             if k != mode:
                 p = p * jnp.take(U[:, r], inds[k], mode="clip")
-        return jax.ops.segment_sum(p, inds[mode], num_segments=dim)
+        # upcast-before-reduce like mttkrp_stream: bf16 columns must
+        # not accumulate at 8 mantissa bits (SPL024)
+        return jax.ops.segment_sum(p.astype(_acc_dtype(p.dtype)),
+                                   inds[mode], num_segments=dim)
 
     rank = factors[0].shape[1]
     cols = jax.lax.map(col, jnp.arange(rank))
@@ -969,7 +976,7 @@ def _native_runnable(layout: ModeLayout, factors: Sequence[jax.Array],
         # additive identities instead
         return False
     vdt = layout.vals.dtype
-    if vdt not in (jnp.float32, jnp.float64):
+    if vdt not in (jnp.float32, jnp.float64):  # splint: ignore[SPL005] native-engine f32/f64 ABI gate
         return False
     if any(f.dtype != vdt for f in factors):
         return False  # mixed dtypes: the XLA paths own promotion

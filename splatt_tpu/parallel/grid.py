@@ -94,7 +94,7 @@ class GridDecomp:
     @staticmethod
     def build(tt: SparseTensor, grid: Optional[Tuple[int, ...]] = None,
               n_devices: Optional[int] = None,
-              val_dtype=np.float32,
+              val_dtype=np.float32,  # splint: ignore[SPL005] shard-builder signature default; callers override via Options.val_dtype
               balance: Optional[bool] = False,
               streamed: Optional[bool] = None,
               out_dir: Optional[str] = None,

@@ -54,7 +54,7 @@ from splatt_tpu.parallel.mesh import make_mesh, single_axis_of
 from splatt_tpu.utils.env import ceil_to as _pad_to
 
 
-def shard_nnz_host(tt: SparseTensor, ndev: int, val_dtype=np.float32,
+def shard_nnz_host(tt: SparseTensor, ndev: int, val_dtype=np.float32,  # splint: ignore[SPL005] shard-builder signature default; callers override via Options.val_dtype
                    partition: Optional[np.ndarray] = None,
                    streamed: Optional[bool] = None,
                    out_dir: Optional[str] = None,
@@ -108,7 +108,7 @@ def shard_nnz_host(tt: SparseTensor, ndev: int, val_dtype=np.float32,
 
 
 def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
-              val_dtype=np.float32,
+              val_dtype=np.float32,  # splint: ignore[SPL005] shard-builder signature default; callers override via Options.val_dtype
               partition: Optional[np.ndarray] = None,
               streamed: Optional[bool] = None,
               out_dir: Optional[str] = None
@@ -135,7 +135,7 @@ def shard_nnz(tt: SparseTensor, mesh: Mesh, axis: str = "nnz",
 
 def shard_blocked_layouts(tt: SparseTensor, mesh: Mesh, opts: Options,
                           dims_pad: Tuple[int, ...], axis: str = "nnz",
-                          val_dtype=np.float32,
+                          val_dtype=np.float32,  # splint: ignore[SPL005] shard-builder signature default; callers override via Options.val_dtype
                           partition: Optional[np.ndarray] = None,
                           out_dir: Optional[str] = None,
                           chunk: int = 1 << 22):

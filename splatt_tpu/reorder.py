@@ -193,7 +193,7 @@ def _hgraph_perm(tt: SparseTensor) -> Permutation:
     for m in range(tt.nmodes):
         others = [k for k in range(tt.nmodes) if k != m]
         order = tt.sort_order(others)
-        pos = np.empty(tt.nnz, dtype=np.float64)
+        pos = np.empty(tt.nnz, dtype=np.float64)  # splint: ignore[SPL005] BFS position keys need exact f64 host arithmetic
         pos[order] = np.arange(tt.nnz)
         sums = np.bincount(tt.inds[m], weights=pos, minlength=tt.dims[m])
         counts = tt.mode_histogram(m)

@@ -132,6 +132,31 @@ class TunedPlan:
     reorder: str = "identity"
 
 
+#: The v5 plan-cache schema contract, in ONE declared place (splint
+#: SPL027 audits the code against it in both directions):
+#: ``key`` — the regime components :func:`plan_key` must fold in;
+#: ``fields`` — every :class:`TunedPlan` field; ``match`` — the subset
+#: dispatch must STRICT-compare against the built layout before
+#: applying a plan (ops/mttkrp._tuned_plan_for); ``exempt`` — fields
+#: that are evidence or applied outputs, never match predicates.
+#: Growing TunedPlan/plan_key without updating this dict (and bumping
+#: PLAN_CACHE_VERSION — the v2..v5 history above) is the silent
+#: mis-dispatch drift class: a plan measured under one layout axis
+#: steering a layout built under another.  cached_plan consults
+#: ``fields`` so a foreign/partial cache entry is rejected as a
+#: schema mismatch instead of half-read.
+PLAN_SCHEMA = {
+    "version": 5,
+    "key": ("dims", "nnz", "mode", "rank", "dtype", "skew", "batch",
+            "mode_density"),
+    "fields": ("path", "engine", "nnz_block", "scan_target", "sec",
+               "idx_width", "val_storage", "packing", "reorder"),
+    "match": ("path", "nnz_block", "idx_width", "val_storage",
+              "packing", "reorder"),
+    "exempt": ("engine", "scan_target", "sec"),
+}
+
+
 @dataclasses.dataclass
 class TuneResult:
     """What one :func:`tune` invocation did: the per-mode winning plans,
@@ -386,6 +411,13 @@ def cached_plan(dims: Sequence[int], nnz: int, mode: int, rank: int,
     if not entry or "plan" not in entry:
         return None
     p = entry["plan"]
+    unknown = set(p) - set(PLAN_SCHEMA["fields"])
+    if unknown:
+        # field drift without a version bump (a foreign-schema writer):
+        # reject the entry classified instead of half-reading it
+        _cache_io_error("load", ValueError(
+            f"plan entry carries undeclared fields {sorted(unknown)}"))
+        return None
     try:
         return TunedPlan(path=str(p["path"]), engine=str(p["engine"]),
                          nnz_block=int(p["nnz_block"]),

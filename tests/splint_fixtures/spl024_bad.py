@@ -1,13 +1,24 @@
-"""SPL024 bad: recording a metric the METRICS registry never declared,
-and recording a declared counter through the gauge verb (which would
-raise at runtime)."""
+"""SPL024 bad: reductions over possibly-narrow storage with no
+accumulation-dtype discipline — an unpinned Gram, a raw segment-sum,
+and a method-sum with no dtype pin.  Under bf16 factors each of these
+accumulates at 8 mantissa bits."""
 
-from splatt_tpu import trace
-
-
-def rogue_counter():
-    trace.metric_inc("spl024_fixture_undeclared_total")
+import jax
+import jax.numpy as jnp
 
 
-def mistyped_verb():
-    trace.metric_set("splatt_retries_total", 1.0)
+def bad_unpinned_gram(U):
+    # no preferred_element_type: bf16 @ bf16 accumulates bf16
+    return jnp.matmul(U.T, U)
+
+
+def bad_raw_segment_reduce(prod, inds, dim):
+    # segment_sum accumulates in the operand dtype; the operand was
+    # never upcast through the acc-dtype helpers
+    return jax.ops.segment_sum(prod, inds, num_segments=dim)
+
+
+def bad_method_sum(had):
+    # .sum() with no dtype= pin over an operand splint cannot prove
+    # wide or exact
+    return had.sum()

@@ -1,12 +1,28 @@
-"""SPL024 good: metric emissions name declared METRICS entries through
-the verb matching each declared type (docs/observability.md)."""
+"""SPL024 good: every reduce carries the accumulation-dtype
+discipline — pinned dots, acc-helper upcasts at the segment reduce,
+explicit dtype= on sums, and exact integer counting."""
 
-from splatt_tpu import trace
+import jax
+import jax.numpy as jnp
+
+from splatt_tpu.config import acc_dtype
 
 
-def counted_retry():
-    trace.metric_inc("splatt_retries_total")
+def good_pinned_gram(U):
+    return jnp.matmul(U.T, U,
+                      preferred_element_type=acc_dtype(U.dtype))
 
 
-def observed_wall(seconds):
-    trace.metric_observe("splatt_job_seconds", float(seconds))
+def good_upcast_segment_reduce(prod, inds, dim):
+    return jax.ops.segment_sum(prod.astype(acc_dtype(prod.dtype)),
+                               inds, num_segments=dim)
+
+
+def good_sum_with_acc(had):
+    acc = acc_dtype(had.dtype)
+    return jnp.sum(had, dtype=acc)
+
+
+def good_exact_count(mask):
+    # integer/bool reductions accumulate exactly — no pin needed
+    return mask.astype(jnp.int32).sum()

@@ -1,0 +1,47 @@
+"""SPL025 bad: dtype-blind sublane padding and misaligned literal
+block dims, plus a ragged grid division — each a Mosaic layout error
+(or silent tail drop) the tests only hit on TPU."""
+
+import jax
+from jax.experimental import pallas as pl
+
+from splatt_tpu.utils.env import ceil_to
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def bad_dtype_blind_pad(x, R, width):
+    # ceil_to(R, 8) under-pads bf16 storage (16 sublanes per tile)
+    R8 = ceil_to(R, 8)
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((R8, width), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((R8, width), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R8, width), x.dtype),
+    )(x)
+
+
+def bad_misaligned_literals(x):
+    # (12, 100) neither divides nor multiplies the native (8, 128)
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((12, 100), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((12, 100), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((12, 100), x.dtype),
+    )(x)
+
+
+def bad_ragged_grid(x, nb):
+    # nb was never padded to a multiple of 8: the tail block is
+    # silently dropped
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(nb // 8,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8 * nb, 128), x.dtype),
+    )(x)

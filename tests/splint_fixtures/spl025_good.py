@@ -1,0 +1,54 @@
+"""SPL025 good: dtype-aware sublane padding via config.tile_packing,
+native-aligned literals, and grids over helper-padded extents."""
+
+import jax
+from jax.experimental import pallas as pl
+
+from splatt_tpu.config import tile_packing
+from splatt_tpu.utils.env import ceil_to
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def spl025_vmem_ok(block_elems):
+    return 4 * block_elems * 2 <= 96 * 1024 * 1024
+
+
+def good_dtype_aware_pad(x, R, width):
+    R8 = ceil_to(R, tile_packing(x.dtype)[0])
+    if not spl025_vmem_ok(R8 * width):
+        raise ValueError("block too large for VMEM")
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((R8, width), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((R8, width), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R8, width), x.dtype),
+    )(x)
+
+
+def good_aligned_literals(x):
+    if not spl025_vmem_ok(16 * 256):
+        raise ValueError("block too large for VMEM")
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((16, 256), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((16, 256), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 256), x.dtype),
+    )(x)
+
+
+def good_padded_grid(x, nb):
+    nb_pad = ceil_to(nb, 8)
+    if not spl025_vmem_ok(8 * 128):
+        raise ValueError("block too large for VMEM")
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(nb_pad // 8,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8 * nb, 128), x.dtype),
+    )(x)

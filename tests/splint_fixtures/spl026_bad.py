@@ -1,0 +1,20 @@
+"""SPL026 bad: a kernel whose static block-buffer sum blows the
+declared VMEM envelope (a streamed 4096x8192 f32 block is 256 MiB
+double-buffered), issued with no dispatch gate registered."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def oversized_entry(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((4096, 8192), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((4096, 8192), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16384, 8192), x.dtype),
+    )(x)

@@ -1,0 +1,27 @@
+"""SPL026 good: a small, gate-registered kernel — the block budget
+fits, the vmem-gate-map entry exists, and the gate is consulted at
+dispatch."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def toy_vmem_ok(nblocks, block_elems):
+    # dispatch-time gate: both double-buffered copies must fit
+    return 2 * 2 * block_elems * 4 <= (8 << 20)
+
+
+def toy_pallas_entry(x):
+    if not toy_vmem_ok(4, 128 * 128):
+        raise ValueError("block too large for VMEM")
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((512, 128), x.dtype),
+    )(x)

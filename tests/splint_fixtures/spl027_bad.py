@@ -1,0 +1,38 @@
+"""SPL027 bad: the strict-match comparator skips a declared match
+field (nnz_block) and compares a field the schema never declared
+(engine) — the silent mis-dispatch drift class."""
+
+PLAN_CACHE_VERSION = 2
+
+PLAN_SCHEMA = {
+    "version": 2,
+    "key": ("dims", "nnz"),
+    "fields": ("path", "nnz_block", "sec"),
+    "match": ("path", "nnz_block"),
+    "exempt": ("sec",),
+}
+# v2: nnz_block joined the measured configuration
+
+
+class TunedPlan:
+    path: str
+    nnz_block: int
+    sec: float
+
+
+def plan_key(dims, nnz):
+    return f"{dims}|{nnz}"
+
+
+def cached_plan(key):
+    return None
+
+
+def _tuned_plan_for(layout, path):
+    plan = cached_plan(plan_key(layout.dims, layout.nnz))
+    if plan is None or plan.path != path or plan.sec <= 0.0 \
+            or plan.engine != "stream":
+        # nnz_block is stored and declared match, but never compared:
+        # a plan measured at block 4096 steers a 16384 dispatch
+        return None
+    return plan

@@ -1,0 +1,35 @@
+"""SPL027 good: schema, plan record, key builder and strict-match
+comparator agree in both directions."""
+
+PLAN_CACHE_VERSION = 2
+
+PLAN_SCHEMA = {
+    "version": 2,
+    "key": ("dims", "nnz"),
+    "fields": ("path", "nnz_block", "sec"),
+    "match": ("path", "nnz_block"),
+    "exempt": ("sec",),
+}
+# v2: nnz_block joined the measured configuration
+
+
+class TunedPlan:
+    path: str
+    nnz_block: int
+    sec: float
+
+
+def plan_key(dims, nnz):
+    return f"{dims}|{nnz}"
+
+
+def cached_plan(key):
+    return None
+
+
+def _tuned_plan_for(layout, path):
+    plan = cached_plan(plan_key(layout.dims, layout.nnz))
+    if plan is None or plan.path != path \
+            or plan.nnz_block != layout.block:
+        return None
+    return plan

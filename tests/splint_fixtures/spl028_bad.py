@@ -1,0 +1,14 @@
+"""SPL028 bad: a hot stream op mixing the declared-narrow factor
+with the wide model matrix — the product materializes at f32 BEFORE
+the accumulate point, doubling hot-loop bytes."""
+
+import jax.numpy as jnp
+
+from splatt_tpu.config import acc_dtype
+
+
+def zz_stream(M, U, lam):
+    acc = acc_dtype(M.dtype)
+    # M is f32, U is bf16 (declared storage contract): M * U promotes
+    # the whole stream to f32 before the reduce
+    return jnp.sum(M * U, dtype=acc)

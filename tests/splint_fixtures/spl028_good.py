@@ -1,0 +1,11 @@
+"""SPL028 good: the upcast happens INSIDE the sanctioned accumulate
+point — one pinned contraction, no wide elementwise intermediate."""
+
+import jax.numpy as jnp
+
+from splatt_tpu.config import acc_dtype
+
+
+def zz_stream(M, U, lam):
+    acc = acc_dtype(M.dtype)
+    return jnp.einsum("dr,dr->", M, U, preferred_element_type=acc)

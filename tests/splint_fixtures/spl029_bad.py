@@ -1,0 +1,13 @@
+"""SPL029 bad: recording a metric the METRICS registry never declared,
+and recording a declared counter through the gauge verb (which would
+raise at runtime)."""
+
+from splatt_tpu import trace
+
+
+def rogue_counter():
+    trace.metric_inc("spl029_fixture_undeclared_total")
+
+
+def mistyped_verb():
+    trace.metric_set("splatt_retries_total", 1.0)

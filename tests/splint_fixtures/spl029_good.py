@@ -1,0 +1,12 @@
+"""SPL029 good: metric emissions name declared METRICS entries through
+the verb matching each declared type (docs/observability.md)."""
+
+from splatt_tpu import trace
+
+
+def counted_retry():
+    trace.metric_inc("splatt_retries_total")
+
+
+def observed_wall(seconds):
+    trace.metric_observe("splatt_job_seconds", float(seconds))

@@ -1,0 +1,82 @@
+"""The dtype-flow oracle (tools/splint/dtypecheck.py).
+
+The oracle is the DYNAMIC plane of the SPL024/SPL028 accumulation
+discipline: jax.eval_shape over the real factorization entry points
+across the f32/bf16 storage matrix, one interpret-mode Pallas
+execution, and a static-plane cross-check.  These tests prove (a) the
+clean tree certifies, (b) every wired-in mutant is caught — the
+oracle has teeth — and (c) the CLI contract CI scripts rely on.
+
+Mutants run in SUBPROCESSES: they monkeypatch production modules and
+jitted entry points may cache traces made under the patch, so a fresh
+interpreter is the only honest way to run one.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from tools.splint.dtypecheck import (MUTANTS, _apply_mutant,  # noqa: E402
+                                     run_dtype_check)
+
+
+def test_clean_matrix_certifies():
+    """The real tree passes the whole storage×compute matrix and the
+    static numerics/tiling family agrees (zero findings)."""
+    res = run_dtype_check()
+    assert res.ok, [f"{v.scenario} [{v.storage}]: {v.detail}"
+                    for v in res.violations]
+    assert res.checks >= 29
+    assert res.static_findings == {}
+
+
+def test_unknown_mutant_rejected():
+    with pytest.raises(ValueError):
+        run_dtype_check(mutant="definitely_not_a_mutant")
+
+
+def test_mutant_patches_are_restored():
+    """_apply_mutant's undo puts the real functions back — a leaked
+    patch would corrupt every later test in the process."""
+    from splatt_tpu import config
+    from splatt_tpu.ops import linalg
+
+    before = (config.acc_dtype, linalg.gram, linalg.normalize_columns)
+    for name in MUTANTS:
+        _apply_mutant(name)()
+    assert (config.acc_dtype, linalg.gram,
+            linalg.normalize_columns) == before
+
+
+@pytest.mark.parametrize("mutant", MUTANTS)
+def test_each_mutant_is_caught(mutant):
+    """Each wired-in dtype regression — the config promotion dropped,
+    gram unpinned, the engines' local acc helper neutered, λ² summed
+    narrow — must be caught, or the oracle is decorative.  Run in a
+    subprocess: the jit caches must never see a mutant trace."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.splint.dtypecheck",
+         "--mutant", mutant],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "caught" in out.stdout
+
+
+def test_cli_json_report():
+    """`python -m tools.splint.dtypecheck --json` is the CI entry:
+    exit 0 and a machine-readable certification on the clean tree."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.splint.dtypecheck", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["ok"] is True
+    assert rep["violations"] == []
+    assert rep["checks"] >= 29
+    assert rep["static_findings"] == {}

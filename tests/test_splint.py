@@ -10,6 +10,7 @@ and one known-good example.
 
 import ast
 import json
+import shutil
 import subprocess
 import sys
 import textwrap
@@ -82,8 +83,13 @@ def test_baseline_never_contains_zero_budget_rules():
 
 
 def test_baseline_entries_are_justified():
+    """The v5 burn-down emptied the baseline — every rule is a
+    zero-rule now.  Any entry that ever reappears must carry a
+    human-written reason and a live count."""
     baseline = load_baseline(REPO / "tools" / "splint" / "baseline.json")
-    assert baseline, "baseline should hold the grandfathered groups"
+    assert baseline == {}, \
+        "the baseline was burned down to empty; do not grandfather " \
+        "new findings — fix them or add a reasoned inline pragma"
     for key, entry in baseline.items():
         reason = entry.get("reason", "")
         assert reason and not reason.startswith("UNJUSTIFIED"), \
@@ -108,7 +114,8 @@ RULE_IDS = ["SPL000", "SPL001", "SPL002", "SPL003", "SPL004", "SPL005",
             "SPL006", "SPL007", "SPL008", "SPL009", "SPL010", "SPL011",
             "SPL012", "SPL013", "SPL014", "SPL015", "SPL016", "SPL017",
             "SPL018", "SPL019", "SPL020", "SPL021", "SPL022",
-            "SPL023", "SPL024"]
+            "SPL023", "SPL024", "SPL025", "SPL026", "SPL027",
+            "SPL028", "SPL029"]
 
 
 @pytest.mark.parametrize("rule", RULE_IDS)
@@ -263,7 +270,7 @@ def test_spl013_span_registry_matches_runtime():
         assert isinstance(doc, str) and len(doc) > 10, name
 
 
-def _spl024_project(tmp_path, docs: str = None):
+def _spl029_project(tmp_path, docs: str = None):
     (tmp_path / "pkg").mkdir(exist_ok=True)
     (tmp_path / "pkg" / "trace.py").write_text(
         "METRICS = {'splatt_used_total': ('counter', 'doc'),\n"
@@ -288,15 +295,15 @@ def _spl024_project(tmp_path, docs: str = None):
                   trace_module="pkg/trace.py", **kw)
 
 
-def test_spl024_metric_drift(tmp_path):
+def test_spl029_metric_drift(tmp_path):
     """Both registry directions plus the type check, on a
     mini-project: an undeclared recorded name fires at the call site,
     a declared-but-never-recorded name fires at the registry, and a
     counter recorded through the gauge verb (a runtime raise) is a
     finding before anything runs."""
-    cfg = _spl024_project(tmp_path)
+    cfg = _spl029_project(tmp_path)
     msgs = [f.message for f in run(cfg, baseline={}).findings
-            if f.rule == "SPL024"]
+            if f.rule == "SPL029"]
     assert any("splatt_rogue_total" in m and "not declared" in m
                for m in msgs)
     assert any("splatt_dead_total" in m and "never recorded" in m
@@ -306,7 +313,7 @@ def test_spl024_metric_drift(tmp_path):
     assert not any("splatt_used_total" in m for m in msgs)
 
 
-def test_spl024_docs_table_both_directions(tmp_path):
+def test_spl029_docs_table_both_directions(tmp_path):
     """The docs legs: a declared metric missing from the configured
     metrics doc fires at the registry, and a doc-table metric the
     registry never declares is a dead promise."""
@@ -315,9 +322,9 @@ def test_spl024_docs_table_both_directions(tmp_path):
             "| `splatt_used_total` | counter |\n"
             "| `splatt_ghost_total{x=y}` | counter |\n"
             "| `splatt_depth` | gauge |\n")
-    cfg = _spl024_project(tmp_path, docs=docs)
+    cfg = _spl029_project(tmp_path, docs=docs)
     msgs = [f.message for f in run(cfg, baseline={}).findings
-            if f.rule == "SPL024"]
+            if f.rule == "SPL029"]
     assert any("splatt_dead_total" in m and "no row" in m
                for m in msgs)
     assert any("splatt_ghost_total" in m and "never declares" in m
@@ -330,11 +337,11 @@ def test_spl024_docs_table_both_directions(tmp_path):
         docs.replace("| `splatt_ghost_total{x=y}` | counter |\n", "")
         + "| `splatt_dead_total` | counter |\n")
     msgs2 = [f.message for f in run(cfg, baseline={}).findings
-             if f.rule == "SPL024"]
+             if f.rule == "SPL029"]
     assert not any("row" in m or "never declares" in m for m in msgs2)
 
 
-def test_spl024_registry_matches_runtime_and_docs():
+def test_spl029_registry_matches_runtime_and_docs():
     """The real registry is importable and the real docs table is in
     sync (the full-tree zero gate enforces this too; this pins the
     wiring: metrics-doc configured, every metric typed + documented)."""
@@ -1146,13 +1153,64 @@ def test_cli_json_lockstep_for_concurrency_rules(tmp_path):
     assert {r for r, _, _ in cli} == fam  # every rule fires somewhere
 
 
+def test_cli_sarif_structure(tmp_path):
+    """`--sarif` writes a SARIF 2.1.0 log whose results agree with the
+    --json findings — the CI code-scanning upload cannot drift from
+    the gate.  Checked on a mini-project where SPL024 actually fires
+    (the production tree is clean, so its results array is empty)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "spl024_bad.py").write_text(
+        (FIXTURES / "spl024_bad.py").read_text())
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.splint]\n'
+        'paths = ["pkg"]\n'
+        'numerics-modules = ["pkg/spl024_bad.py"]\n')
+    sarif_path = tmp_path / "out.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.splint", "--root", str(tmp_path),
+         "--sarif", str(sarif_path), "--json", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    sarif = json.loads(sarif_path.read_text())
+    assert sarif["version"] == "2.1.0"
+    driver = sarif["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "splint"
+    by_id = {r["id"]: r for r in driver["rules"]}
+    assert "SPL024" in by_id
+    assert len(by_id["SPL024"]["shortDescription"]["text"]) > 10
+    results = sarif["runs"][0]["results"]
+    got = sorted(
+        (r["ruleId"],
+         r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+         r["locations"][0]["physicalLocation"]["region"]["startLine"])
+        for r in results)
+    want = sorted((f["rule"], f["path"], f["line"])
+                  for f in payload["findings"])
+    assert got and got == want
+    assert all(r["ruleId"] in by_id for r in results)
+    # new findings carry no suppression; none are baselined here
+    assert not any("suppressions" in r for r in results)
+    # the clean production tree writes an empty results array
+    clean_path = tmp_path / "clean.sarif"
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.splint",
+         "--sarif", str(clean_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert json.loads(clean_path.read_text())["runs"][0]["results"] == []
+
+
 def test_cli_list_rules_covers_new_rules():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.splint", "--list-rules"],
         cwd=REPO, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     for rid in ("SPL008", "SPL009", "SPL010", "SPL011", "SPL012",
-                "SPL014", "SPL015", "SPL016", "SPL017", "SPL018"):
+                "SPL014", "SPL015", "SPL016", "SPL017", "SPL018",
+                "SPL024", "SPL025", "SPL026", "SPL027", "SPL028",
+                "SPL029"):
         line = next((ln for ln in proc.stdout.splitlines()
                      if ln.startswith(rid)), "")
         assert line and len(line.split(None, 1)[1]) > 10, \
@@ -1237,6 +1295,148 @@ def test_config_matches_pyproject():
     assert {"publish_bytes", "publish_json", "publish_file",
             "append_line"} <= set(cfg.durable_write_helpers)
     assert "splatt_tpu/serve.py::submit" in cfg.hot_lock_paths
+    # the v5 numerics/tiling family (SPL024-SPL028) is zero-budget and
+    # its config surface is populated
+    assert {"SPL024", "SPL025", "SPL026", "SPL027", "SPL028"} \
+        <= set(cfg.zero_rules)
+    assert "splatt_tpu/ops/linalg.py" in cfg.numerics_modules
+    assert "acc_dtype" in cfg.acc_dtype_helpers
+    assert "splatt_tpu/cpd.py::_zz_inner" in cfg.hot_stream_functions
+    assert any(e.startswith("splatt_tpu/cpd.py::_zz_inner::U_last=")
+               for e in cfg.hot_stream_param_dtypes)
+    assert "splatt_tpu/ops/pallas_kernels.py" in cfg.pallas_modules
+    assert "tile_packing" in cfg.tile_pack_helpers
+    assert int(cfg.vmem_budget_mib) > 0
+    gate_map = dict(e.split("=") for e in cfg.vmem_gate_map)
+    assert gate_map["fused_mttkrp"] == "fused_vmem_ok"
+    assert "_tuned_plan_for" in cfg.plan_match_functions
+    # SPL005 joined the zero-rules in the v5 burn-down
+    assert "SPL005" in cfg.zero_rules
+
+
+# -- the v5 guards: the numerics/tiling fixes are load-bearing --------------
+#
+# Each test re-introduces one production bug the v5 pass fixed (or a
+# regression the rules exist to catch) into a tmp copy of the REAL
+# package tree and asserts the matching rule fires.  The unmutated
+# tree is clean (the tree gate above), so these prove the rules guard
+# the real files, not just the fixtures.
+
+def _copy_package_tree(tmp_path, rel, mutate):
+    """A tmp copy of the full splatt_tpu package (+ the docs the
+    registry rules read) with `mutate(src) -> src` applied to `rel`."""
+    shutil.copytree(REPO / "splatt_tpu", tmp_path / "splatt_tpu")
+    (tmp_path / "docs").mkdir()
+    shutil.copy(REPO / "docs" / "observability.md", tmp_path / "docs")
+    target = tmp_path / rel
+    target.write_text(mutate(target.read_text()))
+    cfg = _cfg()
+    cfg.root = tmp_path
+    cfg.paths = ["splatt_tpu"]
+    return cfg
+
+
+def test_spl024_fires_when_gram_pin_reverted(tmp_path):
+    """Dropping gram's preferred_element_type pin — the exact shape
+    the reference port had before the v5 fix — must trip SPL024: a
+    bf16 factor would then accumulate its Gram matrix at bf16 and feed
+    the error straight into the normal equations."""
+    anchor = ("    return jnp.matmul(U.T, U, "
+              "preferred_element_type=acc_dtype(U.dtype),\n"
+              "                      precision=mxu_precision(U.dtype))")
+
+    def mutate(src):
+        assert anchor in src, "linalg.py gram anchor drifted"
+        return src.replace(anchor, "    return jnp.matmul(U.T, U)")
+
+    cfg = _copy_package_tree(tmp_path, "splatt_tpu/ops/linalg.py", mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL024" and f.path.endswith("linalg.py")]
+    assert hits and any("matmul" in f.message for f in hits)
+
+
+def test_spl025_fires_when_rank_pad_reverted(tmp_path):
+    """Reverting a kernel's rank padding to the dtype-blind
+    ``ceil_to(R, 8)`` (the pre-v5 shape: correct for f32, half the
+    sublane tile for bf16) must trip SPL025 on the block position the
+    padded value certifies."""
+    anchor = ("    R8 = _rank_pad(R, dtype)\n"
+              "    others = [k for k in range(layout.nmodes) "
+              "if k != mode]\n"
+              "    grid = (nb,)\n")
+
+    def mutate(src):
+        assert anchor in src, "pallas_kernels.py rank-pad anchor drifted"
+        return src.replace(
+            anchor,
+            "    R8 = ceil_to(R, 8)\n"
+            "    others = [k for k in range(layout.nmodes) "
+            "if k != mode]\n"
+            "    grid = (nb,)\n", 1)
+
+    cfg = _copy_package_tree(
+        tmp_path, "splatt_tpu/ops/pallas_kernels.py", mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL025"]
+    assert hits and any("R8" in f.message for f in hits)
+
+
+def test_spl026_fires_when_gate_consult_dropped(tmp_path):
+    """Short-circuiting the fused_t dispatch gate — the kernel runs
+    whether or not its block plan fits VMEM — must trip SPL026's
+    registry leg: the declared gate is never consulted."""
+    anchor = ('    if pallas and live("fused_t") and '
+              "fused_t_vmem_ok(factors, mode,")
+
+    def mutate(src):
+        assert anchor in src, "mttkrp.py fused_t gate anchor drifted"
+        return src.replace(
+            anchor,
+            '    if pallas and live("fused_t") and '
+            "(lambda *a: True)(factors, mode,", 1)
+
+    cfg = _copy_package_tree(tmp_path, "splatt_tpu/ops/mttkrp.py", mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL026"]
+    assert hits and any("fused_t_vmem_ok" in f.message
+                        and "consulted" in f.message for f in hits)
+
+
+def test_spl027_fires_when_match_comparison_dropped(tmp_path):
+    """Deleting one strict-match comparison from _tuned_plan_for (a
+    plan measured for another nnz block would then steer this
+    dispatch) must trip SPL027's dispatch leg."""
+    anchor = "            or plan.nnz_block != layout.block\n"
+
+    def mutate(src):
+        assert anchor in src, "mttkrp.py plan-match anchor drifted"
+        return src.replace(anchor, "", 1)
+
+    cfg = _copy_package_tree(tmp_path, "splatt_tpu/ops/mttkrp.py", mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL027"]
+    assert hits and any("nnz_block" in f.message for f in hits)
+
+
+def test_spl028_fires_when_zz_inner_product_reverted(tmp_path):
+    """Reverting _zz_inner's pinned einsum to the elementwise
+    ``M * U_last`` product must trip SPL028 under the declared storage
+    contract (M wide, U_last narrow): the product materializes a wide
+    (dim, R) intermediate ahead of the reduce — the doubled hot-loop
+    bytes the rule exists to catch."""
+    anchor = ('    inner = jnp.einsum("dr,dr,r->", M, U_last, lam,\n'
+              "                       preferred_element_type=acc)")
+
+    def mutate(src):
+        assert anchor in src, "cpd.py _zz_inner anchor drifted"
+        return src.replace(
+            anchor,
+            "    inner = jnp.sum(M * U_last * lam[None, :], dtype=acc)")
+
+    cfg = _copy_package_tree(tmp_path, "splatt_tpu/cpd.py", mutate)
+    hits = [f for f in run(cfg, baseline={}).findings
+            if f.rule == "SPL028" and f.path.endswith("cpd.py")]
+    assert hits
 
 
 def test_run_report_registry_matches_runtime():

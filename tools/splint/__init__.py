@@ -27,6 +27,19 @@ Rules (see docs/static-analysis.md for the full catalog):
   lock, contextvar set/reset leaks — paired with the dynamic side,
   ``tools/splint/interleave.py``, a bounded-exhaustive interleaving
   checker for the fleet lease protocol
+- SPL019–SPL023 — the durability family (tools/splint/durability.py):
+  atomic-publish protocol order, lease-fenced terminal appends,
+  stamp/persist pairing, journal-kind vocabulary, fsync barriers
+  under durable roots — paired with ``tools/splint/crashpoint.py``,
+  the exhaustive crash-point replay checker
+- SPL024–SPL028 — the numerics/tiling family
+  (tools/splint/numerics.py, tools/splint/tiling.py):
+  accumulation-dtype discipline via an abstract dtype-lattice
+  interpreter, Pallas tile alignment per dtype packing, static VMEM
+  envelopes with a kernel→gate registry, plan-cache schema
+  completeness, narrow×wide hot-stream products — paired with
+  ``tools/splint/dtypecheck.py``, the eval_shape dtype oracle
+- SPL029 — metric-name drift against ``trace.py:METRICS``
 
 Escape hatch: ``# splint: ignore[SPL002] <reason>`` on the flagged
 line (inline) or as a full-line comment directly above it; the reason

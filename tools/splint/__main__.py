@@ -76,6 +76,53 @@ def _explain(config, rule_id: str) -> int:
     return 0
 
 
+def _sarif_report(shown, new_keys) -> dict:
+    """The findings as a SARIF 2.1.0 log — the interchange format CI
+    code-scanning upload steps consume.  Every shown finding becomes a
+    result; baselined ones carry a suppression so scanners display
+    them as acknowledged instead of new."""
+    rule_meta = []
+    for rule in RULES:
+        rule_meta.append({
+            "id": rule.id,
+            "name": rule.id,
+            "shortDescription": {"text": rule.title},
+            **({"help": {"text": rule.hint}} if rule.hint else {}),
+        })
+    results = []
+    for f in shown:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if id(f) not in new_keys:
+            result["suppressions"] = [{
+                "kind": "external",
+                "justification": "baselined in tools/splint/baseline.json",
+            }]
+        results.append(result)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "splint",
+                "informationUri": "docs/static-analysis.md",
+                "rules": rule_meta,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.splint",
@@ -91,6 +138,10 @@ def main(argv=None) -> int:
                     help="project root holding pyproject.toml")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write the findings as a SARIF 2.1.0 "
+                         "log (CI code-scanning upload format); "
+                         "baselined findings carry suppressions")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: [tool.splint] "
                          "baseline)")
@@ -158,9 +209,16 @@ def main(argv=None) -> int:
     shown = [f for f in report.findings if in_focus(f)]
     new = [f for f in report.new if in_focus(f)]
     ok = not new
+    new_keys = {id(f) for f in new}
+
+    if args.sarif:
+        sarif_path = Path(args.sarif)
+        if not sarif_path.is_absolute():
+            sarif_path = Path(config.root) / sarif_path
+        sarif_path.write_text(
+            json.dumps(_sarif_report(shown, new_keys), indent=1) + "\n")
 
     if args.as_json:
-        new_keys = {id(f) for f in new}
         print(json.dumps({
             "ok": ok,
             "findings": [f.as_dict(baselined=id(f) not in new_keys)

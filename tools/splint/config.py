@@ -47,9 +47,9 @@ class Config:
     #: the resilience module declaring RUN_REPORT_EVENTS (SPL012)
     resilience_module: str = "splatt_tpu/resilience.py"
     #: the trace module declaring the SPANS name registry (SPL013)
-    #: and the METRICS registry (SPL024)
+    #: and the METRICS registry (SPL029)
     trace_module: str = "splatt_tpu/trace.py"
-    #: the markdown file whose metrics table SPL024 checks against
+    #: the markdown file whose metrics table SPL029 checks against
     #: trace.METRICS in both directions ("" disables the docs legs)
     metrics_doc: str = "docs/observability.md"
     #: functions returning shared-cache file paths; values derived
@@ -106,6 +106,47 @@ class Config:
     #: the serve module declaring TERMINAL and KNOWN_KINDS (SPL020,
     #: SPL022)
     serve_module: str = "splatt_tpu/serve.py"
+    #: files/dirs whose reductions the SPL024 dtype-flow interpreter
+    #: audits for accumulation-dtype discipline
+    numerics_modules: List[str] = dataclasses.field(default_factory=list)
+    #: the sanctioned accumulation-dtype helper names — a reduce
+    #: routed through one carries the discipline (SPL024); each must
+    #: exist in config-module (registry leg)
+    acc_dtype_helpers: List[str] = dataclasses.field(default_factory=list)
+    #: hot stream functions ("relpath::name") audited by SPL028 for
+    #: narrow×wide elementwise promotion before the accumulate point
+    hot_stream_functions: List[str] = dataclasses.field(
+        default_factory=list)
+    #: declared entry dtypes for the hot stream functions
+    #: ("relpath::fn::param=bf16") — the storage contract the dispatch
+    #: layer feeds them (SPL024/SPL028 lattice seeds)
+    hot_stream_param_dtypes: List[str] = dataclasses.field(
+        default_factory=list)
+    #: files/dirs whose BlockSpecs SPL025/SPL026 audit
+    pallas_modules: List[str] = dataclasses.field(default_factory=list)
+    #: dtype-blind padding helpers (ceil_to/_pad_blocks) — values they
+    #: produce need a unit that certifies the block position (SPL025)
+    align_helpers: List[str] = dataclasses.field(default_factory=list)
+    #: dtype-AWARE padding helpers (_rank_pad/tile_packing) whose
+    #: results certify any sublane position (SPL025)
+    tile_pack_helpers: List[str] = dataclasses.field(default_factory=list)
+    #: declared dispatch envelope for SPL026's static accounting:
+    #: "dim-text=int" caps a block dim by its unparsed source text,
+    #: "*name=int" caps a starred spec list's multiplicity
+    vmem_dim_caps: List[str] = dataclasses.field(default_factory=list)
+    #: default per-kernel VMEM budget in MiB (SPL026)
+    vmem_budget_mib: str = "16"
+    #: per-kernel overrides, "fn=MiB" (SPL026)
+    vmem_kernel_budgets: List[str] = dataclasses.field(
+        default_factory=list)
+    #: kernel-wrapper → dispatch-gate registry, "fn=gate" (SPL026):
+    #: every pallas_call wrapper needs one, the gate must exist in the
+    #: wrapper's module and be consulted somewhere
+    vmem_gate_map: List[str] = dataclasses.field(default_factory=list)
+    #: functions performing the plan cache's strict-match comparison;
+    #: SPL027 checks each compares the schema's match set exactly
+    plan_match_functions: List[str] = dataclasses.field(
+        default_factory=list)
     #: rules whose finding budget is ZERO — never baselined, never
     #: grandfathered; the pytest gate enforces each at 0 findings
     zero_rules: List[str] = dataclasses.field(default_factory=list)

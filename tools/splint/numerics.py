@@ -1,0 +1,551 @@
+"""splint v5 (part 1): dtype-precision flow rules (SPL024, SPL028).
+
+splatt-tpu's mixed-precision story is a contract, not a convention:
+factors and nonzeros may be stored narrow (bf16/f16) but every
+reduction over them — segment-sums, Grams, inner products, fit
+numerators — must accumulate wide.  The sanctioned forms are exactly
+three: route the dtype through the ``config`` acc-dtype helpers
+(``acc_dtype`` / ``_acc_dtype`` / ``fit_dtype`` / ``host_acc_dtype``),
+pin MXU output via ``preferred_element_type=...``, or pass an explicit
+wide ``dtype=`` to the reduce itself.  An unpinned ``bf16 @ bf16`` is
+silently 8-mantissa-bit accumulation; a pre-reduce ``narrow * wide``
+stream op silently doubles hot-loop bytes.  Both are invisible to
+tests that only run f32.
+
+These rules run a small abstract interpreter over each audited
+function: a dtype lattice {f64, f32, bf16, f16, int, bool, py-scalar,
+unknown} is propagated through assignments (``astype``, ``dtype=``
+kwargs, ``zeros/full``-style constructors, elementwise ops, promotion
+at binops, comparisons → bool) so reduce operands can be judged at
+their consumption site.  The lattice is deliberately conservative:
+``unknown`` never passes a reduce — the fix is either a sanctioned
+upcast (which the lattice CAN see) or a ``# splint: ignore[SPL024]``
+with a reason.
+
+Rules (hard zero-rules — never baselined):
+
+SPL024 accumulation-dtype discipline
+    In the ``numerics-modules`` scope, every reduction call —
+    ``jnp.sum``/``.sum()``/``mean``/``prod``, ``segment_sum``,
+    ``dot_general``/``matmul``/``dot``/``tensordot``/``einsum`` and
+    the ``@`` operator — must satisfy one of: a
+    ``preferred_element_type=`` pin (dot family), an explicit
+    ``dtype=`` kwarg (sum family), an operand the lattice proves wide
+    (f32/f64 — including the RESULT of an already-pinned dot, and of
+    ``x.astype(acc_helper(...))``), or an operand proven integer/bool
+    (index math and mask counting accumulate exactly).  Anything
+    else — narrow or unresolvable — fires.  Each configured
+    acc-dtype helper must also exist in the dtype-policy module
+    (``config-module``), both directions of the registry.
+
+SPL028 implicit-upcast-on-hot-path
+    Hot stream functions (``hot-stream-functions``, with entry dtypes
+    declared in ``hot-stream-param-dtypes`` — the storage contract the
+    dispatch layer feeds them) must not mix narrow and wide operands
+    in elementwise arithmetic before the sanctioned accumulate point:
+    ``f32_M * bf16_U`` materializes a full-size f32 stream where the
+    kernel was supposed to move bf16 bytes and upcast only inside the
+    reduce.  The fix is a single pinned contraction
+    (``einsum(..., preferred_element_type=acc)``) or reordering the
+    upcast into the reduce operand.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.splint.core import (FileCtx, Finding, Project, walk_nodes)
+
+WIDE = ("f64", "f32")
+NARROW = ("bf16", "f16")
+EXACT = ("int", "bool")
+
+#: dtype attribute / string spellings → lattice token
+_DTYPE_TOKENS = {
+    "float64": "f64", "double": "f64",
+    "float32": "f32", "float_": "f32", "single": "f32",
+    "bfloat16": "bf16", "float16": "f16", "half": "f16",
+    "int8": "int", "int16": "int", "int32": "int", "int64": "int",
+    "uint8": "int", "uint16": "int", "uint32": "int", "uint64": "int",
+    "bool_": "bool", "bool": "bool",
+}
+
+#: lattice join order for binop promotion (higher wins)
+_ORDER = {"py": 0, "bool": 1, "int": 2, "f16": 3, "bf16": 3,
+          "f32": 4, "f64": 5}
+
+_SUM_FAMILY = ("sum", "mean", "prod", "nansum", "nanmean")
+_DOT_FAMILY = ("dot_general", "matmul", "dot", "tensordot", "einsum")
+_SEGMENT_FAMILY = ("segment_sum", "segment_max", "segment_min",
+                   "segment_prod")
+#: elementwise/shape ops through which the operand dtype passes
+_PASSTHROUGH = (
+    "sqrt", "abs", "exp", "log", "log1p", "negative", "square",
+    "maximum", "minimum", "where", "clip", "reshape", "transpose",
+    "swapaxes", "broadcast_to", "pad", "ravel", "squeeze",
+    "expand_dims", "take", "take_along_axis", "concatenate", "stack",
+    "outer", "multiply", "add", "subtract", "divide", "true_divide",
+)
+_ARITH_OPS = (ast.Mult, ast.Add, ast.Sub, ast.Div, ast.Pow)
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _functions(tree: ast.AST):
+    for node in walk_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _last_seg(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _in_scope(relpath: str, entries: List[str]) -> bool:
+    for e in entries:
+        e = e.rstrip("/")
+        if relpath == e or relpath.startswith(e + "/"):
+            return True
+    return False
+
+
+def _join(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """Binop promotion on the lattice.  ``py`` (python scalar literal)
+    is neutral — jax weak types take the array side's dtype.  Unknown
+    poisons (promotion with an unknown operand is unknown)."""
+    if a == "py":
+        return b
+    if b == "py":
+        return a
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    # two distinct narrow floats never meet in this codebase; if they
+    # do, the result is not provably anything useful
+    if _ORDER[a] == _ORDER[b]:
+        return None
+    return a if _ORDER[a] > _ORDER[b] else b
+
+
+class _Env:
+    """Per-function abstract state: array lattice values and
+    dtype-valued locals (``acc = _acc_dtype(x.dtype)``)."""
+
+    def __init__(self):
+        self.arrays: Dict[str, Optional[str]] = {}
+        self.dtypes: Dict[str, Optional[str]] = {}
+
+
+def _is_acc_helper(ctx: FileCtx, call: ast.Call,
+                   helpers: List[str]) -> bool:
+    dotted = ctx.resolve(call.func) or ""
+    return bool(dotted) and _last_seg(dotted) in helpers
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _dtype_token(ctx: FileCtx, expr: Optional[ast.expr], env: _Env,
+                 helpers: List[str]) -> Optional[str]:
+    """Evaluate an expression in DTYPE position (``astype(...)`` arg,
+    ``dtype=`` kwarg, ``preferred_element_type=`` kwarg)."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _DTYPE_TOKENS.get(expr.value)
+    if isinstance(expr, ast.Name):
+        if expr.id in env.dtypes:
+            return env.dtypes[expr.id]
+        dotted = ctx.resolve(expr) or ""
+        return _DTYPE_TOKENS.get(_last_seg(dotted)) if dotted else None
+    if isinstance(expr, ast.Attribute):
+        if expr.attr == "dtype":
+            # x.dtype — the lattice value of x IS its dtype
+            if isinstance(expr.value, ast.Name):
+                return env.arrays.get(expr.value.id)
+            return None
+        dotted = ctx.resolve(expr) or ""
+        return _DTYPE_TOKENS.get(_last_seg(dotted)) if dotted else None
+    if isinstance(expr, ast.Call):
+        if _is_acc_helper(ctx, expr, helpers):
+            # the whole point of the helpers: result is never narrow.
+            # f64 in → f64 out, everything else → f32; "f32" is the
+            # conservative wide witness either way.
+            arg = _dtype_token(ctx, expr.args[0], env, helpers) \
+                if expr.args else None
+            return arg if arg == "f64" else "f32"
+        dotted = ctx.resolve(expr.func) or ""
+        if _last_seg(dotted) == "dtype" and expr.args:
+            # jnp.dtype(x) — identity on the lattice
+            return _dtype_token(ctx, expr.args[0], env, helpers)
+    return None
+
+
+def _array_value(ctx: FileCtx, expr: ast.expr, env: _Env,
+                 helpers: List[str]) -> Optional[str]:
+    """Evaluate an expression in ARRAY position → lattice token."""
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, bool):
+            return "bool"
+        if isinstance(expr.value, (int, float)):
+            return "py"
+        return None
+    if isinstance(expr, ast.Name):
+        return env.arrays.get(expr.id)
+    if isinstance(expr, (ast.Subscript, ast.Starred)):
+        return _array_value(ctx, expr.value, env, helpers)
+    if isinstance(expr, ast.UnaryOp):
+        return _array_value(ctx, expr.operand, env, helpers)
+    if isinstance(expr, ast.IfExp):
+        return _join(_array_value(ctx, expr.body, env, helpers),
+                     _array_value(ctx, expr.orelse, env, helpers))
+    if isinstance(expr, ast.Compare):
+        return "bool"
+    if isinstance(expr, ast.BoolOp):
+        return "bool"
+    if isinstance(expr, ast.BinOp):
+        return _join(_array_value(ctx, expr.left, env, helpers),
+                     _array_value(ctx, expr.right, env, helpers))
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        val: Optional[str] = "py"
+        for e in expr.elts:
+            val = _join(val, _array_value(ctx, e, env, helpers))
+        return val
+    if isinstance(expr, ast.Call):
+        return _call_value(ctx, expr, env, helpers)
+    return None
+
+
+def _call_value(ctx: FileCtx, call: ast.Call, env: _Env,
+                helpers: List[str]) -> Optional[str]:
+    dotted = ctx.resolve(call.func) or ""
+    last = _last_seg(dotted) if dotted else ""
+    # x.astype(d) — method on an unresolvable receiver: resolve() gives
+    # None for calls-on-calls, so handle the Attribute shape directly
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "astype":
+        return _dtype_token(ctx, call.args[0] if call.args else None,
+                            env, helpers)
+    if not last:
+        return None
+    if last in ("asarray", "array", "zeros", "ones", "full", "empty",
+                "zeros_like", "ones_like", "full_like", "empty_like"):
+        tok = _dtype_token(ctx, _kwarg(call, "dtype"), env, helpers)
+        if tok is not None:
+            return tok
+        if last.endswith("_like") and call.args:
+            return _array_value(ctx, call.args[0], env, helpers)
+        if last in ("asarray", "array") and call.args:
+            return _array_value(ctx, call.args[0], env, helpers)
+        return None
+    if last in ("arange", "argsort", "argmax", "argmin", "searchsorted",
+                "nonzero", "flatnonzero"):
+        tok = _dtype_token(ctx, _kwarg(call, "dtype"), env, helpers)
+        return tok if tok is not None else "int"
+    if last in _DOT_FAMILY:
+        pin = _kwarg(call, "preferred_element_type")
+        if pin is not None:
+            # the pin IS the sanctioned discipline; if splint cannot
+            # resolve its value the author still routed it explicitly,
+            # and the conservative downstream witness is "wide"
+            tok = _dtype_token(ctx, pin, env, helpers)
+            return tok if tok is not None else "f32"
+        args = [a for a in call.args
+                if not (isinstance(a, ast.Constant)
+                        and isinstance(a.value, str))]
+        val: Optional[str] = "py"
+        for a in args:
+            val = _join(val, _array_value(ctx, a, env, helpers))
+        return val
+    if last in _SUM_FAMILY or last in _SEGMENT_FAMILY:
+        tok = _dtype_token(ctx, _kwarg(call, "dtype"), env, helpers)
+        if tok is not None:
+            return tok
+        if isinstance(call.func, ast.Attribute) and not dotted.startswith(
+                ("jax", "numpy", "jnp", "np")):
+            return _array_value(ctx, call.func.value, env, helpers)
+        if call.args:
+            return _array_value(ctx, call.args[0], env, helpers)
+        return None
+    if last in _PASSTHROUGH:
+        if last == "where" and len(call.args) == 3:
+            return _join(_array_value(ctx, call.args[1], env, helpers),
+                         _array_value(ctx, call.args[2], env, helpers))
+        if last in ("concatenate", "stack") and call.args:
+            return _array_value(ctx, call.args[0], env, helpers)
+        if isinstance(call.func, ast.Attribute) and last in (
+                "reshape", "transpose", "swapaxes", "ravel", "squeeze",
+                "take", "clip"):
+            recv = _array_value(ctx, call.func.value, env, helpers)
+            if recv is not None:
+                return recv
+        if call.args:
+            val: Optional[str] = "py"
+            for a in call.args:
+                val = _join(val, _array_value(ctx, a, env, helpers))
+            return val
+    return None
+
+
+def _seed_params(fn: ast.AST, relpath: str, env: _Env,
+                 param_dtypes: List[str]) -> None:
+    """Apply ``hot-stream-param-dtypes`` declarations
+    ("relpath::fn::param=token") — the storage contract the dispatch
+    layer feeds this function."""
+    prefix = f"{relpath}::{fn.name}::"
+    for entry in param_dtypes:
+        if not entry.startswith(prefix):
+            continue
+        param, _, tok = entry[len(prefix):].partition("=")
+        if tok in _ORDER:
+            env.arrays[param.strip()] = tok.strip()
+
+
+def _build_env(ctx: FileCtx, fn: ast.AST, helpers: List[str],
+               seed: Optional[_Env] = None) -> _Env:
+    """Two-pass flow-insensitive assignment sweep.  Conflicting
+    re-assignments degrade to unknown; the second pass lets values
+    assigned late in a loop body reach uses earlier in it."""
+    env = _Env()
+    if seed is not None:
+        env.arrays.update(seed.arrays)
+        env.dtypes.update(seed.dtypes)
+    seeded = set(env.arrays)
+    for _ in range(2):
+        stmts = [n for n in walk_nodes(fn)
+                 if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign))]
+        for st in sorted(stmts, key=lambda n: n.lineno):
+            value = st.value
+            if value is None:
+                continue
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            dt = _dtype_token(ctx, value, env, helpers)
+            av = _array_value(ctx, value, env, helpers)
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if t.id in seeded:
+                    continue  # declared contract wins over local flow
+                if isinstance(st, ast.AugAssign):
+                    env.arrays[t.id] = _join(env.arrays.get(t.id), av)
+                    continue
+                # a name can hold a dtype OR an array, not both; dtype
+                # interpretation wins only when the RHS is clearly a
+                # dtype expression (helper call / dtype literal)
+                if dt is not None and (
+                        isinstance(value, ast.Call)
+                        and _is_acc_helper(ctx, value, helpers)
+                        or isinstance(value, (ast.Attribute, ast.Name))
+                        and av is None):
+                    env.dtypes[t.id] = dt
+                prev = env.arrays.get(t.id)
+                env.arrays[t.id] = av if prev is None else (
+                    av if prev == av else None)
+    return env
+
+
+class _NumericsRule:
+    id = "SPL0xx"
+    title = ""
+    hint = ""
+
+    def finding(self, ctx_or_path, line: int, message: str) -> Finding:
+        path = (ctx_or_path.relpath if isinstance(ctx_or_path, FileCtx)
+                else ctx_or_path)
+        return Finding(self.id, path, line, f"{self.title}: {message}",
+                       hint=self.hint)
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+
+class AccumulationDiscipline(_NumericsRule):
+    """SPL024: every reduce over possibly-narrow storage must carry the
+    accumulation-dtype discipline."""
+
+    id = "SPL024"
+    title = "reduction without accumulation-dtype discipline"
+    hint = ("route the reduce through config.acc_dtype (operand "
+            "``.astype(acc_dtype(x.dtype))``, ``dtype=acc`` on the sum, "
+            "or ``preferred_element_type=acc`` on the dot); if the "
+            "operand is provably exact/wide for a reason splint cannot "
+            "see, add `# splint: ignore[SPL024] <reason>`")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        conf = project.config
+        if not _in_scope(ctx.relpath, conf.numerics_modules):
+            return []
+        helpers = conf.acc_dtype_helpers
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            env = _Env()
+            _seed_params(fn, ctx.relpath, env,
+                         conf.hot_stream_param_dtypes)
+            env = _build_env(ctx, fn, helpers, seed=env)
+            for node in walk_nodes(fn):
+                if isinstance(node, ast.BinOp) and isinstance(
+                        node.op, ast.MatMult):
+                    val = _join(
+                        _array_value(ctx, node.left, env, helpers),
+                        _array_value(ctx, node.right, env, helpers))
+                    if val not in WIDE and val not in EXACT:
+                        out.append(self.finding(
+                            ctx, node.lineno,
+                            "`@` has no preferred_element_type pin and "
+                            f"its operands are {val or 'unresolvable'}; "
+                            "narrow storage would accumulate narrow"))
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                out.extend(self._check_call(ctx, node, env, helpers))
+        return _dedupe(out)
+
+    def _check_call(self, ctx: FileCtx, call: ast.Call, env: _Env,
+                    helpers: List[str]) -> List[Finding]:
+        dotted = ctx.resolve(call.func) or ""
+        if not dotted and isinstance(call.func, ast.Attribute):
+            # method reduce on an unresolvable receiver: x.sum()
+            last = call.func.attr
+            if last not in _SUM_FAMILY:
+                return []
+            if _dtype_token(ctx, _kwarg(call, "dtype"), env,
+                            helpers) is not None:
+                return []
+            recv = _array_value(ctx, call.func.value, env, helpers)
+            if recv in WIDE or recv in EXACT:
+                return []
+            return [self.finding(
+                ctx, call.lineno,
+                f".{last}() over a {recv or 'unresolvable'} operand "
+                "with no dtype= accumulation pin")]
+        last = _last_seg(dotted) if dotted else ""
+        if last in _DOT_FAMILY:
+            if _kwarg(call, "preferred_element_type") is not None:
+                return []
+            args = [a for a in call.args
+                    if not (isinstance(a, ast.Constant)
+                            and isinstance(a.value, str))]
+            val: Optional[str] = "py"
+            for a in args:
+                val = _join(val, _array_value(ctx, a, env, helpers))
+            if val in WIDE or val in EXACT:
+                return []
+            return [self.finding(
+                ctx, call.lineno,
+                f"{last} has no preferred_element_type pin and its "
+                f"operands are {val or 'unresolvable'}")]
+        if last in _SUM_FAMILY or last in _SEGMENT_FAMILY:
+            is_jnp_style = dotted.startswith(
+                ("jax", "numpy", "jnp", "np"))
+            if not is_jnp_style and not isinstance(
+                    call.func, ast.Attribute):
+                return []
+            if _dtype_token(ctx, _kwarg(call, "dtype"), env,
+                            helpers) is not None:
+                return []
+            if last in _SEGMENT_FAMILY or is_jnp_style or not isinstance(
+                    call.func, ast.Attribute):
+                operand = call.args[0] if call.args else None
+            else:
+                operand = call.func.value
+            val = _array_value(ctx, operand, env, helpers) \
+                if operand is not None else None
+            if val in WIDE or val in EXACT:
+                return []
+            if last in _SEGMENT_FAMILY:
+                return [self.finding(
+                    ctx, call.lineno,
+                    f"{last} accumulates in its operand dtype "
+                    f"({val or 'unresolvable'}) — upcast the operand "
+                    "via .astype(acc_dtype(...)) before the reduce")]
+            return [self.finding(
+                ctx, call.lineno,
+                f"{last} over a {val or 'unresolvable'} operand with "
+                "no dtype= accumulation pin")]
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        """Registry leg: every configured acc-dtype helper that looks
+        project-local (not dunder/builtin) must exist in the
+        dtype-policy module — a helper splint trusts but nobody
+        defines is a hole in the discipline."""
+        conf = project.config
+        ctx = project.ctx_for(conf.config_module)
+        if ctx is None:
+            return []
+        defined = {fn.name for fn in _functions(ctx.tree)}
+        out: List[Finding] = []
+        for h in conf.acc_dtype_helpers:
+            base = h.lstrip("_")
+            if h in defined or base in defined or f"_{base}" in defined:
+                continue
+            out.append(self.finding(
+                conf.config_module, 1,
+                f"configured acc-dtype helper {h!r} is not defined in "
+                "the dtype-policy module (stale [tool.splint] entry?)"))
+        return out
+
+
+class ImplicitHotUpcast(_NumericsRule):
+    """SPL028: narrow×wide elementwise arithmetic in a hot stream
+    function materializes a wide stream before the reduce."""
+
+    id = "SPL028"
+    title = "implicit upcast on hot path"
+    hint = ("fold the upcast into the sanctioned accumulate point — a "
+            "single pinned contraction (einsum/dot_general with "
+            "preferred_element_type) or .astype(acc) directly on the "
+            "reduce operand — instead of materializing a wide "
+            "elementwise intermediate")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        conf = project.config
+        wanted = {e.split("::", 2)[1] for e in conf.hot_stream_functions
+                  if e.startswith(ctx.relpath + "::")}
+        if not wanted:
+            return []
+        helpers = conf.acc_dtype_helpers
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            if fn.name not in wanted:
+                continue
+            env = _Env()
+            _seed_params(fn, ctx.relpath, env,
+                         conf.hot_stream_param_dtypes)
+            env = _build_env(ctx, fn, helpers, seed=env)
+            for node in walk_nodes(fn):
+                if not (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, _ARITH_OPS)):
+                    continue
+                left = _array_value(ctx, node.left, env, helpers)
+                right = _array_value(ctx, node.right, env, helpers)
+                pair = {left, right}
+                if pair & set(NARROW) and pair & set(WIDE):
+                    out.append(self.finding(
+                        ctx, node.lineno,
+                        f"{fn.name}: elementwise op mixes "
+                        f"{left or '?'} and {right or '?'} — the "
+                        "result promotes wide BEFORE the accumulate "
+                        "point, doubling hot-loop bytes"))
+        return _dedupe(out)
+
+
+NUMERICS_RULES = [AccumulationDiscipline(), ImplicitHotUpcast()]

@@ -1281,10 +1281,10 @@ class SpanNameDrift(Rule):
         return out
 
 
-# -- SPL024 -----------------------------------------------------------------
+# -- SPL029 -----------------------------------------------------------------
 
 #: the metric-recording verbs, each bound to the one sample type it
-#: may record (trace.py raises on the mismatch at runtime; SPL024
+#: may record (trace.py raises on the mismatch at runtime; SPL029
 #: catches it before anything runs)
 _METRIC_FNS = {"metric_inc": "counter", "metric_set": "gauge",
                "metric_observe": "histogram"}
@@ -1352,7 +1352,7 @@ class MetricNameDrift(Rule):
     Prometheus surface that dashboards and the fleet aggregator are
     built on (docs/observability.md)."""
 
-    id = "SPL024"
+    id = "SPL029"
     title = "metric-name drift against trace.py:METRICS / the docs table"
     hint = ("declare the metric (name -> (type, doc)) in "
             "splatt_tpu/trace.py:METRICS and add its row to the docs "
@@ -1985,6 +1985,10 @@ def _dedupe(findings: List[Finding]) -> List[Finding]:
 from tools.splint.durability import (ReplayTotality,  # noqa: E402
                                      FsyncBarrier, StampFactorAtomicity,
                                      TornPublish, UnfencedTerminalCommit)
+from tools.splint.numerics import (AccumulationDiscipline,  # noqa: E402
+                                   ImplicitHotUpcast)
+from tools.splint.tiling import (PlanSchemaDrift,  # noqa: E402
+                                 TileAlignment, VmemBudget)
 
 RULES: List[Rule] = [
     RawEnvironAccess(),
@@ -2011,4 +2015,9 @@ RULES: List[Rule] = [
     StampFactorAtomicity(),
     ReplayTotality(),
     FsyncBarrier(),
+    AccumulationDiscipline(),
+    TileAlignment(),
+    VmemBudget(),
+    PlanSchemaDrift(),
+    ImplicitHotUpcast(),
 ]

@@ -1,0 +1,859 @@
+"""splint v5 (part 2): TPU tiling + plan-schema rules (SPL025–SPL027).
+
+Mosaic's layout rules are unforgiving and invisible from Python: the
+last two dims of every block must divide the dtype's native
+(sublane, lane) packing — (8, 128) for 4-byte types, (16, 128) for
+bf16/f16 — or equal the array dims exactly; every block buffer lives
+in ~16 MiB of VMEM, with grid-streamed operands double-buffered; and
+the plan cache silently mis-dispatches the moment a regime-key
+component or a ``TunedPlan`` field stops being compared.  Each of
+these failed at runtime at least once before these rules existed.
+
+SPL025 tile alignment
+    Every ``pl.BlockSpec`` / ``pltpu.VMEM`` block tuple in the
+    ``pallas-modules`` scope has its last-two dims judged:
+
+    * int literal / module-const int → must divide or be a multiple
+      of the position's unit (8 sublane, 128 lane);
+    * a name this function PADDED (assigned from ``align-helpers``
+      (``ceil_to``/``_pad_blocks``) or ``tile-pack-helpers``
+      (``_rank_pad``/``tile_packing``)) → the pad unit must certify
+      the position — lane: multiple of 128; sublane: a tile-pack
+      helper or a multiple of 16 (bf16-safe).  A dtype-blind
+      ``ceil_to(R, 8)`` fires: it under-pads 2-byte storage.  This
+      class is judged FIRST — such a name also matching the out-shape
+      is a circular certificate (the array is that size only because
+      this very computation padded it);
+    * a name the function merely READS (``.shape``-derived, attribute
+      extent like ``layout.block``, ``len(...)``, or appearing in the
+      call's ``ShapeDtypeStruct``/``reshape`` shapes) → trusted: the
+      block equals a materialized array dim (Mosaic's equal-dims
+      escape);
+    * anything else (arithmetic, unknown calls) → finding.
+
+    Grid completeness: any ``//`` inside a ``grid=`` expression (or
+    the local def of its elements) must have a numerator that was
+    padded via the align/tile-pack helpers — ``nb // chunk`` over an
+    unpadded extent silently drops the ragged tail block.
+
+SPL026 static VMEM budget
+    Per ``pallas_call``: sum of block-buffer bytes — every in/out
+    spec and scratch shape, dims resolved through literals,
+    module consts, and the declared dispatch envelope
+    (``vmem-dim-caps``, entries ``"text=int"`` matched on the
+    unparsed dim/spec expression; ``"*name=int"`` caps a starred
+    spec-list's multiplicity) — at 4 B/elem (accumulator width,
+    conservative for narrow storage), ×2 for specs whose index_map
+    actually uses a grid axis (Pallas double-buffers streamed
+    operands).  The sum must fit the kernel's budget
+    (``vmem-kernel-budgets`` ``"fn=MiB"``, else ``vmem-budget-mib``).
+    A tile-size bump that cannot fit now fails CI instead of a
+    runtime Mosaic error.  An unresolvable dim is itself a finding —
+    a budget splint cannot evaluate is not a budget.
+
+    Gate registry, both directions: every function issuing a
+    ``pallas_call`` must appear in ``vmem-gate-map`` (``"fn=gate"``),
+    its gate must exist in the same module, and the gate must be
+    consulted somewhere outside its own def — an ungated kernel or an
+    orphaned gate is exactly how the fused_t double-buffer
+    undercount shipped.
+
+SPL027 plan-cache schema completeness
+    Any module assigning ``PLAN_CACHE_VERSION`` must declare
+    ``PLAN_SCHEMA`` (version / key / fields / match / exempt) and the
+    code must agree with it in BOTH directions: ``plan_key`` params ==
+    schema key (each actually folded into the key); ``TunedPlan``
+    annotated fields == schema fields; match ∪ exempt == fields,
+    disjoint; every ``plan-match-functions`` dispatch comparator
+    compares at least the match set and only declared fields;
+    ``PLAN_SCHEMA['version'] == PLAN_CACHE_VERSION``; and the module
+    carries a ``v<n>:`` history marker for every version 2..N (the
+    bump discipline).  Growing ``TunedPlan`` or ``plan_key`` without
+    updating the schema — the silent mis-dispatch drift class — now
+    fails statically.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.splint.core import (FileCtx, Finding, Project, walk_nodes)
+
+_SUBLANE_UNIT = 8
+_LANE_UNIT = 128
+_NARROW_SUBLANE = 16   # bf16/f16 packing — the dtype-safe pad unit
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _functions(tree: ast.AST):
+    for node in walk_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _last_seg(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _in_scope(relpath: str, entries: List[str]) -> bool:
+    for e in entries:
+        e = e.rstrip("/")
+        if relpath == e or relpath.startswith(e + "/"):
+            return True
+    return False
+
+
+def _pairs(entries: List[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for e in entries:
+        k, _, v = e.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _module_int_consts(tree: ast.AST) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in ast.iter_child_nodes(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _contains_shape(expr: ast.AST) -> bool:
+    for n in walk_nodes(expr):
+        if isinstance(n, ast.Attribute) and n.attr == "shape":
+            return True
+        if isinstance(n, ast.Call):
+            dotted = None
+            if isinstance(n.func, ast.Name):
+                dotted = n.func.id
+            if dotted == "len":
+                return True
+    return False
+
+
+class _FnShapes:
+    """Per-function classification of the names block dims may use."""
+
+    def __init__(self, ctx: FileCtx, fn: ast.AST, align: List[str],
+                 pack: List[str], consts: Dict[str, int]):
+        self.helpers = set(align) | set(pack)
+        self.consts = dict(consts)
+        #: name → unit expr of its ceil_to-style pad (None = unknown)
+        self.ceil: Dict[str, Optional[ast.expr]] = {}
+        #: names padded through a dtype-aware tile-pack helper
+        self.packed: set = set()
+        #: names the function merely reads off existing arrays/layouts
+        self.extent: set = set()
+        for st in walk_nodes(fn):
+            if not isinstance(st, ast.Assign):
+                continue
+            for tgt, val in self._bindings(st):
+                if not isinstance(tgt, ast.Name):
+                    continue
+                name = tgt.id
+                if isinstance(val, ast.Constant) and isinstance(
+                        val.value, int):
+                    self.consts[name] = val.value
+                    continue
+                helper = ""
+                if isinstance(val, ast.Call):
+                    dotted = ctx.resolve(val.func) or ""
+                    helper = _last_seg(dotted) if dotted else ""
+                if helper in pack:
+                    self.packed.add(name)
+                elif helper in align:
+                    self.ceil[name] = (val.args[1]
+                                       if len(val.args) > 1 else None)
+                elif isinstance(val, ast.BinOp) and isinstance(
+                        val.op, ast.FloorDiv):
+                    # n = padded // unit keeps the numerator's class
+                    if (isinstance(val.left, ast.Name)
+                            and (val.left.id in self.ceil
+                                 or val.left.id in self.packed)):
+                        self.ceil[name] = None
+                elif isinstance(val, ast.Attribute):
+                    self.extent.add(name)
+                elif _contains_shape(val):
+                    self.extent.add(name)
+        # names appearing inside shape-tuple positions of
+        # reshape/ShapeDtypeStruct/pad/broadcast_to calls: the block
+        # dim provably equals a materialized array dim
+        for call in (n for n in walk_nodes(fn) if isinstance(n, ast.Call)):
+            dotted = ctx.resolve(call.func) or ""
+            last = _last_seg(dotted) if dotted else (
+                call.func.attr if isinstance(call.func, ast.Attribute)
+                else "")
+            if last not in ("reshape", "ShapeDtypeStruct", "pad",
+                            "broadcast_to", "zeros", "full", "empty"):
+                continue
+            for a in call.args:
+                for n in walk_nodes(a):
+                    if isinstance(n, ast.Name):
+                        self.extent.add(n.id)
+        # a padded name is never a trusted extent: the out array is
+        # that size only because this function padded it (circular)
+        self.extent -= set(self.ceil) | set(self.packed)
+
+    @staticmethod
+    def _bindings(st: ast.Assign):
+        for tgt in st.targets:
+            if isinstance(tgt, ast.Name):
+                yield tgt, st.value
+            elif isinstance(tgt, ast.Tuple):
+                if (isinstance(st.value, ast.Tuple)
+                        and len(st.value.elts) == len(tgt.elts)):
+                    yield from zip(tgt.elts, st.value.elts)
+                else:
+                    for e in tgt.elts:
+                        yield e, st.value
+
+
+class _TilingRule:
+    id = "SPL0xx"
+    title = ""
+    hint = ""
+
+    def finding(self, ctx_or_path, line: int, message: str) -> Finding:
+        path = (ctx_or_path.relpath if isinstance(ctx_or_path, FileCtx)
+                else ctx_or_path)
+        return Finding(self.id, path, line, f"{self.title}: {message}",
+                       hint=self.hint)
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+
+def _block_calls(ctx: FileCtx, fn: ast.AST):
+    """Yield (call, kind) for BlockSpec / pltpu.VMEM constructors."""
+    for node in walk_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = ctx.resolve(node.func) or ""
+        last = _last_seg(dotted) if dotted else ""
+        if last == "BlockSpec":
+            yield node, "BlockSpec"
+        elif last == "VMEM":
+            yield node, "VMEM"
+
+
+class TileAlignment(_TilingRule):
+    """SPL025: block last-two dims must respect the dtype's native
+    (sublane, lane) packing."""
+
+    id = "SPL025"
+    title = "tile-alignment hazard"
+    hint = ("pad the sublane dim through config.tile_packing / "
+            "_rank_pad (dtype-aware: 8 f32, 16 bf16) and lane dims to "
+            "multiples of 128; block dims equal to the materialized "
+            "array extent are fine.  If Mosaic provably accepts this "
+            "shape, add `# splint: ignore[SPL025] <reason>`")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        conf = project.config
+        if not _in_scope(ctx.relpath, conf.pallas_modules):
+            return []
+        consts = _module_int_consts(ctx.tree)
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            shapes = _FnShapes(ctx, fn, conf.align_helpers,
+                               conf.tile_pack_helpers, consts)
+            for call, kind in _block_calls(ctx, fn):
+                if not call.args:
+                    continue   # memory_space-only spec
+                block = call.args[0]
+                if not isinstance(block, ast.Tuple):
+                    if _contains_shape(block):
+                        continue   # whole-array extent (u.shape, ...)
+                    out.append(self.finding(
+                        ctx, call.lineno,
+                        f"{kind} block {ast.unparse(block)!r} is not a "
+                        "dim tuple nor a .shape-derived extent — "
+                        "alignment cannot be audited"))
+                    continue
+                dims = block.elts
+                judged = dims[-2:] if len(dims) >= 2 else dims[-1:]
+                units = ([_SUBLANE_UNIT, _LANE_UNIT]
+                         if len(judged) == 2 else [_LANE_UNIT])
+                for dim, unit in zip(judged, units):
+                    msg = self._judge(ctx, dim, unit, shapes)
+                    if msg:
+                        out.append(self.finding(ctx, call.lineno, msg))
+            out.extend(self._check_grid(ctx, fn, shapes))
+        return _dedupe(out)
+
+    def _judge(self, ctx: FileCtx, dim: ast.expr, unit: int,
+               shapes: _FnShapes) -> Optional[str]:
+        pos = "sublane" if unit == _SUBLANE_UNIT else "lane"
+        value: Optional[int] = None
+        if isinstance(dim, ast.Constant) and isinstance(dim.value, int):
+            value = dim.value
+        elif isinstance(dim, ast.Name):
+            name = dim.id
+            # computed pads are judged FIRST (circular-certificate rule)
+            if name in shapes.packed:
+                return None
+            if name in shapes.ceil:
+                return self._judge_ceil(ctx, name, shapes.ceil[name],
+                                        pos, shapes)
+            if name in shapes.consts:
+                value = shapes.consts[name]
+            elif name in shapes.extent:
+                return None
+            else:
+                return (f"block {pos} dim {name!r} is neither a "
+                        "literal, a helper-padded value, nor a "
+                        "materialized array extent")
+        elif isinstance(dim, ast.Call):
+            dotted = ctx.resolve(dim.func) or ""
+            last = _last_seg(dotted) if dotted else ""
+            if last in ("len", "int") or _contains_shape(dim):
+                return None
+            return (f"block {pos} dim {ast.unparse(dim)!r} cannot be "
+                    "audited for alignment")
+        else:
+            if _contains_shape(dim):
+                return None
+            return (f"block {pos} dim {ast.unparse(dim)!r} cannot be "
+                    "audited for alignment")
+        if value is None:
+            return None
+        if value % unit == 0 or unit % value == 0:
+            return None
+        return (f"block {pos} dim {value} neither divides nor is a "
+                f"multiple of the native unit {unit}")
+
+    def _judge_ceil(self, ctx: FileCtx, name: str,
+                    unit_expr: Optional[ast.expr], pos: str,
+                    shapes: _FnShapes) -> Optional[str]:
+        if unit_expr is None:
+            return (f"block {pos} dim {name!r} was padded with a unit "
+                    "splint cannot resolve")
+        if isinstance(unit_expr, ast.Call):
+            dotted = ctx.resolve(unit_expr.func) or ""
+            # ceil_to(R, tile_packing(dtype)[0]) — dtype-aware
+            return None if dotted else (
+                f"block {pos} dim {name!r}: unresolvable pad unit")
+        if isinstance(unit_expr, ast.Subscript):
+            return None   # tile_packing(dtype)[0]-style indexing
+        uval: Optional[int] = None
+        if isinstance(unit_expr, ast.Constant) and isinstance(
+                unit_expr.value, int):
+            uval = unit_expr.value
+        elif isinstance(unit_expr, ast.Name):
+            uval = shapes.consts.get(unit_expr.id)
+        if uval is None:
+            return (f"block {pos} dim {name!r}: unresolvable pad unit "
+                    f"{ast.unparse(unit_expr)!r}")
+        if pos == "lane":
+            return None if uval % _LANE_UNIT == 0 else (
+                f"block lane dim {name!r} padded to {uval}, not a "
+                f"multiple of {_LANE_UNIT}")
+        # sublane: a fixed unit must cover the NARROW packing too —
+        # ceil_to(R, 8) under-pads bf16 storage (needs 16)
+        if uval % _NARROW_SUBLANE == 0:
+            return None
+        return (f"block sublane dim {name!r} padded with dtype-blind "
+                f"unit {uval}; bf16/f16 storage packs "
+                f"{_NARROW_SUBLANE} sublanes — pad via "
+                "config.tile_packing (see _rank_pad)")
+
+    def _check_grid(self, ctx: FileCtx, fn: ast.AST,
+                    shapes: _FnShapes) -> List[Finding]:
+        out: List[Finding] = []
+        grid_exprs: List[ast.expr] = []
+        for node in walk_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = ctx.resolve(node.func) or ""
+            if _last_seg(dotted) != "pallas_call":
+                continue
+            for kw in node.keywords:
+                if kw.arg == "grid":
+                    grid_exprs.append(kw.value)
+        # chase grid names to their local defs
+        defs: Dict[str, ast.expr] = {}
+        for st in walk_nodes(fn):
+            if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)):
+                defs[st.targets[0].id] = st.value
+        todo = list(grid_exprs)
+        seen_names: set = set()
+        while todo:
+            e = todo.pop()
+            for n in walk_nodes(e):
+                if (isinstance(n, ast.Name) and n.id in defs
+                        and n.id not in seen_names):
+                    seen_names.add(n.id)
+                    todo.append(defs[n.id])
+                if not (isinstance(n, ast.BinOp)
+                        and isinstance(n.op, ast.FloorDiv)):
+                    continue
+                num = n.left
+                ok = (isinstance(num, ast.Name)
+                      and (num.id in shapes.ceil
+                           or num.id in shapes.packed))
+                if not ok and isinstance(num, ast.Call):
+                    # inline ceil_to(nb, chunk) // chunk
+                    dotted = ctx.resolve(num.func) or ""
+                    ok = _last_seg(dotted) in shapes.helpers
+                if not ok:
+                    out.append(self.finding(
+                        ctx, n.lineno,
+                        "grid division "
+                        f"{ast.unparse(n)!r}: numerator was not "
+                        "padded to a multiple of the divisor — the "
+                        "ragged tail block is silently dropped"))
+        return out
+
+
+class VmemBudget(_TilingRule):
+    """SPL026: static block-buffer accounting against the per-kernel
+    VMEM budget, plus the kernel↔gate registry."""
+
+    id = "SPL026"
+    title = "VMEM budget"
+    hint = ("shrink the block (or raise the kernel's declared budget "
+            "in [tool.splint] vmem-kernel-budgets WITH a measurement); "
+            "declare new block dims in vmem-dim-caps — the caps are "
+            "the dispatch envelope, keep them honest")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        conf = project.config
+        if not _in_scope(ctx.relpath, conf.pallas_modules):
+            return []
+        caps = {k: int(v) for k, v in
+                _pairs(conf.vmem_dim_caps).items()}
+        budgets = {k: float(v) for k, v in
+                   _pairs(conf.vmem_kernel_budgets).items()}
+        default_mib = float(conf.vmem_budget_mib or "16")
+        consts = _module_int_consts(ctx.tree)
+        gate_map = _pairs(conf.vmem_gate_map)
+        out: List[Finding] = []
+        for fn in _functions(ctx.tree):
+            shapes = _FnShapes(ctx, fn, conf.align_helpers,
+                               conf.tile_pack_helpers, consts)
+            calls = [n for n in walk_nodes(fn)
+                     if isinstance(n, ast.Call)
+                     and _last_seg(ctx.resolve(n.func) or "")
+                     == "pallas_call"]
+            if not calls:
+                continue
+            if fn.name not in gate_map:
+                out.append(self.finding(
+                    ctx, fn.lineno,
+                    f"kernel wrapper {fn.name!r} has no entry in "
+                    "[tool.splint] vmem-gate-map — every pallas_call "
+                    "needs a dispatch-time VMEM gate"))
+            for call in calls:
+                out.extend(self._check_call(
+                    ctx, fn, call, shapes, caps,
+                    budgets.get(fn.name, default_mib)))
+        return _dedupe(out)
+
+    # -- accounting -----------------------------------------------------
+
+    def _check_call(self, ctx: FileCtx, fn, call: ast.Call,
+                    shapes: _FnShapes, caps: Dict[str, int],
+                    budget_mib: float) -> List[Finding]:
+        out: List[Finding] = []
+        total = 0
+        specs: List[Tuple[ast.expr, bool]] = []   # (spec expr, scratch?)
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                vals = (kw.value.elts
+                        if isinstance(kw.value, (ast.List, ast.Tuple))
+                        else [kw.value])
+                specs.extend((v, False) for v in vals)
+            elif kw.arg == "scratch_shapes":
+                vals = (kw.value.elts
+                        if isinstance(kw.value, (ast.List, ast.Tuple))
+                        else [kw.value])
+                specs.extend((v, True) for v in vals)
+        for spec, is_scratch in specs:
+            if isinstance(spec, ast.Starred):
+                got = self._starred_bytes(ctx, fn, spec, shapes, caps)
+            elif isinstance(spec, ast.Name):
+                # out_spec chosen by an if/else: charge the LARGEST
+                # candidate — the budget must cover every branch
+                cands = [st.value for st in walk_nodes(fn)
+                         if isinstance(st, ast.Assign)
+                         and any(isinstance(t, ast.Name)
+                                 and t.id == spec.id
+                                 for t in st.targets)]
+                if not cands:
+                    got = (f"spec {spec.id!r} has no local BlockSpec "
+                           "definition splint can account")
+                else:
+                    sized = [self._spec_bytes(ctx, c, shapes, caps,
+                                              is_scratch)
+                             for c in cands]
+                    errs = [s for s in sized if isinstance(s, str)]
+                    got = errs[0] if errs else max(sized)
+            else:
+                got = self._spec_bytes(ctx, spec, shapes, caps,
+                                       is_scratch)
+            if isinstance(got, str):
+                out.append(self.finding(ctx, spec.lineno, got))
+            else:
+                total += got
+        limit = int(budget_mib * (1 << 20))
+        if total > limit:
+            out.append(self.finding(
+                ctx, call.lineno,
+                f"{fn.name}: static block-buffer sum "
+                f"{total / (1 << 20):.1f} MiB exceeds the declared "
+                f"budget {budget_mib:.0f} MiB (streamed specs counted "
+                "double-buffered, 4 B/elem)"))
+        return out
+
+    def _starred_bytes(self, ctx, fn, spec: ast.Starred, shapes,
+                       caps):
+        name = (spec.value.id if isinstance(spec.value, ast.Name)
+                else ast.unparse(spec.value))
+        mult = caps.get(f"*{name}")
+        if mult is None:
+            return (f"starred spec list {name!r} has no "
+                    f"'*{name}=<count>' multiplicity cap in "
+                    "vmem-dim-caps")
+        # find the list's element BlockSpec (listcomp or list literal)
+        elem: Optional[ast.expr] = None
+        for st in walk_nodes(fn):
+            if not (isinstance(st, ast.Assign)
+                    and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)
+                    and st.targets[0].id == name):
+                continue
+            v = st.value
+            if isinstance(v, ast.ListComp):
+                elem = v.elt
+            elif isinstance(v, (ast.List, ast.Tuple)) and v.elts:
+                elem = v.elts[0]
+        if elem is None:
+            return (f"starred spec list {name!r}: cannot locate its "
+                    "element BlockSpec")
+        got = self._spec_bytes(ctx, elem, shapes, caps, False)
+        if isinstance(got, str):
+            return got
+        return got * mult
+
+    def _spec_bytes(self, ctx, spec: ast.expr, shapes, caps,
+                    is_scratch: bool):
+        """Bytes of one BlockSpec/VMEM entry, or an error message."""
+        if not isinstance(spec, ast.Call):
+            return (f"spec {ast.unparse(spec)!r} is not a "
+                    "BlockSpec/VMEM call splint can account")
+        if not spec.args:
+            return 0   # memory_space-only
+        block = spec.args[0]
+        streamed = (not is_scratch
+                    and self._is_streamed(spec))
+        elems = 1
+        if isinstance(block, ast.Tuple):
+            for dim in block.elts:
+                got = self._dim_value(ctx, dim, shapes, caps)
+                if got is None:
+                    return (f"block dim {ast.unparse(dim)!r} has no "
+                            "literal/const value and no vmem-dim-caps "
+                            "entry — the budget cannot be evaluated")
+                elems *= got
+        else:
+            cap = caps.get(ast.unparse(block))
+            if cap is None:
+                return (f"whole-extent block {ast.unparse(block)!r} "
+                        "needs an element-count entry in vmem-dim-caps")
+            elems = cap
+        return elems * 4 * (2 if streamed else 1)
+
+    @staticmethod
+    def _is_streamed(spec: ast.Call) -> bool:
+        """A spec is grid-streamed (→ double-buffered) iff its
+        index_map uses at least one grid axis."""
+        imap = None
+        if len(spec.args) > 1:
+            imap = spec.args[1]
+        for kw in spec.keywords:
+            if kw.arg == "index_map":
+                imap = kw.value
+        if not isinstance(imap, ast.Lambda):
+            return imap is not None   # unknown callable: assume streamed
+        params = {a.arg for a in imap.args.args}
+        return any(isinstance(n, ast.Name) and n.id in params
+                   for n in walk_nodes(imap.body))
+
+    def _dim_value(self, ctx, dim: ast.expr, shapes,
+                   caps) -> Optional[int]:
+        if isinstance(dim, ast.Constant) and isinstance(dim.value, int):
+            return dim.value
+        text = ast.unparse(dim)
+        if text in caps:
+            return caps[text]
+        if isinstance(dim, ast.Name) and dim.id in shapes.consts:
+            return shapes.consts[dim.id]
+        return None
+
+    # -- gate registry --------------------------------------------------
+
+    def finalize(self, project: Project) -> List[Finding]:
+        conf = project.config
+        gate_map = _pairs(conf.vmem_gate_map)
+        out: List[Finding] = []
+        # collect, per pallas module, defined functions + call names
+        defined: Dict[str, set] = {}
+        called: set = set()
+        for ctx in project.files:
+            for fn in _functions(ctx.tree):
+                if _in_scope(ctx.relpath, conf.pallas_modules):
+                    defined.setdefault(ctx.relpath, set()).add(fn.name)
+            for node in walk_nodes(ctx.tree):
+                if isinstance(node, ast.Call):
+                    dotted = ctx.resolve(node.func) or ""
+                    if dotted:
+                        called.add(_last_seg(dotted))
+        if not defined:
+            return []
+        all_defined = set().union(*defined.values())
+        for kernel, gate in gate_map.items():
+            if kernel not in all_defined:
+                continue   # entry for a module outside this run's paths
+            krel = next(r for r, fns in defined.items()
+                        if kernel in fns)
+            if gate not in defined.get(krel, set()):
+                out.append(self.finding(
+                    krel, 1,
+                    f"vmem-gate-map names gate {gate!r} for "
+                    f"{kernel!r} but the gate is not defined in the "
+                    "kernel's module"))
+                continue
+            if gate not in called:
+                out.append(self.finding(
+                    krel, 1,
+                    f"VMEM gate {gate!r} (for kernel {kernel!r}) is "
+                    "never consulted — an orphaned gate guards "
+                    "nothing"))
+        return _dedupe(out)
+
+
+class PlanSchemaDrift(_TilingRule):
+    """SPL027: the plan cache's key/fields/match sets must agree with
+    the declared PLAN_SCHEMA in both directions."""
+
+    id = "SPL027"
+    title = "plan-cache schema drift"
+    hint = ("update PLAN_SCHEMA together with TunedPlan/plan_key/the "
+            "strict-match comparator, bump PLAN_CACHE_VERSION, and "
+            "add the v<n>: history marker — a key component that is "
+            "stored but not compared silently mis-dispatches")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        version_node = self._module_assign(ctx, "PLAN_CACHE_VERSION")
+        if version_node is None:
+            return []
+        out: List[Finding] = []
+        schema_node = self._module_assign(ctx, "PLAN_SCHEMA")
+        if schema_node is None:
+            return [self.finding(
+                ctx, version_node.lineno,
+                "module defines PLAN_CACHE_VERSION but no PLAN_SCHEMA "
+                "declaration to audit the cache against")]
+        try:
+            schema = ast.literal_eval(schema_node.value)
+            assert isinstance(schema, dict)
+        except Exception:
+            return [self.finding(
+                ctx, schema_node.lineno,
+                "PLAN_SCHEMA is not a literal dict splint can read")]
+        for req in ("version", "key", "fields", "match", "exempt"):
+            if req not in schema:
+                out.append(self.finding(
+                    ctx, schema_node.lineno,
+                    f"PLAN_SCHEMA lacks the {req!r} component"))
+        if out:
+            return out
+        fields = set(schema["fields"])
+        match = set(schema["match"])
+        exempt = set(schema["exempt"])
+        # version agreement + bump history
+        try:
+            version = int(ast.literal_eval(version_node.value))
+        except Exception:
+            version = None
+        if version is not None and schema["version"] != version:
+            out.append(self.finding(
+                ctx, schema_node.lineno,
+                f"PLAN_SCHEMA version {schema['version']} != "
+                f"PLAN_CACHE_VERSION {version}"))
+        if version is not None:
+            for n in range(2, version + 1):
+                if f"v{n}:" not in ctx.source:
+                    out.append(self.finding(
+                        ctx, version_node.lineno,
+                        f"no 'v{n}:' history marker for cache version "
+                        f"{n} — the bump discipline requires each "
+                        "version's change to be recorded"))
+        # match/exempt partition the fields
+        if match & exempt:
+            out.append(self.finding(
+                ctx, schema_node.lineno,
+                f"fields {sorted(match & exempt)} are both matched "
+                "and exempt"))
+        if match | exempt != fields:
+            out.append(self.finding(
+                ctx, schema_node.lineno,
+                "match ∪ exempt != fields: "
+                f"{sorted((match | exempt) ^ fields)} unaccounted — "
+                "every stored field is either strictly compared or "
+                "explicitly exempt"))
+        out.extend(self._check_plan_class(ctx, fields))
+        out.extend(self._check_plan_key(ctx, set(schema["key"])))
+        return _dedupe(out)
+
+    @staticmethod
+    def _module_assign(ctx: FileCtx, name: str) -> Optional[ast.Assign]:
+        for node in ast.iter_child_nodes(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == name):
+                return node
+        return None
+
+    def _check_plan_class(self, ctx: FileCtx,
+                          fields: set) -> List[Finding]:
+        cls = None
+        for node in walk_nodes(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "TunedPlan":
+                cls = node
+        if cls is None:
+            return [self.finding(
+                ctx, 1, "no TunedPlan class next to PLAN_SCHEMA")]
+        declared = {st.target.id for st in cls.body
+                    if isinstance(st, ast.AnnAssign)
+                    and isinstance(st.target, ast.Name)}
+        out = []
+        for f in sorted(declared - fields):
+            out.append(self.finding(
+                ctx, cls.lineno,
+                f"TunedPlan field {f!r} is not declared in "
+                "PLAN_SCHEMA['fields'] — it will be stored but never "
+                "audited for strict matching"))
+        for f in sorted(fields - declared):
+            out.append(self.finding(
+                ctx, cls.lineno,
+                f"PLAN_SCHEMA declares field {f!r} that TunedPlan "
+                "does not carry"))
+        return out
+
+    def _check_plan_key(self, ctx: FileCtx, key: set) -> List[Finding]:
+        fn = None
+        for node in walk_nodes(ctx.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node.name == "plan_key":
+                fn = node
+        if fn is None:
+            return [self.finding(
+                ctx, 1, "no plan_key function next to PLAN_SCHEMA")]
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args
+                  if a.arg != "self"]
+        out = []
+        for p in sorted(set(params) - key):
+            out.append(self.finding(
+                ctx, fn.lineno,
+                f"plan_key takes {p!r} which PLAN_SCHEMA['key'] does "
+                "not declare"))
+        for p in sorted(key - set(params)):
+            out.append(self.finding(
+                ctx, fn.lineno,
+                f"PLAN_SCHEMA['key'] declares {p!r} but plan_key "
+                "does not take it"))
+        used = {n.id for st in fn.body for n in walk_nodes(st)
+                if isinstance(n, ast.Name)}
+        for p in sorted(set(params) & key):
+            if p not in used:
+                out.append(self.finding(
+                    ctx, fn.lineno,
+                    f"plan_key parameter {p!r} is never folded into "
+                    "the key — two regimes differing only in it "
+                    "share a cache entry"))
+        return out
+
+    def finalize(self, project: Project) -> List[Finding]:
+        """Dispatch leg: the strict-match comparators must compare at
+        least the schema's match set and only declared fields."""
+        conf = project.config
+        plan_ctx = None
+        for ctx in project.files:
+            if self._module_assign(ctx, "PLAN_SCHEMA") is not None:
+                plan_ctx = ctx
+        if plan_ctx is None:
+            return []
+        schema_node = self._module_assign(plan_ctx, "PLAN_SCHEMA")
+        try:
+            schema = ast.literal_eval(schema_node.value)
+            fields = set(schema["fields"])
+            match = set(schema["match"])
+        except Exception:
+            return []   # already reported by check()
+        out: List[Finding] = []
+        found_any = False
+        for ctx in project.files:
+            for fn in _functions(ctx.tree):
+                if fn.name not in conf.plan_match_functions:
+                    continue
+                found_any = True
+                # the plan variable is whichever receiver is compared
+                # on >= 2 declared fields; attrs on OTHER receivers
+                # (layout.block, fmt.encoding ...) are the comparison
+                # TARGETS, not plan fields
+                per_recv: Dict[str, set] = {}
+                for node in walk_nodes(fn):
+                    if not isinstance(node, ast.Compare):
+                        continue
+                    for side in [node.left] + list(node.comparators):
+                        if (isinstance(side, ast.Attribute)
+                                and isinstance(side.value, ast.Name)):
+                            per_recv.setdefault(side.value.id,
+                                                set()).add(side.attr)
+                compared = set()
+                for recv, attrs in per_recv.items():
+                    if len(attrs & fields) >= 2:
+                        compared |= attrs
+                        for attr in sorted(attrs - fields
+                                           - set(schema["key"])):
+                            out.append(self.finding(
+                                ctx, fn.lineno,
+                                f"{fn.name} compares {recv}.{attr} "
+                                "but PLAN_SCHEMA declares no such "
+                                "field"))
+                for attr in sorted(match - compared):
+                    out.append(self.finding(
+                        ctx, fn.lineno,
+                        f"{fn.name} never compares match field "
+                        f"{attr!r} — a plan tuned for one "
+                        f"{attr} regime will be adopted by another"))
+        if not found_any and conf.plan_match_functions:
+            out.append(self.finding(
+                plan_ctx.relpath, 1,
+                "PLAN_SCHEMA is declared but none of the configured "
+                "plan-match-functions exist in the analyzed files — "
+                "the strict-match side of the contract is missing"))
+        return _dedupe(out)
+
+
+TILING_RULES = [TileAlignment(), VmemBudget(), PlanSchemaDrift()]
