@@ -183,7 +183,8 @@ def scenario_tensor(scenario: str, shape: str, nnz: int, seed: int):
                 "dense-mode", "densemode")
     raise ValueError(
         f"unknown SPLATT_BENCH_SCENARIO {scenario!r}; want uniform, "
-        f"zipf:<a>, powerlaw, amazon-like, densemode or batched")
+        f"zipf:<a>, powerlaw, amazon-like, densemode, batched, "
+        f"predict or ingest")
 
 
 def _timing_cv(times) -> float:
@@ -957,6 +958,139 @@ def _run_predict_bench(gate: bool) -> None:
         raise SystemExit(1)
 
 
+def _run_ingest_bench(gate: bool) -> None:
+    """SPLATT_BENCH_SCENARIO=ingest (docs/ingest.md): the streaming
+    ingest plane end-to-end.  (a) Throughput: a synthetic mixed feed
+    (vocab-keyed mode 0, ~1% malformed rows so the quarantine path's
+    cost is in the number) ingested fresh per rep through the full
+    exactly-once pipeline — parse + vocab delta + segment publish +
+    fsync'd journal append per chunk — reported as records/sec with
+    the headline as wall ms per 1k records (lower-better, so the
+    regression gate's slowdown rule reads it directly).  (b)
+    Freshness: a serve ``ingest`` job chaining ``update`` jobs off a
+    committed base model; the commit->update-observe lag
+    (splatt_ingest_update_lag_seconds) p95 is the live-feed freshness
+    number.  The rep CV rides ``timing_stats`` so the 2x-CV noise
+    rule applies to the throughput comparison."""
+    import shutil
+    import tempfile
+
+    from splatt_tpu import ingest, resilience, serve
+
+    records = int(os.environ.get("SPLATT_BENCH_INGEST_RECORDS")
+                  or 60_000)
+    chunk = int(os.environ.get("SPLATT_BENCH_INGEST_CHUNK") or 5_000)
+    reps = 3
+    root = tempfile.mkdtemp(prefix="splatt-bench-ingest-")
+    src = os.path.join(root, "stream.tns")
+    rng = np.random.default_rng(5)
+    us = rng.integers(0, 4096, size=records)
+    ii = rng.integers(0, 512, size=records)
+    kk = rng.integers(0, 64, size=records)
+    vv = rng.random(records) + 0.1
+    bad = 0
+    with open(src, "w") as f:
+        for n in range(records):
+            if n % 101 == 13:
+                f.write("malformed row\n")
+                bad += 1
+            else:
+                f.write(f"u{us[n]} {ii[n]} {kk[n]} {vv[n]:.6f}\n")
+    print(f"bench: ingest stream {records} records ({bad} malformed), "
+          f"chunk {chunk}", file=sys.stderr, flush=True)
+
+    def leg(tag):
+        dest = os.path.join(root, f"dest-{tag}")
+        t0 = time.perf_counter()
+        summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                       chunk_records=chunk)
+        sec = time.perf_counter() - t0
+        assert summary["status"] == "converged", summary
+        assert summary["quarantined"] == bad, summary
+        shutil.rmtree(dest, ignore_errors=True)
+        return sec
+
+    print("bench: ingest warmup pass", file=sys.stderr, flush=True)
+    leg("warmup")
+    secs = []
+    for r in range(reps):
+        secs.append(leg(f"r{r}"))
+        print(f"bench: ingest rep {r + 1}/{reps}: "
+              f"{records / secs[-1]:,.0f} records/s",
+              file=sys.stderr, flush=True)
+    med = float(np.median(secs))
+    cv = _timing_cv(secs)
+    rps = records / med
+    ms_per_krec = 1e3 * med / (records / 1000.0)
+
+    # freshness leg: serve ingest job chaining updates off a base
+    # model — each update result carries the commit->observe lag the
+    # splatt_ingest_update_lag_seconds histogram records
+    srv = serve.Server(os.path.join(root, "serve"), workers=1)
+    dims = [48, 32, 16]
+    base = {"id": "base", "rank": 4, "iters": 6, "seed": 7,
+            "checkpoint_every": 2,
+            "synthetic": {"dims": dims, "nnz": 2000, "seed": 3}}
+    lags = []
+    if srv.submit(base)["state"] == serve.ACCEPTED:
+        srv.run_once()
+        usrc = os.path.join(root, "updates.tns")
+        un = 4000
+        with open(usrc, "w") as f:
+            for n in range(un):
+                f.write(f"{rng.integers(0, dims[0])} "
+                        f"{rng.integers(0, dims[1])} "
+                        f"{rng.integers(0, dims[2])} "
+                        f"{rng.random() + 0.1:.5f}\n")
+        spec = {"id": "ing", "kind": "ingest", "source": usrc,
+                "base": "base", "dims": dims,
+                "chunk_records": un // 8, "update_every": 2}
+        if srv.submit(spec)["state"] == serve.ACCEPTED:
+            srv.run_once()
+            res = serve.read_result(srv.root, "ing") or {}
+            for uid in res.get("updates", []):
+                ur = serve.read_result(srv.root, uid) or {}
+                lag = (ur.get("update") or {}).get("ingest_lag_s")
+                if ur.get("status") == "converged" and lag is not None:
+                    lags.append(float(lag))
+    lag_p95 = (round(float(np.percentile(lags, 95)), 4)
+               if lags else None)
+    print(f"bench: ingest {rps:,.0f} records/s (cv {cv:.4f}); "
+          f"update lag p95 "
+          f"{'n/a' if lag_p95 is None else f'{lag_p95}s'} over "
+          f"{len(lags)} update(s)", file=sys.stderr, flush=True)
+
+    rec = {
+        "metric": f"streaming ingest wall ms per 1k records, mixed "
+                  f"vocab+numeric 4-col feed with ~1% quarantined, "
+                  f"{records} records chunk {chunk}, host-side numpy "
+                  f"+ fsync'd exactly-once commits",
+        "value": round(ms_per_krec, 4),
+        "unit": "ms/krec",
+        "timing_stats": {"ingest_stream": {"median": round(med, 4),
+                                           "cv": round(cv, 4)}},
+        "ingest": {
+            "records": records, "malformed": bad,
+            "chunk_records": chunk, "reps": reps,
+            "records_per_sec": round(rps, 1),
+            "sec_per_rep": [round(s, 4) for s in secs],
+            "cv": round(cv, 4),
+            "update_lag_p95_s": lag_p95,
+            "updates_chained": len(lags),
+        },
+    }
+    regressions = []
+    try:
+        regressions = _apply_regression_gate(rec)
+    except Exception as e:
+        print(f"bench: regression gate skipped "
+              f"({resilience.classify_failure(e).value}: {e})",
+              file=sys.stderr, flush=True)
+    print(json.dumps(rec))
+    if gate and regressions:
+        raise SystemExit(1)
+
+
 def _device_precheck(timeout_sec: int = 180) -> None:
     """Probe device availability in a subprocess so a wedged accelerator
     lease cannot hang the benchmark; fall back to CPU on failure.
@@ -1036,6 +1170,11 @@ def main(gate: bool = False) -> None:
         # the prediction plane's request-latency A/B is host-side
         # numpy over a committed model store — no device needed
         _run_predict_bench(gate)
+        return
+    if os.environ.get("SPLATT_BENCH_SCENARIO", "").strip() == "ingest":
+        # the streaming-ingest plane is host-side numpy + fsync'd
+        # commits — no device needed
+        _run_ingest_bench(gate)
         return
     _device_precheck()
     import jax

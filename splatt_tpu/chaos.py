@@ -1341,6 +1341,271 @@ def format_serve_report(res: ServeChaosResult) -> List[str]:
     return lines
 
 
+# -- ingest soak (docs/ingest.md) -------------------------------------------
+#
+# The unit tests prove each ingest pillar in isolation; the soak proves
+# the one that only a REAL kill can: SIGKILL a `splatt ingest`
+# subprocess mid-stream, restart it, and audit the chunk journal ALONE
+# for the exactly-once invariant — zero records lost, zero duplicated,
+# every quarantined record accounted, the final tensor byte-exact with
+# what an uninterrupted run would have built.
+
+@dataclasses.dataclass
+class IngestChaosResult:
+    """One ingest kill-and-resume soak's verdict and evidence."""
+
+    verdict: str                  # "survived" | "violated"
+    killed_mid_stream: bool       # the SIGKILL landed before finalize
+    watermark_at_kill: int        # journal watermark at the post-mortem
+    chunks: int                   # chunks committed end-to-end
+    nnz: int                      # nonzeros in the finalized tensor
+    quarantined: int              # records quarantined end-to-end
+    resumed: bool                 # the restart reported a journal resume
+    violations: List[str]         # invariant breaches (empty = pass)
+    error: Optional[str] = None
+    #: which durable-op crash windows the SIGKILL actually landed in
+    #: (crash-point checker vocabulary, tools/splint/crashpoint.py —
+    #: the ingest_chunk_commit protocol's windows)
+    crash_windows: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _ingest_crash_windows(dest: str) -> List[str]:
+    """Classify the ingest directory's post-kill state into the
+    durable-op crash windows the kill evidently landed in (same
+    vocabulary as the crash-point checker's ``ingest_chunk_commit``
+    protocol).  Conservative: only unambiguous debris counts."""
+    windows = set()
+    jpath = os.path.join(dest, "journal.jsonl")
+    try:
+        with open(jpath, "rb") as f:
+            data = f.read()
+    except OSError:
+        data = b""
+    if data:
+        if not data.endswith(b"\n"):
+            windows.add("journal.append.torn")
+        import json as _json
+
+        for ln in data.split(b"\n"):
+            if not ln.strip():
+                continue
+            try:
+                kind = _json.loads(ln).get("rec")
+            except ValueError:
+                continue
+            if kind:
+                windows.add(f"journal.append[{kind}]")
+    for dirpath, _dirs, names in os.walk(dest):
+        base = os.path.basename(dirpath)
+        for name in names:
+            if ".tmp" not in name and ".build" not in name:
+                continue
+            if base == "seg":
+                windows.add("ingest.seg.publish")
+            elif base == "vocab":
+                windows.add("ingest.vocab.publish")
+            elif "tensor.bin" in name:
+                windows.add("ingest.bin.publish")
+    return sorted(windows)
+
+
+def run_ingest_chaos(seed: int = 0, smoke: bool = True,
+                     verbose: bool = False) -> IngestChaosResult:
+    """Kill-and-resume soak of the streaming ingest plane
+    (docs/ingest.md).
+
+    Generates a seeded record stream — string keys in mode 0 (the
+    vocab store is in the blast radius) and a deterministic sprinkle
+    of malformed records (the quarantine sidecar too) — then:
+
+    1. runs a REAL ``splatt ingest`` subprocess with a slow fault
+       armed at ``ingest.commit`` so each chunk commit dawdles and the
+       kill window is deterministic;
+    2. SIGKILLs it once the journal shows >= 2 committed chunks
+       (mid-stream, no drain, no cleanup);
+    3. audits the surviving journal ALONE (``ingest.audit_journal``):
+       every journaled chunk's segment/vocab intact under its recorded
+       sha, no watermark gaps, sidecar accounting covered;
+    4. restarts the same command unfaulted and checks it RESUMES from
+       the watermark and converges;
+    5. checks end-to-end exactly-once accounting against the
+       generator's ground truth: records seen == lines written, nnz ==
+       good records, quarantined == malformed records, and the
+       finalized ``tensor.bin`` loads with exactly that nnz.
+    """
+    import json
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    from splatt_tpu import ingest, resilience
+
+    chunk_records = 120 if smoke else 1000
+    nchunks_target = 12 if smoke else 40
+    violations: List[str] = []
+    crash_windows: List[str] = []
+    killed = False
+    watermark_at_kill = -1
+    resumed = False
+    chunks = nnz = quarantined = 0
+    error = None
+    tmp = tempfile.mkdtemp(prefix="splatt-ingest-chaos-")
+    src = os.path.join(tmp, "stream.tns")
+    dest = os.path.join(tmp, "ingest")
+
+    # seeded ground truth: every 23rd line malformed (bad arity), the
+    # rest "u<k> <i> <j> <val>" — string keys force the vocab path
+    rng = np.random.default_rng(seed)
+    total = chunk_records * nchunks_target
+    good = bad = 0
+    with open(src, "w") as f:
+        f.write("# ingest soak stream\n")
+        for n in range(total):
+            if n and n % 23 == 0:
+                f.write("malformed\n")
+                bad += 1
+            else:
+                f.write(f"u{rng.integers(0, 500)} "
+                        f"{rng.integers(0, 64)} {rng.integers(0, 48)} "
+                        f"{rng.random() + 0.1:.6f}\n")
+                good += 1
+
+    cmd = [sys.executable, "-m", "splatt_tpu.cli", "ingest", src, dest,
+           "--format", "tns", "--chunk", str(chunk_records), "--json"]
+    # splint: ignore[SPL001] forwarding the whole environment to the
+    # ingest subprocess, not reading config — no single ENV_VARS name
+    env = dict(os.environ)
+    env["SPLATT_FAULTS"] = "ingest.commit:slow:delay=0.25:*"
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        deadline = time.time() + 180
+        while time.time() < deadline and proc.poll() is None:
+            recs, _torn = ingest.replay_journal(dest)
+            if sum(1 for r in recs
+                   if r.get("rec") == ingest.REC_CHUNK) >= 2:
+                break
+            time.sleep(0.05)
+        if proc.poll() is None:
+            proc.kill()      # SIGKILL: no drain, no cleanup
+            killed = True
+        else:
+            violations.append(
+                "ingest finished (or died) before the kill — the soak "
+                "did not exercise a mid-stream resume")
+        proc.wait(timeout=60)
+
+        # post-mortem, BEFORE the restart heals anything
+        crash_windows = _ingest_crash_windows(dest)
+        resilience.run_report().add(
+            "crash_windows_exercised", soak="ingest",
+            windows=",".join(crash_windows))
+        aud = ingest.audit_journal(dest)
+        watermark_at_kill = aud["watermark"]
+        if not aud["ok"]:
+            violations.append(
+                f"journal audit after the SIGKILL found "
+                f"{len(aud['violations'])} exactly-once violation(s): "
+                f"{'; '.join(aud['violations'][:3])}")
+
+        # the resume leg: same command, faults disarmed
+        env.pop("SPLATT_FAULTS", None)
+        restart = subprocess.run(cmd, env=env, capture_output=True,
+                                 text=True, timeout=600)
+        if restart.returncode != 0:
+            violations.append(
+                f"restarted ingest exited nonzero "
+                f"({restart.returncode}): {restart.stdout[-300:]}")
+        summary = None
+        for line in reversed(restart.stdout.splitlines()):
+            if line.startswith("{"):
+                try:
+                    summary = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if summary is None:
+            violations.append("restarted ingest printed no JSON "
+                              "summary — accounting unauditable")
+        else:
+            resumed = bool(summary.get("resumed"))
+            chunks = int(summary.get("chunks") or 0)
+            nnz = int(summary.get("nnz") or 0)
+            quarantined = int(summary.get("quarantined") or 0)
+            if summary.get("status") != "converged":
+                violations.append(
+                    f"restarted ingest finished "
+                    f"{summary.get('status')!r} instead of converging")
+            if killed and watermark_at_kill >= 0 and not resumed:
+                violations.append(
+                    "the kill landed mid-stream but the restart did "
+                    "not resume from the journal watermark")
+            for name, got, want in (
+                    ("records", summary.get("records"), good + bad),
+                    ("nnz", nnz, good),
+                    ("quarantined", quarantined, bad)):
+                if got != want:
+                    violations.append(
+                        f"end-to-end {name} accounted {got}, ground "
+                        f"truth is {want} — records were LOST or "
+                        f"DUPLICATED across the kill")
+
+        aud2 = ingest.audit_journal(dest)
+        if not aud2["ok"]:
+            violations.append(
+                f"final journal audit found violations: "
+                f"{'; '.join(aud2['violations'][:3])}")
+        elif not aud2["finalized"]:
+            violations.append("the journal carries no finalize record "
+                              "after a converged run")
+        from splatt_tpu import io as _io
+
+        binp = os.path.join(dest, "tensor.bin")
+        try:
+            tt = _io.load_memmap(binp)
+            if tt.nnz != good:
+                violations.append(
+                    f"finalized tensor holds {tt.nnz} nnz, ground "
+                    f"truth is {good}")
+        except (OSError, ValueError) as e:
+            violations.append(f"finalized tensor.bin unloadable: {e}")
+    except Exception as e:  # the harness itself must not crash the CLI
+        error = (f"{resilience.classify_failure(e).value}: "
+                 f"{resilience.failure_message(e)[:300]}")
+        violations.append(f"ingest-chaos harness error: {error}")
+    verdict = "violated" if violations else "survived"
+    return IngestChaosResult(verdict=verdict, killed_mid_stream=killed,
+                             watermark_at_kill=watermark_at_kill,
+                             chunks=chunks, nnz=nnz,
+                             quarantined=quarantined, resumed=resumed,
+                             violations=violations, error=error,
+                             crash_windows=crash_windows)
+
+
+def format_ingest_report(res: IngestChaosResult) -> List[str]:
+    """Human-readable ingest-soak verdict lines for the CLI."""
+    lines = [f"ingest chaos: SIGKILL mid-stream "
+             f"{'landed' if res.killed_mid_stream else 'MISSED'} at "
+             f"watermark {res.watermark_at_kill}; resume "
+             f"{'replayed the journal' if res.resumed else 'MISSING'}",
+             f"  end-to-end: {res.chunks} chunk(s), {res.nnz} nnz, "
+             f"{res.quarantined} quarantined",
+             f"  crash windows exercised: "
+             f"{', '.join(res.crash_windows) or '(none)'}"]
+    for v in res.violations:
+        lines.append(f"INVARIANT VIOLATED: {v}")
+    lines.append(f"ingest chaos verdict: {res.verdict.upper()}")
+    return lines
+
+
 def format_report(res: ChaosResult) -> List[str]:
     """Human-readable chaos verdict lines for the CLI."""
     lines = [f"chaos schedule: {res.schedule}",

@@ -294,6 +294,19 @@ def cmd_chaos(args) -> int:
 
             print(_json.dumps(res.to_json()))
         return 0 if res.ok else 1
+    if args.ingest:
+        # ingest soak: SIGKILL a real `splatt ingest` subprocess
+        # mid-stream, restart it, and audit the chunk journal ALONE
+        # for the exactly-once invariant (docs/ingest.md)
+        res = chaos.run_ingest_chaos(seed=args.seed, smoke=args.smoke,
+                                     verbose=args.verbose > 0)
+        for line in chaos.format_ingest_report(res):
+            print(line)
+        if args.json:
+            import json as _json
+
+            print(_json.dumps(res.to_json()))
+        return 0 if res.ok else 1
     # schedule resolution (--schedule, else $SPLATT_CHAOS_SCHEDULE,
     # else the default recipe) lives in run_chaos — the single owner;
     # the resolved string comes back on the result for reporting
@@ -327,6 +340,59 @@ def cmd_chaos(args) -> int:
 
         print(_json.dumps(res.to_json()))
     return 0 if (res.ok and gate_ok) else 1
+
+
+def cmd_ingest(args) -> int:
+    """`splatt ingest` — stream a raw record file (.tns / CSV /
+    JSONL) into a COO tensor under the exactly-once chunk journal
+    (docs/ingest.md).  Re-running the same SOURCE into the same DEST
+    resumes from the journal watermark: zero lost, zero duplicated
+    records.  Exit 0 on a converged (finalized) run, 1 when the
+    quarantine budget degraded it or nothing could be committed."""
+    import json as _json
+
+    from splatt_tpu import ingest, resilience
+
+    dims = None
+    if args.dims:
+        try:
+            dims = tuple(int(d) for d in args.dims.lower().split("x"))
+        except ValueError:
+            print(f"splatt ingest: bad --dims {args.dims!r} "
+                  f"(want IxJxK)", flush=True)
+            return 2
+    try:
+        summary = ingest.ingest_stream(
+            args.source, args.dest, fmt=args.format,
+            chunk_records=args.chunk, dims=dims,
+            quarantine_max=args.quarantine_max,
+            quarantine_rate=args.quarantine_rate)
+    except (OSError, ValueError) as e:
+        cls = resilience.classify_failure(e)
+        print(f"splatt ingest: FAILED ({cls.value}): "
+              f"{resilience.failure_message(e)[:200]}", flush=True)
+        if args.json:
+            print(_json.dumps({"status": "failed",
+                               "failure_class": cls.value,
+                               "error": str(e)[:200]}))
+        return 1
+    verb = "resumed and " if summary["resumed"] else ""
+    print(f"splatt ingest: {verb}{summary['status']} — "
+          f"{summary['chunks']} chunk(s), {summary['nnz']} nnz from "
+          f"{summary['records']} record(s) "
+          f"({summary['quarantined']} quarantined) at "
+          f"{summary['records_per_sec']} rec/s")
+    if summary.get("tensor"):
+        print(f"splatt ingest: tensor at {summary['tensor']} "
+              f"(dims {'x'.join(str(d) for d in summary['dims'])})")
+    lines = resilience.run_report().summary()
+    if lines:
+        print("Resilience events:")
+        for line in lines:
+            print(line)
+    if args.json:
+        print(_json.dumps(summary))
+    return 0 if summary["status"] == "converged" else 1
 
 
 def cmd_serve(args) -> int:
@@ -838,6 +904,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--replicas", type=int, default=None, metavar="N",
                    help="fleet soak: replica count (default 2 under "
                         "--smoke, else 3)")
+    p.add_argument("--ingest", action="store_true",
+                   help="soak the streaming-ingest plane instead: "
+                        "SIGKILL a real `splatt ingest` subprocess "
+                        "mid-stream, restart it, and audit the chunk "
+                        "journal ALONE for zero lost and zero "
+                        "duplicated records with every quarantined "
+                        "record accounted (docs/ingest.md)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-r", "--rank", type=int, default=4)
     p.add_argument("-i", "--iters", type=int, default=8)
@@ -853,6 +926,49 @@ def build_parser() -> argparse.ArgumentParser:
                         "point event ON THE TRACE (the exporter leg of "
                         "the invariant; docs/observability.md)")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "ingest", help="stream a raw record file into a COO tensor",
+        epilog="Chunked, crash-resumable ingest (docs/ingest.md): "
+               "SOURCE is cut into chunks of --chunk records; each "
+               "chunk parses (malformed records quarantined to "
+               "DEST/quarantine.jsonl with classified events), "
+               "vocab-maps string keys, publishes its segment "
+               "atomically, and journals LAST — so a SIGKILL at any "
+               "point resumes from DEST/journal.jsonl with zero lost "
+               "and zero duplicated records.  A finalized run lands "
+               "DEST/tensor.bin in the binary memmap layout "
+               "(`splatt cpd DEST/tensor.bin --mmap ...`).")
+    p.add_argument("source", help="record stream: .tns text, CSV, or "
+                                  "JSONL arrays [i0, ..., val]")
+    p.add_argument("dest", help="ingest state directory (journal, "
+                                "seg/, vocab/, quarantine sidecar, "
+                                "tensor.bin)")
+    p.add_argument("--format", choices=["auto", "tns", "csv", "jsonl"],
+                   default="auto",
+                   help="record format (default: by file extension)")
+    p.add_argument("--chunk", type=_positive_int, metavar="N",
+                   help="records per chunk commit (default: "
+                        "$SPLATT_INGEST_CHUNK; a resume must match "
+                        "the journal's value)")
+    p.add_argument("--dims", metavar="IxJxK",
+                   help="declared mode sizes: out-of-range indices "
+                        "quarantine as bad_index instead of growing "
+                        "the tensor (required when chaining updates "
+                        "against a served model)")
+    p.add_argument("--quarantine-max", type=int, dest="quarantine_max",
+                   metavar="N",
+                   help="absolute bad-record budget (default: "
+                        "$SPLATT_INGEST_QUARANTINE_MAX); past it the "
+                        "run degrades classified")
+    p.add_argument("--quarantine-rate", type=float,
+                   dest="quarantine_rate", metavar="X",
+                   help="max quarantined/parsed ratio (default: "
+                        "$SPLATT_INGEST_QUARANTINE_RATE)")
+    p.add_argument("--json", action="store_true",
+                   help="also print the machine-readable run summary")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    p.set_defaults(fn=cmd_ingest)
 
     p = sub.add_parser(
         "serve", help="run the multi-tenant decomposition daemon",

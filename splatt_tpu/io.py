@@ -19,6 +19,7 @@ The text parser uses a vectorized numpy parse; a C++ fast path
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Optional, Tuple
 
@@ -34,6 +35,7 @@ _BIN_VERSION = 1
 
 def _parse_text(path: str) -> Tuple[np.ndarray, np.ndarray]:
     """Parse a coordinate text file into (inds (m,nnz) int64, vals f64)."""
+    malformed = False
     try:
         from splatt_tpu import native
 
@@ -42,20 +44,61 @@ def _parse_text(path: str) -> Tuple[np.ndarray, np.ndarray]:
             return parsed
     except ImportError:
         pass
+    except ValueError:
+        # The C++ fast path rejects without a location; fall through to
+        # the python pass, whose diagnostics name the line and offset.
+        malformed = True
     with open(path, "rb") as f:
         data = f.read()
+    if malformed:
+        body0 = next((ln for ln in data.split(b"\n")
+                      if ln.strip() and not ln.lstrip().startswith(b"#")),
+                     b"")
+        raise _diagnose_text(path, data, len(body0.split()))
     lines = data.split(b"\n")
     body = [ln for ln in lines if ln.strip() and not ln.lstrip().startswith(b"#")]
     if not body:
         raise ValueError(f"{path}: empty tensor file")
     ncols = len(body[0].split())
-    toks = np.array(b" ".join(body).split(), dtype=np.float64)  # splint: ignore[SPL005] text ingest parses at full precision; storage dtype resolves later
+    try:
+        toks = np.array(b" ".join(body).split(), dtype=np.float64)  # splint: ignore[SPL005] text ingest parses at full precision; storage dtype resolves later
+    except ValueError:
+        raise _diagnose_text(path, data, ncols) from None
     if toks.size % ncols != 0:
-        raise ValueError(f"{path}: ragged rows in tensor file")
+        raise _diagnose_text(path, data, ncols)
     table = toks.reshape(-1, ncols)
     inds = table[:, :-1].astype(np.int64).T
     vals = np.ascontiguousarray(table[:, -1])
     return np.ascontiguousarray(inds), vals
+
+
+def _diagnose_text(path: str, data: bytes, ncols: int) -> ValueError:
+    """Pinpoint the first malformed line after the vectorized parse fails.
+
+    The fast path gives up the location; this slow pass recovers it so
+    the error names the exact line number and byte offset — the message
+    carries the "ragged row" / "bad token" deterministic markers that
+    :func:`splatt_tpu.resilience.classify_failure` refuses to retry.
+    """
+    offset = 0
+    for lineno, ln in enumerate(data.split(b"\n"), start=1):
+        stripped = ln.strip()
+        if stripped and not stripped.startswith(b"#"):
+            toks = stripped.split()
+            if len(toks) != ncols:
+                return ValueError(
+                    f"{path}: ragged row at line {lineno} (file offset "
+                    f"{offset}): expected {ncols} columns, got {len(toks)}")
+            for t in toks:
+                try:
+                    float(t)
+                except ValueError:
+                    return ValueError(
+                        f"{path}: bad token "
+                        f"{t.decode('utf-8', 'replace')!r} at line "
+                        f"{lineno} (file offset {offset})")
+        offset += len(ln) + 1
+    return ValueError(f"{path}: malformed tensor file")
 
 
 def load_coord(path: str) -> SparseTensor:
@@ -119,18 +162,53 @@ def _save_binary(tt: SparseTensor, path: str) -> None:
 
 
 def _bin_header(path: str):
+    """Decode and VALIDATE the binary header before any array maps it.
+
+    Every field is checked against what the file can actually hold: a
+    half-written or torn ``.bin`` must be refused here with a
+    deterministic "truncated or torn" error, never surfaced later as a
+    short memmap or a garbage frombuffer.
+    """
+    size = os.path.getsize(path)
     with open(path, "rb") as f:
         magic = f.read(4)
         if magic != _BIN_MAGIC:
             raise ValueError(f"{path}: bad magic")
-        version, nmodes, idx_width, val_width = struct.unpack("<IIII",
-                                                              f.read(16))
+        head = f.read(16)
+        if len(head) != 16:
+            raise ValueError(
+                f"{path}: truncated or torn binary header "
+                f"({4 + len(head)} of 20 bytes)")
+        version, nmodes, idx_width, val_width = struct.unpack("<IIII", head)
         if version != _BIN_VERSION:
             raise ValueError(f"{path}: unsupported binary version {version}")
-        dims = np.frombuffer(f.read(8 * nmodes),
-                             dtype=np.uint64).astype(np.int64)
-        (nnz,) = struct.unpack("<Q", f.read(8))
+        if not 0 < nmodes <= 64:
+            raise ValueError(
+                f"{path}: implausible mode count {nmodes} — "
+                f"truncated or torn header")
+        if idx_width not in (4, 8) or val_width not in (4, 8):
+            raise ValueError(
+                f"{path}: bad index/value widths "
+                f"({idx_width}/{val_width}) — truncated or torn header")
+        draw = f.read(8 * nmodes)
+        if len(draw) != 8 * nmodes:
+            raise ValueError(
+                f"{path}: truncated or torn dims block "
+                f"({len(draw)} of {8 * nmodes} bytes)")
+        dims = np.frombuffer(draw, dtype=np.uint64).astype(np.int64)
+        if (dims < 0).any():
+            raise ValueError(
+                f"{path}: implausible dimension — truncated or torn header")
+        raw = f.read(8)
+        if len(raw) != 8:
+            raise ValueError(f"{path}: truncated or torn nnz field")
+        (nnz,) = struct.unpack("<Q", raw)
         data_offset = f.tell()
+    expect = data_offset + nmodes * nnz * idx_width + nnz * val_width
+    if size < expect:
+        raise ValueError(
+            f"{path}: truncated or torn binary tensor — header promises "
+            f"{expect} bytes ({nnz} nnz x {nmodes} modes), file has {size}")
     return nmodes, idx_width, val_width, tuple(int(d) for d in dims), \
         int(nnz), data_offset
 

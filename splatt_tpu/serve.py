@@ -156,8 +156,11 @@ BATCH_MAX = 32
 #: sweeps — the journal/checkpoint store acting as a model store —
 #: and "predict" reads a committed model generation (docs/predict.md)
 #: on a dedicated low-latency lane: leased/journaled like every job,
-#: but never coalesced, never affinity-deferred
-JOB_KINDS = ("cpd", "update", "predict")
+#: but never coalesced, never affinity-deferred; "ingest" streams a
+#: raw record file into a COO tensor under the exactly-once chunk
+#: journal (docs/ingest.md) and — when the spec names a `base` model —
+#: emits one `update` job per watermark interval, the live-feed shape
+JOB_KINDS = ("cpd", "update", "predict", "ingest")
 
 
 def _job_id(spec: dict) -> str:
@@ -613,6 +616,15 @@ class Server:
                 reason = ("invalid: predict job needs 'coords': "
                           "[[i0, i1, ...], ...] and/or 'top_k': "
                           "{fixed, mode, k}")
+            elif kind == "ingest" and not spec.get("source"):
+                reason = ("invalid: ingest job needs 'source': "
+                          "<record stream path>")
+            elif kind == "ingest" and spec.get("base") \
+                    and not spec.get("dims"):
+                reason = ("invalid: ingest job chaining updates "
+                          "against 'base' needs 'dims' (the base "
+                          "model's mode sizes — deltas must not grow "
+                          "past the checkpointed factors)")
             elif prio is not None and str(prio) not in PRIORITIES:
                 reason = (f"invalid: unknown priority {prio!r} (want "
                           f"one of {sorted(PRIORITIES)})")
@@ -1839,6 +1851,7 @@ class Server:
                         faults.maybe_fail("serve.job_run")
                         update_info = None
                         predict_rec = None
+                        ingest_rec = None
                         model_gen = None
                         job_kind = str(spec.get("kind") or "cpd")
                         if job_kind == "update":
@@ -1847,6 +1860,10 @@ class Server:
                             tune_info = None
                         elif job_kind == "predict":
                             predict_rec = self._run_predict(jid, spec)
+                            out, tune_info = None, None
+                        elif job_kind == "ingest":
+                            ingest_rec = self._run_ingest(
+                                jid, spec, _stop_or_deadline)
                             out, tune_info = None, None
                         else:
                             out, tune_info, model_gen = self._run_cpd(
@@ -1870,6 +1887,11 @@ class Server:
                     # own status class — never "converged", and a
                     # refusal is a degrade, not a failure
                     record.update(predict_rec)
+                elif ingest_rec is not None:
+                    # ingest's own verdict: "converged" on a finalized
+                    # stream, "degraded" when the quarantine budget
+                    # tripped — committed chunks survive either way
+                    record.update(ingest_rec)
                 else:
                     degraded = bool(
                         sc.report.events("health_degraded"))
@@ -2163,6 +2185,15 @@ class Server:
 
             info["model_gen"] = int(advance_generation(
                 self.ckpt_dir, base, out.factors, out.lam))
+            if spec.get("ingest_committed_ts"):
+                # an ingest-chained update: the source chunk's journal
+                # commit to THIS model-store commit is the live-feed
+                # freshness number (docs/ingest.md)
+                lag = max(time.time()
+                          - float(spec["ingest_committed_ts"]), 0.0)
+                trace.metric_observe(
+                    "splatt_ingest_update_lag_seconds", lag)
+                info["ingest_lag_s"] = round(lag, 3)
         return out, info
 
     # -- one generation-fenced predict (docs/predict.md) ---------------------
@@ -2261,6 +2292,82 @@ class Server:
                     max(time.time() - float(t_accepted), 0.0))
             sp.set(status="served", gen=gen, cache=cache_outcome)
         return rec
+
+    # -- one streaming-ingest job (docs/ingest.md) ---------------------------
+
+    def _run_ingest(self, jid: str, spec: dict, stop) -> dict:
+        """The ``ingest`` job body: stream ``spec['source']`` into
+        ``<root>/ingest/<jid>/`` under the exactly-once chunk journal
+        (ingest.py), and — when the spec names a ``base`` model —
+        emit one ``update`` job per watermark interval
+        (``update_every`` / SPLATT_INGEST_UPDATE_EVERY committed
+        chunks), each carrying its chunk's journal-commit timestamp so
+        the model-store commit can observe end-to-end update lag
+        (the ``splatt_ingest_update_lag_seconds`` histogram).
+
+        A SIGKILLed or lease-stopped ingest job re-runs whole through
+        the normal resume path and ingest's own watermark replay makes
+        the re-run exactly-once — committed chunks are skipped, not
+        re-landed, and already-emitted update jobs dedup on their
+        deterministic ids (``<jid>-up<k>``)."""
+        from splatt_tpu import ingest as ingest_mod
+        from splatt_tpu.utils.env import read_env_int
+
+        source = str(spec["source"])
+        dest = str(spec.get("dest")
+                   or os.path.join(self.root, "ingest", jid))
+        base = spec.get("base")
+        update_every = int(spec.get("update_every")
+                           or read_env_int("SPLATT_INGEST_UPDATE_EVERY"))
+        dims = (tuple(int(d) for d in spec["dims"])
+                if spec.get("dims") else None)
+        updates: list = []
+        covered = {"hi": -1}
+
+        def on_watermark(st, rec):
+            if not base:
+                return
+            n = int(rec["n"])
+            if n - covered["hi"] < max(update_every, 1):
+                return
+            lo = covered["hi"] + 1
+            k = len(updates)
+            dpath = os.path.join(dest, "deltas", f"up-{k:04d}.bin")
+            os.makedirs(os.path.dirname(dpath), exist_ok=True)
+            delta = ingest_mod.assemble_delta(
+                dest, lo, n, dims or st.final_dims(), dpath)
+            covered["hi"] = n
+            if not delta.nnz:
+                return
+            res = self.submit({
+                "kind": "update", "base": str(base),
+                "delta_tensor": dpath, "id": f"{jid}-up{k}",
+                "tenant": spec.get("tenant"),
+                "ingest_committed_ts": float(rec.get("ts") or 0.0)})
+            state = res.get("state") or ("queued" if res.get("job")
+                                         else "rejected")
+            if res.get("job") and state not in ("rejected",):
+                updates.append(res["job"])
+            else:
+                self._log(f"job {jid}: watermark update for chunks "
+                          f"[{lo}, {n}] not accepted ({res}); the "
+                          f"delta file remains for a manual replay",
+                          error=True)
+
+        summary = ingest_mod.ingest_stream(
+            source, dest, fmt=str(spec.get("format") or "auto"),
+            chunk_records=(int(spec["chunk_records"])
+                           if spec.get("chunk_records") else None),
+            dims=dims, stop=stop, on_watermark=on_watermark)
+        return {
+            "status": summary["status"],
+            "ingest": {k: summary[k] for k in
+                       ("dest", "format", "chunks", "watermark",
+                        "records", "nnz", "quarantined", "resumed",
+                        "stopped", "dims", "tensor",
+                        "records_per_sec", "error")},
+            "updates": updates,
+        }
 
     # -- plumbing ------------------------------------------------------------
 

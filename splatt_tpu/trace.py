@@ -153,6 +153,12 @@ SPANS = {
                      "lookup (or direct read) + the λ·Π reconstruct "
                      "or top-k scan (attrs: job, model, gen, cache; "
                      "docs/predict.md)",
+    "ingest.run": "one streaming-ingest run end-to-end: resume-aware "
+                  "open through finalize (attrs: source, resumed, "
+                  "status, chunks, nnz; docs/ingest.md)",
+    "ingest.chunk": "one exactly-once chunk commit — parse/quarantine "
+                    "through the journal-append watermark fence "
+                    "(attrs: n, nnz, quarantined; docs/ingest.md)",
     "trace.export": "writing one Chrome-trace JSON file",
     "timer.*": "legacy utils/timers.py brackets routed through the "
                "span layer (timer.cpd, timer.mttkrp, ...)",
@@ -251,6 +257,27 @@ METRICS = {
     "splatt_predict_queue_depth": (
         "gauge", "serve: pending predicts in the bounded low-latency "
                  "lane (docs/predict.md)"),
+    "splatt_ingest_records_total": (
+        "counter", "ingest: stream records by outcome (committed = "
+                   "landed under a journaled chunk, quarantined = "
+                   "malformed, sidecar-journaled with a classified "
+                   "record_quarantined event; docs/ingest.md)"),
+    "splatt_ingest_chunks_total": (
+        "counter", "ingest: chunk commits by outcome (committed = "
+                   "journal fence appended this run, skipped = "
+                   "already journaled, replayed from the watermark "
+                   "on resume — the exactly-once dedup made visible; "
+                   "docs/ingest.md)"),
+    "splatt_ingest_watermark": (
+        "gauge", "ingest: highest contiguously committed chunk "
+                 "ordinal — the crash-resume point; -1 until the "
+                 "first commit (docs/ingest.md)"),
+    "splatt_ingest_update_lag_seconds": (
+        "histogram", "serve: seconds from a chunk's journal commit to "
+                     "the model-store commit of the update job it fed "
+                     "(serve.py _run_update on ingest-chained specs) "
+                     "— the live-feed freshness SLO of docs/"
+                     "ingest.md"),
 }
 
 #: histogram bucket upper bounds (seconds); +Inf is implicit.  The

@@ -466,7 +466,12 @@ ENV_VARS = {
                                     "densemode (one near-dense mode, "
                                     "docs/dense.md — adds the hybrid "
                                     "dense-tile path row and the "
-                                    "flops/roofline-verdict fields).  "
+                                    "flops/roofline-verdict fields), "
+                                    "batched (docs/batched.md), "
+                                    "predict (docs/predict.md), or "
+                                    "ingest (docs/ingest.md: "
+                                    "streaming-ingest records/sec + "
+                                    "update-lag p95).  "
                                     "Non-uniform scenarios tag the "
                                     "metric string so the regression "
                                     "gate only compares like "
@@ -490,6 +495,38 @@ ENV_VARS = {
                                    "sweep"),
     "SPLATT_SCALING_CHILD": EnvVar(None, "bench.py internal: marks a "
                                    "scaling-sweep child process"),
+    # -- streaming ingest (splatt_tpu/ingest.py, docs/ingest.md) --
+    "SPLATT_INGEST_CHUNK": EnvVar(5000, "ingest.py: records per "
+                                  "chunk commit — the exactly-once "
+                                  "watermark grain (docs/ingest.md); "
+                                  "a resume must reuse the journal's "
+                                  "value or ingest refuses"),
+    "SPLATT_INGEST_INFLIGHT": EnvVar(4, "ingest.py: bounded reader-"
+                                     "to-committer queue depth — the "
+                                     "backpressure knob; the reader "
+                                     "blocks rather than buffering "
+                                     "the stream unboundedly"),
+    "SPLATT_INGEST_QUARANTINE_MAX": EnvVar(1000, "ingest.py: absolute "
+                                           "quarantined-record budget "
+                                           "per run; past it the run "
+                                           "DEGRADES classified "
+                                           "(ingest_degraded) instead "
+                                           "of shipping a corrupt "
+                                           "tensor; 0 disables the "
+                                           "count half of the budget"),
+    "SPLATT_INGEST_QUARANTINE_RATE": EnvVar(0.5, "ingest.py: max "
+                                            "quarantined/parsed ratio "
+                                            "(evaluated once >= 200 "
+                                            "records seen) before the "
+                                            "run degrades classified; "
+                                            "0 disables the rate half "
+                                            "of the budget"),
+    "SPLATT_INGEST_UPDATE_EVERY": EnvVar(1, "serve.py ingest job "
+                                         "kind: emit one update job "
+                                         "per this many committed "
+                                         "chunks (the watermark "
+                                         "interval of the live-feed "
+                                         "lane, docs/ingest.md)"),
 }
 
 

@@ -267,6 +267,26 @@ SITES = {
                         "classified — the stamp never advances, so "
                         "readers keep serving the previous "
                         "generation (docs/predict.md)",
+    "ingest.read": "one chunk read from the raw record stream "
+                   "(ingest.py IngestState.read_chunks, docs/"
+                   "ingest.md); a raised fault must ABORT the run "
+                   "classified with every committed chunk intact — "
+                   "a re-run resumes from the journal watermark and "
+                   "re-reads from the recorded byte offset, losing "
+                   "and duplicating nothing",
+    "ingest.vocab": "the vocab-delta publish of one chunk commit "
+                    "(ingest.py IngestState.publish_vocab); a raised "
+                    "fault must ABORT that chunk BEFORE its journal "
+                    "append — the watermark never moves, so the "
+                    "vocab can never land ahead of or behind the "
+                    "data (docs/ingest.md fence order)",
+    "ingest.commit": "the journal-append watermark fence of one "
+                     "chunk commit (ingest.py "
+                     "IngestState.append_journal); a raised fault "
+                     "leaves published segment/vocab debris but NO "
+                     "journal record — the chunk re-commits "
+                     "bit-identically on resume, the exactly-once "
+                     "invariant's load-bearing window",
 }
 
 

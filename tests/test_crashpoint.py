@@ -48,13 +48,14 @@ def test_enumeration_is_exhaustive_and_bounded():
     # rename-lost sibling per state with un-fsynced renames
     expected_ops = sum(len(ops) for p in _protocols()
                       for ops in p.expected.values())
-    assert res.ops_enumerated == expected_ops == 21
-    assert res.states == 36
+    assert res.ops_enumerated == expected_ops == 29
+    assert res.states == 50
     assert res.per_protocol == {
         # complete + per-op crash states + torn append variants +
         # rename-lost siblings (the ckpt.rotate window)
         "fit_commit": 9, "update_commit": 7, "torn_ckpt_read": 2,
         "lease": 5, "journal": 9, "terminal_commit": 4,
+        "ingest_chunk_commit": 14,
     }
 
 
@@ -69,6 +70,9 @@ def test_window_coverage_spans_every_plane():
         "stamp.bak.publish", "tensor.publish", "result.publish",
         "lease.publish", "lease.release", "journal.append[accepted]",
         "journal.append[started]", "journal.append[done]",
+        "ingest.seg.publish", "ingest.vocab.publish",
+        "journal.append[begin]", "journal.append[chunk]",
+        "journal.append[quarantined]",
     }
     assert set(res.windows) <= _windows()
 
@@ -95,6 +99,9 @@ def test_mutant_violations_name_the_right_invariant():
     kinds = {v.invariant for v in
              run_crash_check("no_dir_fsync").violations}
     assert "lost-job" in kinds
+    assert {v.invariant for v in
+            run_crash_check("watermark_first").violations} == \
+        {"exactly-once"}
 
 
 def test_unknown_mutant_rejected():
@@ -142,7 +149,7 @@ def test_cli_json_report():
     assert out.returncode == 0, out.stdout + out.stderr
     rep = json.loads(out.stdout)
     assert rep["ok"] is True
-    assert rep["states"] == 36
+    assert rep["states"] == 50
     assert rep["violations"] == []
 
 

@@ -1,0 +1,453 @@
+"""Durable streaming ingest — the exactly-once chunk pipeline
+(docs/ingest.md).
+
+The contracts under test:
+
+- EXACTLY-ONCE: a resumed ingest continues from the journal watermark
+  with zero lost and zero duplicated records, a chunk whose commit was
+  torn before the journal fence re-commits bit-identically, and the
+  journal-alone audit (``audit_journal``) detects every violation
+  class it claims to;
+- QUARANTINE: malformed records land in the sidecar with classified
+  ``record_quarantined`` events (bad_arity / bad_token / bad_index /
+  nonfinite_value), and past the count or rate budget the run DEGRADES
+  classified with the committed watermark intact;
+- VOCAB ATOMICITY: string keys map through per-chunk vocab deltas
+  that commit atomically with their chunk record — a fault between the
+  vocab publish and the journal append never leaves the vocab ahead of
+  the watermark;
+- FAULT DRILLS: the ``ingest.read`` / ``ingest.vocab`` /
+  ``ingest.commit`` sites abort classified and a clean re-run lands
+  the exact ground-truth totals;
+- SERVE LINEAGE: the ``ingest`` job kind drives the pipeline against
+  a live model store, emitting chained ``update`` jobs per watermark
+  interval with the commit→update lag observable;
+- SOAK: a REAL `splatt ingest` subprocess SIGKILLed mid-stream
+  resumes exactly-once, audited from the journal alone
+  (``splatt chaos --ingest --smoke``).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from splatt_tpu import ingest, resilience, serve
+from splatt_tpu.utils import faults
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    def clean():
+        faults.reset()
+        resilience.reset_demotions()
+        resilience.run_report().clear()
+
+    clean()
+    yield
+    clean()
+
+
+# a stream with one vocab mode (string users), two numeric modes, and
+# a known sprinkle of malformed records
+def _write_stream(path, lines=60, bad_every=0, seed=0):
+    rng = np.random.default_rng(seed)
+    good = bad = 0
+    with open(path, "w") as f:
+        f.write("# test stream\n")
+        for n in range(lines):
+            if bad_every and n and n % bad_every == 0:
+                f.write("malformed\n")
+                bad += 1
+            else:
+                f.write(f"u{rng.integers(0, 12)} "
+                        f"{rng.integers(0, 8)} {rng.integers(0, 6)} "
+                        f"{rng.random() + 0.1:.6f}\n")
+                good += 1
+    return good, bad
+
+
+def _events(kind):
+    return resilience.run_report().events(kind)
+
+
+# -- fresh round-trip --------------------------------------------------------
+
+def test_fresh_ingest_roundtrip(tmp_path):
+    from splatt_tpu.io import load_memmap
+
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, bad = _write_stream(src, lines=50, bad_every=9)
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=16)
+    assert summary["status"] == "converged" and not summary["resumed"]
+    assert summary["records"] == good + bad
+    assert summary["nnz"] == good and summary["quarantined"] == bad
+    # the finalized tensor is the memmap binary layout, exactly good nnz
+    tt = load_memmap(summary["tensor"])
+    assert tt.nnz == good and len(tt.dims) == 3
+    # mode 0 was vocab-mapped: its dim is the vocabulary cardinality
+    aud = ingest.audit_journal(dest)
+    assert aud["ok"], aud["violations"]
+    assert aud["finalized"] and aud["nnz"] == good
+    # the observable evidence trail
+    assert len(_events("watermark_advanced")) == summary["chunks"]
+    assert len(_events("record_quarantined")) == bad
+    assert _events("vocab_stats")
+
+
+def test_jsonl_and_csv_formats(tmp_path):
+    rows = [[0, 1, 1.5], [1, 0, 2.5], [2, 2, 0.5]]
+    jl = tmp_path / "s.jsonl"
+    jl.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    s1 = ingest.ingest_stream(str(jl), str(tmp_path / "a"))
+    cs = tmp_path / "s.csv"
+    cs.write_text("".join(",".join(str(x) for x in r) + "\n"
+                          for r in rows))
+    s2 = ingest.ingest_stream(str(cs), str(tmp_path / "b"))
+    for s in (s1, s2):
+        assert s["status"] == "converged" and s["nnz"] == 3
+        assert s["dims"] == [3, 3]
+
+
+# -- exactly-once resume -----------------------------------------------------
+
+def test_watermark_resume_exactly_once(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, bad = _write_stream(src, lines=48, bad_every=7)
+    # first leg: commit exactly two chunks, then "die" (no finalize)
+    st = ingest.IngestState(src, dest, fmt="tns", chunk_records=12)
+    for rc in st.read_chunks():
+        st.commit_chunk(rc)
+        if st.watermark == 1:
+            break
+    first = ingest.audit_journal(dest)
+    assert first["ok"] and first["watermark"] == 1
+    # second leg: the public driver resumes from the watermark
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=12)
+    assert summary["resumed"] and summary["status"] == "converged"
+    assert summary["records"] == good + bad
+    assert summary["nnz"] == good and summary["quarantined"] == bad
+    assert _events("ingest_resumed")
+    aud = ingest.audit_journal(dest)
+    assert aud["ok"], aud["violations"]
+    # no ordinal journaled twice: replay counts one chunk record each
+    recs, torn = ingest.replay_journal(dest)
+    ordinals = [r["n"] for r in recs if r["rec"] == ingest.REC_CHUNK]
+    assert torn == 0 and sorted(ordinals) == sorted(set(ordinals))
+
+
+def test_rerun_after_convergence_is_idempotent(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, _ = _write_stream(src, lines=30)
+    s1 = ingest.ingest_stream(src, dest, chunk_records=10)
+    with open(s1["tensor"], "rb") as f:
+        bin1 = f.read()
+    s2 = ingest.ingest_stream(src, dest, chunk_records=10)
+    assert s2["resumed"] and s2["status"] == "converged"
+    assert s2["nnz"] == s1["nnz"] == good
+    with open(s2["tensor"], "rb") as f:
+        assert f.read() == bin1  # finalize verified, not rebuilt
+
+
+def test_torn_commit_replays_chunk_bit_identically(tmp_path):
+    """A crash AFTER the segment/vocab publish but BEFORE the journal
+    fence: the chunk is not committed (watermark unmoved), and the
+    resume re-commits it over the orphan debris bit-identically."""
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, bad = _write_stream(src, lines=36, bad_every=11)
+    st = ingest.IngestState(src, dest, fmt="tns", chunk_records=12)
+    rc0 = next(st.read_chunks())
+    pc = st.parse_chunk(rc0)
+    st.publish_vocab(pc)
+    st.publish_segment(pc)          # ...crash here: nothing journaled
+    with open(ingest._segment_path(dest, 0), "rb") as f:
+        orphan = f.read()
+    aud = ingest.audit_journal(dest)
+    assert aud["ok"] and aud["watermark"] == -1  # debris, no commit
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=12)
+    assert summary["status"] == "converged"
+    assert summary["nnz"] == good and summary["quarantined"] == bad
+    with open(ingest._segment_path(dest, 0), "rb") as f:
+        assert f.read() == orphan  # the re-commit overwrote it 1:1
+    assert ingest.audit_journal(dest)["ok"]
+
+
+def test_resume_refuses_misaligned_chunking(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    _write_stream(src, lines=30)
+    st = ingest.IngestState(src, dest, fmt="tns", chunk_records=10)
+    st.commit_chunk(next(st.read_chunks()))
+    with pytest.raises(ingest.IngestError, match="chunk_records"):
+        ingest.IngestState(src, dest, fmt="tns", chunk_records=7)
+
+
+# -- audit teeth -------------------------------------------------------------
+
+def test_audit_detects_missing_segment_and_torn_content(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    _write_stream(src, lines=40)
+    ingest.ingest_stream(src, dest, chunk_records=10)
+    seg1 = ingest._segment_path(dest, 1)
+    with open(seg1, "rb") as f:
+        raw = f.read()
+    os.remove(seg1)
+    aud = ingest.audit_journal(dest)
+    assert not aud["ok"]
+    assert any("segment file is missing" in v for v in aud["violations"])
+    with open(seg1, "wb") as f:
+        f.write(raw[:-3] + b"xyz")
+    aud = ingest.audit_journal(dest)
+    assert any("does not match its" in v for v in aud["violations"])
+
+
+def test_audit_detects_watermark_gap(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    _write_stream(src, lines=40)
+    ingest.ingest_stream(src, dest, chunk_records=10)
+    # surgically remove chunk 1's record: chunks 2, 3 sit above a gap
+    jp = ingest._journal_path(dest)
+    with open(jp, "rb") as f:
+        lines = [ln for ln in f.read().split(b"\n") if ln.strip()]
+    kept = [ln for ln in lines
+            if not (b'"rec": "chunk"' in ln and b'"n": 1' in ln)]
+    with open(jp, "wb") as f:
+        f.write(b"\n".join(kept) + b"\n")
+    aud = ingest.audit_journal(dest)
+    assert not aud["ok"] and aud["watermark"] == 0
+    assert any("above a gap" in v for v in aud["violations"])
+
+
+# -- quarantine --------------------------------------------------------------
+
+def test_quarantine_classes_and_sidecar(tmp_path):
+    # chunk 0 (2 records) pins the mode policy — vocab, numeric,
+    # numeric — so the later chunks' malformed rows classify against
+    # it instead of flipping a mode to vocab
+    src = tmp_path / "s.tns"
+    src.write_text(
+        "u1 2 3 1.0\n"       # policy row: vocab, numeric, numeric
+        "u2 1 2\n"           # bad_arity
+        "u3 x 1 2.0\n"       # bad_token (non-integer numeric mode)
+        "u4 1 99 3.0\n"      # bad_index (dims pins mode 2 to 6)
+        "u5 2 3 nan\n"       # nonfinite_value
+        "u6 3 4 4.0\n")
+    dest = str(tmp_path / "ing")
+    summary = ingest.ingest_stream(str(src), dest, fmt="tns",
+                                   chunk_records=2, dims=(64, 8, 6))
+    assert summary["nnz"] == 2 and summary["quarantined"] == 4
+    with open(ingest._quarantine_path(dest), "rb") as f:
+        side = [json.loads(ln) for ln in f.read().splitlines()
+                if ln.strip()]
+    assert [q["class"] for q in side] == [
+        "bad_arity", "bad_token", "bad_index", "nonfinite_value"]
+    assert all(q["class"] in ingest.QUARANTINE_CLASSES for q in side)
+    # sidecar records carry the source line for operator triage
+    assert [q["line"] for q in side] == [2, 3, 4, 5]
+    evs = _events("record_quarantined")
+    assert sorted(e["quarantine_class"] for e in evs) == sorted(
+        q["class"] for q in side)
+
+
+def test_quarantine_count_budget_degrades_classified(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, bad = _write_stream(src, lines=60, bad_every=4)
+    assert bad > 3
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=12, quarantine_max=3)
+    assert summary["status"] == "degraded"
+    assert "quarantine budget exhausted" in summary["error"]
+    evs = _events("ingest_degraded")
+    assert evs and evs[0]["failure_class"] == "deterministic"
+    # committed chunks survive the degrade: a re-run with a real
+    # budget resumes them and lands the exact ground truth
+    resilience.run_report().clear()
+    s2 = ingest.ingest_stream(src, dest, fmt="tns", chunk_records=12,
+                              quarantine_max=0)
+    assert s2["resumed"] and s2["status"] == "converged"
+    assert s2["nnz"] == good and s2["quarantined"] == bad
+
+
+def test_quarantine_rate_budget(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    # > half the stream malformed, well past _RATE_MIN_RECORDS
+    _write_stream(src, lines=500, bad_every=2)
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=300, quarantine_max=0,
+                                   quarantine_rate=0.25)
+    assert summary["status"] == "degraded"
+    assert "quarantine rate" in summary["error"]
+
+
+# -- vocab atomicity ---------------------------------------------------------
+
+def test_vocab_commits_atomically_with_watermark(tmp_path):
+    """A fault at ``ingest.vocab`` aborts the chunk BEFORE the journal
+    fence: the watermark never moves, so the vocab can never run ahead
+    of the data — and the clean re-run lands identical mappings."""
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, _ = _write_stream(src, lines=40)
+    with faults.inject("ingest.vocab", "runtime", times=1):
+        with pytest.raises(RuntimeError):
+            ingest.ingest_stream(src, dest, fmt="tns",
+                                 chunk_records=10)
+    aud = ingest.audit_journal(dest)
+    assert aud["ok"] and aud["watermark"] == -1  # nothing journaled
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=10)
+    assert summary["status"] == "converged" and summary["nnz"] == good
+    # every committed chunk's journaled vocab sha matches its delta
+    # file, and the union of deltas IS the final mode-0 cardinality
+    recs, _ = ingest.replay_journal(dest)
+    keys = set()
+    for r in recs:
+        if r["rec"] == ingest.REC_CHUNK and r.get("vocab_sha"):
+            with open(ingest._vocab_path(dest, r["n"]), "rb") as f:
+                delta = json.loads(f.read())
+            keys.update(delta["modes"]["0"])
+    assert len(keys) == summary["dims"][0]
+
+
+def test_quarantined_record_never_grows_vocab(tmp_path):
+    src = tmp_path / "s.tns"
+    src.write_text("alpha 1 1.0\n"
+                   "ghost 2 nan\n"     # quarantined: must not mint 'ghost'
+                   "beta 3 2.0\n")
+    dest = str(tmp_path / "ing")
+    summary = ingest.ingest_stream(str(src), dest, fmt="tns")
+    assert summary["quarantined"] == 1
+    assert summary["dims"][0] == 2  # alpha, beta — no ghost entry
+    with open(ingest._vocab_path(dest, 0), "rb") as f:
+        delta = json.loads(f.read())
+    assert delta["modes"]["0"] == ["alpha", "beta"]
+
+
+# -- fault-site drills -------------------------------------------------------
+
+def _drill_abort_then_resume(tmp_path, tag, injected):
+    """Abort under `injected` (an armed faults.inject), then resume
+    and land the exact ground truth — zero lost, zero duplicated."""
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / f"ing-{tag}")
+    good, bad = _write_stream(src, lines=48, bad_every=7)
+    with injected:
+        with pytest.raises(RuntimeError):
+            ingest.ingest_stream(src, dest, fmt="tns",
+                                 chunk_records=12)
+    # whatever was committed before the abort is intact and audited
+    assert ingest.audit_journal(dest)["ok"]
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=12)
+    assert summary["status"] == "converged"
+    assert summary["nnz"] == good and summary["quarantined"] == bad
+    assert ingest.audit_journal(dest)["ok"]
+
+
+def test_read_fault_aborts_then_resumes_exactly_once(tmp_path):
+    _drill_abort_then_resume(
+        tmp_path, "read", faults.inject("ingest.read", "runtime", times=1))
+
+
+def test_commit_fault_aborts_then_resumes_exactly_once(tmp_path):
+    _drill_abort_then_resume(
+        tmp_path, "commit",
+        faults.inject("ingest.commit", "runtime", times=1))
+
+
+def test_commit_fault_mid_stream_leaves_watermark_resumable(tmp_path):
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    good, _ = _write_stream(src, lines=50)
+    # the 3rd journal append dies (begin + chunk0 land, chunk1 doesn't)
+    with faults.inject("ingest.commit", "runtime", iter_at=3):
+        with pytest.raises(RuntimeError):
+            ingest.ingest_stream(src, dest, fmt="tns",
+                                 chunk_records=10)
+    aud = ingest.audit_journal(dest)
+    assert aud["ok"] and aud["watermark"] == 1
+    summary = ingest.ingest_stream(src, dest, fmt="tns",
+                                   chunk_records=10)
+    assert summary["resumed"] and summary["nnz"] == good
+
+
+# -- serve lineage: ingest -> chained updates --------------------------------
+
+def test_serve_ingest_job_chains_updates(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    # the base model the updates advance
+    base = {"id": "base", "rank": 3, "iters": 8, "seed": 7,
+            "checkpoint_every": 2,
+            "synthetic": {"dims": [24, 16, 12], "nnz": 900, "seed": 3}}
+    r = srv.submit(base)
+    assert r["state"] == serve.ACCEPTED
+    srv.run_once()
+    assert serve.read_result(srv.root, "base")["status"] == "converged"
+
+    src = str(tmp_path / "s.tns")
+    with open(src, "w") as f:
+        rng = np.random.default_rng(5)
+        for _ in range(40):
+            f.write(f"{rng.integers(0, 24)} {rng.integers(0, 16)} "
+                    f"{rng.integers(0, 12)} {rng.random() + 0.1:.5f}\n")
+    spec = {"id": "ing", "kind": "ingest", "source": src,
+            "base": "base", "dims": [24, 16, 12], "chunk_records": 10,
+            "update_every": 2}
+    r = srv.submit(spec)
+    assert r["state"] == serve.ACCEPTED
+    srv.run_once()
+    res = serve.read_result(srv.root, "ing")
+    assert res["status"] == "converged"
+    info = res["ingest"]
+    assert info["chunks"] == 4 and info["nnz"] == 40
+    # one update per 2-chunk watermark interval, all converged, each
+    # carrying the commit->update lag the histogram observes
+    assert len(res["updates"]) == 2
+    for uid in res["updates"]:
+        ur = serve.read_result(srv.root, uid)
+        assert ur["status"] == "converged"
+        assert ur["update"]["base"] == "base"
+        assert ur["update"].get("ingest_lag_s") is not None
+    # lineage is journaled: ingest accepted before its updates
+    recs, _ = serve.Journal(
+        os.path.join(srv.root, "journal.jsonl")).replay()
+    order = [r["job"] for r in recs if r.get("rec") == serve.ACCEPTED]
+    assert order.index("ing") < order.index(res["updates"][0])
+
+
+def test_serve_ingest_spec_validation(tmp_path):
+    srv = serve.Server(str(tmp_path), workers=1)
+    r = srv.submit({"id": "x", "kind": "ingest"})
+    assert r["state"] == serve.REJECTED
+    r = srv.submit({"id": "y", "kind": "ingest", "source": "s.tns",
+                    "base": "base"})  # base without dims
+    assert r["state"] == serve.REJECTED
+
+
+# -- the SIGKILL soak (tier-1 smoke) -----------------------------------------
+
+def test_ingest_chaos_smoke_sigkill_resume():
+    from splatt_tpu import chaos
+
+    res = chaos.run_ingest_chaos(seed=0, smoke=True)
+    assert res.killed_mid_stream, res.violations
+    assert res.ok, res.violations
+    assert res.verdict == "survived" and res.resumed
+    # the post-mortem names real crash-checker windows
+    assert any(w.startswith("journal.append") for w in res.crash_windows)
+    lines = chaos.format_ingest_report(res)
+    assert any("SURVIVED" in ln for ln in lines)

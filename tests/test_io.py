@@ -94,3 +94,72 @@ def test_load_memmap_roundtrip(tmp_path, any_tensor):
     # memmapped tensors work through the normal pipeline
     assert out.normsq() == pytest.approx(tt.normsq())
     assert out.sorted_by(range(out.nmodes)).nnz == tt.nnz
+
+
+# -- torn / truncated binary refusal (docs/ingest.md satellite) --------------
+#
+# A half-written .bin — the debris of a writer killed mid-stream —
+# must be REFUSED at the header with a classified "truncated or torn"
+# error, never surfaced later as a short memmap or garbage frombuffer.
+
+def _torn_bin(tmp_path, name, mutate):
+    tt = gen.fixture_tensor("small")
+    path = str(tmp_path / "good.bin")
+    save(tt, path)
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    out = str(tmp_path / name)
+    with open(out, "wb") as f:
+        f.write(bytes(mutate(raw)))
+    return out
+
+
+@pytest.mark.parametrize("name,mutate,marker", [
+    # payload cut short: header promises more bytes than the file holds
+    ("payload.bin", lambda raw: raw[:-7], "truncated or torn"),
+    # header itself cut mid-field
+    ("header.bin", lambda raw: raw[:11], "truncated or torn"),
+    # dims block cut: nmodes promises dims the file lacks
+    ("dims.bin", lambda raw: raw[:22], "truncated or torn"),
+    # torn width field (a garbage idx_width no writer produces)
+    ("width.bin",
+     lambda raw: raw[:12] + (7).to_bytes(4, "little") + raw[16:],
+     "bad index/value widths"),
+    # torn nmodes field claiming an implausible mode count
+    ("modes.bin",
+     lambda raw: raw[:8] + (10**6).to_bytes(4, "little") + raw[12:],
+     "implausible mode count"),
+])
+def test_torn_binary_refused(tmp_path, name, mutate, marker):
+    from splatt_tpu.io import _load_binary, load_memmap
+    from splatt_tpu.resilience import FailureClass, classify_failure
+
+    path = _torn_bin(tmp_path, name, mutate)
+    for loader in (load_memmap, _load_binary, load):
+        with pytest.raises(ValueError, match="torn|width|mode count") \
+                as ei:
+            loader(path)
+        assert marker in str(ei.value)
+        # the refusal is content-deterministic: never retried
+        assert classify_failure(ei.value) is FailureClass.DETERMINISTIC
+
+
+def test_parse_text_names_line_and_offset(tmp_path):
+    from splatt_tpu.io import _parse_text
+    from splatt_tpu.resilience import FailureClass, classify_failure
+
+    ragged = tmp_path / "ragged.tns"
+    ragged.write_text("# hdr\n1 2 1.0\n3 4\n5 6 2.0\n")
+    with pytest.raises(ValueError) as ei:
+        _parse_text(str(ragged))
+    assert "ragged row at line 3" in str(ei.value)
+    assert "offset 14" in str(ei.value)  # after "# hdr\n1 2 1.0\n"
+    assert classify_failure(ei.value) is FailureClass.DETERMINISTIC
+
+    bad = tmp_path / "bad.tns"
+    bad.write_text("1 2 1.0\n1 zap 2.0\n")
+    with pytest.raises(ValueError) as ei:
+        _parse_text(str(bad))
+    assert "bad token 'zap' at line 2" in str(ei.value)
+    assert "offset 8" in str(ei.value)
+    assert classify_failure(ei.value) is FailureClass.DETERMINISTIC

@@ -51,7 +51,12 @@ that the invariants have teeth):
   adopt_same_gen lease adoption forgets the generation bump, so a
                  takeover shares lineage with the deposed owner;
   no_dir_fsync   directory fsyncs are dropped (the SPL019/SPL023
-                 hazard), so acknowledged renames can be lost.
+                 hazard), so acknowledged renames can be lost;
+  watermark_first the ingest chunk commit journals its watermark
+                 record BEFORE publishing the segment/vocab payloads
+                 it names (docs/ingest.md fence order inverted), so a
+                 crash in between leaves a watermark claiming data
+                 that does not exist.
 
 Exit status: with no mutant, 0 iff zero violations.  With a mutant,
 0 iff the mutant WAS caught (>=1 violation) — so both modes can gate
@@ -73,7 +78,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 MODEL = "m1"
 JOB = "j1"
-MUTANTS = ("stamp_first", "no_heal", "adopt_same_gen", "no_dir_fsync")
+MUTANTS = ("stamp_first", "no_heal", "adopt_same_gen", "no_dir_fsync",
+           "watermark_first")
+
+#: ingest journal record kinds (ingest.py REC_*) — a static copy so
+#: _windows() stays importable without the package (the label
+#: vocabulary is asserted against the real protocol traces anyway)
+_INGEST_KINDS = ("begin", "chunk", "finalize", "quarantined")
 
 
 def _known_kinds() -> Tuple[str, ...]:
@@ -92,8 +103,11 @@ def _windows() -> frozenset:
         "ckpt.publish", "tensor.publish", "result.publish",
         "lease.publish", "lease.release", "journal.append",
         "journal.append.torn",
+        "ingest.seg.publish", "ingest.vocab.publish",
+        "ingest.bin.publish",
     }
     base.update(f"journal.append[{k}]" for k in _known_kinds())
+    base.update(f"journal.append[{k}]" for k in _INGEST_KINDS)
     return frozenset(base)
 
 
@@ -109,6 +123,14 @@ class _Crash(BaseException):
 def _classify_replace(dst: str) -> str:
     b = os.path.basename(str(dst))
     parent = os.path.basename(os.path.dirname(str(dst)))
+    # ingest layout first: its segments are .npz files too, and the
+    # parent dir is what distinguishes them from model checkpoints
+    if parent == "seg":
+        return "ingest.seg.publish"
+    if parent == "vocab":
+        return "ingest.vocab.publish"
+    if b == "tensor.bin":
+        return "ingest.bin.publish"
     if b.endswith(".gen.json.bak"):
         return "stamp.bak.publish"
     if b.endswith(".gen.json"):
@@ -615,6 +637,114 @@ def _verify_terminal(env: dict, ins: Instrument, state: str):
     return v
 
 
+_INGEST_SOURCE = (
+    "a 0 1.0\n"
+    "badline\n"
+    "b 1 2.0\n"       # chunk 0: 3 record lines (2 kept, 1 quarantined)
+    "a 2 3.0\n"
+    "c 0 4.0\n"
+    "b 3 5.0\n"       # chunk 1: 3 kept
+)
+_INGEST_CHUNK_RECORDS = 3
+# per-chunk ground truth of the 6-line source above: (nnz, quarantined)
+_INGEST_TRUTH = {"nnz": 5, "quarantined": 1, "records": 6}
+
+
+def _ingest_env(env: dict) -> None:
+    env["src"] = os.path.join(env["root"], "stream.tns")
+    env["dest"] = os.path.join(env["root"], "ingest")
+    with open(env["src"], "w") as f:
+        f.write(_INGEST_SOURCE)
+
+
+def _ingest_state(env: dict):
+    from splatt_tpu import ingest as im
+
+    return im.IngestState(env["src"], env["dest"], fmt="tns",
+                          chunk_records=_INGEST_CHUNK_RECORDS)
+
+
+def _init_ingest_fresh(env: dict) -> None:
+    _ingest_env(env)
+
+
+def _init_ingest_chunk0(env: dict) -> None:
+    # chunk 0 committed fully durable BEFORE instrumentation: the
+    # body's commit of chunk 1 exercises the steady-state fence
+    _ingest_env(env)
+    st = _ingest_state(env)
+    for rc in st.read_chunks():
+        st.commit_chunk(rc)
+        break
+
+
+def _body_ingest_chunk(env: dict) -> None:
+    """ONE chunk commit through the real code.  Unmutated this is
+    ingest.IngestState.commit_chunk verbatim (quarantine sidecar →
+    vocab publish → segment publish → journal append LAST); the
+    watermark_first mutant hand-sequences the same real sub-steps
+    with the journal fence moved FIRST — the modeled regression."""
+    from splatt_tpu.utils.durable import publish_bytes
+
+    st = _ingest_state(env)   # fresh open appends [begin]; resume no-op
+    for rc in st.read_chunks():
+        if env["mutant"] == "watermark_first":
+            import hashlib
+
+            pc = st.parse_chunk(rc)
+            vb = st.vocab_bytes(pc)
+            sb = st.segment_bytes(pc)
+            rec = st.chunk_record(
+                pc, hashlib.sha256(sb).hexdigest(),
+                hashlib.sha256(vb).hexdigest() if vb else None)
+            st.append_journal(rec)        # the watermark moves FIRST
+            if vb is not None:
+                publish_bytes(os.path.join(env["dest"], "vocab",
+                                           f"delta-{pc.n:08d}.json"), vb)
+            publish_bytes(os.path.join(env["dest"], "seg",
+                                       f"chunk-{pc.n:08d}.npz"), sb)
+            st.advance(pc, rec)
+        else:
+            st.commit_chunk(rc)
+        break
+
+
+def _verify_ingest(env: dict, ins: Instrument, state: str):
+    """The exactly-once invariant, from the journal alone: every
+    journaled chunk's artifacts intact under their recorded shas, no
+    gaps below the watermark, sidecar accounting covered — then the
+    recovery leg completes the stream with the REAL resume driver and
+    the end-to-end totals must match the source's ground truth with
+    zero lost and zero duplicated records."""
+    from splatt_tpu import ingest as im
+
+    v: List[Tuple[str, str]] = []
+    try:
+        aud = im.audit_journal(env["dest"])
+    except Exception as e:
+        return [("exactly-once",
+                 f"journal audit raised {type(e).__name__}: {e}")]
+    if not aud["ok"]:
+        return [("exactly-once", "; ".join(aud["violations"]))]
+    try:
+        summary = im.ingest_stream(
+            env["src"], env["dest"], fmt="tns",
+            chunk_records=_INGEST_CHUNK_RECORDS)
+    except Exception as e:
+        return [("exactly-once",
+                 f"resume raised {type(e).__name__}: {e}")]
+    if summary["status"] != "converged":
+        v.append(("exactly-once",
+                  f"resume finished {summary['status']!r}"))
+    for key in ("nnz", "quarantined", "records"):
+        if summary[key] != _INGEST_TRUTH[key]:
+            v.append(("exactly-once",
+                      f"resume accounted {key}={summary[key]}, ground "
+                      f"truth is {_INGEST_TRUTH[key]} — records were "
+                      f"lost or duplicated across the crash"))
+    return v
+
+
 @dataclasses.dataclass
 class Protocol:
     name: str
@@ -688,6 +818,26 @@ def _protocols() -> List[Protocol]:
             expected={
                 "accepted_started": ["result.publish",
                                      "journal.append[done]"],
+            },
+        ),
+        Protocol(
+            name="ingest_chunk_commit",
+            inits={"fresh": _init_ingest_fresh,
+                   "chunk0_committed": _init_ingest_chunk0},
+            body=_body_ingest_chunk,
+            verify=_verify_ingest,
+            expected={
+                # fresh open journals [begin], the malformed record
+                # quarantines to the sidecar, then the fence order:
+                # vocab delta → segment → the chunk record LAST
+                "fresh": ["journal.append[begin]",
+                          "journal.append[quarantined]",
+                          "ingest.vocab.publish",
+                          "ingest.seg.publish",
+                          "journal.append[chunk]"],
+                "chunk0_committed": ["ingest.vocab.publish",
+                                     "ingest.seg.publish",
+                                     "journal.append[chunk]"],
             },
         ),
     ]
