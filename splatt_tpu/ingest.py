@@ -204,7 +204,11 @@ def replay_journal(dest: str) -> Tuple[List[dict], int]:
     """Parse every complete ingest-journal record → (records, torn).
     A torn line — the debris of a writer SIGKILLed mid-append — is
     skipped with a classified ``journal_torn`` event, exactly like the
-    serve journal's replay: crash debris is tolerated AND observable."""
+    serve journal's replay: crash debris is tolerated AND observable.
+    A newline-less tail counts as torn even when its bytes decode as
+    valid JSON (a writer killed between the write and its newline):
+    only a newline-terminated record is committed, so the watermark
+    can never rest on an append the fence did not finish."""
     from splatt_tpu import resilience
 
     path = _journal_path(dest)
@@ -222,6 +226,10 @@ def replay_journal(dest: str) -> Tuple[List[dict], int]:
         if not raw.strip():
             continue
         try:
+            if not complete:
+                raise ValueError(
+                    "truncated or torn journal tail — append debris "
+                    "with no newline")
             rec = json.loads(raw.decode(errors="replace"))
             if not isinstance(rec, dict):
                 raise ValueError("journal record is not an object")
@@ -564,6 +572,11 @@ class IngestState:
             raise IngestError(
                 f"{self.source}: records need >= 2 columns "
                 f"(indices... value); got {len(rows[0])}")
+        if self.dims is not None and len(self.dims) != self.nmodes:
+            raise IngestError(
+                f"{self.source}: declared dims carry {len(self.dims)} "
+                f"mode(s) but the records carry {self.nmodes} — this "
+                f"mismatch is deterministic, fix the declared dims")
         self.vocab_modes = []
         for m in range(self.nmodes):
             numeric = True
@@ -669,6 +682,16 @@ class IngestState:
                     if known is None:
                         known = len(self.vocab[m]) + sum(
                             1 for sm, _ in staged if sm == m)
+                        # declared dims bound the vocabulary too: a
+                        # delta built past the base model's mode size
+                        # would index factor rows that do not exist
+                        if self.dims is not None \
+                                and known >= self.dims[m]:
+                            bad = ("bad_index",
+                                   f"new key {t!r} would grow mode "
+                                   f"{m} vocabulary past declared dim "
+                                   f"{self.dims[m]}")
+                            break
                         staged.append((m, t))
                     idx.append(known)
                     continue
@@ -838,12 +861,16 @@ class IngestState:
     # -- finalize ------------------------------------------------------------
 
     def final_dims(self) -> Tuple[int, ...]:
+        """Declared dims always win — on vocab modes too (parse_chunk
+        quarantines any record that would grow a vocabulary past its
+        declared dim, so indices stay in range); otherwise the vocab
+        cardinality or the observed max index decides."""
         dims = []
         for m in range(self.nmodes or 0):
-            if self.vocab_modes[m]:
-                dims.append(len(self.vocab[m]))
-            elif self.dims is not None:
+            if self.dims is not None:
                 dims.append(self.dims[m])
+            elif self.vocab_modes[m]:
+                dims.append(len(self.vocab[m]))
             else:
                 dims.append(self.max_index[m] + 1)
         return tuple(dims)
@@ -975,14 +1002,30 @@ def ingest_stream(source: str, dest: str, fmt: str = "auto",
     depth = int(inflight or read_env_int("SPLATT_INGEST_INFLIGHT"))
     q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
     _DONE = object()
+    abort = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that yields to the abort signal: when the
+        committer exits early (degraded run, on_watermark raise) the
+        reader must never block forever against a full queue — that
+        leaks the thread AND the open source fd for the daemon's
+        lifetime."""
+        while not abort.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _reader():
         try:
             for rc in st.read_chunks(stop=stop):
-                q.put(rc)
-            q.put(_DONE)
+                if not _put(rc):
+                    return
+            _put(_DONE)
         except BaseException as e:  # splint: ignore[SPL002] relayed to the committer loop, which re-raises and classifies
-            q.put(e)
+            _put(e)
 
     status = "converged"
     degrade_error = None
@@ -1004,13 +1047,18 @@ def ingest_stream(source: str, dest: str, fmt: str = "auto",
                     rec = st.commit_chunk(item)
                 except IngestDegraded as e:
                     # the quarantine budget: stop CLASSIFIED with the
-                    # committed watermark intact — degraded, not lost
+                    # committed watermark intact — degraded, not lost.
+                    # The failing chunk's sidecar appends already
+                    # happened durably, so fold its pending count in:
+                    # the summary must account the very records that
+                    # tripped the budget
+                    st.quarantined_total += getattr(st, "_q_pending", 0)
+                    st._q_pending = 0
                     cls = resilience.classify_failure(e)
                     resilience.run_report().add(
                         "ingest_degraded", dest=dest,
                         watermark=st.watermark,
-                        quarantined=st.quarantined_total
-                        + getattr(st, "_q_pending", 0),
+                        quarantined=st.quarantined_total,
                         failure_class=cls.value,
                         error=resilience.failure_message(e)[:200])
                     status = "degraded"
@@ -1019,14 +1067,21 @@ def ingest_stream(source: str, dest: str, fmt: str = "auto",
                 if on_watermark is not None:
                     on_watermark(st, rec)
         finally:
-            # drain the bounded queue so the reader can observe _DONE
-            # or die with the run instead of blocking on put()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            reader.join(timeout=10.0)
+            # stop the reader, then drain UNTIL it joins: one drain
+            # pass is not enough — a long remaining stream refills the
+            # bounded queue and a put()-blocked daemon thread would
+            # hold the open source fd forever
+            abort.set()
+            deadline = time.monotonic() + 10.0
+            while reader.is_alive():
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+                reader.join(timeout=0.2)
+                if time.monotonic() > deadline:
+                    break
         stopped = stop is not None and stop()
         final = None
         if status == "converged" and not stopped \

@@ -2305,12 +2305,22 @@ class Server:
         the model-store commit can observe end-to-end update lag
         (the ``splatt_ingest_update_lag_seconds`` histogram).
 
-        A SIGKILLed or lease-stopped ingest job re-runs whole through
-        the normal resume path and ingest's own watermark replay makes
-        the re-run exactly-once — committed chunks are skipped, not
-        re-landed, and already-emitted update jobs dedup on their
-        deterministic ids (``<jid>-up<k>``)."""
+        Update emission is durable in its own right: each watermark
+        interval lands in ``deltas/updates.jsonl`` in fence order —
+        delta file published atomically under a range-keyed name
+        (never overwriting a published delta), the intent journaled,
+        THEN the job submitted under the deterministic id
+        ``<jid>-up-<lo>-<hi>``.  A SIGKILLed or lease-stopped ingest
+        job re-runs whole: ingest's watermark replay makes the chunk
+        plane exactly-once, and the updates-journal replay re-derives
+        the covered chunk range from disk — the re-run never re-spans
+        chunks an earlier run already fed to an update, re-assembles
+        a journaled-but-missing delta from the committed segments,
+        and re-submits every journaled intent (the job store dedups
+        known ids), so the live-feed update chain neither drops nor
+        double-applies records across a crash."""
         from splatt_tpu import ingest as ingest_mod
+        from splatt_tpu.io import _bin_header
         from splatt_tpu.utils.env import read_env_int
 
         source = str(spec["source"])
@@ -2322,7 +2332,51 @@ class Server:
         dims = (tuple(int(d) for d in spec["dims"])
                 if spec.get("dims") else None)
         updates: list = []
+        ujournal = Journal(os.path.join(dest, "deltas",
+                                        "updates.jsonl"))
         covered = {"hi": -1}
+
+        def _submit_update(intent: dict) -> None:
+            res = self.submit({
+                "kind": "update", "base": str(base),
+                "delta_tensor": str(intent["delta"]),
+                "id": str(intent["id"]),
+                "tenant": spec.get("tenant"),
+                "ingest_committed_ts":
+                    float(intent.get("ingest_committed_ts") or 0.0)})
+            state = res.get("state") or ("queued" if res.get("job")
+                                         else REJECTED)
+            if res.get("job") and state != REJECTED:
+                if res["job"] not in updates:
+                    updates.append(res["job"])
+            else:
+                self._log(f"job {jid}: watermark update for chunks "
+                          f"[{intent['lo']}, {intent['hi']}] not "
+                          f"accepted ({res}); the delta file and its "
+                          f"journaled intent remain for the next "
+                          f"re-run to retry", error=True)
+
+        if base:
+            # re-run recovery, from durable state BEFORE any new
+            # interval fires: the journaled intents say which chunks
+            # earlier runs already fed to updates, a missing delta
+            # (crash between intent append and publish never happens
+            # — publish precedes the append — but debris-cleaned
+            # dests do) re-assembles from the committed segments, and
+            # every intent re-submits idempotently (dedup by id)
+            intents, _torn = ujournal.replay()
+            for it in intents:
+                if it.get("rec") != "update_intent":
+                    continue
+                covered["hi"] = max(covered["hi"], int(it["hi"]))
+                if not int(it.get("nnz") or 0):
+                    continue
+                if not os.path.exists(str(it["delta"])):
+                    ingest_mod.assemble_delta(
+                        dest, int(it["lo"]), int(it["hi"]),
+                        tuple(it.get("dims") or dims),
+                        str(it["delta"]))
+                _submit_update(it)
 
         def on_watermark(st, rec):
             if not base:
@@ -2331,28 +2385,33 @@ class Server:
             if n - covered["hi"] < max(update_every, 1):
                 return
             lo = covered["hi"] + 1
-            k = len(updates)
-            dpath = os.path.join(dest, "deltas", f"up-{k:04d}.bin")
+            dpath = os.path.join(dest, "deltas",
+                                 f"up-{lo:08d}-{n:08d}.bin")
             os.makedirs(os.path.dirname(dpath), exist_ok=True)
-            delta = ingest_mod.assemble_delta(
-                dest, lo, n, dims or st.final_dims(), dpath)
-            covered["hi"] = n
-            if not delta.nnz:
-                return
-            res = self.submit({
-                "kind": "update", "base": str(base),
-                "delta_tensor": dpath, "id": f"{jid}-up{k}",
-                "tenant": spec.get("tenant"),
-                "ingest_committed_ts": float(rec.get("ts") or 0.0)})
-            state = res.get("state") or ("queued" if res.get("job")
-                                         else "rejected")
-            if res.get("job") and state not in ("rejected",):
-                updates.append(res["job"])
+            ddims = tuple(int(d) for d in (dims or st.final_dims()))
+            if os.path.exists(dpath):
+                # a crashed attempt already published this exact
+                # range (publish is atomic, so the file is whole):
+                # reuse it — a published delta is never overwritten
+                nnz = int(_bin_header(dpath)[4])
             else:
-                self._log(f"job {jid}: watermark update for chunks "
-                          f"[{lo}, {n}] not accepted ({res}); the "
-                          f"delta file remains for a manual replay",
-                          error=True)
+                delta = ingest_mod.assemble_delta(dest, lo, n, ddims,
+                                                  dpath)
+                nnz = int(delta.nnz)
+            intent = {"rec": "update_intent", "lo": lo, "hi": n,
+                      "id": f"{jid}-up-{lo}-{n}", "delta": dpath,
+                      "nnz": nnz, "dims": [int(d) for d in ddims],
+                      "ingest_committed_ts":
+                          float(rec.get("ts") or 0.0)}
+            # the emission fence: the intent journals BEFORE the
+            # submit, so a crash in between re-submits by id on the
+            # next run instead of re-deriving an overlapping range.
+            # Load-bearing — an append failure aborts the job rather
+            # than risk a double-applied interval
+            ujournal.append(intent)
+            covered["hi"] = n
+            if nnz:
+                _submit_update(intent)
 
         summary = ingest_mod.ingest_stream(
             source, dest, fmt=str(spec.get("format") or "auto"),

@@ -143,6 +143,30 @@ def test_watermark_resume_exactly_once(tmp_path):
     assert torn == 0 and sorted(ordinals) == sorted(set(ordinals))
 
 
+def test_replay_treats_newline_less_tail_as_torn(tmp_path):
+    """A journal tail with no newline is torn debris even when its
+    bytes decode as valid JSON (writer killed between write and
+    newline): the watermark must never rest on an unfinished append."""
+    src = str(tmp_path / "s.tns")
+    dest = str(tmp_path / "ing")
+    _write_stream(src, lines=20)
+    ingest.ingest_stream(src, dest, fmt="tns", chunk_records=10)
+    fake = {"rec": "chunk", "n": 2, "lo": 999, "hi": 1200,
+            "records": 10, "nnz": 10, "quarantined": 0,
+            "sha": "x", "seg_sha": "y", "vocab_sha": None}
+    with open(ingest._journal_path(dest), "ab") as f:
+        f.write(json.dumps(fake).encode())  # deliberately no newline
+    recs, torn = ingest.replay_journal(dest)
+    assert torn == 1
+    assert all(r.get("n") != 2 for r in recs
+               if r.get("rec") == ingest.REC_CHUNK)
+    evs = _events("journal_torn")
+    assert evs and evs[-1]["failure_class"] == "deterministic"
+    # the audit does not count the torn tail either
+    aud = ingest.audit_journal(dest)
+    assert aud["ok"] and aud["watermark"] == 1
+
+
 def test_rerun_after_convergence_is_idempotent(tmp_path):
     src = str(tmp_path / "s.tns")
     dest = str(tmp_path / "ing")
@@ -281,6 +305,49 @@ def test_quarantine_count_budget_degrades_classified(tmp_path):
     assert s2["nnz"] == good and s2["quarantined"] == bad
 
 
+def test_degraded_summary_accounts_pending_quarantine(tmp_path):
+    """The records that TRIP the budget live in the failing chunk's
+    pending count (its commit never advanced): the degraded summary
+    and the ingest_degraded event must both account them."""
+    src = tmp_path / "s.tns"
+    src.write_text("1 1 1.0\n"
+                   "bad\n" "bad\n" "bad\n")
+    summary = ingest.ingest_stream(str(src), str(tmp_path / "ing"),
+                                   fmt="tns", chunk_records=50,
+                                   quarantine_max=2)
+    assert summary["status"] == "degraded"
+    assert summary["quarantined"] == 3
+    evs = _events("ingest_degraded")
+    assert evs and evs[0]["quarantined"] == 3
+
+
+def test_degraded_run_does_not_leak_reader_thread(tmp_path):
+    """A committer that exits early (budget trip) with a long stream
+    still queued must stop the reader: a single queue drain is not
+    enough — a refilled bounded queue would park the thread (and the
+    open source fd) in put() forever."""
+    import threading
+    import time as _time
+
+    src = str(tmp_path / "s.tns")
+    with open(src, "w") as f:
+        f.write("0 0 1.0\n1 1 1.0\n")          # chunk 0: policy, clean
+        f.write("bad\nbad\n")                  # chunk 1 trips max=1
+        for n in range(2000):                  # long remaining stream
+            f.write(f"{n % 7} {n % 5} 1.0\n")
+    summary = ingest.ingest_stream(src, str(tmp_path / "ing"),
+                                   fmt="tns", chunk_records=2,
+                                   quarantine_max=1, inflight=1)
+    assert summary["status"] == "degraded"
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline and any(
+            t.name == "splatt-ingest-reader" and t.is_alive()
+            for t in threading.enumerate()):
+        _time.sleep(0.05)
+    assert not any(t.name == "splatt-ingest-reader" and t.is_alive()
+                   for t in threading.enumerate())
+
+
 def test_quarantine_rate_budget(tmp_path):
     src = str(tmp_path / "s.tns")
     dest = str(tmp_path / "ing")
@@ -321,6 +388,40 @@ def test_vocab_commits_atomically_with_watermark(tmp_path):
                 delta = json.loads(f.read())
             keys.update(delta["modes"]["0"])
     assert len(keys) == summary["dims"][0]
+
+
+def test_declared_dims_bound_vocab_modes(tmp_path):
+    """--dims is a hard bound on vocab-mapped modes too: a new key
+    that would grow the vocabulary past the declared dim quarantines
+    as bad_index (an update delta must never index factor rows the
+    base model does not have), and the finalized dims are the
+    DECLARED dims."""
+    src = tmp_path / "s.tns"
+    src.write_text("a 0 1.0\n"
+                   "b 1 2.0\n"
+                   "c 2 3.0\n"    # third key: past declared dim 2
+                   "a 3 4.0\n")   # known key: still fine
+    dest = str(tmp_path / "ing")
+    summary = ingest.ingest_stream(str(src), dest, fmt="tns",
+                                   chunk_records=10, dims=(2, 5))
+    assert summary["status"] == "converged"
+    assert summary["nnz"] == 3 and summary["quarantined"] == 1
+    assert summary["dims"] == [2, 5]
+    with open(ingest._quarantine_path(dest), "rb") as f:
+        side = [json.loads(ln) for ln in f.read().splitlines()
+                if ln.strip()]
+    assert [q["class"] for q in side] == ["bad_index"]
+    # the quarantined key was never minted
+    with open(ingest._vocab_path(dest, 0), "rb") as f:
+        assert json.loads(f.read())["modes"]["0"] == ["a", "b"]
+
+
+def test_dims_arity_mismatch_refuses(tmp_path):
+    src = tmp_path / "s.tns"
+    src.write_text("1 2 3 1.0\n")
+    with pytest.raises(ingest.IngestError, match="deterministic"):
+        ingest.ingest_stream(str(src), str(tmp_path / "ing"),
+                             fmt="tns", dims=(4, 4))
 
 
 def test_quarantined_record_never_grows_vocab(tmp_path):
@@ -427,6 +528,73 @@ def test_serve_ingest_job_chains_updates(tmp_path):
         os.path.join(srv.root, "journal.jsonl")).replay()
     order = [r["job"] for r in recs if r.get("rec") == serve.ACCEPTED]
     assert order.index("ing") < order.index(res["updates"][0])
+
+
+def test_serve_ingest_rerun_resumes_update_chain_exactly_once(tmp_path):
+    """A killed/lease-stopped ingest job re-runs whole; the durable
+    updates journal must make the update chain exactly-once across
+    the re-run: the recovered leg never re-spans chunks the first leg
+    already fed to an update (no wider delta over applied chunks, no
+    dedup-dropped interval), and a published delta file is never
+    overwritten."""
+    srv = serve.Server(str(tmp_path), workers=1)
+    base = {"id": "base", "rank": 3, "iters": 8, "seed": 7,
+            "checkpoint_every": 2,
+            "synthetic": {"dims": [24, 16, 12], "nnz": 900, "seed": 3}}
+    assert srv.submit(base)["state"] == serve.ACCEPTED
+    srv.run_once()
+    assert serve.read_result(srv.root, "base")["status"] == "converged"
+
+    src = str(tmp_path / "s.tns")
+    with open(src, "w") as f:
+        rng = np.random.default_rng(5)
+        for _ in range(40):
+            f.write(f"{rng.integers(0, 24)} {rng.integers(0, 16)} "
+                    f"{rng.integers(0, 12)} {rng.random() + 0.1:.5f}\n")
+    spec = {"id": "ing", "kind": "ingest", "source": src,
+            "base": "base", "dims": [24, 16, 12], "chunk_records": 10,
+            "update_every": 2}
+
+    # leg 1: the job is stopped (lease loss) after two chunks — one
+    # update emitted, covering chunks [0, 1]
+    calls = {"n": 0}
+
+    def stop_after_two():
+        calls["n"] += 1
+        return calls["n"] > 2
+
+    r1 = srv._run_ingest("ing", spec, stop_after_two)
+    assert r1["ingest"]["stopped"] and r1["ingest"]["watermark"] == 1
+    assert r1["updates"] == ["ing-up-0-1"]
+    dpath = os.path.join(srv.root, "ingest", "ing", "deltas",
+                         "up-00000000-00000001.bin")
+    with open(dpath, "rb") as f:
+        delta1 = f.read()
+
+    # leg 2: the re-run resumes both planes from durable state
+    r2 = srv._run_ingest("ing", spec, lambda: False)
+    assert r2["ingest"]["resumed"]
+    assert r2["ingest"]["watermark"] == 3
+    # the recovered interval re-submits (deduped) and the NEW interval
+    # covers exactly the new chunks — not a wider span from chunk 0
+    assert r2["updates"] == ["ing-up-0-1", "ing-up-2-3"]
+    with open(dpath, "rb") as f:
+        assert f.read() == delta1  # published delta never overwritten
+
+    # the durable intents partition the chunk sequence: disjoint,
+    # contiguous, zero-overlap — the journal-alone proof
+    recs, torn = serve.Journal(os.path.join(
+        srv.root, "ingest", "ing", "deltas", "updates.jsonl")).replay()
+    spans = [(r["lo"], r["hi"]) for r in recs
+             if r.get("rec") == "update_intent"]
+    assert torn == 0 and spans == [(0, 1), (2, 3)]
+
+    # both updates run to convergence against the base model
+    srv.run_once()
+    for uid in r2["updates"]:
+        ur = serve.read_result(srv.root, uid)
+        assert ur["status"] == "converged"
+        assert ur["update"]["base"] == "base"
 
 
 def test_serve_ingest_spec_validation(tmp_path):
